@@ -1,0 +1,16 @@
+"""lambdagap_tpu — a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch JAX/XLA re-design with the capabilities of the reference
+LightGBM fork (adaliaramon/LambdaGap): leaf-wise histogram GBDT with the
+extended LambdaGap ranking objective family, running its compute core as
+XLA/Pallas programs on TPU and its distributed learners over
+``jax.sharding`` meshes.
+"""
+from .config import Config
+from .data import BinnedDataset, Metadata
+from .models import GBDT, Tree
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "BinnedDataset", "Metadata", "GBDT", "Tree",
+           "__version__"]

@@ -1,0 +1,514 @@
+"""Configuration for lambdagap_tpu.
+
+TPU-native analog of the reference's single annotated ``Config`` struct
+(reference: include/LightGBM/config.h:104-1348) plus alias resolution
+(``Config::KV2Map``/``Config::Set``, src/io/config.cpp:512 and the generated
+alias table in src/io/config_auto.cpp). One dataclass is the single source of
+truth for parameter names, defaults, and validation.
+
+Fork-specific parameters (the LambdaGap delta): ``lambdarank_target`` with 18
+selectable gradient targets and ``lambdagap_weight``
+(reference: include/LightGBM/config.h:989-1013).
+"""
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .utils import log
+
+# ---------------------------------------------------------------------------
+# Alias table (reference: src/io/config_auto.cpp alias map; kept by hand here,
+# names and semantics match the reference docs)
+# ---------------------------------------------------------------------------
+_ALIASES: Dict[str, str] = {}
+
+
+def _alias(canonical: str, *names: str) -> None:
+    for n in names:
+        _ALIASES[n] = canonical
+
+
+_alias("config", "config_file")
+_alias("task", "task_type")
+_alias("objective", "objective_type", "app", "application", "loss")
+_alias("boosting", "boosting_type", "boost")
+_alias("data_sample_strategy", "sample_strategy")
+_alias("data", "train", "train_data", "train_data_file", "data_filename")
+_alias("valid", "test", "valid_data", "valid_data_file", "test_data",
+       "test_data_file", "valid_filenames")
+_alias("num_iterations", "num_iteration", "n_iter", "num_tree", "num_trees",
+       "num_round", "num_rounds", "nrounds", "num_boost_round", "n_estimators",
+       "max_iter")
+_alias("learning_rate", "shrinkage_rate", "eta")
+_alias("num_leaves", "num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes")
+_alias("tree_learner", "tree", "tree_type", "tree_learner_type")
+_alias("num_threads", "num_thread", "nthread", "nthreads", "n_jobs")
+_alias("device_type", "device")
+_alias("seed", "random_seed", "random_state")
+_alias("min_data_in_leaf", "min_data_per_leaf", "min_data", "min_child_samples",
+       "min_samples_leaf")
+_alias("min_sum_hessian_in_leaf", "min_sum_hessian_per_leaf", "min_sum_hessian",
+       "min_hessian", "min_child_weight")
+_alias("bagging_fraction", "sub_row", "subsample", "bagging")
+_alias("pos_bagging_fraction", "pos_sub_row", "pos_subsample", "pos_bagging")
+_alias("neg_bagging_fraction", "neg_sub_row", "neg_subsample", "neg_bagging")
+_alias("bagging_freq", "subsample_freq")
+_alias("bagging_seed", "bagging_fraction_seed")
+_alias("feature_fraction", "sub_feature", "colsample_bytree")
+_alias("feature_fraction_bynode", "sub_feature_bynode", "colsample_bynode")
+_alias("feature_fraction_seed", "feature_fraction_random_seed")
+_alias("extra_trees", "extra_tree")
+_alias("early_stopping_round", "early_stopping_rounds", "early_stopping",
+       "n_iter_no_change")
+_alias("max_delta_step", "max_tree_output", "max_leaf_output")
+_alias("lambda_l1", "reg_alpha", "l1_regularization")
+_alias("lambda_l2", "reg_lambda", "lambda", "l2_regularization")
+_alias("linear_lambda", "linear_tree_regularization")
+_alias("min_gain_to_split", "min_split_gain")
+_alias("drop_rate", "rate_drop")
+_alias("max_drop", "max_drops")
+_alias("uniform_drop", "uniform_drops")
+_alias("top_rate", "goss_top_rate")
+_alias("other_rate", "goss_other_rate")
+_alias("min_data_per_group", "min_data_per_categorical_group")
+_alias("cat_smooth", "categorical_smooth", "cat_smooth_ratio")
+_alias("cat_l2", "categorical_l2")
+_alias("max_cat_threshold", "max_categorical_threshold")
+_alias("max_cat_to_onehot", "max_categorical_to_onehot")
+_alias("top_k", "topk")
+_alias("monotone_constraints", "mc", "monotone_constraint", "monotonic_cst")
+_alias("monotone_constraints_method", "monotone_constraining_method", "mc_method")
+_alias("monotone_penalty", "monotone_splits_penalty", "ms_penalty", "mc_penalty")
+_alias("feature_contri", "feature_contrib", "fc", "fp", "feature_penalty")
+_alias("forcedsplits_filename", "fs", "forced_splits_filename", "forced_splits_file",
+       "forced_splits")
+_alias("refit_decay_rate", "refit_decay")
+_alias("path_smooth", "path_smoothing")
+_alias("interaction_constraints", "interaction_constraints_vector")
+_alias("verbosity", "verbose")
+_alias("input_model", "model_input", "model_in")
+_alias("output_model", "model_output", "model_out")
+_alias("saved_feature_importance_type", "save_feature_importance_type")
+_alias("snapshot_freq", "save_period")
+_alias("max_bin", "max_bins")
+_alias("min_data_in_bin", "min_data_per_bin")
+_alias("bin_construct_sample_cnt", "subsample_for_bin")
+_alias("data_random_seed", "data_seed")
+_alias("is_enable_sparse", "is_sparse", "enable_sparse", "sparse")
+_alias("enable_bundle", "is_enable_bundle", "bundle")
+_alias("use_missing", "use_missing_values")
+_alias("zero_as_missing", "zero_as_missing_value")
+_alias("two_round", "two_round_loading", "use_two_round_loading")
+_alias("header", "has_header")
+_alias("label_column", "label")
+_alias("weight_column", "weight")
+_alias("group_column", "group", "group_id", "query_column", "query", "query_id")
+_alias("ignore_column", "ignore_feature", "blacklist")
+_alias("categorical_feature", "cat_feature", "categorical_column", "cat_column",
+       "categorical_features")
+_alias("forcedbins_filename", "forced_bins_filename", "forced_bins_file")
+_alias("save_binary", "is_save_binary", "is_save_binary_file")
+_alias("precise_float_parser", "use_precise_float_parser")
+_alias("start_iteration_predict", "predict_start_iteration")
+_alias("num_iteration_predict", "predict_num_iteration")
+_alias("predict_raw_score", "is_predict_raw_score", "raw_score")
+_alias("predict_leaf_index", "is_predict_leaf_index", "leaf_index")
+_alias("predict_contrib", "is_predict_contrib", "contrib")
+_alias("convert_model_language", "convert_model_lang")
+_alias("convert_model", "convert_model_file")
+_alias("num_class", "num_classes")
+_alias("is_unbalance", "unbalance", "unbalanced_sets")
+_alias("scale_pos_weight", "scale_pos_weight_ratio")
+_alias("sigmoid", "sigmoid_param")
+_alias("boost_from_average", "boost_from_mean")
+_alias("alpha", "quantile_alpha")
+_alias("fair_c", "fair_constant")
+_alias("poisson_max_delta_step", "poisson_max_delta")
+_alias("tweedie_variance_power", "tweedie_power")
+_alias("lambdarank_truncation_level", "lambdarank_truncation")
+_alias("metric", "metrics", "metric_types")
+_alias("metric_freq", "output_freq")
+_alias("is_provide_training_metric", "training_metric", "is_training_metric",
+       "train_metric")
+_alias("eval_at", "ndcg_eval_at", "ndcg_at", "map_eval_at", "map_at")
+_alias("num_machines", "num_machine")
+_alias("local_listen_port", "local_port", "port")
+_alias("time_out", "network_timeout")
+_alias("machine_list_filename", "machine_list_file", "machine_list", "mlist")
+_alias("machines", "workers", "nodes")
+_alias("gpu_device_id", "device_id")
+_alias("num_gpu", "num_gpus")
+
+# Fork delta aliases (none published; canonical names only)
+
+_OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1", "mae": "regression_l1",
+    "mean_absolute_error": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "quantile": "quantile", "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank",
+    "rank_xendcg": "rank_xendcg", "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg", "xendcg_mart": "rank_xendcg",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+LAMBDARANK_TARGETS = (
+    "ranknet", "bin-ranknet", "ndcg", "bndcg",
+    "lambdaloss-ndcg", "lambdaloss-bndcg",
+    "lambdaloss-ndcg-plus-plus", "lambdaloss-bndcg-plus-plus",
+    "precision", "arpk", "lambdaloss-arp1", "lambdaloss-arp2",
+    "lambdagap-s", "lambdagap-x",
+    "lambdagap-s-plus", "lambdagap-x-plus",
+    "lambdagap-s-plus-plus", "lambdagap-x-plus-plus",
+)
+
+
+def _parse_list(val: Any, typ=float) -> List:
+    if val is None:
+        return []
+    if isinstance(val, str):
+        if not val.strip():
+            return []
+        return [typ(x) for x in val.replace(";", ",").split(",") if x.strip()]
+    if isinstance(val, (list, tuple)):
+        return [typ(x) for x in val]
+    return [typ(val)]
+
+
+def _parse_bool(val: Any) -> bool:
+    if isinstance(val, bool):
+        return val
+    if isinstance(val, str):
+        return val.strip().lower() in ("true", "1", "yes", "+", "on")
+    return bool(val)
+
+
+@dataclass
+class Config:
+    """Full training/prediction configuration.
+
+    Field names, defaults and checks follow the reference's Config struct
+    (include/LightGBM/config.h); only fields meaningful on TPU are kept live,
+    the rest are accepted and preserved for compatibility.
+    """
+
+    # -- core -------------------------------------------------------------
+    task: str = "train"
+    objective: str = "regression"
+    boosting: str = "gbdt"                    # gbdt / dart / rf / goss(alias)
+    data_sample_strategy: str = "bagging"     # bagging / goss
+    num_iterations: int = 100
+    learning_rate: float = 0.1
+    num_leaves: int = 31
+    tree_learner: str = "serial"              # serial/feature/data/voting
+    num_threads: int = 0
+    device_type: str = "tpu"                  # cpu (jax-cpu) / tpu
+    seed: int = 0
+    deterministic: bool = False
+
+    # -- learning control -------------------------------------------------
+    force_col_wise: bool = False
+    force_row_wise: bool = False
+    histogram_pool_size: float = -1.0
+    max_depth: int = -1
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    bagging_fraction: float = 1.0
+    pos_bagging_fraction: float = 1.0
+    neg_bagging_fraction: float = 1.0
+    bagging_freq: int = 0
+    bagging_seed: int = 3
+    bagging_by_query: bool = False
+    feature_fraction: float = 1.0
+    feature_fraction_bynode: float = 1.0
+    feature_fraction_seed: int = 2
+    extra_trees: bool = False
+    extra_seed: int = 6
+    early_stopping_round: int = 0
+    early_stopping_min_delta: float = 0.0
+    first_metric_only: bool = False
+    max_delta_step: float = 0.0
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    linear_lambda: float = 0.0
+    min_gain_to_split: float = 0.0
+    drop_rate: float = 0.1
+    max_drop: int = 50
+    skip_drop: float = 0.5
+    xgboost_dart_mode: bool = False
+    uniform_drop: bool = False
+    drop_seed: int = 4
+    top_rate: float = 0.2
+    other_rate: float = 0.1
+    min_data_per_group: int = 100
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    top_k: int = 20
+    monotone_constraints: List[int] = field(default_factory=list)
+    monotone_constraints_method: str = "basic"
+    monotone_penalty: float = 0.0
+    feature_contri: List[float] = field(default_factory=list)
+    forcedsplits_filename: str = ""
+    refit_decay_rate: float = 0.9
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    cegb_penalty_feature_lazy: List[float] = field(default_factory=list)
+    cegb_penalty_feature_coupled: List[float] = field(default_factory=list)
+    path_smooth: float = 0.0
+    interaction_constraints: List[List[int]] = field(default_factory=list)
+    verbosity: int = 1
+    use_quantized_grad: bool = False
+    num_grad_quant_bins: int = 4
+    quant_train_renew_leaf: bool = False
+    stochastic_rounding: bool = True
+
+    # -- IO / dataset -----------------------------------------------------
+    input_model: str = ""
+    output_model: str = "LightGBM_model.txt"
+    saved_feature_importance_type: int = 0
+    snapshot_freq: int = -1
+    linear_tree: bool = False
+    max_bin: int = 255
+    max_bin_by_feature: List[int] = field(default_factory=list)
+    min_data_in_bin: int = 3
+    bin_construct_sample_cnt: int = 200000
+    data_random_seed: int = 1
+    is_enable_sparse: bool = True
+    enable_bundle: bool = True
+    use_missing: bool = True
+    zero_as_missing: bool = False
+    feature_pre_filter: bool = True
+    pre_partition: bool = False
+    two_round: bool = False
+    header: bool = False
+    label_column: str = ""
+    weight_column: str = ""
+    group_column: str = ""
+    ignore_column: str = ""
+    categorical_feature: str = ""
+    forcedbins_filename: str = ""
+    save_binary: bool = False
+    precise_float_parser: bool = False
+    parser_config_file: str = ""
+
+    # -- predict ----------------------------------------------------------
+    start_iteration_predict: int = 0
+    num_iteration_predict: int = -1
+    predict_raw_score: bool = False
+    predict_leaf_index: bool = False
+    predict_contrib: bool = False
+    predict_disable_shape_check: bool = False
+    pred_early_stop: bool = False
+    pred_early_stop_freq: int = 10
+    pred_early_stop_margin: float = 10.0
+
+    # -- convert ----------------------------------------------------------
+    convert_model_language: str = ""
+    convert_model: str = "gbdt_prediction.cpp"
+
+    # -- objective --------------------------------------------------------
+    num_class: int = 1
+    is_unbalance: bool = False
+    scale_pos_weight: float = 1.0
+    sigmoid: float = 1.0
+    boost_from_average: bool = True
+    reg_sqrt: bool = False
+    alpha: float = 0.9
+    fair_c: float = 1.0
+    poisson_max_delta_step: float = 0.7
+    tweedie_variance_power: float = 1.5
+    lambdarank_truncation_level: int = 30
+    lambdarank_norm: bool = True
+    # Fork delta (include/LightGBM/config.h:989-1013): 18-way gradient target
+    lambdarank_target: str = "ndcg"
+    lambdagap_weight: float = 1.0
+    label_gain: List[float] = field(default_factory=list)
+    lambdarank_position_bias_regularization: float = 0.0
+
+    # -- metric -----------------------------------------------------------
+    metric: List[str] = field(default_factory=list)
+    metric_freq: int = 1
+    is_provide_training_metric: bool = False
+    eval_at: List[int] = field(default_factory=lambda: [1, 2, 3, 4, 5])
+    multi_error_top_k: int = 1
+    auc_mu_weights: List[float] = field(default_factory=list)
+
+    # -- network (TPU: mesh axes instead of sockets) ----------------------
+    num_machines: int = 1
+    local_listen_port: int = 12400
+    time_out: int = 120
+    machine_list_filename: str = ""
+    machines: str = ""
+
+    # -- device -----------------------------------------------------------
+    gpu_platform_id: int = -1
+    gpu_device_id: int = -1
+    gpu_use_dp: bool = False
+    num_gpu: int = 1
+
+    # TPU-specific knobs (no reference analog; tuning surface for XLA/Pallas)
+    tpu_hist_dtype: str = "float32"
+    tpu_rows_per_block: int = 4096
+    tpu_hist_impl: str = "auto"               # auto / onehot / scatter / pallas
+    tpu_num_devices: int = 0                  # 0 = all visible devices
+
+    # unknown/passthrough params preserved verbatim
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def canonical_name(name: str) -> str:
+        name = name.strip().lower()
+        return _ALIASES.get(name, name)
+
+    @classmethod
+    def from_params(cls, params: Optional[Dict[str, Any]]) -> "Config":
+        cfg = cls()
+        cfg.update(params or {})
+        return cfg
+
+    def update(self, params: Dict[str, Any]) -> None:
+        fields = {f.name: f for f in dataclasses.fields(self)}
+        seen: Dict[str, str] = {}
+        for raw_key, val in params.items():
+            key = self.canonical_name(raw_key)
+            if key in seen:
+                log.warning("%s is set with both %s and %s, using the latter",
+                            key, seen[key], raw_key)
+            seen[key] = raw_key
+            if key == "objective" and isinstance(val, str):
+                val = _OBJECTIVE_ALIASES.get(val.strip().lower(), val.strip().lower())
+            if key == "boosting" and isinstance(val, str):
+                val = {"gbrt": "gbdt", "gbm": "gbdt", "dart": "dart",
+                       "rf": "rf", "random_forest": "rf",
+                       "goss": "goss"}.get(val.strip().lower(), val.strip().lower())
+            if key not in fields:
+                self.extra[key] = val
+                continue
+            f = fields[key]
+            try:
+                if f.type in ("int", int):
+                    setattr(self, key, int(val))
+                elif f.type in ("float", float):
+                    setattr(self, key, float(val))
+                elif f.type in ("bool", bool):
+                    setattr(self, key, _parse_bool(val))
+                elif key in ("eval_at", "max_bin_by_feature"):
+                    setattr(self, key, _parse_list(val, int))
+                elif key == "monotone_constraints":
+                    setattr(self, key, _parse_list(val, int))
+                elif key in ("label_gain", "feature_contri", "auc_mu_weights",
+                             "cegb_penalty_feature_lazy", "cegb_penalty_feature_coupled"):
+                    setattr(self, key, _parse_list(val, float))
+                elif key == "metric":
+                    if isinstance(val, str):
+                        setattr(self, key, [m.strip() for m in val.split(",") if m.strip()])
+                    elif isinstance(val, (list, tuple)):
+                        setattr(self, key, list(val))
+                    else:
+                        setattr(self, key, [val])
+                elif key == "interaction_constraints":
+                    setattr(self, key, _parse_interaction_constraints(val))
+                else:
+                    setattr(self, key, val)
+            except (TypeError, ValueError) as e:
+                log.fatal("Parameter %s should be of type %s, got %r (%s)",
+                          key, f.type, val, e)
+        # `boosting=goss` is accepted as alias for gbdt + goss sampling
+        # (reference: config.cpp GetBoostingType handling).
+        if self.boosting == "goss":
+            self.boosting = "gbdt"
+            self.data_sample_strategy = "goss"
+        self._check()
+
+    def _check(self) -> None:
+        checks = [
+            (self.num_leaves >= 2, "num_leaves must be >= 2"),
+            (self.num_iterations >= 0, "num_iterations must be >= 0"),
+            (self.learning_rate > 0, "learning_rate must be > 0"),
+            (0 < self.bagging_fraction <= 1, "bagging_fraction in (0, 1]"),
+            (0 < self.feature_fraction <= 1, "feature_fraction in (0, 1]"),
+            (0 < self.feature_fraction_bynode <= 1, "feature_fraction_bynode in (0, 1]"),
+            (self.max_bin > 1, "max_bin must be > 1"),
+            (self.min_data_in_bin > 0, "min_data_in_bin must be > 0"),
+            (self.lambda_l1 >= 0, "lambda_l1 must be >= 0"),
+            (self.lambda_l2 >= 0, "lambda_l2 must be >= 0"),
+            (self.min_gain_to_split >= 0, "min_gain_to_split must be >= 0"),
+            (0 <= self.drop_rate <= 1, "drop_rate in [0, 1]"),
+            (0 <= self.skip_drop <= 1, "skip_drop in [0, 1]"),
+            (self.top_rate + self.other_rate <= 1.0, "top_rate + other_rate <= 1"),
+            (0 < self.alpha < 1, "alpha in (0, 1)"),
+            (self.fair_c > 0, "fair_c must be > 0"),
+            (1.0 <= self.tweedie_variance_power < 2.0, "tweedie_variance_power in [1, 2)"),
+            (self.lambdarank_truncation_level > 0, "lambdarank_truncation_level > 0"),
+            (self.sigmoid > 0, "sigmoid must be > 0"),
+            (self.num_class >= 1, "num_class must be >= 1"),
+            (self.lambdarank_target in LAMBDARANK_TARGETS,
+             f"unknown lambdarank_target {self.lambdarank_target!r}"),
+            (self.tree_learner in ("serial", "feature", "data", "voting"),
+             f"unknown tree_learner {self.tree_learner!r}"),
+            (self.boosting in ("gbdt", "dart", "rf"),
+             f"unknown boosting {self.boosting!r}"),
+            (self.data_sample_strategy in ("bagging", "goss"),
+             f"unknown data_sample_strategy {self.data_sample_strategy!r}"),
+            (self.monotone_constraints_method in ("basic", "intermediate", "advanced"),
+             "unknown monotone_constraints_method"),
+        ]
+        for ok, msg in checks:
+            if not ok:
+                log.fatal("Config check failed: %s", msg)
+        if self.boosting == "rf":
+            if not (self.bagging_freq > 0 and self.bagging_fraction < 1.0):
+                log.fatal("Random forest needs bagging_freq > 0 and bagging_fraction < 1")
+        log.set_verbosity(self.verbosity)
+
+    # convenient views ----------------------------------------------------
+    @property
+    def is_ranking(self) -> bool:
+        return self.objective in ("lambdarank", "rank_xendcg")
+
+    @property
+    def num_tree_per_iteration(self) -> int:
+        return self.num_class if self.objective in ("multiclass", "multiclassova") else 1
+
+    def label_gain_or_default(self, max_label: int) -> List[float]:
+        """Default label_gain = 2^i - 1 (reference: config.cpp default fill)."""
+        if self.label_gain:
+            return list(self.label_gain)
+        return [float((1 << i) - 1) if i < 31 else float(2 ** 31 - 1)
+                for i in range(max(max_label + 1, 32))]
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d.pop("extra", None)
+        return d
+
+
+def _parse_interaction_constraints(val: Any) -> List[List[int]]:
+    if isinstance(val, str):
+        out: List[List[int]] = []
+        buf = val.strip()
+        # format like "[0,1,2],[2,3]"
+        for part in buf.replace("][", "]|[").strip("[]").split("]|["):
+            part = part.strip("[] ")
+            if part:
+                out.append([int(x) for x in part.split(",")])
+        return out
+    if isinstance(val, (list, tuple)):
+        return [[int(x) for x in g] for g in val]
+    return []

@@ -1,0 +1,233 @@
+"""Binned dataset resident in TPU HBM.
+
+TPU-native analog of the reference's ``Dataset``/``Metadata``/``FeatureGroup``
+(reference: include/LightGBM/dataset.h:48-397,487; src/io/dataset.cpp). Instead
+of per-group Bin objects with dense/sparse variants, the TPU layout is a single
+dense row-major ``uint8``/``uint16`` matrix ``[num_data, num_used_features]``
+padded to lane multiples — the analog of ``CUDARowData``'s row-wise layout
+(reference: include/LightGBM/cuda/cuda_row_data.hpp:32). EFB merges
+mutually-exclusive sparse features into shared columns before the matrix is
+built (reference: src/io/dataset.cpp:107 FindGroups, :246 FastFeatureBundling).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+from .binning import (BIN_CATEGORICAL, BIN_NUMERICAL, MISSING_NAN, MISSING_NONE,
+                      MISSING_ZERO, BinMapper)
+
+
+@dataclass
+class Metadata:
+    """Labels, weights, query boundaries, positions, init scores
+    (reference: include/LightGBM/dataset.h:48-397)."""
+
+    label: Optional[np.ndarray] = None
+    weight: Optional[np.ndarray] = None
+    query_boundaries: Optional[np.ndarray] = None   # int32 [num_queries+1]
+    query_weights: Optional[np.ndarray] = None
+    init_score: Optional[np.ndarray] = None          # [num_data * num_class]
+    position: Optional[np.ndarray] = None            # int32 [num_data]
+    position_ids: Optional[List[str]] = None
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+    def set_group(self, group: Optional[np.ndarray]) -> None:
+        """Accepts group sizes (LightGBM convention) or per-row query ids."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group)
+        if self.label is not None and len(group) == len(self.label) and len(group) > 0 \
+                and not _looks_like_sizes(group, len(self.label)):
+            # per-row query ids -> boundaries
+            change = np.nonzero(np.diff(group))[0] + 1
+            self.query_boundaries = np.concatenate(
+                [[0], change, [len(group)]]).astype(np.int32)
+        else:
+            sizes = group.astype(np.int64)
+            self.query_boundaries = np.concatenate(
+                [[0], np.cumsum(sizes)]).astype(np.int32)
+
+    def check(self, num_data: int) -> None:
+        if self.label is not None and len(self.label) != num_data:
+            log.fatal("Length of label (%d) != num_data (%d)", len(self.label), num_data)
+        if self.weight is not None and len(self.weight) != num_data:
+            log.fatal("Length of weight (%d) != num_data (%d)", len(self.weight), num_data)
+        if self.query_boundaries is not None and self.query_boundaries[-1] != num_data:
+            log.fatal("Sum of query counts (%d) != num_data (%d)",
+                      int(self.query_boundaries[-1]), num_data)
+        if self.position is not None and len(self.position) != num_data:
+            log.fatal("Length of position (%d) != num_data (%d)", len(self.position), num_data)
+
+
+def _looks_like_sizes(group: np.ndarray, num_data: int) -> bool:
+    try:
+        return int(np.sum(group)) == num_data
+    except (TypeError, ValueError):
+        return False
+
+
+class BinnedDataset:
+    """The constructed, immutable training matrix
+    (reference analog: Dataset after ``Construct``, src/io/dataset.cpp:~350).
+
+    Attributes
+    ----------
+    binned : np.ndarray uint8/uint16 [num_data, num_used_features]
+    mappers : list[BinMapper], one per *original* feature
+    used_features : original indices of non-trivial features (column order)
+    feature_num_bins : bins per used feature
+    bin_offsets : cumulative bin offset per used feature (flattened histograms)
+    """
+
+    def __init__(self) -> None:
+        self.binned: Optional[np.ndarray] = None
+        self.mappers: List[BinMapper] = []
+        self.used_features: List[int] = []
+        self.feature_num_bins: List[int] = []
+        self.bin_offsets: List[int] = []
+        self.num_total_bins: int = 0
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.metadata = Metadata()
+        self.feature_names: List[str] = []
+        self.max_bin: int = 255
+        self._device_cache: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_matrix(cls, data: np.ndarray, config: Config,
+                    label: Optional[np.ndarray] = None,
+                    weight: Optional[np.ndarray] = None,
+                    group: Optional[np.ndarray] = None,
+                    init_score: Optional[np.ndarray] = None,
+                    position: Optional[np.ndarray] = None,
+                    categorical_features: Sequence[int] = (),
+                    feature_names: Optional[Sequence[str]] = None,
+                    reference: Optional["BinnedDataset"] = None) -> "BinnedDataset":
+        """Construct from a dense float matrix.
+
+        Mirrors DatasetLoader::ConstructFromSampleData
+        (reference: src/io/dataset_loader.cpp:593): sample rows, find bins,
+        then push all rows.
+        """
+        data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        if data.ndim != 2:
+            log.fatal("Training data must be 2-dimensional, got shape %s", data.shape)
+        ds = cls()
+        ds.num_data, ds.num_total_features = data.shape
+        ds.max_bin = config.max_bin
+        ds.feature_names = (list(feature_names) if feature_names
+                            else [f"Column_{i}" for i in range(ds.num_total_features)])
+
+        if reference is not None:
+            # validation set aligned to training bins
+            # (reference: Dataset::CreateValid, src/io/dataset.cpp)
+            ds.mappers = reference.mappers
+            ds.used_features = reference.used_features
+            ds.feature_num_bins = reference.feature_num_bins
+            ds.bin_offsets = reference.bin_offsets
+            ds.num_total_bins = reference.num_total_bins
+            ds.feature_names = reference.feature_names
+            ds.max_bin = reference.max_bin
+        else:
+            ds._find_bins(data, config, set(categorical_features))
+        ds._push_data(data)
+
+        md = ds.metadata
+        if label is not None:
+            md.label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if weight is not None:
+            md.weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if init_score is not None:
+            md.init_score = np.asarray(init_score, dtype=np.float64).reshape(-1)
+        if position is not None:
+            md.position = np.asarray(position, dtype=np.int32).reshape(-1)
+        md.set_group(group)
+        md.check(ds.num_data)
+        return ds
+
+    def _find_bins(self, data: np.ndarray, config: Config,
+                   categorical: set) -> None:
+        """Sample rows and build per-feature BinMappers
+        (reference: DatasetLoader::ConstructBinMappersFromTextData,
+        src/io/dataset_loader.cpp:1072)."""
+        n = self.num_data
+        sample_cnt = min(config.bin_construct_sample_cnt, n)
+        rng = np.random.RandomState(config.data_random_seed)
+        sample_idx = (np.arange(n) if sample_cnt >= n
+                      else np.sort(rng.choice(n, sample_cnt, replace=False)))
+        sample = data[sample_idx]
+
+        self.mappers = []
+        self.used_features = []
+        self.feature_num_bins = []
+        for j in range(self.num_total_features):
+            col = sample[:, j]
+            bin_type = BIN_CATEGORICAL if j in categorical else BIN_NUMERICAL
+            # sparse convention: pass non-zero entries, infer zeros from total
+            nz = col[~((col == 0.0) & ~np.isnan(col))]
+            mapper = BinMapper.find_bin(
+                nz, total_sample_cnt=len(col),
+                max_bin=(config.max_bin_by_feature[j]
+                         if j < len(config.max_bin_by_feature) else config.max_bin),
+                min_data_in_bin=config.min_data_in_bin,
+                bin_type=bin_type,
+                use_missing=config.use_missing,
+                zero_as_missing=config.zero_as_missing)
+            self.mappers.append(mapper)
+            if not mapper.is_trivial:
+                self.used_features.append(j)
+                self.feature_num_bins.append(mapper.num_bin)
+        if not self.used_features:
+            log.fatal("Cannot construct Dataset: all features are trivial "
+                      "(constant); check your input data")
+        self.bin_offsets = list(np.concatenate(
+            [[0], np.cumsum(self.feature_num_bins)[:-1]]).astype(int))
+        self.num_total_bins = int(np.sum(self.feature_num_bins))
+
+    def _push_data(self, data: np.ndarray) -> None:
+        dtype = np.uint8 if max(self.feature_num_bins, default=2) <= 256 else np.uint16
+        binned = np.empty((self.num_data, len(self.used_features)), dtype=dtype)
+        for k, j in enumerate(self.used_features):
+            binned[:, k] = self.mappers[j].values_to_bins(data[:, j]).astype(dtype)
+        self.binned = binned
+
+    # ------------------------------------------------------------------
+    @property
+    def num_features(self) -> int:
+        return len(self.used_features)
+
+    @property
+    def label(self) -> Optional[np.ndarray]:
+        return self.metadata.label
+
+    def feature_arrays(self):
+        """Static per-feature metadata arrays used by the jitted split scan."""
+        F = self.num_features
+        num_bins = np.asarray(self.feature_num_bins, dtype=np.int32)
+        offsets = np.asarray(self.bin_offsets, dtype=np.int32)
+        default_bins = np.zeros(F, dtype=np.int32)
+        missing_types = np.zeros(F, dtype=np.int32)   # 0=None, 1=Zero, 2=NaN
+        is_categorical = np.zeros(F, dtype=bool)
+        mt_codes = {MISSING_NONE: 0, MISSING_ZERO: 1, MISSING_NAN: 2}
+        for k, j in enumerate(self.used_features):
+            m = self.mappers[j]
+            default_bins[k] = m.default_bin
+            missing_types[k] = mt_codes[m.missing_type]
+            is_categorical[k] = m.bin_type == BIN_CATEGORICAL
+        return dict(num_bins=num_bins, offsets=offsets, default_bins=default_bins,
+                    missing_types=missing_types, is_categorical=is_categorical)
+
+    def real_threshold(self, feature_k: int, bin_threshold: int) -> float:
+        """Bin threshold -> raw-value threshold for model serialization."""
+        j = self.used_features[feature_k]
+        return self.mappers[j].bin_to_value(bin_threshold)

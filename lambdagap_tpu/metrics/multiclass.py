@@ -1,0 +1,68 @@
+"""Multiclass metrics (reference: src/metric/multiclass_metric.hpp:368)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Metric, register_metric
+
+EPS = 1e-15
+
+
+@register_metric
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, scores, objective=None):
+        # scores: [K, N] converted probabilities
+        p = np.clip(scores, EPS, 1.0)
+        y = self.label.astype(np.int64)
+        point = -np.log(p[y, np.arange(len(y))])
+        return [("multi_logloss", self._avg(point))]
+
+
+@register_metric
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def eval(self, scores, objective=None):
+        """top-k error (reference: multiclass_metric.hpp MultiErrorMetric w/
+        multi_error_top_k)."""
+        k = max(1, self.config.multi_error_top_k)
+        y = self.label.astype(np.int64)
+        s = np.asarray(scores)          # [K, N]
+        true_score = s[y, np.arange(len(y))]
+        # rank of true class: number of classes with strictly greater score
+        rank = np.sum(s > true_score[None, :], axis=0)
+        err = (rank >= k).astype(np.float64)
+        name = "multi_error" if k == 1 else f"multi_error@{k}"
+        return [(name, self._avg(err))]
+
+
+@register_metric
+class AucMuMetric(Metric):
+    """AUC-mu for multiclass (reference: multiclass_metric.hpp AucMuMetric,
+    Kleiman & Page 2019): average pairwise separability."""
+    name = "auc_mu"
+    greater_is_better = True
+
+    def eval(self, scores, objective=None):
+        s = np.asarray(scores)          # [K, N]
+        K = s.shape[0]
+        y = self.label.astype(np.int64)
+        w = self.weight if self.weight is not None else np.ones(len(y))
+        total = 0.0
+        pairs = 0
+        for a in range(K):
+            for b in range(a + 1, K):
+                mask = (y == a) | (y == b)
+                if not mask.any():
+                    continue
+                ya = (y[mask] == a).astype(np.float64)
+                # decision value: s_a - s_b partition direction
+                # (reference uses auc_mu_weights matrix; default: difference)
+                sv = s[a][mask] - s[b][mask]
+                from .binary import _weighted_auc
+                auc = _weighted_auc(ya, sv, w[mask])
+                total += auc
+                pairs += 1
+        return [("auc_mu", total / max(pairs, 1))]

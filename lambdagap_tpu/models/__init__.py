@@ -1,0 +1,5 @@
+from .gbdt import GBDT
+from .learner import SerialTreeLearner
+from .tree import Tree
+
+__all__ = ["GBDT", "SerialTreeLearner", "Tree"]

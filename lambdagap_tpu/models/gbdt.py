@@ -1,0 +1,303 @@
+"""GBDT boosting orchestration.
+
+TPU re-implementation of the reference's GBDT class
+(reference: src/boosting/gbdt.{h:37,cpp} — Init :73-129, TrainOneIter
+:346-454, BoostFromAverage :321, UpdateScore :495-524, eval :476-493).
+
+Scores live on device as ``[K, N]`` float32. The training-score update never
+traverses trees: the learner's partition already knows every row's leaf, so
+adding a tree is one gather + scatter-add (the analog of
+``ScoreUpdater::AddScore`` going through ``AddScoreByLeaf``,
+reference: src/boosting/score_updater.hpp:21-110).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..data.dataset import BinnedDataset
+from ..metrics.base import Metric, create_metrics
+from ..objectives.base import ObjectiveFunction, create_objective
+from ..ops.predict import predict_tree_binned, predict_tree_raw, tree_to_arrays
+from ..utils import log
+from .learner import SerialTreeLearner
+from .sample_strategy import create_sample_strategy
+from .tree import Tree
+
+K_EPSILON = 1e-15
+
+
+@functools.partial(jax.jit, static_argnames=("num_leaves",))
+def _add_tree_score(score, perm, leaf_begin, leaf_count, leaf_values,
+                    num_leaves: int):
+    """score[perm[i]] += leaf_value[leaf containing position i]."""
+    del leaf_count
+    N = score.shape[0]
+    order = jnp.argsort(leaf_begin)
+    sorted_begin = leaf_begin[order]
+    which = jnp.searchsorted(sorted_begin, jnp.arange(N, dtype=leaf_begin.dtype),
+                             side="right") - 1
+    pos_leaf = order[which]
+    vals = leaf_values[pos_leaf]
+    return score.at[perm].add(vals)
+
+
+def _round_depth(d: int) -> int:
+    """Pad traversal depth to a multiple of 8 to bound jit specializations."""
+    return max(8, ((d + 7) // 8) * 8)
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree booster."""
+
+    def __init__(self, config: Config, train_set: Optional[BinnedDataset]) -> None:
+        self.config = config
+        self.train_set = train_set
+        self.iter_ = 0
+        self.models: List[Tree] = []           # flat: iter-major, class-minor
+        self.best_iteration = -1
+        self.shrinkage_rate = config.learning_rate
+
+        self.objective: Optional[ObjectiveFunction] = create_objective(config)
+        self.num_class = self.objective.num_class if self.objective else config.num_class
+        self.num_tree_per_iteration = max(self.num_class, 1)
+
+        self.train_metrics: List[Metric] = []
+        self.valid_sets: List[Tuple[str, BinnedDataset]] = []
+        self.valid_binned: List[jax.Array] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.valid_scores: List[jax.Array] = []
+
+        if train_set is not None:
+            self._setup_training(train_set)
+
+    # ------------------------------------------------------------------
+    def _setup_training(self, ds: BinnedDataset) -> None:
+        self.num_data = ds.num_data
+        if self.objective is not None:
+            self.objective.init(ds.metadata, ds.num_data)
+        self.learner = SerialTreeLearner(ds, self.config)
+        self.sample_strategy = create_sample_strategy(
+            self.config, ds.num_data,
+            label=None if ds.metadata.label is None else np.asarray(ds.metadata.label),
+            query_boundaries=ds.metadata.query_boundaries)
+        K, N = self.num_tree_per_iteration, ds.num_data
+        init = jnp.zeros((K, N), dtype=jnp.float32)
+        if ds.metadata.init_score is not None:
+            s = np.asarray(ds.metadata.init_score, dtype=np.float32)
+            init = jnp.asarray(s.reshape(K, N) if s.size == K * N
+                               else np.tile(s, (K, 1)))
+            self.has_init_score = True
+        else:
+            self.has_init_score = False
+        self.scores = init
+        if self.config.is_provide_training_metric:
+            self.train_metrics = create_metrics(self.config, ds.metadata, N)
+        self._meta = ds.feature_arrays()
+        if self.config.boosting == "rf":
+            self.shrinkage_rate = 1.0
+
+    def add_valid_set(self, ds: BinnedDataset, name: str) -> None:
+        self.valid_sets.append((name, ds))
+        self.valid_binned.append(jnp.asarray(ds.binned))
+        self.valid_metrics.append(create_metrics(self.config, ds.metadata, ds.num_data))
+        K = self.num_tree_per_iteration
+        init = jnp.zeros((K, ds.num_data), dtype=jnp.float32)
+        if ds.metadata.init_score is not None:
+            s = np.asarray(ds.metadata.init_score, dtype=np.float32)
+            init = jnp.asarray(s.reshape(K, ds.num_data) if s.size == K * ds.num_data
+                               else np.tile(s, (K, 1)))
+        self.valid_scores.append(init)
+        # replay existing model onto the new valid set
+        for i, tree in enumerate(self.models):
+            k = i % self.num_tree_per_iteration
+            self._add_valid_tree_score(len(self.valid_sets) - 1, tree, k)
+
+    # ------------------------------------------------------------------
+    def boosting(self) -> Tuple[jax.Array, jax.Array]:
+        """Compute gradients at current scores
+        (reference: GBDT::Boosting, gbdt.cpp:222-237)."""
+        return self.objective.get_gradients(self.scores)
+
+    def train_one_iter(self, grad: Optional[jax.Array] = None,
+                       hess: Optional[jax.Array] = None) -> bool:
+        """One boosting iteration. Returns True when training should stop
+        (no splittable leaves), mirroring gbdt.cpp:346-454."""
+        cfg = self.config
+        init_scores = [0.0] * self.num_tree_per_iteration
+        if grad is None or hess is None:
+            if self.objective is None:
+                log.fatal("No objective and no custom gradients provided")
+            # boost from average once, before the first gradient computation
+            if not self.models and not self.has_init_score \
+                    and cfg.boost_from_average:
+                for k in range(self.num_tree_per_iteration):
+                    init = self.objective.boost_from_score(k)
+                    if abs(init) > K_EPSILON:
+                        init_scores[k] = init
+                        self.scores = self.scores.at[k].add(init)
+                        for vi in range(len(self.valid_scores)):
+                            self.valid_scores[vi] = self.valid_scores[vi].at[k].add(init)
+                        log.info("Start training from score %f", init)
+            grad, hess = self.boosting()
+
+        grad, hess, mask = self.sample_strategy.sample(self.iter_, grad, hess)
+
+        should_continue = False
+        for k in range(self.num_tree_per_iteration):
+            tree = self.learner.train(grad[k], hess[k], row_mask=mask)
+            if tree.num_leaves > 1:
+                should_continue = True
+                if self.objective is not None and self.objective.is_renew_tree_output:
+                    self._renew_tree_output(tree, k, mask)
+                tree.apply_shrinkage(self.shrinkage_rate)
+                self._update_train_score(tree, k)
+                for vi in range(len(self.valid_sets)):
+                    self._add_valid_tree_score(vi, tree, k)
+                if abs(init_scores[k]) > K_EPSILON:
+                    self._tree_add_bias(tree, init_scores[k], k)
+            else:
+                if len(self.models) < self.num_tree_per_iteration:
+                    if self.objective is not None and not cfg.boost_from_average \
+                            and not self.has_init_score:
+                        init_scores[k] = self.objective.boost_from_score(k)
+                        self.scores = self.scores.at[k].add(init_scores[k])
+                        for vi in range(len(self.valid_scores)):
+                            self.valid_scores[vi] = \
+                                self.valid_scores[vi].at[k].add(init_scores[k])
+                    tree.leaf_value[0] = init_scores[k]
+            self.models.append(tree)
+
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > self.num_tree_per_iteration:
+                del self.models[-self.num_tree_per_iteration:]
+            return True
+        self.iter_ += 1
+        return False
+
+    def _tree_add_bias(self, tree: Tree, bias: float, k: int) -> None:
+        """Fold the boost-from-average init into the first tree
+        (reference: Tree::AddBias via gbdt.cpp:421)."""
+        tree.leaf_value[:tree.num_leaves] += bias
+        tree.internal_value = [v + bias for v in tree.internal_value]
+
+    def _update_train_score(self, tree: Tree, k: int) -> None:
+        lv = jnp.asarray(tree.leaf_value[:tree.num_leaves], dtype=jnp.float32)
+        self.scores = self.scores.at[k].set(_add_tree_score(
+            self.scores[k], self.learner.last_perm,
+            jnp.asarray(self.learner.last_leaf_begin, dtype=jnp.int32),
+            jnp.asarray(self.learner.last_leaf_count, dtype=jnp.int32),
+            lv, tree.num_leaves))
+
+    def _add_valid_tree_score(self, vi: int, tree: Tree, k: int) -> None:
+        x = self.valid_binned[vi]
+        arrs = tree_to_arrays(tree, feature_meta=self._meta, use_inner_feature=True)
+        depth = _round_depth(tree.max_depth + 1)
+        add = predict_tree_binned(x, arrs, depth)
+        self.valid_scores[vi] = self.valid_scores[vi].at[k].add(add)
+
+    def _renew_tree_output(self, tree: Tree, k: int, mask) -> None:
+        """L1-family leaf refit by weighted percentile of residuals
+        (reference: RenewTreeOutput path in gbdt.cpp:412 +
+        regression_objective.hpp percentiles)."""
+        perm = np.asarray(jax.device_get(self.learner.last_perm))
+        score = np.asarray(jax.device_get(self.scores[k]))
+        mask_np = None if mask is None else np.asarray(jax.device_get(mask))
+        begins = self.learner.last_leaf_begin
+        counts = self.learner.last_leaf_count
+        for leaf in range(tree.num_leaves):
+            rows = perm[int(begins[leaf]): int(begins[leaf]) + int(counts[leaf])]
+            if mask_np is not None:
+                rows = rows[mask_np[rows]]
+            if len(rows) == 0:
+                continue
+            tree.leaf_value[leaf] = self.objective.renew_tree_output(rows, score)
+
+    # ------------------------------------------------------------------
+    # evaluation
+    # ------------------------------------------------------------------
+    def _converted_scores(self, raw: jax.Array) -> np.ndarray:
+        out = self.objective.convert_output(raw) if self.objective else raw
+        out = np.asarray(jax.device_get(out)).astype(np.float64)
+        return out[0] if self.num_tree_per_iteration == 1 else out
+
+    def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        return self._eval("training", self.train_metrics,
+                          self._converted_scores(self.scores))
+
+    def eval_valid(self) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        for vi, (name, _) in enumerate(self.valid_sets):
+            out.extend(self._eval(name, self.valid_metrics[vi],
+                                  self._converted_scores(self.valid_scores[vi])))
+        return out
+
+    @staticmethod
+    def _eval(data_name, metrics, converted) -> List[Tuple[str, str, float, bool]]:
+        res = []
+        for m in metrics:
+            for mname, val in m.eval(converted):
+                res.append((data_name, mname, val, m.greater_is_better))
+        return res
+
+    # ------------------------------------------------------------------
+    # prediction
+    # ------------------------------------------------------------------
+    def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+        """Raw scores for new data [N, D] -> [N] or [N, K]."""
+        data = np.asarray(data, dtype=np.float32)
+        x = jnp.asarray(data)
+        K = self.num_tree_per_iteration
+        N = data.shape[0]
+        out = jnp.zeros((K, N), dtype=jnp.float32)
+        end = len(self.models) if num_iteration < 0 else min(
+            len(self.models), (start_iteration + num_iteration) * K)
+        for i in range(start_iteration * K, end):
+            tree = self.models[i]
+            arrs = tree_to_arrays(tree, use_inner_feature=False)
+            depth = _round_depth(tree.max_depth + 1)
+            out = out.at[i % K].add(predict_tree_raw(x, arrs, depth))
+        res = np.asarray(jax.device_get(out))
+        return res[0] if K == 1 else res.T
+
+    def predict(self, data: np.ndarray, raw_score: bool = False,
+                start_iteration: int = 0, num_iteration: int = -1) -> np.ndarray:
+        raw = self.predict_raw(data, start_iteration, num_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        dev = jnp.asarray(raw.T if raw.ndim == 2 else raw[None, :])
+        conv = np.asarray(jax.device_get(self.objective.convert_output(dev)))
+        return conv[0] if self.num_tree_per_iteration == 1 else conv.T
+
+    # ------------------------------------------------------------------
+    @property
+    def num_iterations_trained(self) -> int:
+        return self.iter_
+
+    def rollback_one_iter(self) -> None:
+        """(reference: GBDT::RollbackOneIter, gbdt.cpp:456) — drop the last
+        iteration's trees and subtract their score contributions."""
+        if self.iter_ <= 0:
+            return
+        for k in range(self.num_tree_per_iteration):
+            tree = self.models[-(self.num_tree_per_iteration - k)]
+            # subtract contribution by re-adding with negated leaf values
+            arrs = tree_to_arrays(tree, feature_meta=self._meta,
+                                  use_inner_feature=True)
+            arrs = arrs._replace(leaf_value=-arrs.leaf_value)
+            depth = _round_depth(tree.max_depth + 1)
+            self.scores = self.scores.at[k].add(
+                predict_tree_binned(self.learner.x_binned, arrs, depth))
+            for vi in range(len(self.valid_sets)):
+                self.valid_scores[vi] = self.valid_scores[vi].at[k].add(
+                    predict_tree_binned(self.valid_binned[vi], arrs, depth))
+        del self.models[-self.num_tree_per_iteration:]
+        self.iter_ -= 1

@@ -1,0 +1,260 @@
+"""Serial (single-device) leaf-wise tree learner.
+
+TPU re-design of the reference's canonical leaf-wise loop
+(reference: src/treelearner/serial_tree_learner.cpp:179-245 Train, :288
+BeforeTrain, :340-384 histogram-pool juggling, :404-476 FindBestSplits,
+:766-920 SplitInner). Like the CUDA learner
+(reference: src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp:158-260) the
+host only orchestrates: every step is a jitted device call with shape-stable
+padded sizes (power-of-2 buckets bound recompilation), and the
+histogram-subtraction trick keeps per-split work at O(min(|left|, |right|)).
+
+Host state per tree: leaf begin/count bookkeeping and fetched best-split
+records (one small D2H per step, like the CUDA learner's single SplitInfo
+copy at cuda_single_gpu_tree_learner.cpp:246).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..data.dataset import BinnedDataset
+from ..ops.histogram import full_histogram, leaf_histogram
+from ..ops.partition import split_partition
+from ..ops.split import SplitParams, find_best_split
+from ..utils import log
+from .tree import Tree
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class _HostSplit:
+    """A fetched best-split record (host mirror of SplitInfo)."""
+    __slots__ = ("gain", "feature", "threshold", "default_left",
+                 "left_sum_g", "left_sum_h", "left_count",
+                 "right_sum_g", "right_sum_h", "right_count",
+                 "left_output", "right_output", "is_categorical", "cat_bitset")
+
+    def __init__(self, res) -> None:
+        (self.gain, self.feature, self.threshold, self.default_left,
+         self.left_sum_g, self.left_sum_h, self.left_count,
+         self.right_sum_g, self.right_sum_h, self.right_count,
+         self.left_output, self.right_output, self.is_categorical,
+         self.cat_bitset) = [np.asarray(x) for x in res]
+
+    @property
+    def gain_f(self) -> float:
+        return float(self.gain)
+
+
+class SerialTreeLearner:
+    """Single-device leaf-wise learner over a BinnedDataset."""
+
+    def __init__(self, dataset: BinnedDataset, config: Config) -> None:
+        self.dataset = dataset
+        self.config = config
+        self.num_data = dataset.num_data
+        self.num_features = dataset.num_features
+
+        meta = dataset.feature_arrays()
+        self.num_bins_arr = jnp.asarray(meta["num_bins"])
+        self.default_bins_arr = jnp.asarray(meta["default_bins"])
+        self.missing_types_arr = jnp.asarray(meta["missing_types"])
+        self.is_categorical_arr = jnp.asarray(meta["is_categorical"])
+        self.has_categorical = bool(meta["is_categorical"].any())
+        self.meta_host = meta
+
+        # uniform per-feature bin budget (power of two for clean tiling)
+        self.max_num_bins = int(meta["num_bins"].max())
+        self.B = max(_next_pow2(self.max_num_bins), 8)
+
+        self.x_binned = jnp.asarray(dataset.binned)
+        self.perm0 = jnp.arange(self.num_data, dtype=jnp.int32)
+
+        self.params = SplitParams(
+            lambda_l1=config.lambda_l1, lambda_l2=config.lambda_l2,
+            max_delta_step=config.max_delta_step, path_smooth=config.path_smooth,
+            min_data_in_leaf=config.min_data_in_leaf,
+            min_sum_hessian_in_leaf=config.min_sum_hessian_in_leaf,
+            min_gain_to_split=config.min_gain_to_split,
+            cat_smooth=config.cat_smooth, cat_l2=config.cat_l2,
+            max_cat_threshold=config.max_cat_threshold,
+            max_cat_to_onehot=config.max_cat_to_onehot,
+            min_data_per_group=config.min_data_per_group)
+
+        self.rows_per_block = config.tpu_rows_per_block
+        self._col_rng = np.random.RandomState(config.feature_fraction_seed)
+
+        # outputs of the last Train call, used for the O(1)-per-row score update
+        self.last_perm: Optional[jax.Array] = None
+        self.last_leaf_begin: Optional[np.ndarray] = None
+        self.last_leaf_count: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def _pad_size(self, count: int) -> int:
+        return min(max(_next_pow2(max(count, 1)), 256), _next_pow2(self.num_data))
+
+    def _feature_mask(self) -> jax.Array:
+        """Per-tree column sampling (reference: src/treelearner/col_sampler.hpp)."""
+        frac = self.config.feature_fraction
+        if frac >= 1.0:
+            return jnp.ones(self.num_features, dtype=bool)
+        k = max(1, int(np.ceil(frac * self.num_features)))
+        chosen = self._col_rng.choice(self.num_features, k, replace=False)
+        mask = np.zeros(self.num_features, dtype=bool)
+        mask[chosen] = True
+        return jnp.asarray(mask)
+
+    def _best(self, hist, pg, ph, pc, parent_output, fmask) -> _HostSplit:
+        res = find_best_split(
+            hist, pg, ph, pc, parent_output,
+            self.num_bins_arr, self.default_bins_arr, self.missing_types_arr,
+            self.is_categorical_arr, fmask, self.params,
+            has_categorical=self.has_categorical)
+        return _HostSplit(jax.device_get(res))
+
+    def _cat_bitset_real(self, feature_k: int, bitset_bins: np.ndarray) -> np.ndarray:
+        """Convert a bin-space bitset to raw-category space for model export."""
+        j = self.dataset.used_features[feature_k]
+        mapper = self.dataset.mappers[j]
+        out = np.zeros(8, dtype=np.uint32)
+        for b in range(mapper.num_bin):
+            if (bitset_bins[b // 32] >> (b % 32)) & 1:
+                cat = mapper.bin_2_categorical[b] if b < len(mapper.bin_2_categorical) else -1
+                if 0 <= cat < 256:
+                    out[cat // 32] |= np.uint32(1) << np.uint32(cat % 32)
+        return out
+
+    # ------------------------------------------------------------------
+    def train(self, grad: jax.Array, hess: jax.Array,
+              row_mask: Optional[jax.Array] = None) -> Tree:
+        """Grow one tree. grad/hess are [N] float32 on device, already
+        multiplied by the bagging mask when sampling is active."""
+        cfg = self.config
+        num_leaves = cfg.num_leaves
+        max_depth = cfg.max_depth
+        tree = Tree(max_leaves=num_leaves)
+        fmask = self._feature_mask()
+
+        perm = self.perm0
+        leaf_begin = np.zeros(num_leaves, dtype=np.int64)
+        leaf_count = np.zeros(num_leaves, dtype=np.int64)
+        leaf_count[0] = self.num_data
+
+        # root histogram + totals (BeforeTrain analog)
+        hist_root = full_histogram(self.x_binned, grad, hess, row_mask, self.B,
+                                   self.rows_per_block)
+        totals = jnp.sum(hist_root[0], axis=0)   # (g, h, c) — every row hits f0
+        root_out = _leaf_output_scalar(totals[0], totals[1], totals[2], self.params)
+        hists: Dict[int, jax.Array] = {0: hist_root}
+        sums: Dict[int, tuple] = {0: (totals[0], totals[1], totals[2], root_out)}
+        best: Dict[int, _HostSplit] = {
+            0: self._best(hist_root, totals[0], totals[1], totals[2], root_out, fmask)}
+
+        tree.leaf_value[0] = float(jax.device_get(root_out))
+        tree.leaf_weight[0] = float(jax.device_get(totals[1]))
+        tree.leaf_count[0] = int(float(jax.device_get(totals[2])))
+
+        for _ in range(num_leaves - 1):
+            # pick the leaf with max gain (ArgMax over best_split_per_leaf_,
+            # reference: serial_tree_learner.cpp:225)
+            cand = [(s.gain_f, leaf) for leaf, s in best.items()
+                    if np.isfinite(s.gain_f) and s.gain_f > 0
+                    and (max_depth <= 0 or tree.leaf_depth[leaf] < max_depth)]
+            if not cand:
+                break
+            _, leaf = max(cand)
+            s = best.pop(leaf)
+
+            begin, count = int(leaf_begin[leaf]), int(leaf_count[leaf])
+            P = self._pad_size(count)
+            feat = int(s.feature)
+            perm, left_cnt_dev = split_partition(
+                self.x_binned, perm,
+                jnp.int32(begin), jnp.int32(count),
+                jnp.int32(feat), jnp.int32(s.threshold),
+                jnp.asarray(bool(s.default_left)),
+                self.default_bins_arr[feat], self.missing_types_arr[feat],
+                self.num_bins_arr[feat], jnp.asarray(bool(s.is_categorical)),
+                jnp.asarray(s.cat_bitset), P)
+            left_cnt = int(jax.device_get(left_cnt_dev))
+            right_cnt = count - left_cnt
+            if left_cnt == 0 or right_cnt == 0:
+                # numerically degenerate split; drop this leaf from candidates
+                log.warning("Degenerate split on leaf %d (feature %d): "
+                            "left=%d right=%d; skipping", leaf, feat, left_cnt, right_cnt)
+                continue
+
+            j = self.dataset.used_features[feat]
+            mapper = self.dataset.mappers[j]
+            cat_real = (self._cat_bitset_real(feat, s.cat_bitset)
+                        if s.is_categorical else None)
+            mt_code = {"None": 0, "Zero": 1, "NaN": 2}[mapper.missing_type]
+            right_leaf = tree.split(
+                leaf, feature=j, feature_inner=feat,
+                threshold_bin=int(s.threshold),
+                threshold_real=mapper.bin_to_value(int(s.threshold)),
+                default_left=bool(s.default_left), missing_type=mt_code,
+                gain=s.gain_f,
+                left_value=float(s.left_output), right_value=float(s.right_output),
+                left_weight=float(s.left_sum_h), right_weight=float(s.right_sum_h),
+                left_count=left_cnt, right_count=right_cnt,
+                is_categorical=bool(s.is_categorical),
+                cat_bitset=np.asarray(s.cat_bitset),
+                cat_bitset_real=cat_real)
+
+            leaf_begin[leaf] = begin
+            leaf_count[leaf] = left_cnt
+            leaf_begin[right_leaf] = begin + left_cnt
+            leaf_count[right_leaf] = right_cnt
+
+            parent_hist = hists.pop(leaf)
+            l_sums = (jnp.float32(s.left_sum_g), jnp.float32(s.left_sum_h),
+                      jnp.float32(s.left_count), jnp.float32(s.left_output))
+            r_sums = (jnp.float32(s.right_sum_g), jnp.float32(s.right_sum_h),
+                      jnp.float32(s.right_count), jnp.float32(s.right_output))
+
+            if tree.num_leaves >= num_leaves:
+                break  # no more splits: skip children histograms
+
+            # smaller child gets a fresh histogram; sibling by subtraction
+            # (reference: serial_tree_learner.cpp:408-476)
+            small_is_left = left_cnt <= right_cnt
+            sb, sc = (begin, left_cnt) if small_is_left else (begin + left_cnt, right_cnt)
+            Ph = self._pad_size(sc)
+            hist_small = leaf_histogram(
+                self.x_binned, perm, grad, hess,
+                jnp.int32(sb), jnp.int32(sc), Ph, self.B,
+                self.rows_per_block, row_mask)
+            hist_large = parent_hist - hist_small
+
+            small_leaf = leaf if small_is_left else right_leaf
+            large_leaf = right_leaf if small_is_left else leaf
+            s_sums = l_sums if small_is_left else r_sums
+            g_sums = r_sums if small_is_left else l_sums
+
+            hists[small_leaf] = hist_small
+            hists[large_leaf] = hist_large
+            best[small_leaf] = self._best(hist_small, *s_sums, fmask)
+            best[large_leaf] = self._best(hist_large, *g_sums, fmask)
+            sums[small_leaf] = s_sums
+            sums[large_leaf] = g_sums
+
+        self.last_perm = perm
+        self.last_leaf_begin = leaf_begin[:tree.num_leaves].copy()
+        self.last_leaf_count = leaf_count[:tree.num_leaves].copy()
+        return tree
+
+
+def _leaf_output_scalar(g, h, c, params: SplitParams):
+    from ..ops.split import calculate_leaf_output
+    return calculate_leaf_output(g, h, params, c, 0.0)

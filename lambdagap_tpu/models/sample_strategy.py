@@ -1,0 +1,142 @@
+"""Row sampling strategies: bagging and GOSS.
+
+(reference: src/boosting/sample_strategy.{h,cpp} factory,
+src/boosting/bagging.hpp:14, src/boosting/goss.hpp:18.)
+
+TPU design: instead of compacting a ``bag_data_indices`` array (the
+reference's subset path), sampling produces a boolean in-bag mask [N] on
+device. Out-of-bag rows keep flowing through the partition with zeroed
+grad/hess and are excluded from histogram counts via the mask — index
+compaction would fight XLA's static shapes for no bandwidth win.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+
+
+class SampleStrategy:
+    """Base: no sampling."""
+
+    def __init__(self, config: Config, num_data: int) -> None:
+        self.config = config
+        self.num_data = num_data
+
+    @property
+    def is_hessian_change(self) -> bool:
+        return False
+
+    def sample(self, iter_: int, grad: jax.Array, hess: jax.Array
+               ) -> Tuple[jax.Array, jax.Array, Optional[jax.Array]]:
+        """Returns (grad, hess, mask). mask=None means all rows in-bag."""
+        return grad, hess, None
+
+
+class BaggingStrategy(SampleStrategy):
+    """(reference: src/boosting/bagging.hpp — per-``bagging_freq`` Bernoulli
+    subsample, with optional positive/negative class fractions)."""
+
+    def __init__(self, config: Config, num_data: int,
+                 label: Optional[np.ndarray] = None,
+                 query_boundaries: Optional[np.ndarray] = None) -> None:
+        super().__init__(config, num_data)
+        self.key = jax.random.PRNGKey(config.bagging_seed)
+        self.cur_mask: Optional[jax.Array] = None
+        self.label = label
+        self.query_boundaries = query_boundaries
+        self.balanced = (config.pos_bagging_fraction < 1.0
+                         or config.neg_bagging_fraction < 1.0)
+        if self.balanced and label is not None:
+            self.is_pos = jnp.asarray(label > 0)
+
+    @property
+    def enabled(self) -> bool:
+        c = self.config
+        return c.bagging_freq > 0 and (c.bagging_fraction < 1.0 or self.balanced)
+
+    def sample(self, iter_, grad, hess):
+        c = self.config
+        if not self.enabled:
+            return grad, hess, None
+        if iter_ % c.bagging_freq == 0:
+            self.key, sub = jax.random.split(self.key)
+            if c.bagging_by_query and self.query_boundaries is not None:
+                nq = len(self.query_boundaries) - 1
+                qmask = jax.random.uniform(sub, (nq,)) < c.bagging_fraction
+                qb = jnp.asarray(self.query_boundaries)
+                qid = jnp.searchsorted(qb, jnp.arange(self.num_data),
+                                       side="right") - 1
+                self.cur_mask = qmask[qid]
+            elif self.balanced:
+                u = jax.random.uniform(sub, (self.num_data,))
+                frac = jnp.where(self.is_pos, c.pos_bagging_fraction,
+                                 c.neg_bagging_fraction)
+                self.cur_mask = u < frac
+            else:
+                u = jax.random.uniform(sub, (self.num_data,))
+                self.cur_mask = u < c.bagging_fraction
+        m = self.cur_mask
+        mf = m.astype(grad.dtype)
+        return grad * mf, hess * mf, m
+
+
+class GossStrategy(SampleStrategy):
+    """Gradient-based one-side sampling
+    (reference: src/boosting/goss.hpp — skip the first 1/learning_rate
+    iterations, keep the ``top_rate`` fraction by |g*h|, sample ``other_rate``
+    of the rest and amplify by (1-top_rate)/other_rate)."""
+
+    def __init__(self, config: Config, num_data: int) -> None:
+        super().__init__(config, num_data)
+        self.key = jax.random.PRNGKey(config.bagging_seed)
+
+    @property
+    def is_hessian_change(self) -> bool:
+        return True
+
+    def sample(self, iter_, grad, hess):
+        c = self.config
+        # (reference: goss.hpp:33 — 1/learning_rate warmup iterations)
+        if iter_ < max(1, int(1.0 / c.learning_rate)):
+            return grad, hess, None
+        self.key, sub = jax.random.split(self.key)
+        return _goss_mask(grad, hess, sub, c.top_rate, c.other_rate)
+
+
+@functools.partial(jax.jit, static_argnames=("top_rate", "other_rate"))
+def _goss_mask(grad, hess, key, top_rate: float, other_rate: float):
+    N = grad.shape[-1]
+    score = jnp.abs(grad * hess)
+    if score.ndim > 1:
+        score = jnp.sum(score, axis=0)     # multiclass: combine classes
+    top_k = max(1, int(top_rate * N))
+    kth = -jnp.sort(-score)[top_k - 1]
+    is_top = score >= kth
+    u = jax.random.uniform(key, (N,))
+    keep_prob = other_rate / max(1.0 - top_rate, 1e-12)
+    sampled_rest = (~is_top) & (u < keep_prob)
+    multiplier = (1.0 - top_rate) / max(other_rate, 1e-12)
+    mask = is_top | sampled_rest
+    amp = jnp.where(sampled_rest, multiplier, 1.0).astype(grad.dtype)
+    mf = mask.astype(grad.dtype) * amp
+    return grad * mf, hess * mf, mask
+
+
+def create_sample_strategy(config: Config, num_data: int,
+                           label=None, query_boundaries=None) -> SampleStrategy:
+    """(reference: SampleStrategy::CreateSampleStrategy,
+    src/boosting/sample_strategy.cpp)"""
+    if config.data_sample_strategy == "goss":
+        return GossStrategy(config, num_data)
+    bs = BaggingStrategy(config, num_data, label, query_boundaries)
+    if bs.enabled:
+        log.info("Using bagging, fraction=%g freq=%d",
+                 config.bagging_fraction, config.bagging_freq)
+    return bs

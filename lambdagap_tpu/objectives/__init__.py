@@ -1,0 +1,4 @@
+from .base import ObjectiveFunction, create_objective, register_objective
+from . import regression, binary, multiclass, xentropy  # noqa: F401 — register
+
+__all__ = ["ObjectiveFunction", "create_objective", "register_objective"]

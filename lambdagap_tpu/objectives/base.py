@@ -1,0 +1,129 @@
+"""Objective function interface + factory.
+
+TPU analog of the reference's ``ObjectiveFunction`` + ``CreateObjectiveFunction``
+(reference: include/LightGBM/objective_function.h:19,98,
+src/objective/objective_function.cpp:20-108). Objectives hold device-resident
+label/weight arrays and expose a jit-compiled gradient computation; scores are
+laid out class-major ``[K, N]`` like the reference's flat ``score[class*N+i]``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..data.dataset import Metadata
+from ..utils import log
+
+K_EPSILON = 1e-15
+
+
+class ObjectiveFunction:
+    name = "base"
+    num_model_per_iteration = 1
+
+    def __init__(self, config: Config) -> None:
+        self.config = config
+        self.num_data = 0
+        self.label: Optional[jax.Array] = None
+        self.weight: Optional[jax.Array] = None
+        self.label_np: Optional[np.ndarray] = None
+        self.weight_np: Optional[np.ndarray] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        self.num_data = num_data
+        if metadata.label is None:
+            log.fatal("Objective %s requires labels", self.name)
+        self.label_np = np.asarray(metadata.label, dtype=np.float32)
+        self.label = jnp.asarray(self.label_np)
+        if metadata.weight is not None:
+            self.weight_np = np.asarray(metadata.weight, dtype=np.float32)
+            self.weight = jnp.asarray(self.weight_np)
+
+    # -- core ----------------------------------------------------------
+    def get_gradients(self, scores: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """scores: [K, N] -> (grad, hess) each [K, N]."""
+        raise NotImplementedError
+
+    def boost_from_score(self, class_id: int) -> float:
+        """Initial score (reference: BoostFromScore per objective)."""
+        return 0.0
+
+    def convert_output(self, scores: jax.Array) -> jax.Array:
+        """Raw score -> output space (e.g. sigmoid/exp/softmax)."""
+        return scores
+
+    # -- leaf renewal (L1 family) ---------------------------------------
+    @property
+    def is_renew_tree_output(self) -> bool:
+        return False
+
+    def renew_tree_output(self, leaf_rows: np.ndarray, score: np.ndarray) -> float:
+        """Recompute one leaf's output from its rows (host-side; reference:
+        RenewTreeOutput with residual_getter + weighted percentile)."""
+        raise NotImplementedError
+
+    # -- misc ----------------------------------------------------------
+    @property
+    def is_constant_hessian(self) -> bool:
+        return False
+
+    @property
+    def num_class(self) -> int:
+        return 1
+
+    def to_string(self) -> str:
+        return self.name
+
+
+_REGISTRY: Dict[str, Type[ObjectiveFunction]] = {}
+
+
+def register_objective(cls: Type[ObjectiveFunction]) -> Type[ObjectiveFunction]:
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def create_objective(config: Config) -> Optional[ObjectiveFunction]:
+    """(reference: ObjectiveFunction::CreateObjectiveFunction,
+    src/objective/objective_function.cpp:20)"""
+    name = config.objective
+    if name == "none":
+        return None
+    if name not in _REGISTRY:
+        log.fatal("Unknown objective: %s", name)
+    return _REGISTRY[name](config)
+
+
+def weighted_percentile(values: np.ndarray, weights: Optional[np.ndarray],
+                        alpha: float) -> float:
+    """Weighted percentile matching the reference's PercentileFun /
+    WeightedPercentileFun (reference: src/objective/regression_objective.hpp:23-87)."""
+    n = len(values)
+    if n == 0:
+        return 0.0
+    if n <= 1:
+        return float(values[0])
+    order = np.argsort(values)
+    v = values[order]
+    if weights is None:
+        pos = alpha * (n - 1)
+        lo = int(np.floor(pos))
+        hi = min(lo + 1, n - 1)
+        frac = pos - lo
+        return float(v[lo] * (1 - frac) + v[hi] * frac)
+    w = weights[order].astype(np.float64)
+    cum = np.cumsum(w) - w[0]
+    total = float(np.sum(w))
+    threshold = alpha * (total - w[0])
+    idx = int(np.searchsorted(cum, threshold, side="right")) - 1
+    idx = max(0, min(idx, n - 2))
+    if cum[idx + 1] - cum[idx] > 0:
+        frac = (threshold - cum[idx]) / (cum[idx + 1] - cum[idx])
+    else:
+        frac = 0.0
+    return float(v[idx] * (1 - frac) + v[idx + 1] * frac)

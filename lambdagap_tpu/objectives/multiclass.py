@@ -1,0 +1,110 @@
+"""Multiclass objectives: softmax and one-vs-all.
+
+(reference: src/objective/multiclass_objective.hpp MulticlassSoftmax with the
+K/(K-1) hessian rescale factor, MulticlassOVA wrapping per-class BinaryLogloss.)
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..config import Config
+from ..utils import log
+from .base import K_EPSILON, ObjectiveFunction, register_objective
+from .binary import BinaryLogloss
+
+
+@register_objective
+class MulticlassSoftmax(ObjectiveFunction):
+    name = "multiclass"
+
+    def __init__(self, config: Config) -> None:
+        super().__init__(config)
+        self._num_class = config.num_class
+        if self._num_class < 2:
+            log.fatal("[multiclass]: num_class must be >= 2, got %d", self._num_class)
+        self.factor = self._num_class / (self._num_class - 1.0)
+
+    @property
+    def num_class(self) -> int:
+        return self._num_class
+
+    def init(self, metadata, num_data) -> None:
+        super().init(metadata, num_data)
+        y = self.label_np.astype(np.int32)
+        if np.any((y < 0) | (y >= self._num_class)):
+            log.fatal("[multiclass]: label must be in [0, num_class)")
+        self.label_int = jnp.asarray(y)
+        # class priors for init score (reference: multiclass_objective.hpp:56-76)
+        probs = np.zeros(self._num_class)
+        for k in range(self._num_class):
+            if self.weight_np is not None:
+                probs[k] = np.sum((y == k) * self.weight_np) / np.sum(self.weight_np)
+            else:
+                probs[k] = np.mean(y == k)
+        self.class_init_probs = probs
+
+    def get_gradients(self, scores):
+        """scores [K, N] -> softmax over K
+        (reference: multiclass_objective.hpp:85-130)."""
+        p = _softmax0(scores)
+        onehot = (jnp.arange(self._num_class)[:, None] == self.label_int[None, :])
+        grad = p - onehot.astype(p.dtype)
+        hess = self.factor * p * (1.0 - p)
+        if self.weight is not None:
+            grad = grad * self.weight[None, :]
+            hess = hess * self.weight[None, :]
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        if not self.config.boost_from_average:
+            return 0.0
+        return float(np.log(max(K_EPSILON, self.class_init_probs[class_id])))
+
+    def convert_output(self, scores):
+        return _softmax0(scores)
+
+
+def _softmax0(scores):
+    m = jnp.max(scores, axis=0, keepdims=True)
+    e = jnp.exp(scores - m)
+    return e / jnp.sum(e, axis=0, keepdims=True)
+
+
+@register_objective
+class MulticlassOVA(ObjectiveFunction):
+    """One-vs-all: K independent sigmoid classifiers
+    (reference: multiclass_objective.hpp:180-270)."""
+    name = "multiclassova"
+
+    def __init__(self, config: Config) -> None:
+        super().__init__(config)
+        self._num_class = config.num_class
+        self.sigmoid = config.sigmoid
+        self.binary = [BinaryLogloss(config) for _ in range(self._num_class)]
+
+    @property
+    def num_class(self) -> int:
+        return self._num_class
+
+    def init(self, metadata, num_data) -> None:
+        super().init(metadata, num_data)
+        from ..data.dataset import Metadata as MD
+        y = self.label_np.astype(np.int32)
+        for k in range(self._num_class):
+            md_k = MD(label=(y == k).astype(np.float32), weight=self.weight_np)
+            self.binary[k].init(md_k, num_data)
+
+    def get_gradients(self, scores):
+        grads, hesses = [], []
+        for k in range(self._num_class):
+            g, h = self.binary[k].get_gradients(scores[k][None, :])
+            grads.append(g[0])
+            hesses.append(h[0])
+        return jnp.stack(grads), jnp.stack(hesses)
+
+    def boost_from_score(self, class_id: int) -> float:
+        return self.binary[class_id].boost_from_score(0)
+
+    def convert_output(self, scores):
+        return 1.0 / (1.0 + jnp.exp(-self.sigmoid * scores))

@@ -1,0 +1,85 @@
+"""Cross-entropy objectives for probabilistic labels in [0, 1].
+
+(reference: src/objective/xentropy_objective.hpp:316 — CrossEntropy and
+CrossEntropyLambda, the weight-as-Bernoulli-trials variant.)
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..config import Config
+from ..utils import log
+from .base import K_EPSILON, ObjectiveFunction, register_objective
+
+
+@register_objective
+class CrossEntropy(ObjectiveFunction):
+    """(reference: xentropy_objective.hpp:30-160 CrossEntropy)"""
+    name = "cross_entropy"
+
+    def init(self, metadata, num_data) -> None:
+        super().init(metadata, num_data)
+        if np.any((self.label_np < 0) | (self.label_np > 1)):
+            log.fatal("[cross_entropy]: labels must be in [0, 1]")
+
+    def get_gradients(self, scores):
+        p = 1.0 / (1.0 + jnp.exp(-scores))
+        grad = p - self.label[None, :]
+        hess = p * (1.0 - p)
+        if self.weight is not None:
+            grad = grad * self.weight[None, :]
+            hess = hess * self.weight[None, :]
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        if self.weight_np is not None:
+            pavg = float(np.sum(self.label_np * self.weight_np)
+                         / max(np.sum(self.weight_np), K_EPSILON))
+        else:
+            pavg = float(np.mean(self.label_np))
+        pavg = min(max(pavg, K_EPSILON), 1.0 - K_EPSILON)
+        return float(np.log(pavg / (1.0 - pavg)))
+
+    def convert_output(self, scores):
+        return 1.0 / (1.0 + jnp.exp(-scores))
+
+
+@register_objective
+class CrossEntropyLambda(ObjectiveFunction):
+    """(reference: xentropy_objective.hpp:165-310 CrossEntropyLambda):
+    weights act as Bernoulli trial counts via z = 1 - exp(-w*log1p(exp(s)))."""
+    name = "cross_entropy_lambda"
+
+    def init(self, metadata, num_data) -> None:
+        super().init(metadata, num_data)
+        if np.any((self.label_np < 0) | (self.label_np > 1)):
+            log.fatal("[cross_entropy_lambda]: labels must be in [0, 1]")
+
+    def get_gradients(self, scores):
+        y = self.label[None, :]
+        if self.weight is None:
+            z = 1.0 / (1.0 + jnp.exp(-scores))
+            grad = z - y
+            hess = z * (1.0 - z)
+        else:
+            w = self.weight[None, :]
+            epf = jnp.exp(scores)
+            enf = 1.0 / epf
+            hhat = jnp.log1p(epf)
+            z = 1.0 - jnp.exp(-w * hhat)
+            grad = (1.0 - y / jnp.maximum(z, K_EPSILON)) * w / (1.0 + enf)
+            c = 1.0 / (1.0 - jnp.maximum(z, K_EPSILON))
+            b = 1.0 - c * enf * (z - w * hhat * (1.0 - z))
+            b = b / jnp.maximum(z * z, K_EPSILON)
+            a = w * epf / ((1.0 + epf) * (1.0 + epf))
+            hess = a * (1.0 + y * b)
+        return grad, hess
+
+    def boost_from_score(self, class_id: int) -> float:
+        pavg = float(np.mean(self.label_np))
+        pavg = min(max(pavg, K_EPSILON), 1.0 - K_EPSILON)
+        return float(np.log(pavg / (1.0 - pavg)))
+
+    def convert_output(self, scores):
+        return jnp.log1p(jnp.exp(scores))

@@ -1,0 +1,82 @@
+"""Leaf data partition.
+
+TPU analog of ``DataPartition`` (reference:
+src/treelearner/data_partition.hpp:21-123): a permutation array of row indices
+grouped by leaf plus per-leaf (begin, count). Splitting a leaf stably
+partitions its index slice. The reference CPU uses a parallel two-way stable
+partition; the CUDA learner uses bit-vector + prefix sums
+(reference: src/treelearner/cuda/cuda_data_partition.hpp:106-139). Here the
+stable partition is a key sort over the padded slice (O(P log P) but fully
+vectorized on the VPU), followed by an in-range scatter back into the
+permutation array.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .split import MT_NAN, MT_ZERO
+
+
+def decision_go_left(bin_vals: jax.Array, threshold: jax.Array,
+                     default_left: jax.Array, default_bin: jax.Array,
+                     missing_type: jax.Array, num_bin: jax.Array,
+                     is_categorical: jax.Array, cat_bitset: jax.Array) -> jax.Array:
+    """Routing decision for a batch of bin values of one feature.
+
+    Mirrors the train-time split semantics of the reference's Bin::Split
+    (reference: src/io/dense_bin.hpp Split / tree.h Decision): numerical goes
+    left iff ``bin <= threshold``; rows in the missing bin follow
+    ``default_left``; categorical goes left iff its bin is in the bitset.
+    """
+    b = bin_vals.astype(jnp.int32)
+    is_missing = jnp.where(
+        missing_type == MT_ZERO, b == default_bin,
+        jnp.where(missing_type == MT_NAN, b == num_bin - 1, False))
+    num_left = jnp.where(is_missing, default_left, b <= threshold)
+    word = jnp.clip(b // 32, 0, cat_bitset.shape[0] - 1)
+    bit = jnp.right_shift(cat_bitset[word], (b % 32).astype(jnp.uint32)) & 1
+    cat_left = bit == 1
+    return jnp.where(is_categorical, cat_left, num_left)
+
+
+@functools.partial(jax.jit, static_argnames=("padded_size",))
+def split_partition(x_binned: jax.Array, perm: jax.Array,
+                    begin: jax.Array, count: jax.Array,
+                    feature: jax.Array, threshold: jax.Array,
+                    default_left: jax.Array, default_bin: jax.Array,
+                    missing_type: jax.Array, num_bin: jax.Array,
+                    is_categorical: jax.Array, cat_bitset: jax.Array,
+                    padded_size: int):
+    """Stably partition one leaf's slice of the permutation array.
+
+    Returns ``(new_perm, left_count)``. Rows with ``go_left`` keep their
+    relative order at the front of the slice, the rest follow — matching the
+    reference's stable two-way partition (data_partition.hpp:100-123) so that
+    ordered-gradient gathers stay deterministic.
+    """
+    N = perm.shape[0]
+    lane = jnp.arange(padded_size, dtype=jnp.int32)
+    idx = begin + lane
+    safe_idx = jnp.clip(idx, 0, N - 1)
+    rows = perm[safe_idx]
+    valid = lane < count
+
+    bin_vals = x_binned[rows, feature]
+    go_left = decision_go_left(bin_vals, threshold, default_left, default_bin,
+                               missing_type, num_bin, is_categorical, cat_bitset)
+    go_left = go_left & valid
+
+    # stable 3-way key: valid&left -> 0, valid&right -> 1, padding -> 2;
+    # combined with the lane index so one int32 sort is stable
+    key = jnp.where(go_left, 0, jnp.where(valid, 1, 2)).astype(jnp.int32)
+    order = jnp.argsort(key * padded_size + lane)
+    new_slice = rows[order]
+
+    left_count = jnp.sum(go_left, dtype=jnp.int32)
+    # scatter back; out-of-range lanes dropped, padding lanes rewrite their
+    # original values (they sort after all valid lanes, preserving order)
+    new_perm = perm.at[idx].set(new_slice, mode="drop")
+    return new_perm, left_count

@@ -1,0 +1,194 @@
+"""Batched tree traversal on device.
+
+TPU analog of the reference's prediction paths: per-row inline traversal
+(reference: include/LightGBM/tree.h:130-141 Predict/NumericalDecision) and the
+binned-data traversal used for validation-score updates
+(reference: tree.h AddPredictionToScore over the train/valid Dataset).
+
+Trees are stacked into padded arrays and traversed with a bounded
+``fori_loop`` (leaf-wise trees record their true max depth at build time);
+rows are vectorized with ``vmap`` so the whole batch advances one level per
+iteration — the same shape as the CUDA tree-predict kernel
+(reference: src/io/cuda/cuda_tree.cu).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+K_ZERO_THRESHOLD = 1e-35
+MT_NONE, MT_ZERO, MT_NAN = 0, 1, 2
+
+
+class TreeArrays(NamedTuple):
+    """One tree in device-friendly form. M = padded internal-node count."""
+    split_feature: jax.Array   # i32 [M] — feature index (original or inner)
+    threshold: jax.Array       # f32 [M] raw threshold (numerical)
+    threshold_bin: jax.Array   # i32 [M] bin threshold (numerical, binned data)
+    default_left: jax.Array    # bool [M]
+    missing_type: jax.Array    # i32 [M]
+    default_bin: jax.Array     # i32 [M] (binned decisions, Zero-missing)
+    num_bin: jax.Array         # i32 [M] (binned decisions, NaN-missing)
+    left_child: jax.Array      # i32 [M]
+    right_child: jax.Array     # i32 [M]
+    is_categorical: jax.Array  # bool [M]
+    cat_bitset: jax.Array      # u32 [M, 8] bin-space bitset
+    cat_bitset_real: jax.Array  # u32 [M, 8] raw-category bitset
+    leaf_value: jax.Array      # f32 [L]
+
+
+def tree_to_arrays(tree, feature_meta=None, use_inner_feature: bool = False,
+                   pad_nodes: int = 0) -> TreeArrays:
+    """Stack a host Tree into TreeArrays.
+
+    feature_meta: dict from BinnedDataset.feature_arrays() — required for
+    binned traversal (default_bin / num_bin per node's feature).
+    """
+    n = max(tree.num_internal, 1)
+    M = max(n, pad_nodes)
+
+    def pad_i(vals, fill=0, dtype=np.int32):
+        a = np.full(M, fill, dtype=dtype)
+        a[:len(vals)] = vals
+        return jnp.asarray(a)
+
+    def pad_f(vals, fill=0.0):
+        a = np.full(M, fill, dtype=np.float32)
+        a[:len(vals)] = vals
+        return jnp.asarray(a)
+
+    feats = tree.split_feature_inner if use_inner_feature else tree.split_feature
+    if tree.num_internal == 0:
+        # degenerate single-leaf tree: both children point at leaf 0
+        left = [~0]
+        right = [~0]
+        feats = [0]
+    else:
+        left = tree.left_child
+        right = tree.right_child
+
+    default_bin = np.zeros(M, dtype=np.int32)
+    num_bin = np.zeros(M, dtype=np.int32)
+    if feature_meta is not None:
+        fi = np.asarray(tree.split_feature_inner[:tree.num_internal], dtype=np.int64)
+        if len(fi):
+            default_bin[:len(fi)] = feature_meta["default_bins"][fi]
+            num_bin[:len(fi)] = feature_meta["num_bins"][fi]
+
+    bits = np.zeros((M, 8), dtype=np.uint32)
+    bits_real = np.zeros((M, 8), dtype=np.uint32)
+    for i in range(tree.num_internal):
+        bits[i] = tree.cat_bitset[i]
+        bits_real[i] = tree.cat_bitset_real[i][:8] if len(tree.cat_bitset_real[i]) >= 8 \
+            else np.pad(tree.cat_bitset_real[i], (0, 8 - len(tree.cat_bitset_real[i])))
+
+    L = max(tree.num_leaves, 1)
+    return TreeArrays(
+        split_feature=pad_i(feats[:max(tree.num_internal, 1)]),
+        threshold=pad_f(tree.threshold_real),
+        threshold_bin=pad_i(tree.threshold_bin),
+        default_left=pad_i(tree.default_left, dtype=bool),
+        missing_type=pad_i(tree.missing_type),
+        default_bin=jnp.asarray(default_bin),
+        num_bin=jnp.asarray(num_bin),
+        left_child=pad_i(left, fill=~0),
+        right_child=pad_i(right, fill=~0),
+        is_categorical=pad_i(tree.is_categorical, dtype=bool),
+        cat_bitset=jnp.asarray(bits),
+        cat_bitset_real=jnp.asarray(bits_real),
+        leaf_value=jnp.asarray(tree.leaf_value[:L], dtype=jnp.float32),
+    )
+
+
+def _cat_go_left(cat: jax.Array, bitset_row: jax.Array) -> jax.Array:
+    inb = (cat >= 0) & (cat < bitset_row.shape[-1] * 32)
+    safe = jnp.clip(cat, 0, bitset_row.shape[-1] * 32 - 1)
+    word = safe // 32
+    bit = (bitset_row[word] >> (safe % 32).astype(jnp.uint32)) & jnp.uint32(1)
+    return inb & (bit == jnp.uint32(1))
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_tree_raw(x: jax.Array, t: TreeArrays, max_depth: int) -> jax.Array:
+    """Predict one tree on raw float features [N, D] -> [N] leaf values."""
+
+    def traverse(row):
+        def body(_, node):
+            def step(n):
+                f = t.split_feature[n]
+                v = row[f]
+                nan = jnp.isnan(v)
+                mt = t.missing_type[n]
+                # NaN converted to 0 unless NaN-missing
+                # (reference: tree.h NumericalDecision)
+                v0 = jnp.where(nan & (mt != MT_NAN), 0.0, v)
+                missing = ((mt == MT_NAN) & nan) | \
+                          ((mt == MT_ZERO) & (jnp.abs(v0) <= K_ZERO_THRESHOLD))
+                go_num = jnp.where(missing, t.default_left[n], v0 <= t.threshold[n])
+                cat = jnp.where(nan, -1, v).astype(jnp.int32)
+                go_cat = _cat_go_left(cat, t.cat_bitset_real[n])
+                go = jnp.where(t.is_categorical[n], go_cat, go_num)
+                return jnp.where(go, t.left_child[n], t.right_child[n])
+            return jnp.where(node < 0, node, step(jnp.maximum(node, 0)))
+
+        node = lax.fori_loop(0, max_depth, body, jnp.int32(0))
+        return t.leaf_value[~node]
+
+    return jax.vmap(traverse)(x)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_tree_binned(x_binned: jax.Array, t: TreeArrays,
+                        max_depth: int) -> jax.Array:
+    """Predict one tree on the binned matrix [N, F] (train/valid data).
+    Exactly mirrors train-time routing (ops.partition.decision_go_left)."""
+
+    def traverse(row):
+        def body(_, node):
+            def step(n):
+                f = t.split_feature[n]
+                b = row[f].astype(jnp.int32)
+                mt = t.missing_type[n]
+                missing = ((mt == MT_ZERO) & (b == t.default_bin[n])) | \
+                          ((mt == MT_NAN) & (b == t.num_bin[n] - 1))
+                go_num = jnp.where(missing, t.default_left[n],
+                                   b <= t.threshold_bin[n])
+                go_cat = _cat_go_left(b, t.cat_bitset[n])
+                go = jnp.where(t.is_categorical[n], go_cat, go_num)
+                return jnp.where(go, t.left_child[n], t.right_child[n])
+            return jnp.where(node < 0, node, step(jnp.maximum(node, 0)))
+
+        node = lax.fori_loop(0, max_depth, body, jnp.int32(0))
+        return t.leaf_value[~node]
+
+    return jax.vmap(traverse)(x_binned)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "output_leaf"))
+def predict_leaf_index_binned(x_binned: jax.Array, t: TreeArrays,
+                              max_depth: int, output_leaf: bool = True) -> jax.Array:
+    """Leaf index per row (for refit / predict_leaf_index)."""
+
+    def traverse(row):
+        def body(_, node):
+            def step(n):
+                f = t.split_feature[n]
+                b = row[f].astype(jnp.int32)
+                mt = t.missing_type[n]
+                missing = ((mt == MT_ZERO) & (b == t.default_bin[n])) | \
+                          ((mt == MT_NAN) & (b == t.num_bin[n] - 1))
+                go_num = jnp.where(missing, t.default_left[n],
+                                   b <= t.threshold_bin[n])
+                go_cat = _cat_go_left(b, t.cat_bitset[n])
+                go = jnp.where(t.is_categorical[n], go_cat, go_num)
+                return jnp.where(go, t.left_child[n], t.right_child[n])
+            return jnp.where(node < 0, node, step(jnp.maximum(node, 0)))
+
+        return ~lax.fori_loop(0, max_depth, body, jnp.int32(0))
+
+    return jax.vmap(traverse)(x_binned)

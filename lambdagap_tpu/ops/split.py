@@ -1,0 +1,373 @@
+"""Best-split search over histograms.
+
+Vectorized TPU re-implementation of the reference's per-feature threshold scan
+(reference: src/treelearner/feature_histogram.hpp:396-441 dispatch,
+:828-1058 FindBestThresholdSequentially) and the split gain / leaf output math
+(:711-830 ThresholdL1 / CalculateSplittedLeafOutput / GetLeafGain /
+GetSplitGains). Instead of a sequential two-direction loop per feature, both
+missing-direction scans are computed for every (feature, bin) at once with
+cumulative sums, followed by one flat argmax — the same shape as the CUDA
+best-split kernel (reference: src/treelearner/cuda/cuda_best_split_finder.cu:129)
+but expressed as XLA ops.
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+K_EPSILON = 1e-15
+K_MIN_SCORE = -jnp.inf
+
+# missing-type codes (match data.dataset.feature_arrays)
+MT_NONE, MT_ZERO, MT_NAN = 0, 1, 2
+
+
+@dataclass(frozen=True)
+class SplitParams:
+    """Static hyperparameters entering gain math; hashable so jitted scans
+    specialize on them (they are fixed for a whole training run)."""
+    lambda_l1: float = 0.0
+    lambda_l2: float = 0.0
+    max_delta_step: float = 0.0
+    path_smooth: float = 0.0
+    min_data_in_leaf: int = 20
+    min_sum_hessian_in_leaf: float = 1e-3
+    min_gain_to_split: float = 0.0
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+    min_data_per_group: int = 100
+
+
+class SplitResult(NamedTuple):
+    """Device-resident best split for one leaf — the analog of ``SplitInfo``
+    (reference: src/treelearner/split_info.hpp)."""
+    gain: jax.Array            # f32, -inf when unsplittable
+    feature: jax.Array         # i32 (index into used features)
+    threshold: jax.Array       # i32 bin threshold (left: bin <= threshold)
+    default_left: jax.Array    # bool
+    left_sum_g: jax.Array
+    left_sum_h: jax.Array
+    left_count: jax.Array      # f32 (exact, from count channel)
+    right_sum_g: jax.Array
+    right_sum_h: jax.Array
+    right_count: jax.Array
+    left_output: jax.Array
+    right_output: jax.Array
+    is_categorical: jax.Array  # bool
+    cat_bitset: jax.Array      # u32 [8] — bins going LEFT for categorical splits
+
+
+def threshold_l1(s, l1):
+    """(reference: feature_histogram.hpp:711 ThresholdL1)"""
+    reg = jnp.maximum(0.0, jnp.abs(s) - l1)
+    return jnp.sign(s) * reg
+
+
+def calculate_leaf_output(sum_g, sum_h, p: SplitParams, num_data=None,
+                          parent_output=0.0, l2_extra=0.0):
+    """(reference: feature_histogram.hpp:716-737 CalculateSplittedLeafOutput)"""
+    l2 = p.lambda_l2 + l2_extra
+    if p.lambda_l1 > 0:
+        ret = -threshold_l1(sum_g, p.lambda_l1) / (sum_h + l2)
+    else:
+        ret = -sum_g / (sum_h + l2)
+    if p.max_delta_step > 0:
+        ret = jnp.clip(ret, -p.max_delta_step, p.max_delta_step)
+    if p.path_smooth > K_EPSILON and num_data is not None:
+        n_over_s = num_data / p.path_smooth
+        ret = ret * n_over_s / (n_over_s + 1.0) + parent_output / (n_over_s + 1.0)
+    return ret
+
+
+def leaf_gain_given_output(sum_g, sum_h, output, p: SplitParams, l2_extra=0.0):
+    """(reference: feature_histogram.hpp:818-830 GetLeafGainGivenOutput)"""
+    l2 = p.lambda_l2 + l2_extra
+    sg = threshold_l1(sum_g, p.lambda_l1) if p.lambda_l1 > 0 else sum_g
+    return -(2.0 * sg * output + (sum_h + l2) * output * output)
+
+
+def leaf_gain(sum_g, sum_h, p: SplitParams, num_data=None, parent_output=0.0,
+              l2_extra=0.0):
+    """(reference: feature_histogram.hpp:800-816 GetLeafGain)"""
+    if p.max_delta_step <= 0 and p.path_smooth <= K_EPSILON and l2_extra == 0.0:
+        sg = threshold_l1(sum_g, p.lambda_l1) if p.lambda_l1 > 0 else sum_g
+        return (sg * sg) / (sum_h + p.lambda_l2)
+    out = calculate_leaf_output(sum_g, sum_h, p, num_data, parent_output, l2_extra)
+    return leaf_gain_given_output(sum_g, sum_h, out, p, l2_extra)
+
+
+def split_gains(lg, lh, rg, rh, p: SplitParams, l_cnt=None, r_cnt=None,
+                parent_output=0.0, l2_extra=0.0):
+    """(reference: feature_histogram.hpp:759-797 GetSplitGains, no monotone)"""
+    return (leaf_gain(lg, lh, p, l_cnt, parent_output, l2_extra)
+            + leaf_gain(rg, rh, p, r_cnt, parent_output, l2_extra))
+
+
+# ---------------------------------------------------------------------------
+# numerical scan
+# ---------------------------------------------------------------------------
+
+def _numerical_best(hist, parent_g, parent_h, parent_c, parent_output,
+                    num_bins, default_bins, missing_types, feature_mask,
+                    p: SplitParams):
+    """Both-direction scan for all features at once.
+
+    Returns per-feature best: (gain[F], threshold[F], default_left[F],
+    left_g[F], left_h[F], left_c[F]).
+    """
+    F, B, _ = hist.shape
+    g = hist[:, :, 0].astype(jnp.float32)
+    h = hist[:, :, 1].astype(jnp.float32)
+    c = hist[:, :, 2].astype(jnp.float32)
+    bin_idx = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
+    nb = num_bins[:, None]                                     # [F, 1]
+    is_zero_missing = (missing_types == MT_ZERO)[:, None]
+    is_nan_missing = (missing_types == MT_NAN)[:, None]
+    is_default = bin_idx == default_bins[:, None]
+    is_nan_bin = bin_idx == (nb - 1)
+
+    # Forward scan: missing -> right (default_left=False). The missing bin's
+    # content is excluded from the left accumulation so it lands on the right
+    # via right = parent - left (reference: SKIP_DEFAULT_BIN / NA_AS_MISSING
+    # template args of FindBestThresholdSequentially).
+    excl_fwd = (is_zero_missing & is_default) | (is_nan_missing & is_nan_bin)
+    gf = jnp.where(excl_fwd, 0.0, g)
+    hf = jnp.where(excl_fwd, 0.0, h)
+    cf = jnp.where(excl_fwd, 0.0, c)
+    lg_f = jnp.cumsum(gf, axis=1)
+    lh_f = jnp.cumsum(hf, axis=1)
+    lc_f = jnp.cumsum(cf, axis=1)
+
+    # Reverse scan: missing -> left (default_left=True). Excluded missing bins
+    # stay on the left via left = parent - right.
+    excl_rev = excl_fwd
+    gr = jnp.where(excl_rev, 0.0, g)
+    hr = jnp.where(excl_rev, 0.0, h)
+    cr = jnp.where(excl_rev, 0.0, c)
+    # right sums for threshold t = sum of bins > t
+    rg_r = jnp.cumsum(gr[:, ::-1], axis=1)[:, ::-1] - gr
+    rh_r = jnp.cumsum(hr[:, ::-1], axis=1)[:, ::-1] - hr
+    rc_r = jnp.cumsum(cr[:, ::-1], axis=1)[:, ::-1] - cr
+
+    def eval_dir(left_g, left_h, left_c):
+        right_g = parent_g - left_g
+        right_h = parent_h - left_h
+        right_c = parent_c - left_c
+        ok = ((left_c >= p.min_data_in_leaf) & (right_c >= p.min_data_in_leaf)
+              & (left_h >= p.min_sum_hessian_in_leaf)
+              & (right_h >= p.min_sum_hessian_in_leaf))
+        gain = split_gains(left_g, left_h, right_g, right_h, p,
+                           left_c, right_c, parent_output)
+        return jnp.where(ok, gain, K_MIN_SCORE), right_g, right_h, right_c
+
+    gain_f, _, _, _ = eval_dir(lg_f, lh_f, lc_f)
+    lg_r = parent_g - rg_r
+    lh_r = parent_h - rh_r
+    lc_r = parent_c - rc_r
+    gain_r, _, _, _ = eval_dir(lg_r, lh_r, lc_r)
+
+    # valid threshold candidates: t in [0, num_bin-2]; Zero-missing skips the
+    # default bin as a candidate (it would make train/predict placement of
+    # zeros inconsistent); the reverse scan with NaN-missing cannot place the
+    # NaN bin alone on the right (it must stay left), so t = num_bin-2 is
+    # excluded there (reference: reverse loop starts at num_bin-2-NA_AS_MISSING).
+    cand = (bin_idx < nb - 1) & (feature_mask[:, None])
+    cand_f = cand & ~(is_zero_missing & is_default)
+    cand_r = cand_f & ~(is_nan_missing & (bin_idx == nb - 2))
+    gain_f = jnp.where(cand_f, gain_f, K_MIN_SCORE)
+    gain_r = jnp.where(cand_r, gain_r, K_MIN_SCORE)
+
+    # pick direction per (f, b): reverse wins ties (matches reference running
+    # REVERSE first and requiring strict improvement)
+    use_fwd = gain_f > gain_r
+    gain = jnp.maximum(gain_f, gain_r)
+    left_g = jnp.where(use_fwd, lg_f, lg_r)
+    left_h = jnp.where(use_fwd, lh_f, lh_r)
+    left_c = jnp.where(use_fwd, lc_f, lc_r)
+    default_left = ~use_fwd
+
+    best_t = jnp.argmax(gain, axis=1).astype(jnp.int32)        # [F]
+    take = lambda a: jnp.take_along_axis(a, best_t[:, None], axis=1)[:, 0]
+    return (take(gain), best_t, take(default_left),
+            take(left_g), take(left_h), take(left_c))
+
+
+# ---------------------------------------------------------------------------
+# categorical scan (one-hot + sorted-subset)
+# ---------------------------------------------------------------------------
+
+def _categorical_best(hist, parent_g, parent_h, parent_c, parent_output,
+                      num_bins, feature_mask, p: SplitParams):
+    """Categorical split search
+    (reference: feature_histogram.hpp FindBestThresholdCategoricalInner):
+    one-vs-rest for small cardinality, otherwise scan prefixes of bins sorted
+    by grad/(hess+cat_smooth), both directions, capped at max_cat_threshold.
+
+    Returns per-feature best plus a bitset of bins going left.
+    """
+    F, B, _ = hist.shape
+    g = hist[:, :, 0].astype(jnp.float32)
+    h = hist[:, :, 1].astype(jnp.float32)
+    c = hist[:, :, 2].astype(jnp.float32)
+    bin_idx = jnp.arange(B, dtype=jnp.int32)[None, :]
+    nb = num_bins[:, None]
+    valid_bin = (bin_idx < nb) & (c > 0)
+
+    l2 = p.lambda_l2 + p.cat_l2
+
+    def gains_for(left_g, left_h, left_c):
+        right_g = parent_g - left_g
+        right_h = parent_h - left_h
+        right_c = parent_c - left_c
+        ok = ((left_c >= p.min_data_in_leaf) & (right_c >= p.min_data_in_leaf)
+              & (left_h >= p.min_sum_hessian_in_leaf)
+              & (right_h >= p.min_sum_hessian_in_leaf))
+        gain = split_gains(left_g, left_h, right_g, right_h, p,
+                           left_c, right_c, parent_output, l2_extra=p.cat_l2)
+        return jnp.where(ok, gain, K_MIN_SCORE)
+
+    # --- one-vs-rest: category k alone goes left --------------------------
+    onehot_gain = jnp.where(valid_bin & feature_mask[:, None],
+                            gains_for(g, h, c), K_MIN_SCORE)
+
+    # --- sorted-subset: order bins by g/(h + cat_smooth) ------------------
+    score = g / (h + p.cat_smooth)
+    score = jnp.where(valid_bin, score, jnp.inf)
+    order = jnp.argsort(score, axis=1)                          # [F, B]
+    g_s = jnp.take_along_axis(g, order, axis=1)
+    h_s = jnp.take_along_axis(h, order, axis=1)
+    c_s = jnp.take_along_axis(c, order, axis=1)
+    v_s = jnp.take_along_axis(valid_bin, order, axis=1)
+    g_s = jnp.where(v_s, g_s, 0.0)
+    h_s = jnp.where(v_s, h_s, 0.0)
+    c_s = jnp.where(v_s, c_s, 0.0)
+    csum_g = jnp.cumsum(g_s, axis=1)
+    csum_h = jnp.cumsum(h_s, axis=1)
+    csum_c = jnp.cumsum(c_s, axis=1)
+    prefix_len = jnp.cumsum(v_s.astype(jnp.int32), axis=1)
+    cap_ok = prefix_len <= p.max_cat_threshold
+    sorted_gain = jnp.where(cap_ok & v_s & feature_mask[:, None],
+                            gains_for(csum_g, csum_h, csum_c), K_MIN_SCORE)
+
+    # choose between strategies per feature
+    best_onehot = jnp.max(onehot_gain, axis=1)
+    t_onehot = jnp.argmax(onehot_gain, axis=1).astype(jnp.int32)
+    best_sorted = jnp.max(sorted_gain, axis=1)
+    t_sorted = jnp.argmax(sorted_gain, axis=1).astype(jnp.int32)
+
+    small = num_bins <= p.max_cat_to_onehot
+    use_onehot = small | (best_onehot >= best_sorted)
+    gain = jnp.where(use_onehot, best_onehot, best_sorted)
+
+    # bitsets of bins going left (u32 words)
+    words = jnp.arange(8, dtype=jnp.uint32)[None, :]
+    def onehot_bits(t):
+        w = (t // 32).astype(jnp.uint32)
+        bit = jnp.left_shift(jnp.uint32(1), (t % 32).astype(jnp.uint32))
+        return jnp.where(words == w[:, None], bit[:, None], jnp.uint32(0))
+    in_prefix = (jnp.cumsum(jnp.ones_like(order), axis=1) - 1) <= t_sorted[:, None]
+    member = _scatter_rows(order, in_prefix & v_s)
+    sorted_bits = _bins_to_bitset(member)
+    bits = jnp.where(use_onehot[:, None], onehot_bits(t_onehot), sorted_bits)
+
+    take_left = lambda csA, t: jnp.take_along_axis(csA, t[:, None], axis=1)[:, 0]
+    left_g = jnp.where(use_onehot, take_left(g, t_onehot), take_left(csum_g, t_sorted))
+    left_h = jnp.where(use_onehot, take_left(h, t_onehot), take_left(csum_h, t_sorted))
+    left_c = jnp.where(use_onehot, take_left(c, t_onehot), take_left(csum_c, t_sorted))
+    threshold = jnp.where(use_onehot, t_onehot, t_sorted)
+    return gain, threshold, left_g, left_h, left_c, bits
+
+
+def _scatter_rows(order, values):
+    """out[f, order[f, b]] = values[f, b] via the inverse-permutation gather."""
+    inv = jnp.argsort(order, axis=1)
+    return jnp.take_along_axis(values, inv, axis=1)
+
+
+def _bins_to_bitset(member: jax.Array) -> jax.Array:
+    """bool [F, B] -> u32 [F, 8] bitset (B <= 256)."""
+    F, B = member.shape
+    pad = (-B) % 256
+    m = jnp.pad(member, ((0, 0), (0, pad))).reshape(F, 8, 32)
+    bits = jnp.left_shift(jnp.uint32(1), jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(jnp.where(m, bits, jnp.uint32(0)), axis=2, dtype=jnp.uint32)
+
+
+# ---------------------------------------------------------------------------
+# combined entry
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("params", "has_categorical"))
+def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
+                    parent_c: jax.Array, parent_output: jax.Array,
+                    num_bins: jax.Array, default_bins: jax.Array,
+                    missing_types: jax.Array, is_categorical: jax.Array,
+                    feature_mask: jax.Array, params: SplitParams,
+                    has_categorical: bool = False) -> SplitResult:
+    """Best split for one leaf over all features.
+
+    The analog of ``FindBestSplitsFromHistograms`` + per-leaf argmax
+    (reference: src/treelearner/serial_tree_learner.cpp:477+, :225).
+    """
+    p = params
+    F, B, _ = hist.shape
+
+    num_gain, num_t, num_dl, num_lg, num_lh, num_lc = _numerical_best(
+        hist, parent_g, parent_h, parent_c, parent_output,
+        num_bins, default_bins, missing_types,
+        feature_mask & ~is_categorical, p)
+
+    if has_categorical:
+        cat_gain, cat_t, cat_lg, cat_lh, cat_lc, cat_bits = _categorical_best(
+            hist, parent_g, parent_h, parent_c, parent_output,
+            num_bins, feature_mask & is_categorical, p)
+    else:
+        cat_gain = jnp.full((F,), K_MIN_SCORE)
+        cat_t = jnp.zeros((F,), jnp.int32)
+        cat_lg = cat_lh = cat_lc = jnp.zeros((F,))
+        cat_bits = jnp.zeros((F, 8), jnp.uint32)
+
+    use_cat = is_categorical
+    gain = jnp.where(use_cat, cat_gain, num_gain)
+    thr = jnp.where(use_cat, cat_t, num_t)
+    dl = jnp.where(use_cat, False, num_dl)
+    lg = jnp.where(use_cat, cat_lg, num_lg)
+    lh = jnp.where(use_cat, cat_lh, num_lh)
+    lc = jnp.where(use_cat, cat_lc, num_lc)
+
+    # parent gain shift (reference: BeforeNumerical gain_shift + min_gain_to_split)
+    parent_gain = leaf_gain(parent_g, parent_h, p, parent_c, parent_output)
+    shift = parent_gain + p.min_gain_to_split
+
+    best_f = jnp.argmax(gain, axis=0).astype(jnp.int32)
+    best_gain_raw = gain[best_f]
+    split_gain = best_gain_raw - shift
+
+    left_g = lg[best_f]
+    left_h = lh[best_f]
+    left_c = lc[best_f]
+    right_g = parent_g - left_g
+    right_h = parent_h - left_h
+    right_c = parent_c - left_c
+    num_data = parent_c
+    left_out = calculate_leaf_output(left_g, left_h, p, left_c, parent_output)
+    right_out = calculate_leaf_output(right_g, right_h, p, right_c, parent_output)
+
+    splittable = jnp.isfinite(best_gain_raw) & (split_gain > 0.0)
+    return SplitResult(
+        gain=jnp.where(splittable, split_gain, K_MIN_SCORE),
+        feature=best_f,
+        threshold=thr[best_f],
+        default_left=dl[best_f],
+        left_sum_g=left_g, left_sum_h=left_h, left_count=left_c,
+        right_sum_g=right_g, right_sum_h=right_h, right_count=right_c,
+        left_output=left_out, right_output=right_out,
+        is_categorical=use_cat[best_f],
+        cat_bitset=cat_bits[best_f],
+    )

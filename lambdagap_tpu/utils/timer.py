@@ -1,0 +1,48 @@
+"""Coarse per-phase wall-clock timers.
+
+TPU-native analog of the reference's ``Common::Timer global_timer`` +
+``FunctionTimer`` RAII (reference: include/LightGBM/utils/common.h:984-1068,
+compiled in with USE_TIMETAG). Here the equivalent fine-grained story is
+``jax.profiler`` traces; this module provides the same coarse per-phase table
+the reference prints at exit.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+_ENABLED = os.environ.get("LAMBDAGAP_TIMETAG", "0") not in ("0", "", "false")
+
+
+class Timer:
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def scope(self, name: str) -> Iterator[None]:
+        if not _ENABLED:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> str:
+        lines = ["LambdaGapTPU timers:"]
+        for name in sorted(self.totals, key=self.totals.get, reverse=True):
+            lines.append(f"  {name}: {self.totals[name]:.4f}s x{self.counts[name]}")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        self.totals.clear()
+        self.counts.clear()
+
+
+global_timer = Timer()
