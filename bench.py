@@ -1,0 +1,102 @@
+"""Benchmark: HIGGS-shaped GBDT training wall-clock on TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's published HIGGS train time — 500 iterations,
+num_leaves=255, max_bin=255, 10.5M rows x 28 features — 130.094 s on a
+28-thread dual-Xeon (reference: docs/Experiments.rst:111-124; BASELINE.md).
+The fork ships no CUDA numbers, so the published CPU number is the bar.
+
+To keep the bench bounded we train a slice of the full 500 iterations and
+project: steady-state time/iteration x 500 (+ measured dataset construction).
+Rows can be capped via env BENCH_ROWS (default full 10.5M).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 10_500_000))
+FEATURES = 28
+ITERS_MEASURED = int(os.environ.get("BENCH_ITERS", 30))
+ITERS_TOTAL = 500
+BASELINE_S = 130.094
+
+
+def make_higgs_like(n: int, d: int, seed: int = 7):
+    """Synthetic stand-in with HIGGS-like marginals (no network egress)."""
+    rng = np.random.RandomState(seed)
+    X = np.empty((n, d), dtype=np.float32)
+    block = 1 << 20
+    w = rng.randn(d).astype(np.float32)
+    y = np.empty(n, dtype=np.float32)
+    for lo in range(0, n, block):
+        hi = min(lo + block, n)
+        xb = rng.randn(hi - lo, d).astype(np.float32)
+        # heavy-tailed positive features like HIGGS' kinematics
+        xb[:, d // 2:] = np.abs(xb[:, d // 2:]) ** 1.3
+        X[lo:hi] = xb
+        logits = xb @ w * 0.7 + 0.5 * np.sin(xb[:, 0] * 2) + rng.randn(hi - lo)
+        y[lo:hi] = (logits > 0).astype(np.float32)
+    return X, y
+
+
+def main() -> None:
+    import lambdagap_tpu as lgb
+
+    t_gen0 = time.time()
+    X, y = make_higgs_like(ROWS, FEATURES)
+    t_gen = time.time() - t_gen0
+
+    params = {
+        "objective": "binary",
+        "metric": "auc",
+        "num_leaves": 255,
+        "learning_rate": 0.1,
+        "max_bin": 255,
+        "min_data_in_leaf": 100,
+        "verbose": -1,
+    }
+
+    t0 = time.time()
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.Booster(params=params, train_set=ds)
+    t_construct = time.time() - t0
+
+    # warmup (compilation) iterations, excluded from steady-state timing
+    t1 = time.time()
+    booster.update()
+    booster.update()
+    t_warm = time.time() - t1
+
+    t2 = time.time()
+    for _ in range(ITERS_MEASURED):
+        booster.update()
+    t_meas = time.time() - t2
+    per_iter = t_meas / ITERS_MEASURED
+
+    projected = t_construct + t_warm + per_iter * (ITERS_TOTAL - 2)
+    result = {
+        "metric": "higgs_500iter_train_wall_clock_projected",
+        "value": round(projected, 3),
+        "unit": "seconds",
+        "vs_baseline": round(BASELINE_S / projected, 4),
+        "detail": {
+            "rows": ROWS,
+            "construct_s": round(t_construct, 3),
+            "warmup_2iter_s": round(t_warm, 3),
+            "per_iter_s": round(per_iter, 4),
+            "iters_measured": ITERS_MEASURED,
+            "datagen_s": round(t_gen, 3),
+            "baseline": "reference CPU 130.094s (docs/Experiments.rst)",
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -6,11 +6,17 @@ extended LambdaGap ranking objective family, running its compute core as
 XLA/Pallas programs on TPU and its distributed learners over
 ``jax.sharding`` meshes.
 """
+from .basic import Booster, Dataset
+from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
 from .config import Config
 from .data import BinnedDataset, Metadata
+from .engine import CVBooster, cv, train
 from .models import GBDT, Tree
+from .utils.log import register_logger
 
 __version__ = "0.1.0"
 
-__all__ = ["Config", "BinnedDataset", "Metadata", "GBDT", "Tree",
-           "__version__"]
+__all__ = ["Booster", "Dataset", "Config", "BinnedDataset", "Metadata",
+           "GBDT", "Tree", "train", "cv", "CVBooster",
+           "early_stopping", "log_evaluation", "record_evaluation",
+           "reset_parameter", "register_logger", "__version__"]

@@ -1,0 +1,299 @@
+"""User-facing Dataset and Booster, mirroring the reference Python package.
+
+(reference: python-package/lightgbm/basic.py — ``Dataset`` lazy construction
+with reference alignment (:1744) and ``Booster`` (:3541) with ``update``
+(:4050). Here there is no ctypes/C-API hop: the Python objects wrap the
+framework's own classes directly.)
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .data.dataset import BinnedDataset
+from .models.gbdt import GBDT
+from .utils import log
+
+try:  # pandas optional
+    import pandas as pd
+    _PANDAS = True
+except ImportError:  # pragma: no cover
+    _PANDAS = False
+
+
+def _to_matrix(data) -> tuple:
+    """Accept numpy / pandas / list-of-lists; return (matrix, feature_names,
+    categorical_from_dtype)."""
+    feature_names = None
+    categorical = []
+    if _PANDAS and isinstance(data, pd.DataFrame):
+        feature_names = [str(c) for c in data.columns]
+        mat = np.empty(data.shape, dtype=np.float64)
+        for i, col in enumerate(data.columns):
+            s = data[col]
+            if isinstance(s.dtype, pd.CategoricalDtype):
+                mat[:, i] = s.cat.codes.to_numpy()
+                categorical.append(i)
+            else:
+                mat[:, i] = s.to_numpy(dtype=np.float64, na_value=np.nan)
+        return mat, feature_names, categorical
+    mat = np.asarray(data, dtype=np.float64)
+    return mat, feature_names, categorical
+
+
+class Dataset:
+    """Training data container with lazy construction
+    (reference: basic.py:1744 Dataset._lazy_init)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, position=None) -> None:
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.position = position
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self._constructed: Optional[BinnedDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def construct(self, config: Optional[Config] = None) -> BinnedDataset:
+        if self._constructed is not None:
+            return self._constructed
+        cfg = config or Config.from_params(self.params)
+        mat, auto_names, cat_from_dtype = _to_matrix(self.data)
+        names = None
+        if isinstance(self.feature_name, (list, tuple)):
+            names = [str(n) for n in self.feature_name]
+        elif auto_names is not None:
+            names = auto_names
+
+        categorical: List[int] = list(cat_from_dtype)
+        if isinstance(self.categorical_feature, (list, tuple)):
+            for c in self.categorical_feature:
+                if isinstance(c, str) and names and c in names:
+                    categorical.append(names.index(c))
+                elif isinstance(c, (int, np.integer)):
+                    categorical.append(int(c))
+
+        ref = self.reference.construct(config) if self.reference is not None else None
+        self._constructed = BinnedDataset.from_matrix(
+            mat, cfg, label=self.label, weight=self.weight, group=self.group,
+            init_score=self.init_score, position=self.position,
+            categorical_features=categorical, feature_names=names,
+            reference=ref)
+        if self.free_raw_data:
+            self.data = None
+        return self._constructed
+
+    # -- lightgbm-compatible setters -----------------------------------
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._constructed is not None:
+            self._constructed.metadata.label = np.asarray(label, np.float32).reshape(-1)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._constructed is not None and weight is not None:
+            self._constructed.metadata.weight = np.asarray(weight, np.float32).reshape(-1)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._constructed is not None:
+            self._constructed.metadata.set_group(
+                None if group is None else np.asarray(group))
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._constructed is not None and init_score is not None:
+            self._constructed.metadata.init_score = \
+                np.asarray(init_score, np.float64).reshape(-1)
+        return self
+
+    def set_position(self, position) -> "Dataset":
+        self.position = position
+        if self._constructed is not None and position is not None:
+            self._constructed.metadata.position = \
+                np.asarray(position, np.int32).reshape(-1)
+        return self
+
+    def get_label(self):
+        if self._constructed is not None:
+            return self._constructed.metadata.label
+        return self.label
+
+    def get_weight(self):
+        if self._constructed is not None:
+            return self._constructed.metadata.weight
+        return self.weight
+
+    def get_group(self):
+        if self._constructed is not None and \
+                self._constructed.metadata.query_boundaries is not None:
+            return np.diff(self._constructed.metadata.query_boundaries)
+        return self.group
+
+    def num_data(self) -> int:
+        if self._constructed is not None:
+            return self._constructed.num_data
+        mat, _, _ = _to_matrix(self.data)
+        return mat.shape[0]
+
+    def num_feature(self) -> int:
+        if self._constructed is not None:
+            return self._constructed.num_total_features
+        mat, _, _ = _to_matrix(self.data)
+        return mat.shape[1]
+
+    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+        """Row subset sharing this dataset's bin mappers (used by cv)."""
+        if self.data is None:
+            log.fatal("Cannot subset: raw data freed (set free_raw_data=False)")
+        idx = np.asarray(used_indices)
+        mat, _, _ = _to_matrix(self.data)
+        sub = Dataset(mat[idx],
+                      label=None if self.label is None else np.asarray(self.label)[idx],
+                      reference=self,
+                      weight=None if self.weight is None else np.asarray(self.weight)[idx],
+                      feature_name=self.feature_name,
+                      categorical_feature=self.categorical_feature,
+                      params=params or self.params,
+                      free_raw_data=self.free_raw_data)
+        sub.used_indices = idx
+        return sub
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None, position=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score, params=params,
+                       position=position,
+                       feature_name=self.feature_name,
+                       categorical_feature=self.categorical_feature)
+
+
+class Booster:
+    """Boosting model wrapper (reference: basic.py:3541 Booster)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None) -> None:
+        params = params or {}
+        self.params = params
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._valid_names: List[str] = []
+        self.train_set = train_set
+
+        if train_set is not None:
+            self.config = Config.from_params(params)
+            ds = train_set.construct(self.config)
+            from .models.dart import create_boosting
+            self._booster = create_boosting(self.config, ds)
+        elif model_file is not None:
+            self._booster = GBDT.from_model_file(model_file,
+                                                 Config.from_params(params))
+            self.config = self._booster.config
+        elif model_str is not None:
+            self._booster = GBDT.from_model_string(model_str,
+                                                   Config.from_params(params))
+            self.config = self._booster.config
+        else:
+            log.fatal("Booster needs train_set, model_file or model_str")
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        ds = data.construct(self.config)
+        self._booster.add_valid_set(ds, name)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration; returns True if training should stop
+        (reference: basic.py:4050 Booster.update)."""
+        if fobj is not None:
+            import jax.numpy as jnp
+            scores = self._booster.scores
+            K = self._booster.num_tree_per_iteration
+            raw = np.asarray(scores)
+            grad, hess = fobj(raw[0] if K == 1 else raw.T,
+                              self._booster.train_set)
+            grad = np.asarray(grad, np.float32).reshape(K, -1)
+            hess = np.asarray(hess, np.float32).reshape(K, -1)
+            return self._booster.train_one_iter(jnp.asarray(grad),
+                                                jnp.asarray(hess))
+        return self._booster.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self._booster.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self._booster.iter_
+
+    def num_trees(self) -> int:
+        return len(self._booster.models)
+
+    def eval_train(self):
+        return [("training", n, v, g) for (_, n, v, g)
+                in self._booster.eval_train()]
+
+    def eval_valid(self):
+        return self._booster.eval_valid()
+
+    def predict(self, data, raw_score: bool = False, start_iteration: int = 0,
+                num_iteration: int = -1, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        mat, _, _ = _to_matrix(data)
+        if pred_leaf:
+            return self._booster.predict_leaf(mat, start_iteration, num_iteration)
+        if pred_contrib:
+            return self._booster.predict_contrib(mat, start_iteration, num_iteration)
+        return self._booster.predict(mat, raw_score=raw_score,
+                                     start_iteration=start_iteration,
+                                     num_iteration=num_iteration)
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0, importance_type: str = "split"
+                   ) -> "Booster":
+        it = {"split": 0, "gain": 1}.get(importance_type, 0)
+        ni = -1 if num_iteration is None else num_iteration
+        self._booster.save_model(filename, start_iteration, ni, it)
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        it = {"split": 0, "gain": 1}.get(importance_type, 0)
+        ni = -1 if num_iteration is None else num_iteration
+        return self._booster.save_model_to_string(start_iteration, ni, it)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        from .models.model_text import feature_importance
+        it = {"split": 0, "gain": 1}.get(importance_type, 0)
+        return feature_importance(self._booster, it)
+
+    def feature_name(self) -> List[str]:
+        return self._booster.feature_names
+
+    def num_feature(self) -> int:
+        return len(self._booster.feature_names)
+
+    def num_model_per_iteration(self) -> int:
+        return self._booster.num_tree_per_iteration

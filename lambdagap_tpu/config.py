@@ -366,6 +366,7 @@ class Config:
     tpu_rows_per_block: int = 4096
     tpu_hist_impl: str = "auto"               # auto / onehot / scatter / pallas
     tpu_num_devices: int = 0                  # 0 = all visible devices
+    tpu_fused_learner: str = "auto"           # auto / 1 / 0: whole-tree-on-device
 
     # unknown/passthrough params preserved verbatim
     extra: Dict[str, Any] = field(default_factory=dict)

@@ -1,4 +1,4 @@
 from .base import Metric, create_metrics, metric_names_for, register_metric
-from . import regression, binary, multiclass, xentropy  # noqa: F401 — register
+from . import regression, binary, multiclass, xentropy, rank  # noqa: F401 — register
 
 __all__ = ["Metric", "create_metrics", "metric_names_for", "register_metric"]

@@ -46,7 +46,7 @@ def _weighted_auc(label: np.ndarray, score: np.ndarray,
     cfp = np.cumsum(wn)[boundary]
     tp = np.concatenate([[0.0], ctp])
     fp = np.concatenate([[0.0], cfp])
-    area = np.trapz(tp, fp)
+    area = np.trapezoid(tp, fp) if hasattr(np, "trapezoid") else np.trapz(tp, fp)
     return float(area / (pos * neg))
 
 

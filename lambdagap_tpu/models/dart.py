@@ -1,0 +1,194 @@
+"""DART and Random Forest boosting modes.
+
+(reference: src/boosting/dart.hpp:23 DART — MART with dropout-normalized tree
+weights; src/boosting/rf.hpp:25 RF — bagged trees with averaged outputs and
+one-time gradients.)
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..ops.predict import predict_tree_binned, tree_to_arrays
+from ..utils import log
+from .gbdt import GBDT, K_EPSILON, _round_depth
+from .tree import Tree
+
+
+class DART(GBDT):
+    """Dropout trees before each iteration, renormalize after
+    (reference: dart.hpp DroppingTrees :95-148, Normalize :149-200)."""
+
+    def __init__(self, config: Config, train_set) -> None:
+        super().__init__(config, train_set)
+        self.drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+
+    def _tree_score_delta(self, tree: Tree, factor: float, k: int, valid: bool,
+                          vi: int = 0):
+        """Add ``factor * tree`` to a score vector via binned traversal."""
+        arrs = tree_to_arrays(tree, feature_meta=self._meta, use_inner_feature=True)
+        arrs = arrs._replace(leaf_value=arrs.leaf_value * factor)
+        depth = _round_depth(tree.max_depth + 1)
+        if valid:
+            x = self.valid_binned[vi]
+            self.valid_scores[vi] = self.valid_scores[vi].at[k].add(
+                predict_tree_binned(x, arrs, depth))
+        else:
+            self.scores = self.scores.at[k].set(
+                self.scores[k] + predict_tree_binned(self.learner.x_binned,
+                                                     arrs, depth))
+
+    def _dropping_trees(self) -> List[int]:
+        cfg = self.config
+        drop_index: List[int] = []
+        if self.drop_rng.rand() >= cfg.skip_drop:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop and self.sum_weight > 0:
+                inv_avg = len(self.tree_weight) / self.sum_weight
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate,
+                                    cfg.max_drop * inv_avg / self.sum_weight)
+                for i in range(self.iter_):
+                    if self.drop_rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
+                        drop_index.append(i)
+                        if len(drop_index) >= cfg.max_drop > 0:
+                            break
+            else:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / max(self.iter_, 1))
+                for i in range(self.iter_):
+                    if self.drop_rng.rand() < drop_rate:
+                        drop_index.append(i)
+                        if len(drop_index) >= cfg.max_drop > 0:
+                            break
+        # subtract dropped trees from the training score
+        for i in drop_index:
+            for k in range(self.num_tree_per_iteration):
+                tree = self._tree(i * self.num_tree_per_iteration + k)
+                self._tree_score_delta(tree, -1.0, k, valid=False)
+        k_drop = len(drop_index)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k_drop)
+        else:
+            self.shrinkage_rate = (cfg.learning_rate if k_drop == 0 else
+                                   cfg.learning_rate / (cfg.learning_rate + k_drop))
+        return drop_index
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        drop_index = self._dropping_trees()
+        ret = super().train_one_iter(grad, hess)
+        if ret:
+            return ret
+        self._normalize(drop_index)
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False
+
+    def _normalize(self, drop_index: List[int]) -> None:
+        """Re-add dropped trees at weight k/(k+1)
+        (reference: dart.hpp:149-200 Normalize)."""
+        k = float(len(drop_index))
+        cfg = self.config
+        factor = (k / (k + 1.0) if not cfg.xgboost_dart_mode
+                  else k / (k + cfg.learning_rate))
+        for i in drop_index:
+            for kk in range(self.num_tree_per_iteration):
+                tree = self._tree(i * self.num_tree_per_iteration + kk)
+                # valid scores still contain the full old tree: adjust by
+                # (factor - 1); train scores had it fully removed: add factor
+                self._tree_score_delta(tree, factor, kk, valid=False)
+                for vi in range(len(self.valid_sets)):
+                    self._tree_score_delta(tree, factor - 1.0, kk,
+                                           valid=True, vi=vi)
+                tree.apply_shrinkage(factor)
+            if not cfg.uniform_drop and i < len(self.tree_weight):
+                self.sum_weight -= self.tree_weight[i] * (1.0 / (k + 1.0))
+                self.tree_weight[i] *= k / (k + 1.0)
+
+
+class RF(GBDT):
+    """Random forest: bagged trees, no shrinkage, averaged output
+    (reference: rf.hpp:25)."""
+
+    average_output = True
+
+    def __init__(self, config: Config, train_set) -> None:
+        if not (config.bagging_freq > 0 and 0 < config.bagging_fraction < 1) \
+                and not (0 < config.feature_fraction < 1):
+            log.fatal("RF needs bagging (bagging_freq > 0, bagging_fraction "
+                      "in (0,1)) or feature_fraction in (0,1)")
+        super().__init__(config, train_set)
+        self.shrinkage_rate = 1.0
+        # one-time gradients from the constant init score
+        # (reference: rf.hpp Boosting)
+        self.init_scores = [self.objective.boost_from_score(k)
+                            for k in range(self.num_tree_per_iteration)]
+        K, N = self.num_tree_per_iteration, self.num_data
+        const_scores = jnp.asarray(
+            np.tile(np.asarray(self.init_scores, np.float32)[:, None], (1, N)))
+        self._rf_grad, self._rf_hess = self.objective.get_gradients(const_scores)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        if self.objective is None:
+            log.fatal("RF mode does not support custom objective functions")
+        grad, hess, mask = self.sample_strategy.sample(
+            self.iter_, self._rf_grad, self._rf_hess)
+
+        should_continue = False
+        for k in range(self.num_tree_per_iteration):
+            tree = self.learner.train(grad[k], hess[k], row_mask=mask)
+            if tree.num_leaves > 1:
+                should_continue = True
+                if self.objective.is_renew_tree_output:
+                    self._renew_tree_output_rf(tree, k, mask)
+                if abs(self.init_scores[k]) > K_EPSILON:
+                    self._tree_add_bias(tree, self.init_scores[k], k)
+                # running average: score = (score * iter + tree) / (iter + 1)
+                # (reference: rf.hpp MultiplyScore sandwich)
+                it = self.iter_
+                self.scores = self.scores.at[k].set(self.scores[k] * it)
+                self._update_train_score(tree, k)
+                self.scores = self.scores.at[k].set(self.scores[k] / (it + 1))
+                for vi in range(len(self.valid_sets)):
+                    self.valid_scores[vi] = self.valid_scores[vi].at[k].set(
+                        self.valid_scores[vi][k] * it)
+                    self._add_valid_tree_score(vi, tree, k)
+                    self.valid_scores[vi] = self.valid_scores[vi].at[k].set(
+                        self.valid_scores[vi][k] / (it + 1))
+            self.models.append(tree)
+        if not should_continue:
+            log.warning("Stopped training: no more leaves meet split requirements")
+            del self.models[-self.num_tree_per_iteration:]
+            return True
+        self.iter_ += 1
+        return False
+
+    def _renew_tree_output_rf(self, tree: Tree, k: int, mask) -> None:
+        init = self.init_scores[k]
+        perm = np.asarray(jax.device_get(self.learner.last_perm))
+        const_score = np.full(self.num_data, init)
+        mask_np = None if mask is None else np.asarray(jax.device_get(mask))
+        begins = self.learner.last_leaf_begin
+        counts = self.learner.last_leaf_count
+        for leaf in range(tree.num_leaves):
+            rows = perm[int(begins[leaf]): int(begins[leaf]) + int(counts[leaf])]
+            if mask_np is not None:
+                rows = rows[mask_np[rows]]
+            if len(rows):
+                tree.leaf_value[leaf] = self.objective.renew_tree_output(
+                    rows, const_score)
+
+def create_boosting(config: Config, train_set) -> GBDT:
+    """(reference: Boosting::CreateBoosting, src/boosting/boosting.cpp:34)"""
+    if config.boosting == "dart":
+        return DART(config, train_set)
+    if config.boosting == "rf":
+        return RF(config, train_set)
+    return GBDT(config, train_set)

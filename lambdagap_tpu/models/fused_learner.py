@@ -1,0 +1,432 @@
+"""Fused whole-tree-on-device leaf-wise learner.
+
+The TPU production path: the entire leaf-wise tree build — histogram
+construction, best-split scans, the argmax over leaves, and the data
+partition — runs as ONE jitted program per tree, with zero host round-trips.
+This is the TPU answer to the reference's CUDA learner
+(reference: src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp:158-260),
+which keeps all state device-resident but still drives each split from the
+host: here even the per-split control flow (which leaf to split next) stays
+on device, because the host link may be a high-latency tunnel and a single
+D2H sync per split would dominate the runtime.
+
+Structure: ``fori_loop`` over the ``num_leaves-1`` splits. Row-sized work
+(gathering a leaf's rows for histograms; partitioning the chosen leaf) runs
+in inner ``while_loop``s over fixed-width chunks — static shapes, dynamic
+trip counts — so device time is proportional to actual rows touched, keeping
+the histogram-subtraction trick's O(min(|L|,|R|)) economics
+(reference: serial_tree_learner.cpp:408-476) inside a fully-compiled program.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..config import Config
+from ..data.dataset import BinnedDataset
+from ..ops.partition import decision_go_left
+from ..ops.split import (K_MIN_SCORE, SplitParams, calculate_leaf_output,
+                         leaf_gain, per_feature_best)
+from .learner import SerialTreeLearner, _next_pow2
+from .tree import Tree
+
+HIST_C = 3
+
+
+class DeviceTree(NamedTuple):
+    """One trained tree, resident on device."""
+    node_feature: jax.Array      # i32 [NODES] (inner feature index)
+    node_threshold: jax.Array    # i32 [NODES]
+    node_default_left: jax.Array  # bool [NODES]
+    node_is_cat: jax.Array       # bool [NODES]
+    node_cat_bits: jax.Array     # u32 [NODES, 8]
+    node_left: jax.Array         # i32 [NODES] (>=0 node, <0 ~leaf)
+    node_right: jax.Array        # i32 [NODES]
+    node_gain: jax.Array         # f32 [NODES]
+    node_value: jax.Array        # f32 [NODES] parent output
+    node_weight: jax.Array       # f32 [NODES] parent hess sum
+    node_count: jax.Array        # f32 [NODES]
+    leaf_value: jax.Array        # f32 [L]
+    leaf_weight: jax.Array       # f32 [L]
+    leaf_count: jax.Array        # f32 [L]
+    leaf_depth: jax.Array        # i32 [L]
+    leaf_parent_node: jax.Array  # i32 [L]
+    num_leaves: jax.Array        # i32 scalar
+    row_leaf: jax.Array          # i32 [N] leaf id per training row
+
+
+# best-split store keys, all [L]-indexed (the device analog of
+# best_split_per_leaf_, reference: serial_tree_learner.h)
+_BKEYS = ("bgain", "bfeat", "bthr", "bdl", "bcat", "bbits",
+          "blg", "blh", "blc", "blout", "brout")
+
+
+class FusedTreeLearner(SerialTreeLearner):
+    """Whole-tree-per-dispatch learner. Reuses SerialTreeLearner's dataset
+    plumbing (bin meta, split params, feature sampling)."""
+
+    def __init__(self, dataset: BinnedDataset, config: Config) -> None:
+        super().__init__(dataset, config)
+        # column-major copy for cheap feature-column reads while partitioning
+        # (the analog of CUDAColumnData next to CUDARowData,
+        # reference: src/io/cuda/cuda_column_data.cpp)
+        self.x_cols = jnp.asarray(np.ascontiguousarray(dataset.binned.T))
+        self.chunk = max(min(int(config.tpu_rows_per_block) * 8, 1 << 19), 1 << 12)
+        self._train_jit = jax.jit(self._train_tree_impl,
+                                  static_argnames=("has_mask",))
+        self.last_row_leaf: Optional[jax.Array] = None
+
+    # ------------------------------------------------------------------
+    def train_device(self, grad: jax.Array, hess: jax.Array,
+                     row_mask: Optional[jax.Array] = None) -> DeviceTree:
+        fmask = self._feature_mask()
+        mask = row_mask if row_mask is not None else jnp.ones(1, dtype=bool)
+        rec = self._train_jit(grad, hess, mask, fmask,
+                              has_mask=row_mask is not None)
+        self.last_row_leaf = rec.row_leaf
+        return rec
+
+    def train(self, grad, hess, row_mask=None) -> Tree:
+        """Host-Tree interface (used by tests / non-bench paths)."""
+        return self.materialize(self.train_device(grad, hess, row_mask))
+
+    # ------------------------------------------------------------------
+    def materialize(self, rec: DeviceTree) -> Tree:
+        """Fetch a DeviceTree and build the host Tree model (one transfer;
+        row_leaf stays on device — it is O(N))."""
+        h = jax.device_get({k: v for k, v in rec._asdict().items()
+                            if k != "row_leaf"})
+        L = int(h["num_leaves"])
+        nodes = max(L - 1, 0)
+        tree = Tree(max_leaves=self.config.num_leaves)
+        tree.num_leaves = max(L, 1)
+        mt_codes = {"None": 0, "Zero": 1, "NaN": 2}
+        for k in range(nodes):
+            fi = int(h["node_feature"][k])
+            j = self.dataset.used_features[fi]
+            mapper = self.dataset.mappers[j]
+            tree.split_feature.append(j)
+            tree.split_feature_inner.append(fi)
+            thr_bin = int(h["node_threshold"][k])
+            tree.threshold_bin.append(thr_bin)
+            tree.threshold_real.append(mapper.bin_to_value(thr_bin))
+            tree.default_left.append(bool(h["node_default_left"][k]))
+            tree.missing_type.append(mt_codes[mapper.missing_type])
+            tree.left_child.append(int(h["node_left"][k]))
+            tree.right_child.append(int(h["node_right"][k]))
+            tree.split_gain.append(float(h["node_gain"][k]))
+            is_cat = bool(h["node_is_cat"][k])
+            tree.is_categorical.append(is_cat)
+            bits = np.asarray(h["node_cat_bits"][k], dtype=np.uint32)
+            tree.cat_bitset.append(bits)
+            tree.cat_bitset_real.append(
+                self._cat_bitset_real(fi, bits) if is_cat
+                else np.zeros(8, np.uint32))
+            tree.internal_value.append(float(h["node_value"][k]))
+            tree.internal_weight.append(float(h["node_weight"][k]))
+            tree.internal_count.append(int(h["node_count"][k]))
+        Lb = tree.max_leaves
+        tree.leaf_value[:Lb] = h["leaf_value"][:Lb]
+        tree.leaf_weight[:Lb] = h["leaf_weight"][:Lb]
+        tree.leaf_count[:Lb] = h["leaf_count"][:Lb].astype(np.int64)
+        tree.leaf_depth[:Lb] = h["leaf_depth"][:Lb]
+        tree.leaf_parent[:Lb] = h["leaf_parent_node"][:Lb]
+        return tree
+
+    # ------------------------------------------------------------------
+    # the fused program
+    # ------------------------------------------------------------------
+    def _train_tree_impl(self, grad, hess, row_mask, fmask, *, has_mask: bool):
+        cfg = self.config
+        N = self.num_data
+        F = self.num_features
+        B = self.B
+        L = cfg.num_leaves
+        NODES = max(L - 1, 1)
+        W = min(self.chunk, _next_pow2(N))
+        p = self.params
+        max_depth = cfg.max_depth
+        x_rows = self.x_binned          # [N, F]
+        x_cols = self.x_cols            # [F, N]
+        num_bins = self.num_bins_arr
+        default_bins = self.default_bins_arr
+        missing_types = self.missing_types_arr
+        is_cat_arr = self.is_categorical_arr
+        has_cat = self.has_categorical
+        lane = jnp.arange(W, dtype=jnp.int32)
+        bin_iota = jnp.arange(B, dtype=x_rows.dtype)
+
+        def chunk_hist(perm, begin, count, acc, c):
+            """Histogram of rows perm[begin+cW : begin+(c+1)W] (MXU one-hot)."""
+            offs = begin + c * W + lane
+            rows = perm[jnp.clip(offs, 0, N - 1)]
+            valid = (c * W + lane) < count
+            if has_mask:
+                valid = valid & row_mask[rows]
+            bins = x_rows[rows]                         # [W, F]
+            g = jnp.where(valid, grad[rows], 0.0)
+            h = jnp.where(valid, hess[rows], 0.0)
+            gh = jnp.stack([g, h, valid.astype(jnp.float32)], axis=1)
+            onehot = (bins[:, :, None] == bin_iota).astype(jnp.bfloat16)
+            part = lax.dot_general(
+                gh.astype(jnp.bfloat16).T, onehot.reshape(W, F * B),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return acc + part.reshape(HIST_C, F, B).transpose(1, 2, 0)
+
+        def leaf_hist(perm, begin, count):
+            nch = (count + W - 1) // W
+
+            def body(st):
+                c, acc = st
+                return c + 1, chunk_hist(perm, begin, count, acc, c)
+
+            _, hist = lax.while_loop(
+                lambda st: st[0] < nch, body,
+                (jnp.int32(0), jnp.zeros((F, B, HIST_C), jnp.float32)))
+            return hist
+
+        def best_of(hist, pg, ph, pc, pout, depth):
+            """Best split for one leaf, with the max_depth guard."""
+            gain, thr, dl, lg, lh, lc, bits = per_feature_best(
+                hist, pg, ph, pc, pout, num_bins, default_bins,
+                missing_types, is_cat_arr, fmask, p, has_cat)
+            parent_gain = leaf_gain(pg, ph, p, pc, pout)
+            shift = parent_gain + p.min_gain_to_split
+            f = jnp.argmax(gain, axis=0).astype(jnp.int32)
+            g = gain[f] - shift
+            ok = jnp.isfinite(gain[f]) & (g > 0.0)
+            if max_depth > 0:
+                ok = ok & (depth < max_depth)
+            lout = calculate_leaf_output(lg[f], lh[f], p, lc[f], pout)
+            rout = calculate_leaf_output(pg - lg[f], ph - lh[f], p,
+                                         pc - lc[f], pout)
+            return dict(bgain=jnp.where(ok, g, K_MIN_SCORE), bfeat=f,
+                        bthr=thr[f], bdl=dl[f], bcat=is_cat_arr[f],
+                        bbits=bits[f], blg=lg[f], blh=lh[f], blc=lc[f],
+                        blout=lout, brout=rout)
+
+        # ------------------------------------------------------ state init
+        perm0 = jnp.arange(N, dtype=jnp.int32)
+        hist_root = leaf_hist(perm0, jnp.int32(0), jnp.int32(N))
+        totals = jnp.sum(hist_root[0], axis=0)
+        root_out = calculate_leaf_output(totals[0], totals[1], p, totals[2],
+                                         0.0)
+        b0 = best_of(hist_root, totals[0], totals[1], totals[2], root_out,
+                     jnp.int32(0))
+
+        iota_l = jnp.arange(L, dtype=jnp.int32)
+        state = dict(
+            perm=perm0,
+            perm_buf=jnp.zeros(N, jnp.int32),
+            # inactive leaves carry out-of-range begins so the final
+            # position->leaf searchsorted never matches them
+            leaf_begin=jnp.where(iota_l == 0, 0, N + iota_l),
+            leaf_count=jnp.where(iota_l == 0, N, 0),
+            leaf_sum_g=jnp.zeros(L, jnp.float32).at[0].set(totals[0]),
+            leaf_value=jnp.zeros(L, jnp.float32).at[0].set(root_out),
+            leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(totals[1]),
+            leaf_cnt=jnp.zeros(L, jnp.float32).at[0].set(totals[2]),
+            leaf_depth=jnp.zeros(L, jnp.int32),
+            leaf_parent=jnp.full(L, -1, jnp.int32),
+            leaf_is_left=jnp.zeros(L, bool),
+            hist=jnp.zeros((L, F, B, HIST_C), jnp.float32).at[0].set(hist_root),
+            bgain=jnp.full(L, K_MIN_SCORE, jnp.float32),
+            bfeat=jnp.zeros(L, jnp.int32),
+            bthr=jnp.zeros(L, jnp.int32),
+            bdl=jnp.zeros(L, bool),
+            bcat=jnp.zeros(L, bool),
+            bbits=jnp.zeros((L, 8), jnp.uint32),
+            blg=jnp.zeros(L, jnp.float32),
+            blh=jnp.zeros(L, jnp.float32),
+            blc=jnp.zeros(L, jnp.float32),
+            blout=jnp.zeros(L, jnp.float32),
+            brout=jnp.zeros(L, jnp.float32),
+            node_feature=jnp.zeros(NODES, jnp.int32),
+            node_threshold=jnp.zeros(NODES, jnp.int32),
+            node_default_left=jnp.zeros(NODES, bool),
+            node_is_cat=jnp.zeros(NODES, bool),
+            node_cat_bits=jnp.zeros((NODES, 8), jnp.uint32),
+            node_left=jnp.full(NODES, ~0, jnp.int32),
+            node_right=jnp.full(NODES, ~0, jnp.int32),
+            node_gain=jnp.zeros(NODES, jnp.float32),
+            node_value=jnp.zeros(NODES, jnp.float32),
+            node_weight=jnp.zeros(NODES, jnp.float32),
+            node_count=jnp.zeros(NODES, jnp.float32),
+            num_leaves=jnp.int32(1),
+            done=jnp.asarray(False),
+        )
+        for key, val in b0.items():
+            state[key] = state[key].at[0].set(val)
+
+        # ------------------------------------------------------ split step
+        def split_step(k, st):
+            leaf = jnp.argmax(st["bgain"]).astype(jnp.int32)
+            ok = (st["bgain"][leaf] > 0.0) & (~st["done"])
+
+            def do_split(st):
+                feat = st["bfeat"][leaf]
+                begin = st["leaf_begin"][leaf]
+                count = st["leaf_count"][leaf]
+                col = x_cols[feat]                      # [N]
+                nch = (count + W - 1) // W
+
+                # -- chunked stable partition into perm_buf ------------
+                def pbody(s):
+                    c, lcur, rcur, pbuf = s
+                    offs = begin + c * W + lane
+                    valid = (c * W + lane) < count
+                    rows = st["perm"][jnp.clip(offs, 0, N - 1)]
+                    gl = decision_go_left(
+                        col[rows], st["bthr"][leaf], st["bdl"][leaf],
+                        default_bins[feat], missing_types[feat],
+                        num_bins[feat], st["bcat"][leaf],
+                        st["bbits"][leaf]) & valid
+                    gr = valid & ~gl
+                    nl = jnp.sum(gl, dtype=jnp.int32)
+                    nr = jnp.sum(gr, dtype=jnp.int32)
+                    lpos = lcur + jnp.cumsum(gl) - 1
+                    # rights fill backward from the slice end: stable within
+                    # a chunk, chunk order reversed on the right side — a
+                    # deterministic permutation, only affecting later gather
+                    # order
+                    rpos = rcur - jnp.cumsum(gr)
+                    pos = jnp.where(gl, lpos, jnp.where(gr, rpos, N))
+                    pbuf = pbuf.at[pos].set(rows, mode="drop")
+                    return c + 1, lcur + nl, rcur - nr, pbuf
+
+                _, lend, _, pbuf = lax.while_loop(
+                    lambda s: s[0] < nch, pbody,
+                    (jnp.int32(0), begin, begin + count, st["perm_buf"]))
+                left_count = lend - begin
+                right_count = count - left_count
+
+                # copy the partitioned slice back into perm (chunked)
+                def cbody(s):
+                    c, pm = s
+                    offs = begin + c * W + lane
+                    valid = (c * W + lane) < count
+                    vals = pbuf[jnp.clip(offs, 0, N - 1)]
+                    pm = pm.at[jnp.where(valid, offs, N)].set(vals, mode="drop")
+                    return c + 1, pm
+
+                _, perm = lax.while_loop(lambda s: s[0] < nch, cbody,
+                                         (jnp.int32(0), st["perm"]))
+
+                # -- node record + leaf bookkeeping --------------------
+                new_leaf = st["num_leaves"]
+                node = k
+                pnode = st["leaf_parent"][leaf]
+                was_left = st["leaf_is_left"][leaf]
+                safe_p = jnp.clip(pnode, 0, NODES - 1)
+                node_left = st["node_left"].at[safe_p].set(
+                    jnp.where((pnode >= 0) & was_left, node,
+                              st["node_left"][safe_p]))
+                node_right = st["node_right"].at[safe_p].set(
+                    jnp.where((pnode >= 0) & ~was_left, node,
+                              st["node_right"][safe_p]))
+
+                # parent/child aggregates
+                pg, ph, pc = (st["leaf_sum_g"][leaf], st["leaf_weight"][leaf],
+                              st["leaf_cnt"][leaf])
+                lg, lh, lc = st["blg"][leaf], st["blh"][leaf], st["blc"][leaf]
+                rg, rh, rc = pg - lg, ph - lh, pc - lc
+                lout, rout = st["blout"][leaf], st["brout"][leaf]
+                depth = st["leaf_depth"][leaf] + 1
+
+                upd = dict(st)
+                upd.update(
+                    perm=perm, perm_buf=pbuf,
+                    leaf_begin=st["leaf_begin"].at[new_leaf].set(begin + left_count),
+                    leaf_count=st["leaf_count"].at[leaf].set(left_count)
+                                               .at[new_leaf].set(right_count),
+                    leaf_sum_g=st["leaf_sum_g"].at[leaf].set(lg)
+                                               .at[new_leaf].set(rg),
+                    leaf_value=st["leaf_value"].at[leaf].set(lout)
+                                               .at[new_leaf].set(rout),
+                    leaf_weight=st["leaf_weight"].at[leaf].set(lh)
+                                                 .at[new_leaf].set(rh),
+                    leaf_cnt=st["leaf_cnt"].at[leaf].set(lc)
+                                           .at[new_leaf].set(rc),
+                    leaf_depth=st["leaf_depth"].at[leaf].set(depth)
+                                               .at[new_leaf].set(depth),
+                    leaf_parent=st["leaf_parent"].at[leaf].set(node)
+                                                 .at[new_leaf].set(node),
+                    leaf_is_left=st["leaf_is_left"].at[leaf].set(True)
+                                                   .at[new_leaf].set(False),
+                    node_feature=st["node_feature"].at[node].set(feat),
+                    node_threshold=st["node_threshold"].at[node].set(st["bthr"][leaf]),
+                    node_default_left=st["node_default_left"].at[node].set(st["bdl"][leaf]),
+                    node_is_cat=st["node_is_cat"].at[node].set(st["bcat"][leaf]),
+                    node_cat_bits=st["node_cat_bits"].at[node].set(st["bbits"][leaf]),
+                    node_left=node_left.at[node].set(~leaf),
+                    node_right=node_right.at[node].set(~new_leaf),
+                    node_gain=st["node_gain"].at[node].set(st["bgain"][leaf]),
+                    node_value=st["node_value"].at[node].set(st["leaf_value"][leaf]),
+                    node_weight=st["node_weight"].at[node].set(ph),
+                    node_count=st["node_count"].at[node].set(pc),
+                    num_leaves=st["num_leaves"] + 1,
+                )
+
+                # -- children histograms (smaller built, larger by
+                # subtraction) + their best splits ---------------------
+                small_is_left = left_count <= right_count
+                sb = jnp.where(small_is_left, begin, begin + left_count)
+                sc = jnp.where(small_is_left, left_count, right_count)
+                hist_small = leaf_hist(perm, sb, sc)
+                hist_large = st["hist"][leaf] - hist_small
+                hist_left = jnp.where(small_is_left, hist_small, hist_large)
+                hist_right = jnp.where(small_is_left, hist_large, hist_small)
+                upd["hist"] = st["hist"].at[leaf].set(hist_left) \
+                                        .at[new_leaf].set(hist_right)
+
+                bl = best_of(hist_left, lg, lh, lc, lout, depth)
+                br = best_of(hist_right, rg, rh, rc, rout, depth)
+                for key in _BKEYS:
+                    upd[key] = upd[key].at[leaf].set(bl[key]) \
+                                       .at[new_leaf].set(br[key])
+                return upd
+
+            def no_split(st):
+                st = dict(st)
+                st["done"] = jnp.asarray(True)
+                return st
+
+            return lax.cond(ok, do_split, no_split, st)
+
+        if L > 1:
+            state = lax.fori_loop(0, NODES, split_step, state)
+
+        # -------------------------------------------------- row -> leaf id
+        order = jnp.argsort(state["leaf_begin"])
+        sorted_begin = state["leaf_begin"][order]
+        which = jnp.searchsorted(sorted_begin,
+                                 jnp.arange(N, dtype=jnp.int32),
+                                 side="right") - 1
+        pos_leaf = order[which]
+        row_leaf = jnp.zeros(N, jnp.int32).at[state["perm"]].set(pos_leaf)
+
+        return DeviceTree(
+            node_feature=state["node_feature"],
+            node_threshold=state["node_threshold"],
+            node_default_left=state["node_default_left"],
+            node_is_cat=state["node_is_cat"],
+            node_cat_bits=state["node_cat_bits"],
+            node_left=state["node_left"],
+            node_right=state["node_right"],
+            node_gain=state["node_gain"],
+            node_value=state["node_value"],
+            node_weight=state["node_weight"],
+            node_count=state["node_count"],
+            leaf_value=state["leaf_value"],
+            leaf_weight=state["leaf_weight"],
+            leaf_count=state["leaf_cnt"],
+            leaf_depth=state["leaf_depth"],
+            leaf_parent_node=state["leaf_parent"],
+            num_leaves=state["num_leaves"],
+            row_leaf=row_leaf,
+        )

@@ -52,8 +52,31 @@ def _round_depth(d: int) -> int:
     return max(8, ((d + 7) // 8) * 8)
 
 
+class _LazyTree:
+    """A trained tree still resident on device (fused learner); materializes
+    to a host :class:`Tree` on first access."""
+
+    __slots__ = ("learner", "rec", "shrinkage", "bias")
+
+    def __init__(self, learner, rec, shrinkage: float, bias: float) -> None:
+        self.learner = learner
+        self.rec = rec
+        self.shrinkage = shrinkage
+        self.bias = bias
+
+    def materialize(self) -> "Tree":
+        tree = self.learner.materialize(self.rec)
+        tree.apply_shrinkage(self.shrinkage)
+        if abs(self.bias) > K_EPSILON:
+            tree.leaf_value[:tree.num_leaves] += self.bias
+            tree.internal_value = [v + self.bias for v in tree.internal_value]
+        return tree
+
+
 class GBDT:
     """Gradient Boosting Decision Tree booster."""
+
+    average_output = False   # True for RF (reference: rf.hpp average_output_)
 
     def __init__(self, config: Config, train_set: Optional[BinnedDataset]) -> None:
         self.config = config
@@ -81,7 +104,7 @@ class GBDT:
         self.num_data = ds.num_data
         if self.objective is not None:
             self.objective.init(ds.metadata, ds.num_data)
-        self.learner = SerialTreeLearner(ds, self.config)
+        self.learner = self._create_learner(ds)
         self.sample_strategy = create_sample_strategy(
             self.config, ds.num_data,
             label=None if ds.metadata.label is None else np.asarray(ds.metadata.label),
@@ -101,6 +124,30 @@ class GBDT:
         self._meta = ds.feature_arrays()
         if self.config.boosting == "rf":
             self.shrinkage_rate = 1.0
+
+    def _create_learner(self, ds: BinnedDataset):
+        """Learner dispatch (reference: TreeLearner::CreateTreeLearner,
+        src/treelearner/tree_learner.cpp — (tree_learner, device) -> class).
+
+        For serial training the whole-tree-on-device FusedTreeLearner is the
+        production path (auto on accelerators); the host-orchestrated
+        SerialTreeLearner remains for debugging / explicit opt-out."""
+        tl = self.config.tree_learner
+        if tl == "serial":
+            mode = self.config.tpu_fused_learner
+            use_fused = (jax.default_backend() != "cpu" if mode == "auto"
+                         else mode in ("1", "true", "on", "yes", True))
+            if use_fused:
+                from .fused_learner import FusedTreeLearner
+                return FusedTreeLearner(ds, self.config)
+            return SerialTreeLearner(ds, self.config)
+        from ..parallel import (DataParallelTreeLearner,
+                                FeatureParallelTreeLearner,
+                                VotingParallelTreeLearner)
+        cls = {"data": DataParallelTreeLearner,
+               "feature": FeatureParallelTreeLearner,
+               "voting": VotingParallelTreeLearner}[tl]
+        return cls(ds, self.config)
 
     def add_valid_set(self, ds: BinnedDataset, name: str) -> None:
         self.valid_sets.append((name, ds))
@@ -148,6 +195,30 @@ class GBDT:
 
         grad, hess, mask = self.sample_strategy.sample(self.iter_, grad, hess)
 
+        from .fused_learner import FusedTreeLearner
+        fast = (isinstance(self.learner, FusedTreeLearner)
+                and type(self) is GBDT
+                and (self.objective is None
+                     or not self.objective.is_renew_tree_output))
+        if fast:
+            # zero-sync path: the tree stays on device; host Tree objects are
+            # materialized lazily (save/predict). The "no more splittable
+            # leaves" stop check is skipped to avoid a per-iteration D2H —
+            # converged training just appends constant trees.
+            for k in range(self.num_tree_per_iteration):
+                rec = self.learner.train_device(grad[k], hess[k], row_mask=mask)
+                lv = rec.leaf_value * self.shrinkage_rate
+                self.scores = self.scores.at[k].add(lv[rec.row_leaf])
+                lazy = _LazyTree(self.learner, rec, self.shrinkage_rate,
+                                 init_scores[k])
+                self.models.append(lazy)
+                if self.valid_sets:
+                    tree = self._tree(len(self.models) - 1)
+                    for vi in range(len(self.valid_sets)):
+                        self._add_valid_tree_score(vi, tree, k)
+            self.iter_ += 1
+            return False
+
         should_continue = False
         for k in range(self.num_tree_per_iteration):
             tree = self.learner.train(grad[k], hess[k], row_mask=mask)
@@ -188,8 +259,30 @@ class GBDT:
         tree.leaf_value[:tree.num_leaves] += bias
         tree.internal_value = [v + bias for v in tree.internal_value]
 
+    def _tree(self, i: int) -> Tree:
+        m = self.models[i]
+        if isinstance(m, _LazyTree):
+            m = m.materialize()
+            self.models[i] = m
+        return m
+
+    @property
+    def host_models(self) -> List[Tree]:
+        return [self._tree(i) for i in range(len(self.models))]
+
     def _update_train_score(self, tree: Tree, k: int) -> None:
+        if getattr(self.learner, "last_row_leaf", None) is not None:
+            # fused learner: leaf membership is row_leaf (device)
+            lv = jnp.asarray(
+                np.asarray(tree.leaf_value[:tree.max_leaves], np.float32))
+            self.scores = self.scores.at[k].add(
+                lv[self.learner.last_row_leaf])
+            return
         lv = jnp.asarray(tree.leaf_value[:tree.num_leaves], dtype=jnp.float32)
+        if hasattr(self.learner, "update_scores"):   # distributed learners
+            self.scores = self.scores.at[k].set(
+                self.learner.update_scores(self.scores[k], lv))
+            return
         self.scores = self.scores.at[k].set(_add_tree_score(
             self.scores[k], self.learner.last_perm,
             jnp.asarray(self.learner.last_leaf_begin, dtype=jnp.int32),
@@ -207,13 +300,34 @@ class GBDT:
         """L1-family leaf refit by weighted percentile of residuals
         (reference: RenewTreeOutput path in gbdt.cpp:412 +
         regression_objective.hpp percentiles)."""
-        perm = np.asarray(jax.device_get(self.learner.last_perm))
         score = np.asarray(jax.device_get(self.scores[k]))
         mask_np = None if mask is None else np.asarray(jax.device_get(mask))
+        if getattr(self.learner, "last_row_leaf", None) is not None:
+            # fused learner: leaf membership from row_leaf
+            row_leaf = np.asarray(jax.device_get(self.learner.last_row_leaf))
+            for leaf in range(tree.num_leaves):
+                rows = np.nonzero(row_leaf == leaf)[0]
+                if mask_np is not None:
+                    rows = rows[mask_np[rows]]
+                if len(rows):
+                    tree.leaf_value[leaf] = self.objective.renew_tree_output(
+                        rows, score)
+            return
+        perm = np.asarray(jax.device_get(self.learner.last_perm))
         begins = self.learner.last_leaf_begin
         counts = self.learner.last_leaf_count
+        distributed = begins.ndim == 2     # [D, L] per-shard layout
+        n_loc = getattr(self.learner, "n_loc", 0)
         for leaf in range(tree.num_leaves):
-            rows = perm[int(begins[leaf]): int(begins[leaf]) + int(counts[leaf])]
+            if distributed:
+                parts = []
+                for d in range(begins.shape[0]):
+                    b, c = int(begins[d, leaf]), int(counts[d, leaf])
+                    parts.append(perm[d * n_loc + b: d * n_loc + b + c] + d * n_loc)
+                rows = np.concatenate(parts) if parts else np.empty(0, np.int64)
+                rows = rows[rows < self.num_data]
+            else:
+                rows = perm[int(begins[leaf]): int(begins[leaf]) + int(counts[leaf])]
             if mask_np is not None:
                 rows = rows[mask_np[rows]]
             if len(rows) == 0:
@@ -261,12 +375,42 @@ class GBDT:
         end = len(self.models) if num_iteration < 0 else min(
             len(self.models), (start_iteration + num_iteration) * K)
         for i in range(start_iteration * K, end):
-            tree = self.models[i]
+            tree = self._tree(i)
             arrs = tree_to_arrays(tree, use_inner_feature=False)
             depth = _round_depth(tree.max_depth + 1)
             out = out.at[i % K].add(predict_tree_raw(x, arrs, depth))
         res = np.asarray(jax.device_get(out))
+        if self.average_output:
+            n_iters = max(1, (end - start_iteration * K) // max(K, 1))
+            res = res / n_iters
         return res[0] if K == 1 else res.T
+
+    def predict_leaf(self, data: np.ndarray, start_iteration: int = 0,
+                     num_iteration: int = -1) -> np.ndarray:
+        """Leaf index per (row, tree) (reference: predict_leaf_index path)."""
+        from ..ops.predict import predict_leaf_index_binned  # binned variant exists
+        data = np.asarray(data, dtype=np.float32)
+        x = jnp.asarray(data)
+        K = self.num_tree_per_iteration
+        end = len(self.models) if num_iteration < 0 else min(
+            len(self.models), (start_iteration + num_iteration) * K)
+        cols = []
+        for i in range(start_iteration * K, end):
+            tree = self._tree(i)
+            arrs = tree_to_arrays(tree, use_inner_feature=False)
+            depth = _round_depth(tree.max_depth + 1)
+            # raw-threshold traversal, returning leaf ids
+            vals = jnp.arange(tree.num_leaves, dtype=jnp.float32)
+            arrs = arrs._replace(leaf_value=vals)
+            cols.append(np.asarray(jax.device_get(
+                predict_tree_raw(x, arrs, depth))).astype(np.int32))
+        return np.stack(cols, axis=1) if cols else np.zeros((data.shape[0], 0), np.int32)
+
+    def predict_contrib(self, data: np.ndarray, start_iteration: int = 0,
+                        num_iteration: int = -1) -> np.ndarray:
+        """SHAP feature contributions (reference: predict_contrib /
+        TreeSHAP in tree.h PredictContrib). Not yet implemented."""
+        raise NotImplementedError("pred_contrib lands with the SHAP milestone")
 
     def predict(self, data: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1) -> np.ndarray:
@@ -276,6 +420,89 @@ class GBDT:
         dev = jnp.asarray(raw.T if raw.ndim == 2 else raw[None, :])
         conv = np.asarray(jax.device_get(self.objective.convert_output(dev)))
         return conv[0] if self.num_tree_per_iteration == 1 else conv.T
+
+    # ------------------------------------------------------------------
+    # serialization
+    # ------------------------------------------------------------------
+    @property
+    def feature_names(self) -> List[str]:
+        if self.train_set is not None:
+            return self.train_set.feature_names
+        return getattr(self, "_feature_names",
+                       [f"Column_{i}" for i in range(self.max_feature_idx + 1)])
+
+    def objective_string(self) -> str:
+        if self.objective is None:
+            return getattr(self, "_objective_string", "custom")
+        name = self.objective.name
+        if name == "binary":
+            return f"binary sigmoid:{self.config.sigmoid:g}"
+        if name in ("multiclass", "multiclassova"):
+            return f"{name} num_class:{self.num_class}"
+        if name == "lambdarank":
+            return "lambdarank"
+        return name
+
+    def feature_infos(self) -> List[str]:
+        """Per-feature value ranges (reference: Dataset feature_infos /
+        bin.h:224 bin_info_string)."""
+        if self.train_set is None:
+            return getattr(self, "_feature_infos", [])
+        out = []
+        for m in self.train_set.mappers:
+            if m.is_trivial:
+                out.append("none")
+            elif m.bin_type == "categorical":
+                cats = [str(c) for c in m.bin_2_categorical[1:]]
+                out.append(":".join(cats) if cats else "none")
+            else:
+                out.append(f"[{m.min_val:g}:{m.max_val:g}]")
+        return out
+
+    def save_model_to_string(self, start_iteration: int = 0,
+                             num_iteration: int = -1,
+                             importance_type: int = 0) -> str:
+        from .model_text import save_model_to_string
+        return save_model_to_string(self, start_iteration, num_iteration,
+                                    importance_type)
+
+    def save_model(self, filename: str, start_iteration: int = 0,
+                   num_iteration: int = -1, importance_type: int = 0) -> None:
+        with open(filename, "w") as f:
+            f.write(self.save_model_to_string(start_iteration, num_iteration,
+                                              importance_type))
+
+    @classmethod
+    def from_model_string(cls, text: str, config: Optional[Config] = None):
+        """Load a saved model for prediction / continued training
+        (reference: GBDT::LoadModelFromString, gbdt_model_text.cpp)."""
+        from .model_text import load_model_from_string
+        header, trees = load_model_from_string(text)
+        cfg = config or Config()
+        obj_str = header.get("objective", "regression").split(" ")[0]
+        params = {"objective": obj_str} if obj_str != "custom" else {}
+        for tok in header.get("objective", "").split(" ")[1:]:
+            if ":" in tok:
+                k, v = tok.split(":", 1)
+                params[k] = v
+        if "num_class" in header:
+            params["num_class"] = int(header["num_class"])
+        cfg.update(params)
+        booster = cls(cfg, None)
+        booster.models = trees
+        booster.iter_ = len(trees) // booster.num_tree_per_iteration
+        booster.max_feature_idx = int(header.get("max_feature_idx", 0))
+        if header.get("average_output"):
+            booster.average_output = True
+        booster._feature_names = header.get("feature_names", "").split()
+        booster._feature_infos = header.get("feature_infos", "").split()
+        booster._objective_string = header.get("objective", "custom")
+        return booster
+
+    @classmethod
+    def from_model_file(cls, filename: str, config: Optional[Config] = None):
+        with open(filename) as f:
+            return cls.from_model_string(f.read(), config)
 
     # ------------------------------------------------------------------
     @property
@@ -288,7 +515,7 @@ class GBDT:
         if self.iter_ <= 0:
             return
         for k in range(self.num_tree_per_iteration):
-            tree = self.models[-(self.num_tree_per_iteration - k)]
+            tree = self._tree(len(self.models) - self.num_tree_per_iteration + k)
             # subtract contribution by re-adding with negated leaf values
             arrs = tree_to_arrays(tree, feature_meta=self._meta,
                                   use_inner_feature=True)

@@ -122,6 +122,16 @@ class SerialTreeLearner:
             has_categorical=self.has_categorical)
         return _HostSplit(jax.device_get(res))
 
+    # histogram hook points (overridden by the distributed learners) --------
+    def _root_histogram(self, grad, hess, row_mask):
+        return full_histogram(self.x_binned, grad, hess, row_mask, self.B,
+                              self.rows_per_block)
+
+    def _leaf_histogram(self, perm, grad, hess, begin, count, padded, row_mask):
+        return leaf_histogram(self.x_binned, perm, grad, hess,
+                              jnp.int32(begin), jnp.int32(count), padded,
+                              self.B, self.rows_per_block, row_mask)
+
     def _cat_bitset_real(self, feature_k: int, bitset_bins: np.ndarray) -> np.ndarray:
         """Convert a bin-space bitset to raw-category space for model export."""
         j = self.dataset.used_features[feature_k]
@@ -151,8 +161,7 @@ class SerialTreeLearner:
         leaf_count[0] = self.num_data
 
         # root histogram + totals (BeforeTrain analog)
-        hist_root = full_histogram(self.x_binned, grad, hess, row_mask, self.B,
-                                   self.rows_per_block)
+        hist_root = self._root_histogram(grad, hess, row_mask)
         totals = jnp.sum(hist_root[0], axis=0)   # (g, h, c) — every row hits f0
         root_out = _leaf_output_scalar(totals[0], totals[1], totals[2], self.params)
         hists: Dict[int, jax.Array] = {0: hist_root}
@@ -231,10 +240,8 @@ class SerialTreeLearner:
             small_is_left = left_cnt <= right_cnt
             sb, sc = (begin, left_cnt) if small_is_left else (begin + left_cnt, right_cnt)
             Ph = self._pad_size(sc)
-            hist_small = leaf_histogram(
-                self.x_binned, perm, grad, hess,
-                jnp.int32(sb), jnp.int32(sc), Ph, self.B,
-                self.rows_per_block, row_mask)
+            hist_small = self._leaf_histogram(perm, grad, hess, sb, sc, Ph,
+                                              row_mask)
             hist_large = parent_hist - hist_small
 
             small_leaf = leaf if small_is_left else right_leaf

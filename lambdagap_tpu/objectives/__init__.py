@@ -1,4 +1,4 @@
 from .base import ObjectiveFunction, create_objective, register_objective
-from . import regression, binary, multiclass, xentropy  # noqa: F401 — register
+from . import regression, binary, multiclass, xentropy, rank  # noqa: F401 — register
 
 __all__ = ["ObjectiveFunction", "create_objective", "register_objective"]

@@ -303,21 +303,16 @@ def _bins_to_bitset(member: jax.Array) -> jax.Array:
 # combined entry
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnames=("params", "has_categorical"))
-def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
-                    parent_c: jax.Array, parent_output: jax.Array,
-                    num_bins: jax.Array, default_bins: jax.Array,
-                    missing_types: jax.Array, is_categorical: jax.Array,
-                    feature_mask: jax.Array, params: SplitParams,
-                    has_categorical: bool = False) -> SplitResult:
-    """Best split for one leaf over all features.
-
-    The analog of ``FindBestSplitsFromHistograms`` + per-leaf argmax
-    (reference: src/treelearner/serial_tree_learner.cpp:477+, :225).
-    """
+def per_feature_best(hist: jax.Array, parent_g, parent_h, parent_c,
+                     parent_output, num_bins, default_bins, missing_types,
+                     is_categorical, feature_mask, params: SplitParams,
+                     has_categorical: bool = False):
+    """Per-feature best split candidates (the per-feature stage of
+    ``FindBestSplitsFromHistograms``), used directly by the voting-parallel
+    learner's local top-k vote (reference:
+    src/treelearner/voting_parallel_tree_learner.cpp:151-175)."""
     p = params
     F, B, _ = hist.shape
-
     num_gain, num_t, num_dl, num_lg, num_lh, num_lc = _numerical_best(
         hist, parent_g, parent_h, parent_c, parent_output,
         num_bins, default_bins, missing_types,
@@ -340,6 +335,27 @@ def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
     lg = jnp.where(use_cat, cat_lg, num_lg)
     lh = jnp.where(use_cat, cat_lh, num_lh)
     lc = jnp.where(use_cat, cat_lc, num_lc)
+    return gain, thr, dl, lg, lh, lc, cat_bits
+
+
+@functools.partial(jax.jit, static_argnames=("params", "has_categorical"))
+def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
+                    parent_c: jax.Array, parent_output: jax.Array,
+                    num_bins: jax.Array, default_bins: jax.Array,
+                    missing_types: jax.Array, is_categorical: jax.Array,
+                    feature_mask: jax.Array, params: SplitParams,
+                    has_categorical: bool = False) -> SplitResult:
+    """Best split for one leaf over all features.
+
+    The analog of ``FindBestSplitsFromHistograms`` + per-leaf argmax
+    (reference: src/treelearner/serial_tree_learner.cpp:477+, :225).
+    """
+    p = params
+    use_cat = is_categorical
+    gain, thr, dl, lg, lh, lc, cat_bits = per_feature_best(
+        hist, parent_g, parent_h, parent_c, parent_output, num_bins,
+        default_bins, missing_types, is_categorical, feature_mask, params,
+        has_categorical)
 
     # parent gain shift (reference: BeforeNumerical gain_shift + min_gain_to_split)
     parent_gain = leaf_gain(parent_g, parent_h, p, parent_c, parent_output)

@@ -1,0 +1,7 @@
+from .data_parallel import DataParallelTreeLearner
+from .feature_parallel import FeatureParallelTreeLearner
+from .mesh import DATA_AXIS, make_mesh
+from .voting_parallel import VotingParallelTreeLearner
+
+__all__ = ["DataParallelTreeLearner", "FeatureParallelTreeLearner",
+           "VotingParallelTreeLearner", "make_mesh", "DATA_AXIS"]

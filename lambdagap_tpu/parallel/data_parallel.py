@@ -1,0 +1,311 @@
+"""Distributed tree learners over a device mesh.
+
+TPU re-design of the reference's three distributed learners:
+
+- **data-parallel** (reference: src/treelearner/data_parallel_tree_learner.cpp):
+  rows sharded over the ``data`` mesh axis; per split every device builds the
+  histogram of its local rows and a ``psum`` over ICI replaces the
+  ReduceScatter+HistogramSumReducer machinery (:283-298) — the feature→rank
+  ownership tables (PrepareBufferPos :71-121) disappear because XLA owns the
+  reduction schedule. The best-split argmax runs replicated on every device
+  (deterministic), which subsumes ``SyncUpGlobalBestSplit`` (:443).
+- **feature-parallel** (reference: src/treelearner/feature_parallel_tree_learner.cpp):
+  data replicated, each device builds histograms only for its feature block
+  (:38-59 greedy assignment → here a static equal block), then an
+  ``all_gather`` of per-block histograms replaces the SplitInfo Allgather.
+- **voting-parallel** (reference: src/treelearner/voting_parallel_tree_learner.cpp):
+  data-parallel with communication capped: each device proposes its top-k
+  features by local gain (:151-175 GlobalVoting), histograms are summed only
+  for the voted union (:184 CopyLocalHistogram).
+
+All three keep the serial learner's host loop; only the device ops change.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Config
+from ..data.dataset import BinnedDataset
+from ..models.learner import SerialTreeLearner, _HostSplit, _next_pow2
+from ..models.tree import Tree
+from ..ops.histogram import histogram_from_rows
+from ..ops.partition import decision_go_left
+from ..ops.split import find_best_split
+from ..utils import log
+from .mesh import DATA_AXIS, make_mesh
+
+
+class DataParallelTreeLearner(SerialTreeLearner):
+    """Rows sharded over the mesh; histograms psum-reduced over ICI."""
+
+    def __init__(self, dataset: BinnedDataset, config: Config,
+                 mesh: Optional[Mesh] = None) -> None:
+        super().__init__(dataset, config)
+        self.mesh = mesh if mesh is not None else make_mesh(config.tpu_num_devices)
+        self.n_dev = int(self.mesh.devices.size)
+
+        N = self.num_data
+        pad = (-N) % self.n_dev
+        self.n_pad = N + pad
+        self.n_loc = self.n_pad // self.n_dev
+
+        xb = np.asarray(dataset.binned)
+        if pad:
+            xb = np.pad(xb, ((0, pad), (0, 0)))
+        self.x_sharded = jax.device_put(
+            jnp.asarray(xb), NamedSharding(self.mesh, P(DATA_AXIS, None)))
+        # local permutation per shard (local indices)
+        self.perm0_local = jax.device_put(
+            jnp.tile(jnp.arange(self.n_loc, dtype=jnp.int32), self.n_dev),
+            NamedSharding(self.mesh, P(DATA_AXIS)))
+        # padding-row mask (True = real row)
+        real = np.ones(self.n_pad, dtype=bool)
+        real[N:] = False
+        self.real_mask = jax.device_put(
+            jnp.asarray(real), NamedSharding(self.mesh, P(DATA_AXIS)))
+
+        self._build_ops()
+
+    # -- sharding helpers ----------------------------------------------
+    def shard_grad(self, grad: jax.Array) -> jax.Array:
+        pad = self.n_pad - self.num_data
+        if pad:
+            grad = jnp.pad(grad, (0, pad))
+        return jax.device_put(grad, NamedSharding(self.mesh, P(DATA_AXIS)))
+
+    def combine_mask(self, row_mask: Optional[jax.Array]) -> jax.Array:
+        if row_mask is None:
+            return self.real_mask
+        pad = self.n_pad - self.num_data
+        m = jnp.pad(row_mask, (0, pad)) if pad else row_mask
+        m = jax.device_put(m, NamedSharding(self.mesh, P(DATA_AXIS)))
+        return m & self.real_mask
+
+    # -- shard_map ops --------------------------------------------------
+    def _build_ops(self) -> None:
+        mesh = self.mesh
+        B = self.B
+        rpb = self.rows_per_block
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=P())
+        def root_hist(x_l, g_l, h_l, m_l):
+            local = histogram_from_rows(x_l, g_l, h_l, m_l, B, rpb)
+            return jax.lax.psum(local, DATA_AXIS)
+
+        self._root_hist_op = jax.jit(root_hist)
+
+        def leaf_hist(x_l, perm_l, g_l, h_l, m_l, begin_l, count_l, padded):
+            lane = jnp.arange(padded, dtype=jnp.int32)
+            idx = jnp.clip(begin_l[0] + lane, 0, perm_l.shape[0] - 1)
+            rows = perm_l[idx]
+            valid = (lane < count_l[0]) & m_l[rows]
+            local = histogram_from_rows(x_l[rows], g_l[rows], h_l[rows],
+                                        valid, B, rpb)
+            return jax.lax.psum(local, DATA_AXIS)
+
+        self._leaf_hist_ops: Dict[int, callable] = {}
+        self._leaf_hist_fn = leaf_hist
+
+        def partition(x_l, perm_l, begin_l, count_l, feat, thr, dl, dbin, mt,
+                      nb, is_cat, bits, padded):
+            N_l = perm_l.shape[0]
+            lane = jnp.arange(padded, dtype=jnp.int32)
+            idx = begin_l[0] + lane
+            safe = jnp.clip(idx, 0, N_l - 1)
+            rows = perm_l[safe]
+            valid = lane < count_l[0]
+            bv = x_l[rows, feat]
+            go_left = decision_go_left(bv, thr, dl, dbin, mt, nb, is_cat, bits)
+            go_left = go_left & valid
+            key = jnp.where(go_left, 0, jnp.where(valid, 1, 2)).astype(jnp.int32)
+            order = jnp.argsort(key * padded + lane)
+            new_perm = perm_l.at[idx].set(rows[order], mode="drop")
+            return new_perm, jnp.sum(go_left, dtype=jnp.int32)[None]
+
+        self._partition_fn = partition
+        self._partition_ops: Dict[int, callable] = {}
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
+            out_specs=P(DATA_AXIS))
+        def score_update(score_l, perm_l, leaf_begin, leaf_count, leaf_values):
+            # per-shard leaf layout: [D, L] arrays indexed by my axis position
+            d = jax.lax.axis_index(DATA_AXIS)
+            lb = leaf_begin[d]
+            order = jnp.argsort(lb)
+            sorted_begin = lb[order]
+            N_l = score_l.shape[0]
+            which = jnp.searchsorted(
+                sorted_begin, jnp.arange(N_l, dtype=lb.dtype), side="right") - 1
+            vals = leaf_values[order[which]]
+            return score_l.at[perm_l].add(vals)
+
+        self._score_update_op = jax.jit(score_update)
+
+    def _leaf_hist_op(self, padded: int):
+        if padded not in self._leaf_hist_ops:
+            fn = functools.partial(self._leaf_hist_fn, padded=padded)
+            self._leaf_hist_ops[padded] = jax.jit(shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                          P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=P()))
+        return self._leaf_hist_ops[padded]
+
+    def _root_totals(self, hist_root):
+        """Global (g, h, count) totals from the root histogram."""
+        return jnp.sum(hist_root[0], axis=0)
+
+    def _partition_op(self, padded: int):
+        if padded not in self._partition_ops:
+            fn = functools.partial(self._partition_fn, padded=padded)
+            self._partition_ops[padded] = jax.jit(shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                          P(DATA_AXIS), P(), P(), P(), P(), P(), P(), P(), P()),
+                out_specs=(P(DATA_AXIS), P(DATA_AXIS))))
+        return self._partition_ops[padded]
+
+    # ------------------------------------------------------------------
+    def train(self, grad: jax.Array, hess: jax.Array,
+              row_mask: Optional[jax.Array] = None) -> Tree:
+        cfg = self.config
+        num_leaves = cfg.num_leaves
+        max_depth = cfg.max_depth
+        tree = Tree(max_leaves=num_leaves)
+        fmask = self._feature_mask()
+        D = self.n_dev
+
+        g = self.shard_grad(grad)
+        h = self.shard_grad(hess)
+        m = self.combine_mask(row_mask)
+
+        perm = self.perm0_local
+        # per-shard leaf bookkeeping (host): [D, L]
+        leaf_begin = np.zeros((D, num_leaves), dtype=np.int64)
+        leaf_count = np.zeros((D, num_leaves), dtype=np.int64)
+        leaf_count[:, 0] = self.n_loc
+
+        hist_root = self._root_hist_op(self.x_sharded, g, h, m)
+        totals = self._root_totals(hist_root)
+        from ..models.learner import _leaf_output_scalar
+        root_out = _leaf_output_scalar(totals[0], totals[1], totals[2],
+                                       self.params)
+        hists: Dict[int, jax.Array] = {0: hist_root}
+        best: Dict[int, _HostSplit] = {
+            0: self._best(hist_root, totals[0], totals[1], totals[2],
+                          root_out, fmask)}
+        tree.leaf_value[0] = float(jax.device_get(root_out))
+        tree.leaf_weight[0] = float(jax.device_get(totals[1]))
+        tree.leaf_count[0] = int(float(jax.device_get(totals[2])))
+
+        def shard_scalars(vals: np.ndarray) -> jax.Array:
+            return jax.device_put(jnp.asarray(vals.astype(np.int32)),
+                                  NamedSharding(self.mesh, P(DATA_AXIS)))
+
+        for _ in range(num_leaves - 1):
+            cand = [(s.gain_f, leaf) for leaf, s in best.items()
+                    if np.isfinite(s.gain_f) and s.gain_f > 0
+                    and (max_depth <= 0 or tree.leaf_depth[leaf] < max_depth)]
+            if not cand:
+                break
+            _, leaf = max(cand)
+            s = best.pop(leaf)
+
+            counts_here = leaf_count[:, leaf]
+            P_pad = min(max(_next_pow2(int(counts_here.max())), 64), self.n_loc)
+            feat = int(s.feature)
+            perm, left_counts_dev = self._partition_op(P_pad)(
+                self.x_sharded, perm,
+                shard_scalars(leaf_begin[:, leaf]),
+                shard_scalars(counts_here),
+                jnp.int32(feat), jnp.int32(s.threshold),
+                jnp.asarray(bool(s.default_left)),
+                self.default_bins_arr[feat], self.missing_types_arr[feat],
+                self.num_bins_arr[feat], jnp.asarray(bool(s.is_categorical)),
+                jnp.asarray(s.cat_bitset))
+            left_counts = np.asarray(jax.device_get(left_counts_dev)).astype(np.int64)
+            right_counts = counts_here - left_counts
+            # global child populations come from the histogram count channel
+            gl_left = float(s.left_count)
+            gl_right = float(s.right_count)
+            if gl_left <= 0 or gl_right <= 0:
+                log.warning("Degenerate distributed split on leaf %d; skipping", leaf)
+                continue
+
+            j = self.dataset.used_features[feat]
+            mapper = self.dataset.mappers[j]
+            mt_code = {"None": 0, "Zero": 1, "NaN": 2}[mapper.missing_type]
+            cat_real = (self._cat_bitset_real(feat, s.cat_bitset)
+                        if s.is_categorical else None)
+            right_leaf = tree.split(
+                leaf, feature=j, feature_inner=feat,
+                threshold_bin=int(s.threshold),
+                threshold_real=mapper.bin_to_value(int(s.threshold)),
+                default_left=bool(s.default_left), missing_type=mt_code,
+                gain=s.gain_f,
+                left_value=float(s.left_output), right_value=float(s.right_output),
+                left_weight=float(s.left_sum_h), right_weight=float(s.right_sum_h),
+                left_count=int(gl_left), right_count=int(gl_right),
+                is_categorical=bool(s.is_categorical),
+                cat_bitset=np.asarray(s.cat_bitset), cat_bitset_real=cat_real)
+
+            leaf_begin[:, right_leaf] = leaf_begin[:, leaf] + left_counts
+            leaf_count[:, right_leaf] = right_counts
+            leaf_count[:, leaf] = left_counts
+
+            parent_hist = hists.pop(leaf)
+            l_sums = (jnp.float32(s.left_sum_g), jnp.float32(s.left_sum_h),
+                      jnp.float32(s.left_count), jnp.float32(s.left_output))
+            r_sums = (jnp.float32(s.right_sum_g), jnp.float32(s.right_sum_h),
+                      jnp.float32(s.right_count), jnp.float32(s.right_output))
+            if tree.num_leaves >= num_leaves:
+                break
+
+            small_is_left = gl_left <= gl_right
+            small_leaf = leaf if small_is_left else right_leaf
+            large_leaf = right_leaf if small_is_left else leaf
+            sc = leaf_count[:, small_leaf]
+            Ph = min(max(_next_pow2(int(sc.max())), 64), self.n_loc)
+            hist_small = self._leaf_hist_op(Ph)(
+                self.x_sharded, perm, g, h, m,
+                shard_scalars(leaf_begin[:, small_leaf]),
+                shard_scalars(sc))
+            hist_large = parent_hist - hist_small
+            s_sums = l_sums if small_is_left else r_sums
+            g_sums = r_sums if small_is_left else l_sums
+            hists[small_leaf] = hist_small
+            hists[large_leaf] = hist_large
+            best[small_leaf] = self._best(hist_small, *s_sums, fmask)
+            best[large_leaf] = self._best(hist_large, *g_sums, fmask)
+
+        self.last_perm = perm
+        self.last_leaf_begin = leaf_begin[:, :tree.num_leaves].copy()
+        self.last_leaf_count = leaf_count[:, :tree.num_leaves].copy()
+        return tree
+
+    # ------------------------------------------------------------------
+    def update_scores(self, score: jax.Array, leaf_values: jax.Array) -> jax.Array:
+        """Add the just-trained tree to the training score [N] (unpadded in,
+        unpadded out); the scatter itself runs sharded."""
+        pad = self.n_pad - self.num_data
+        s = jnp.pad(score, (0, pad)) if pad else score
+        s = jax.device_put(s, NamedSharding(self.mesh, P(DATA_AXIS)))
+        out = self._score_update_op(
+            s, self.last_perm,
+            jnp.asarray(self.last_leaf_begin.astype(np.int32)),
+            jnp.asarray(self.last_leaf_count.astype(np.int32)),
+            leaf_values)
+        return out[:self.num_data]

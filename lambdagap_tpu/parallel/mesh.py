@@ -1,0 +1,46 @@
+"""Device mesh helpers.
+
+The TPU replacement for the reference's Network layer
+(reference: src/network/ — socket/MPI Linkers, Bruck allgather,
+recursive-halving reduce-scatter, network.h:89-275 collectives): here the
+"network" is a ``jax.sharding.Mesh`` over ICI/DCN and every collective is an
+XLA op (``psum``/``all_gather``/``psum_scatter``) emitted inside
+``shard_map``; schedules (ring vs tree vs Bruck) are XLA's problem, not ours
+(SURVEY.md §2.6).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_devices: int = 0, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D data mesh. ``num_devices=0`` uses all visible devices."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices and num_devices > 0:
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def shard_rows(mesh: Mesh, array, pad_value=0):
+    """Pad the leading dim to a device multiple and shard it over the mesh."""
+    import jax.numpy as jnp
+    n_dev = mesh.devices.size
+    n = array.shape[0]
+    pad = (-n) % n_dev
+    if pad:
+        pad_widths = [(0, pad)] + [(0, 0)] * (array.ndim - 1)
+        array = jnp.pad(array, pad_widths, constant_values=pad_value)
+    spec = P(DATA_AXIS, *([None] * (array.ndim - 1)))
+    return jax.device_put(array, NamedSharding(mesh, spec)), pad
+
+
+def replicated(mesh: Mesh, array):
+    import jax
+    return jax.device_put(array, NamedSharding(mesh, P()))

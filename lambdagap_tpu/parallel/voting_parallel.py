@@ -1,0 +1,130 @@
+"""Voting-parallel tree learner.
+
+(reference: src/treelearner/voting_parallel_tree_learner.cpp — data-parallel
+with communication held constant: each rank proposes its top-k features by
+local gain, votes are Allgathered, GlobalVoting (:151-175) picks the union,
+and only the voted features' histograms are summed (:184 CopyLocalHistogram
++ Allreduce) before the global best is chosen.)
+
+TPU shape: leaf histograms stay *local* (sharded ``[D*F, B, 3]``); the vote is
+a ``top_k`` + ``all_gather`` of feature ids, and the final reduction is a
+``psum`` over only the voted columns — O(2k·B) bytes on the wire instead of
+O(F·B).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import Config
+from ..data.dataset import BinnedDataset
+from ..models.learner import _HostSplit
+from ..ops.histogram import histogram_from_rows
+from ..ops.split import SplitParams, find_best_split, per_feature_best
+from .data_parallel import DataParallelTreeLearner
+from .mesh import DATA_AXIS
+
+
+class VotingParallelTreeLearner(DataParallelTreeLearner):
+    """Data-parallel loop; histogram reduction replaced by top-k voting."""
+
+    def _build_ops(self) -> None:
+        super()._build_ops()
+        mesh = self.mesh
+        B = self.B
+        rpb = self.rows_per_block
+        F = self.num_features
+        top_k = max(1, min(self.config.top_k, F))
+        params = self.params
+        has_cat = self.has_categorical
+
+        # local histograms, stacked sharded over devices: [D*F, B, 3]
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS)),
+            out_specs=P(DATA_AXIS))
+        def root_hist_local(x_l, g_l, h_l, m_l):
+            return histogram_from_rows(x_l, g_l, h_l, m_l, B, rpb)
+
+        self._root_hist_op = jax.jit(root_hist_local)
+
+        def leaf_hist_local(x_l, perm_l, g_l, h_l, m_l, begin_l, count_l,
+                            padded):
+            lane = jnp.arange(padded, dtype=jnp.int32)
+            idx = jnp.clip(begin_l[0] + lane, 0, perm_l.shape[0] - 1)
+            rows = perm_l[idx]
+            valid = (lane < count_l[0]) & m_l[rows]
+            return histogram_from_rows(x_l[rows], g_l[rows], h_l[rows],
+                                       valid, B, rpb)
+
+        self._leaf_hist_fn = leaf_hist_local
+        self._leaf_hist_ops = {}
+
+        meta = (self.num_bins_arr, self.default_bins_arr,
+                self.missing_types_arr, self.is_categorical_arr)
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(DATA_AXIS),),
+            out_specs=P())
+        def root_totals(hist_l):
+            return jax.lax.psum(jnp.sum(hist_l[0], axis=0), DATA_AXIS)
+
+        self._root_totals_op = jax.jit(root_totals)
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(DATA_AXIS), P(), P(), P(), P(), P()),
+            out_specs=P())
+        def voting_best(hist_l, pg, ph, pc, pout, fmask):
+            """Local top-k vote -> psum of voted columns -> global best."""
+            h0 = hist_l            # local [F, B, 3]
+            num_bins, default_bins, missing_types, is_cat = meta
+            # local parent sums for the vote (approximate, like the reference)
+            lt = jnp.sum(h0[0], axis=0)
+            lgain, *_ = per_feature_best(
+                h0, lt[0], lt[1], lt[2], jnp.float32(0.0),
+                num_bins, default_bins, missing_types, is_cat, fmask,
+                params, has_cat)
+            _, local_top = jax.lax.top_k(lgain, top_k)
+            votes = jax.lax.all_gather(local_top.astype(jnp.int32),
+                                       DATA_AXIS, tiled=True)    # [D*k]
+            hist_voted = jax.lax.psum(h0[votes], DATA_AXIS)      # [D*k, B, 3]
+            res = find_best_split(
+                hist_voted, pg, ph, pc, pout,
+                num_bins[votes], default_bins[votes], missing_types[votes],
+                is_cat[votes], fmask[votes], params,
+                has_categorical=has_cat)
+            # remap the winning index back to the true feature id
+            true_feat = votes[res.feature]
+            return res._replace(feature=true_feat)
+
+        self._voting_best_op = jax.jit(voting_best)
+
+    def _leaf_hist_op(self, padded: int):
+        if padded not in self._leaf_hist_ops:
+            fn = functools.partial(self._leaf_hist_fn, padded=padded)
+            self._leaf_hist_ops[padded] = jax.jit(shard_map(
+                fn, mesh=self.mesh,
+                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                          P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                          P(DATA_AXIS)),
+                out_specs=P(DATA_AXIS)))
+        return self._leaf_hist_ops[padded]
+
+    def _best(self, hist, pg, ph, pc, parent_output, fmask) -> _HostSplit:
+        res = self._voting_best_op(hist, jnp.float32(pg), jnp.float32(ph),
+                                   jnp.float32(pc), jnp.float32(parent_output),
+                                   fmask)
+        return _HostSplit(jax.device_get(res))
+
+    def _root_totals(self, hist_root):
+        # local hists are partial sums: the global totals need a psum
+        return self._root_totals_op(hist_root)

@@ -4,14 +4,22 @@ The driver/bench run on real TPU; tests exercise the same code paths on CPU
 (the reference's analog: CPU-vs-GPU parity tests, tests/python_package_test/
 test_dual.py). 8 virtual devices let distributed learners be tested without
 hardware (SURVEY.md §4).
+
+NOTE: the environment's site hook may pre-register a remote TPU backend and
+force ``JAX_PLATFORMS``; ``jax.config.update`` after import wins as long as
+no backend has been initialized yet, so it must happen here, before any test
+imports touch a jax array.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,99 @@
+"""BinMapper tests (reference analog: bin construction behavior exercised
+throughout tests/python_package_test/test_basic.py)."""
+import numpy as np
+import pytest
+
+from lambdagap_tpu.data.binning import (BIN_CATEGORICAL, MISSING_NAN,
+                                        MISSING_NONE, MISSING_ZERO, BinMapper)
+
+
+def test_simple_numerical_bins():
+    vals = np.repeat(np.arange(10, dtype=float), 20)
+    m = BinMapper.find_bin(vals, total_sample_cnt=len(vals), max_bin=255,
+                           min_data_in_bin=1)
+    assert m.missing_type == MISSING_NONE
+    assert not m.is_trivial
+    bins = m.values_to_bins(np.arange(10, dtype=float))
+    # distinct values get distinct bins, order preserving
+    assert len(np.unique(bins)) == 10
+    assert np.all(np.diff(bins) > 0)
+
+
+def test_max_bin_respected():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(10000)
+    for max_bin in (15, 63, 255):
+        m = BinMapper.find_bin(vals, len(vals), max_bin=max_bin, min_data_in_bin=1)
+        assert m.num_bin <= max_bin
+        bins = m.values_to_bins(vals)
+        assert bins.max() < m.num_bin
+
+
+def test_equal_count_binning():
+    rng = np.random.RandomState(1)
+    vals = rng.randn(100000)
+    m = BinMapper.find_bin(vals, len(vals), max_bin=16, min_data_in_bin=1)
+    bins = m.values_to_bins(vals)
+    counts = np.bincount(bins, minlength=m.num_bin)
+    # roughly equal-count (within 3x of mean)
+    nonzero = counts[counts > 0]
+    assert nonzero.min() > len(vals) / 16 / 3
+
+
+def test_nan_gets_own_bin():
+    vals = np.concatenate([np.random.RandomState(2).randn(1000),
+                           [np.nan] * 100])
+    m = BinMapper.find_bin(vals, len(vals), max_bin=255, min_data_in_bin=1)
+    assert m.missing_type == MISSING_NAN
+    bins = m.values_to_bins(np.asarray([np.nan, 0.0]))
+    assert bins[0] == m.num_bin - 1       # NaN -> last bin
+    assert bins[1] != m.num_bin - 1
+
+
+def test_zero_as_missing():
+    vals = np.random.RandomState(3).randn(500)
+    m = BinMapper.find_bin(vals, total_sample_cnt=1000,  # 500 implicit zeros
+                           max_bin=255, min_data_in_bin=1, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    assert m.default_bin == m.values_to_bins(np.zeros(1))[0]
+
+
+def test_zero_bin_separate():
+    # zeros (sparse convention: absent from sample) land in their own bin
+    vals = np.asarray([-2.0, -1.0, 1.0, 2.0] * 50)
+    m = BinMapper.find_bin(vals, total_sample_cnt=400, max_bin=255,
+                           min_data_in_bin=1)
+    b = m.values_to_bins(np.asarray([-1.5, 0.0, 1.5]))
+    assert len(np.unique(b)) == 3
+
+
+def test_categorical_bins():
+    rng = np.random.RandomState(4)
+    cats = rng.choice([1, 2, 3, 7, 9], size=1000,
+                      p=[0.5, 0.25, 0.15, 0.07, 0.03]).astype(float)
+    m = BinMapper.find_bin(cats, len(cats), max_bin=255, min_data_in_bin=1,
+                           bin_type=BIN_CATEGORICAL)
+    bins = m.values_to_bins(np.asarray([1.0, 2.0, 3.0, 7.0, 9.0]))
+    # most frequent category gets bin 1 (bin 0 is NaN/unseen dummy)
+    assert bins[0] == 1
+    assert len(np.unique(bins)) == 5
+    # unseen category -> dummy bin 0
+    assert m.values_to_bins(np.asarray([999.0]))[0] == 0
+
+
+def test_trivial_feature():
+    vals = np.zeros(100)
+    m = BinMapper.find_bin(vals[vals != 0], total_sample_cnt=100, max_bin=255,
+                           min_data_in_bin=3)
+    assert m.is_trivial
+
+
+def test_bin_to_value_roundtrip():
+    rng = np.random.RandomState(5)
+    vals = rng.randn(5000)
+    m = BinMapper.find_bin(vals, len(vals), max_bin=63, min_data_in_bin=3)
+    bins = m.values_to_bins(vals)
+    # threshold semantics: v <= upper_bound(bin) for every v in that bin
+    for b in np.unique(bins)[:-1]:
+        ub = m.bin_to_value(int(b))
+        assert np.all(vals[bins == b] <= ub)

@@ -1,0 +1,97 @@
+"""Model save/load round-trip (reference analog: model string tests in
+tests/python_package_test/test_basic.py and gbdt_model_text.cpp round trip)."""
+import numpy as np
+from sklearn.datasets import make_classification, make_regression
+
+import lambdagap_tpu as lgb
+
+
+def test_model_string_roundtrip_regression():
+    X, y = make_regression(800, 8, noise=3.0, random_state=0)
+    booster = lgb.train({"objective": "regression", "verbose": -1,
+                         "num_leaves": 15}, lgb.Dataset(X, label=y),
+                        num_boost_round=12)
+    s = booster.model_to_string()
+    assert s.startswith("tree\n")
+    assert "end of trees" in s
+    loaded = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(loaded.predict(X), booster.predict(X),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_model_file_roundtrip_binary(tmp_path):
+    X, y = make_classification(800, 10, random_state=1)
+    booster = lgb.train({"objective": "binary", "verbose": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+    path = str(tmp_path / "model.txt")
+    booster.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(loaded.predict(X), booster.predict(X),
+                               rtol=1e-5, atol=1e-5)
+    # sigmoid conversion preserved
+    assert np.all((loaded.predict(X) >= 0) & (loaded.predict(X) <= 1))
+
+
+def test_model_roundtrip_multiclass():
+    X, y = make_classification(900, 10, n_classes=3, n_informative=6,
+                               random_state=2)
+    booster = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "verbose": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=8)
+    loaded = lgb.Booster(model_str=booster.model_to_string())
+    np.testing.assert_allclose(loaded.predict(X), booster.predict(X),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_model_roundtrip_categorical():
+    rng = np.random.RandomState(3)
+    n = 1500
+    cat = rng.randint(0, 6, n).astype(float)
+    X = np.column_stack([cat, rng.randn(n)])
+    y = (cat == 3) * 2.0 + X[:, 1]
+    booster = lgb.train({"objective": "regression", "verbose": -1,
+                         "num_leaves": 15, "min_data_in_leaf": 5},
+                        lgb.Dataset(X, label=y, categorical_feature=[0]),
+                        num_boost_round=10)
+    loaded = lgb.Booster(model_str=booster.model_to_string())
+    np.testing.assert_allclose(loaded.predict(X), booster.predict(X),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_num_iteration_predict():
+    X, y = make_regression(500, 6, random_state=4)
+    booster = lgb.train({"objective": "regression", "verbose": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=20)
+    p5 = booster.predict(X, num_iteration=5)
+    p20 = booster.predict(X)
+    assert not np.allclose(p5, p20)
+    s = booster.model_to_string(num_iteration=5)
+    loaded = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(loaded.predict(X), p5, rtol=1e-5, atol=1e-5)
+
+
+def test_feature_importance():
+    X, y = make_regression(800, 8, n_informative=3, random_state=5)
+    booster = lgb.train({"objective": "regression", "verbose": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=10)
+    imp_split = booster.feature_importance("split")
+    imp_gain = booster.feature_importance("gain")
+    assert imp_split.shape == (8,)
+    assert imp_split.sum() > 0
+    assert imp_gain.sum() > 0
+
+
+def test_host_predict_matches_device():
+    """Tree.predict_row (host reference semantics) agrees with the batched
+    device traversal."""
+    X, y = make_regression(600, 6, random_state=6)
+    booster = lgb.train({"objective": "regression", "verbose": -1,
+                         "num_leaves": 15}, lgb.Dataset(X, label=y),
+                        num_boost_round=5)
+    gb = booster._booster
+    device = booster.predict(X[:50], raw_score=True)
+    host = np.zeros(50)
+    for tree in gb.models:
+        for i in range(50):
+            host[i] += tree.predict_row(X[i])
+    np.testing.assert_allclose(device, host, rtol=1e-5, atol=1e-5)

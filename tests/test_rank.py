@@ -1,0 +1,159 @@
+"""Ranking objective/metric tests (reference analog: test_engine.py
+lambdarank tests :736-835 + the fork's 18-target surface)."""
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.config import LAMBDARANK_TARGETS
+
+
+def _make_ltr(n_queries=60, docs_per_query=25, n_features=10, seed=0):
+    """Synthetic LTR data: relevance depends on a few features."""
+    rng = np.random.RandomState(seed)
+    n = n_queries * docs_per_query
+    X = rng.randn(n, n_features)
+    util = 2.0 * X[:, 0] + X[:, 1] + 0.5 * rng.randn(n)
+    labels = np.zeros(n)
+    group = np.full(n_queries, docs_per_query)
+    for q in range(n_queries):
+        s = slice(q * docs_per_query, (q + 1) * docs_per_query)
+        u = util[s]
+        ranks = np.argsort(np.argsort(-u))
+        lab = np.zeros(docs_per_query)
+        lab[ranks < 3] = 2
+        lab[(ranks >= 3) & (ranks < 8)] = 1
+        labels[s] = lab
+    return X, labels, group
+
+
+def _ndcg_at(booster, X, labels, group, k=5):
+    scores = booster.predict(X, raw_score=True)
+    qb = np.concatenate([[0], np.cumsum(group)]).astype(int)
+    vals = []
+    for qi in range(len(group)):
+        s, e = qb[qi], qb[qi + 1]
+        order = np.argsort(-scores[s:e])
+        l = labels[s:e][order].astype(int)
+        disc = 1.0 / np.log2(2.0 + np.arange(len(l)))
+        dcg = np.sum((2.0 ** l[:k] - 1) * disc[:k])
+        li = np.sort(labels[s:e].astype(int))[::-1]
+        mdcg = np.sum((2.0 ** li[:k] - 1) * disc[:k])
+        vals.append(dcg / mdcg if mdcg > 0 else 1.0)
+    return float(np.mean(vals))
+
+
+def test_lambdarank_learns_ranking():
+    X, labels, group = _make_ltr()
+    ds = lgb.Dataset(X, label=labels, group=group)
+    booster = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                         "eval_at": [5], "num_leaves": 15, "verbose": -1,
+                         "min_data_in_leaf": 5},
+                        ds, num_boost_round=40)
+    ndcg = _ndcg_at(booster, X, labels, group)
+    assert ndcg > 0.85
+
+
+def test_lambdarank_ndcg_metric_reported():
+    X, labels, group = _make_ltr(seed=1)
+    ds = lgb.Dataset(X, label=labels, group=group)
+    vs = ds.create_valid(X, label=labels, group=group)
+    res = {}
+    lgb.train({"objective": "lambdarank", "metric": "ndcg",
+               "eval_at": [1, 3, 5], "verbose": -1, "min_data_in_leaf": 5},
+              ds, num_boost_round=15, valid_sets=[vs],
+              callbacks=[lgb.record_evaluation(res)])
+    assert "ndcg@1" in res["valid_0"]
+    assert "ndcg@5" in res["valid_0"]
+    assert res["valid_0"]["ndcg@5"][-1] > res["valid_0"]["ndcg@5"][0] - 1e-9
+
+
+@pytest.mark.parametrize("target", LAMBDARANK_TARGETS)
+def test_all_lambdarank_targets_train(target):
+    """Every one of the fork's 18 gradient targets produces a learning model
+    (reference: rank_objective.hpp:22-41)."""
+    X, labels, group = _make_ltr(n_queries=30, docs_per_query=15, seed=2)
+    ds = lgb.Dataset(X, label=labels, group=group)
+    booster = lgb.train({"objective": "lambdarank",
+                         "lambdarank_target": target,
+                         "lambdarank_truncation_level": 5,
+                         "num_leaves": 7, "verbose": -1,
+                         "min_data_in_leaf": 3},
+                        ds, num_boost_round=15)
+    assert booster.num_trees() > 0
+    ndcg = _ndcg_at(booster, X, labels, group)
+    assert ndcg > 0.6, f"target {target} failed to learn: ndcg={ndcg}"
+
+
+def test_lambdagap_weight_changes_gradients():
+    X, labels, group = _make_ltr(seed=3)
+    preds = []
+    for w in (0.1, 5.0):
+        booster = lgb.train({"objective": "lambdarank",
+                             "lambdarank_target": "lambdaloss-ndcg-plus-plus",
+                             "lambdagap_weight": w, "verbose": -1,
+                             "min_data_in_leaf": 5},
+                            lgb.Dataset(X, label=labels, group=group),
+                            num_boost_round=10)
+        preds.append(booster.predict(X, raw_score=True))
+    assert not np.allclose(preds[0], preds[1])
+
+
+def test_rank_xendcg():
+    X, labels, group = _make_ltr(seed=4)
+    booster = lgb.train({"objective": "rank_xendcg", "verbose": -1,
+                         "min_data_in_leaf": 5, "num_leaves": 15},
+                        lgb.Dataset(X, label=labels, group=group),
+                        num_boost_round=40)
+    assert _ndcg_at(booster, X, labels, group) > 0.8
+
+
+def test_query_ids_as_group():
+    """Per-row query ids are accepted in place of group sizes."""
+    X, labels, group = _make_ltr(n_queries=20, seed=5)
+    qid = np.repeat(np.arange(20), 25)
+    b1 = lgb.train({"objective": "lambdarank", "verbose": -1,
+                    "min_data_in_leaf": 5},
+                   lgb.Dataset(X, label=labels, group=group), num_boost_round=5)
+    b2 = lgb.train({"objective": "lambdarank", "verbose": -1,
+                    "min_data_in_leaf": 5},
+                   lgb.Dataset(X, label=labels, group=qid), num_boost_round=5)
+    np.testing.assert_allclose(b1.predict(X), b2.predict(X), rtol=1e-5)
+
+
+def test_position_bias():
+    X, labels, group = _make_ltr(seed=6)
+    pos = np.tile(np.arange(25), 60)
+    booster = lgb.train({"objective": "lambdarank", "verbose": -1,
+                         "min_data_in_leaf": 5},
+                        lgb.Dataset(X, label=labels, group=group, position=pos),
+                        num_boost_round=10)
+    obj = booster._booster.objective
+    assert obj.pos_biases is not None
+    assert obj.pos_biases.shape == (25,)
+    # biases moved away from zero
+    assert float(np.abs(np.asarray(obj.pos_biases)).sum()) > 0
+
+
+def test_precision_metric():
+    X, labels, group = _make_ltr(seed=7)
+    ds = lgb.Dataset(X, label=labels, group=group)
+    vs = ds.create_valid(X, label=labels, group=group)
+    res = {}
+    lgb.train({"objective": "lambdarank", "metric": "precision",
+               "eval_at": [3, 5], "verbose": -1, "min_data_in_leaf": 5},
+              ds, num_boost_round=10, valid_sets=[vs],
+              callbacks=[lgb.record_evaluation(res)])
+    assert "precision@3" in res["valid_0"]
+    assert 0 <= res["valid_0"]["precision@3"][-1] <= 1
+
+
+def test_map_metric():
+    X, labels, group = _make_ltr(seed=8)
+    ds = lgb.Dataset(X, label=(labels > 0).astype(float), group=group)
+    vs = ds.create_valid(X, label=(labels > 0).astype(float), group=group)
+    res = {}
+    lgb.train({"objective": "lambdarank", "metric": "map", "eval_at": [5],
+               "verbose": -1, "min_data_in_leaf": 5},
+              ds, num_boost_round=10, valid_sets=[vs],
+              callbacks=[lgb.record_evaluation(res)])
+    assert "map@5" in res["valid_0"]

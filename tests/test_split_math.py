@@ -1,0 +1,126 @@
+"""Golden tests for split gain / leaf output math against hand-computed
+values (SURVEY.md §7 order-of-construction step 1; mirrors the math of
+reference feature_histogram.hpp:711-830)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lambdagap_tpu.ops.split import (SplitParams, calculate_leaf_output,
+                                     find_best_split, leaf_gain, threshold_l1)
+
+
+def test_threshold_l1():
+    assert float(threshold_l1(5.0, 2.0)) == 3.0
+    assert float(threshold_l1(-5.0, 2.0)) == -3.0
+    assert float(threshold_l1(1.0, 2.0)) == 0.0
+
+
+def test_leaf_output_basic():
+    p = SplitParams(lambda_l2=1.0)
+    # -sum_g / (sum_h + l2)
+    assert np.isclose(float(calculate_leaf_output(4.0, 3.0, p)), -1.0)
+
+
+def test_leaf_output_max_delta_step():
+    p = SplitParams(max_delta_step=0.5)
+    assert np.isclose(float(calculate_leaf_output(10.0, 1.0, p)), -0.5)
+
+
+def test_leaf_gain():
+    p = SplitParams(lambda_l2=0.0)
+    # g^2 / h
+    assert np.isclose(float(leaf_gain(4.0, 2.0, p)), 8.0)
+
+
+def _run_best(hist, parent, params, num_bins=None, missing=0, cat=False):
+    F, B, _ = hist.shape
+    nb = jnp.full((F,), B if num_bins is None else num_bins, jnp.int32)
+    return find_best_split(
+        jnp.asarray(hist, jnp.float32),
+        jnp.float32(parent[0]), jnp.float32(parent[1]), jnp.float32(parent[2]),
+        jnp.float32(0.0), nb,
+        jnp.zeros(F, jnp.int32), jnp.full((F,), missing, jnp.int32),
+        jnp.full((F,), cat), jnp.ones(F, bool), params,
+        has_categorical=cat)
+
+
+def test_obvious_split():
+    """Two bins: all negative gradient in bin 0, positive in bin 1 —
+    the split must separate them at threshold 0."""
+    B = 8
+    hist = np.zeros((1, B, 3), np.float32)
+    hist[0, 0] = [-10.0, 5.0, 50.0]
+    hist[0, 1] = [+10.0, 5.0, 50.0]
+    params = SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=1e-3)
+    res = _run_best(hist, (0.0, 10.0, 100.0), params)
+    assert int(res.feature) == 0
+    assert int(res.threshold) == 0
+    # gain = 10^2/5 + 10^2/5 - 0 = 40
+    assert np.isclose(float(res.gain), 40.0, rtol=1e-5)
+    assert np.isclose(float(res.left_output), 2.0, rtol=1e-5)
+    assert np.isclose(float(res.right_output), -2.0, rtol=1e-5)
+
+
+def test_min_data_in_leaf_blocks_split():
+    B = 8
+    hist = np.zeros((1, B, 3), np.float32)
+    hist[0, 0] = [-10.0, 5.0, 5.0]
+    hist[0, 1] = [+10.0, 5.0, 5.0]
+    params = SplitParams(min_data_in_leaf=6)
+    res = _run_best(hist, (0.0, 10.0, 10.0), params)
+    assert not np.isfinite(float(res.gain))
+
+
+def test_l2_reduces_gain():
+    B = 4
+    hist = np.zeros((1, B, 3), np.float32)
+    hist[0, 0] = [-10.0, 5.0, 50.0]
+    hist[0, 1] = [10.0, 5.0, 50.0]
+    g0 = _run_best(hist, (0.0, 10.0, 100.0), SplitParams(min_data_in_leaf=1))
+    g1 = _run_best(hist, (0.0, 10.0, 100.0),
+                   SplitParams(min_data_in_leaf=1, lambda_l2=5.0))
+    assert float(g1.gain) < float(g0.gain)
+
+
+def test_best_feature_chosen():
+    B = 4
+    hist = np.zeros((3, B, 3), np.float32)
+    # feature 1 separates best
+    hist[:, 0] = [-1.0, 5.0, 50.0]
+    hist[:, 1] = [1.0, 5.0, 50.0]
+    hist[1, 0] = [-20.0, 5.0, 50.0]
+    hist[1, 1] = [20.0, 5.0, 50.0]
+    res = _run_best(hist, (0.0, 10.0, 100.0), SplitParams(min_data_in_leaf=1))
+    assert int(res.feature) == 1
+
+
+def test_missing_nan_direction():
+    """NaN bin content should flow to the better side via default_left."""
+    B = 8
+    nb = 4   # bins: 0,1,2 real; 3 = NaN bin
+    hist = np.zeros((1, B, 3), np.float32)
+    hist[0, 0] = [-10.0, 5.0, 50.0]
+    hist[0, 1] = [10.0, 5.0, 50.0]
+    hist[0, 3] = [-5.0, 2.0, 20.0]   # NaN rows have negative grads (like bin 0)
+    params = SplitParams(min_data_in_leaf=1)
+    res = _run_best(hist, (-5.0, 12.0, 120.0), params, num_bins=nb, missing=2)
+    assert int(res.threshold) == 0
+    # best: NaN joins left (negative side)
+    assert bool(res.default_left)
+    assert np.isclose(float(res.left_sum_g), -15.0, atol=1e-4)
+
+
+def test_categorical_onehot():
+    B = 8
+    nb = 4
+    hist = np.zeros((1, B, 3), np.float32)
+    hist[0, 0] = [0.0, 1.0, 10.0]
+    hist[0, 1] = [-9.0, 3.0, 30.0]    # category 1 is special
+    hist[0, 2] = [3.0, 3.0, 30.0]
+    hist[0, 3] = [3.0, 3.0, 30.0]
+    params = SplitParams(min_data_in_leaf=1, cat_l2=0.0, cat_smooth=0.0,
+                         max_cat_to_onehot=8)
+    res = _run_best(hist, (-3.0, 10.0, 100.0), params, num_bins=nb, cat=True)
+    assert bool(res.is_categorical)
+    # bitset has exactly category-bin 1 going left
+    assert int(res.cat_bitset[0]) == 2
