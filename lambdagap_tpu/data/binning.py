@@ -26,10 +26,30 @@ BIN_NUMERICAL = "numerical"
 BIN_CATEGORICAL = "categorical"
 
 
+def _compress_distinct(distinct: np.ndarray, counts: np.ndarray,
+                       target: int):
+    """Merge adjacent distinct values into ~``target`` equal-count groups so
+    the greedy boundary loop below stays O(target) regardless of sample
+    cardinality. Each group is represented by its largest member (the
+    midpoint-based boundaries shift by less than one group width)."""
+    if len(distinct) <= target:
+        return distinct, counts
+    csum = np.cumsum(counts)
+    edges = np.searchsorted(csum, np.linspace(0, csum[-1], target + 1)[1:],
+                            side="left")
+    edges = np.unique(np.clip(edges, 0, len(distinct) - 1))
+    group_counts = np.diff(np.concatenate([[0], csum[edges]]))
+    keep = group_counts > 0
+    return distinct[edges][keep], group_counts[keep].astype(np.int64)
+
+
 def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
                      max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
     """Equal-count greedy bin boundary search
     (reference: src/io/bin.cpp:78-155 GreedyFindBin)."""
+    if len(distinct_values) > 8 * max_bin:
+        distinct_values, counts = _compress_distinct(
+            distinct_values, counts, 8 * max_bin)
     num_distinct = len(distinct_values)
     bounds: List[float] = []
     if num_distinct == 0:

@@ -89,7 +89,10 @@ def histogram_from_rows(bins: jax.Array, grad: jax.Array, hess: jax.Array,
             preferred_element_type=jnp.float32)
         return acc + part, None
 
-    init = jnp.zeros((HIST_CHANNELS, F * B), dtype=jnp.float32)
+    # zeros-of-inputs trick keeps the carry's device-varying annotation
+    # consistent when this runs inside shard_map (per-shard partial hists)
+    init = (jnp.zeros((HIST_CHANNELS, F * B), dtype=jnp.float32)
+            + gh[0, 0] * 0 + bins[0, 0].astype(jnp.float32) * 0)
     acc, _ = lax.scan(body, init, (bins_blocks, gh_blocks))
     return acc.reshape(HIST_CHANNELS, F, B).transpose(1, 2, 0)
 

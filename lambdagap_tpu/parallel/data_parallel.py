@@ -28,7 +28,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
@@ -143,10 +143,14 @@ class DataParallelTreeLearner(SerialTreeLearner):
         def score_update(score_l, perm_l, leaf_begin, leaf_count, leaf_values):
             # per-shard leaf layout: [D, L] arrays indexed by my axis position
             d = jax.lax.axis_index(DATA_AXIS)
-            lb = leaf_begin[d]
+            N_l = score_l.shape[0]
+            L = leaf_begin.shape[1]
+            # leaves empty on this shard would duplicate another leaf's begin
+            # offset; push them past the end so searchsorted never picks them
+            lb = jnp.where(leaf_count[d] > 0, leaf_begin[d],
+                           N_l + jnp.arange(L, dtype=leaf_begin.dtype))
             order = jnp.argsort(lb)
             sorted_begin = lb[order]
-            N_l = score_l.shape[0]
             which = jnp.searchsorted(
                 sorted_begin, jnp.arange(N_l, dtype=lb.dtype), side="right") - 1
             vals = leaf_values[order[which]]

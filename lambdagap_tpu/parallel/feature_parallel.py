@@ -19,7 +19,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import Config
@@ -69,7 +69,7 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         op = jax.jit(shard_map(
             hist_blocked, mesh=self.mesh,
             in_specs=(P(), P(), P(), P(), P(), P(), P()),
-            out_specs=P()))
+            out_specs=P(), check_vma=False))
         self._hist_cache[padded] = op
         return op
 

@@ -19,7 +19,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
@@ -82,7 +82,8 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         @functools.partial(
             shard_map, mesh=mesh,
             in_specs=(P(DATA_AXIS), P(), P(), P(), P(), P()),
-            out_specs=P())
+            out_specs=P(),
+            check_vma=False)   # psum/all_gather make outputs replicated
         def voting_best(hist_l, pg, ph, pc, pout, fmask):
             """Local top-k vote -> psum of voted columns -> global best."""
             h0 = hist_l            # local [F, B, 3]
