@@ -1,0 +1,166 @@
+"""Command-line application.
+
+(reference: src/main.cpp:13 + src/application/application.cpp — ``key=value``
+arguments plus ``config=`` file, tasks train / predict / convert_model /
+refit / save_binary :172-290.)
+
+Usage::
+
+    python -m lambdagap_tpu task=train data=train.csv objective=binary \
+        num_iterations=100 output_model=model.txt
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from .config import Config
+from .data.loader import load_data_file, save_binary
+from .models.gbdt import GBDT
+from .models.dart import create_boosting
+from .utils import log
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    """``key=value`` args + config file lines (reference:
+    application.cpp:31-86 LoadParameters + Config::KV2Map)."""
+    params: Dict[str, str] = {}
+    config_path = None
+    for arg in argv:
+        if "=" not in arg:
+            log.warning("Unknown argument %r ignored", arg)
+            continue
+        k, v = arg.split("=", 1)
+        k = k.strip()
+        if Config.canonical_name(k) == "config":
+            config_path = v.strip()
+        else:
+            params[k] = v.strip()
+    if config_path:
+        file_params: Dict[str, str] = {}
+        with open(config_path) as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                file_params[k.strip()] = v.strip()
+        # command-line overrides config file (reference: application.cpp:50)
+        file_params.update(params)
+        params = file_params
+    return params
+
+
+def run_train(cfg: Config) -> None:
+    if not cfg.data:
+        log.fatal("task=train requires data=<file>")
+    log.info("Loading training data from %s", cfg.data)
+    train = load_data_file(cfg.data, cfg)
+    booster = (GBDT.from_model_file(cfg.input_model, cfg) if cfg.input_model
+               else create_boosting(cfg, train))
+    if cfg.input_model:
+        log.fatal("Continued training from input_model via CLI lands with "
+                  "the refit milestone")
+    valids = []
+    if cfg.valid:
+        for i, vf in enumerate(str(cfg.valid).split(",")):
+            vds = load_data_file(vf.strip(), cfg, reference=train)
+            booster.add_valid_set(vds, f"valid_{i}")
+    for it in range(cfg.num_iterations):
+        stop = booster.train_one_iter()
+        if cfg.metric_freq > 0 and (it + 1) % cfg.metric_freq == 0:
+            msgs = []
+            if cfg.is_provide_training_metric:
+                msgs += [f"training {m}: {v:g}"
+                         for (_, m, v, _) in booster.eval_train()]
+            msgs += [f"{d} {m}: {v:g}" for (d, m, v, _) in booster.eval_valid()]
+            if msgs:
+                log.info("[%d] %s", it + 1, "  ".join(msgs))
+        if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
+            booster.save_model(f"{cfg.output_model}.snapshot_iter_{it + 1}")
+        if stop:
+            break
+    booster.save_model(cfg.output_model)
+    log.info("Finished training; model saved to %s", cfg.output_model)
+
+
+def run_predict(cfg: Config) -> None:
+    if not cfg.data or not cfg.input_model:
+        log.fatal("task=predict requires data=<file> and input_model=<model>")
+    booster = GBDT.from_model_file(cfg.input_model, cfg)
+    ds_raw = _load_raw_matrix(cfg.data, cfg)
+    if cfg.predict_leaf_index:
+        out = booster.predict_leaf(ds_raw, cfg.start_iteration_predict,
+                                   cfg.num_iteration_predict)
+    else:
+        out = booster.predict(ds_raw, raw_score=cfg.predict_raw_score,
+                              start_iteration=cfg.start_iteration_predict,
+                              num_iteration=cfg.num_iteration_predict)
+    out_path = cfg.extra.get("output_result", "LightGBM_predict_result.txt")
+    np.savetxt(out_path, out, fmt="%.10g",
+               delimiter="\t" if np.ndim(out) > 1 else "\n")
+    log.info("Predictions written to %s", out_path)
+
+
+def _load_raw_matrix(path: str, cfg: Config) -> np.ndarray:
+    from .data.loader import detect_format, _load_delim, _load_libsvm, \
+        _parse_column_spec
+    fmt = detect_format(path)
+    if fmt == "libsvm":
+        X, _ = _load_libsvm(path)
+        return X
+    delim = "," if fmt == "csv" else "\t"
+    header_names = None
+    if cfg.header:
+        with open(path) as f:
+            header_names = f.readline().strip().split(delim)
+    M = _load_delim(path, delim, cfg.header)
+    label_col = (_parse_column_spec(cfg.label_column, header_names)
+                 if cfg.label_column else 0)
+    keep = [j for j in range(M.shape[1]) if j != label_col]
+    return M[:, keep]
+
+
+def run_save_binary(cfg: Config) -> None:
+    if not cfg.data:
+        log.fatal("task=save_binary requires data=<file>")
+    ds = load_data_file(cfg.data, cfg)
+    save_binary(ds, cfg.data + ".bin")
+
+
+def run_convert_model(cfg: Config) -> None:
+    from .models.model_codegen import model_to_cpp
+    booster = GBDT.from_model_file(cfg.input_model, cfg)
+    code = model_to_cpp(booster)
+    with open(cfg.convert_model, "w") as f:
+        f.write(code)
+    log.info("Model converted to %s", cfg.convert_model)
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    params = parse_args(argv)
+    cfg = Config.from_params(params)
+    # data-path params are canonicalized into cfg.extra by Config.update
+    cfg.data = cfg.extra.get("data", "")
+    cfg.valid = cfg.extra.get("valid", "")
+    task = cfg.task
+    if task == "train":
+        run_train(cfg)
+    elif task in ("predict", "prediction", "test"):
+        run_predict(cfg)
+    elif task == "save_binary":
+        run_save_binary(cfg)
+    elif task == "convert_model":
+        run_convert_model(cfg)
+    elif task == "refit":
+        log.fatal("task=refit lands with the refit milestone")
+    else:
+        log.fatal("Unknown task %r", task)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,234 @@
+"""Text/binary dataset loading.
+
+(reference: src/io/dataset_loader.cpp — LoadFromFile :203 with auto-detected
+CSV/TSV/LibSVM parsers (src/io/parser.cpp), label/weight/group columns,
+``<file>.weight`` / ``<file>.query`` sidecar files, and the binary dataset
+cache LoadFromBinFile :417 / SaveBinaryFile.)
+
+Parsing runs through the native C++ extension (lambdagap_tpu.native); the
+binary cache is an npz with the binned matrix + mappers so reloading skips
+bin finding entirely.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+from .binning import BinMapper
+from .dataset import BinnedDataset
+
+BINARY_MAGIC = "lambdagap_tpu.binned.v1"
+
+
+def detect_format(path: str) -> str:
+    """Sniff CSV vs TSV vs LibSVM from the first data line
+    (reference: parser.cpp auto-detection)."""
+    with open(path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            tokens = line.replace("\t", " ").split()
+            if any(":" in t for t in tokens[1:]):
+                return "libsvm"
+            if "\t" in line:
+                return "tsv"
+            return "csv"
+    return "csv"
+
+
+def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    from ..native import get_lib
+    lib = get_lib()
+    if lib is not None:
+        rows = ctypes.c_int64()
+        maxf = ctypes.c_int64()
+        if lib.lg_count_libsvm(path.encode(), ctypes.byref(rows),
+                               ctypes.byref(maxf)) != 0:
+            log.fatal("Cannot open data file %s", path)
+        n, cols = rows.value, maxf.value + 1
+        X = np.zeros((n, max(cols, 1)), dtype=np.float64)
+        y = np.zeros(n, dtype=np.float64)
+        rc = lib.lg_parse_libsvm(
+            path.encode(),
+            X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, X.shape[1])
+        if rc != 0:
+            log.fatal("Failed to parse LibSVM file %s (rc=%d)", path, rc)
+        return X, y
+    # python fallback
+    xs, ys = [], []
+    maxf = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            ys.append(float(parts[0]))
+            row = {}
+            for tok in parts[1:]:
+                k, v = tok.split(":")
+                row[int(k)] = float(v)
+                maxf = max(maxf, int(k))
+            xs.append(row)
+    X = np.zeros((len(xs), maxf + 1))
+    for i, row in enumerate(xs):
+        for k, v in row.items():
+            X[i, k] = v
+    return X, np.asarray(ys)
+
+
+def _load_delim(path: str, delim: str, header: bool) -> np.ndarray:
+    from ..native import get_lib
+    lib = get_lib()
+    if lib is not None:
+        rows = ctypes.c_int64()
+        cols = ctypes.c_int64()
+        if lib.lg_count_delim(path.encode(), delim.encode(), int(header),
+                              ctypes.byref(rows), ctypes.byref(cols)) != 0:
+            log.fatal("Cannot open data file %s", path)
+        M = np.empty((rows.value, cols.value), dtype=np.float64)
+        rc = lib.lg_parse_delim(
+            path.encode(), delim.encode(), int(header),
+            M.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            rows.value, cols.value)
+        if rc != 0:
+            log.fatal("Failed to parse %s (rc=%d)", path, rc)
+        return M
+    return np.genfromtxt(path, delimiter=delim,
+                         skip_header=1 if header else 0)
+
+
+def _parse_column_spec(spec: str, header_names: Optional[List[str]]) -> int:
+    """``name:<col>`` or an integer index (reference: config label_column)."""
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if header_names and name in header_names:
+            return header_names.index(name)
+        log.fatal("Column name %s not found in header", name)
+    return int(spec)
+
+
+def load_data_file(path: str, config: Config,
+                   reference: Optional[BinnedDataset] = None) -> BinnedDataset:
+    """Load a text data file into a BinnedDataset
+    (reference: DatasetLoader::LoadFromFile)."""
+    if path.endswith(".bin") and os.path.exists(path):
+        return load_binary(path)
+    fmt = detect_format(path)
+    weight = None
+    group = None
+    if fmt == "libsvm":
+        X, y = _load_libsvm(path)
+    else:
+        delim = "," if fmt == "csv" else "\t"
+        header_names = None
+        if config.header:
+            with open(path) as f:
+                header_names = f.readline().strip().split(delim)
+        M = _load_delim(path, delim, config.header)
+        label_col = (_parse_column_spec(config.label_column, header_names)
+                     if config.label_column else 0)
+        drop = [label_col]
+        if config.weight_column:
+            wc = _parse_column_spec(config.weight_column, header_names)
+            weight = M[:, wc]
+            drop.append(wc)
+        if config.group_column:
+            gc = _parse_column_spec(config.group_column, header_names)
+            group = M[:, gc].astype(np.int64)
+            drop.append(gc)
+        if config.ignore_column:
+            for spec in config.ignore_column.split(","):
+                if spec.strip():
+                    drop.append(_parse_column_spec(spec.strip(), header_names))
+        y = M[:, label_col]
+        keep = [j for j in range(M.shape[1]) if j not in set(drop)]
+        X = M[:, keep]
+
+    # sidecar files (reference: Metadata::LoadWeights/LoadQueryBoundaries)
+    if weight is None and os.path.exists(path + ".weight"):
+        weight = np.loadtxt(path + ".weight", dtype=np.float64)
+    qpath = next((p for p in (path + ".query", path + ".group")
+                  if os.path.exists(p)), None)
+    qgroups = None
+    if qpath is not None:
+        qgroups = np.loadtxt(qpath, dtype=np.int64).reshape(-1)
+    elif group is not None:
+        qgroups = group
+    init_score = None
+    if os.path.exists(path + ".init"):
+        init_score = np.loadtxt(path + ".init", dtype=np.float64)
+    pos = None
+    if os.path.exists(path + ".position"):
+        pos = np.loadtxt(path + ".position", dtype=np.int64)
+
+    categorical = []
+    if config.categorical_feature:
+        for tok in str(config.categorical_feature).split(","):
+            tok = tok.strip()
+            if tok:
+                categorical.append(int(tok.replace("name:", "")
+                                       if not tok.startswith("name:")
+                                       else tok[5:]))
+    return BinnedDataset.from_matrix(
+        X, config, label=y, weight=weight, group=qgroups,
+        init_score=init_score, position=pos,
+        categorical_features=categorical, reference=reference)
+
+
+# ---------------------------------------------------------------------------
+# binary dataset cache (reference: save_binary task + LoadFromBinFile)
+# ---------------------------------------------------------------------------
+
+def save_binary(ds: BinnedDataset, path: str) -> None:
+    md = ds.metadata
+    np.savez_compressed(
+        path if path.endswith(".bin") else path,
+        __magic__=BINARY_MAGIC,
+        binned=ds.binned,
+        used_features=np.asarray(ds.used_features, np.int64),
+        feature_num_bins=np.asarray(ds.feature_num_bins, np.int64),
+        num_total_features=ds.num_total_features,
+        feature_names=np.asarray(ds.feature_names),
+        mappers=np.frombuffer(pickle.dumps(ds.mappers), dtype=np.uint8),
+        label=md.label if md.label is not None else np.empty(0),
+        weight=md.weight if md.weight is not None else np.empty(0),
+        query_boundaries=(md.query_boundaries
+                          if md.query_boundaries is not None else np.empty(0)),
+        init_score=(md.init_score if md.init_score is not None else np.empty(0)),
+        position=(md.position if md.position is not None else np.empty(0)),
+    )
+    log.info("Saved binary dataset to %s", path)
+
+
+def load_binary(path: str) -> BinnedDataset:
+    z = np.load(path, allow_pickle=False)
+    if str(z["__magic__"]) != BINARY_MAGIC:
+        log.fatal("%s is not a lambdagap_tpu binary dataset", path)
+    ds = BinnedDataset()
+    ds.binned = z["binned"]
+    ds.num_data = ds.binned.shape[0]
+    ds.used_features = [int(x) for x in z["used_features"]]
+    ds.feature_num_bins = [int(x) for x in z["feature_num_bins"]]
+    ds.num_total_features = int(z["num_total_features"])
+    ds.feature_names = [str(x) for x in z["feature_names"]]
+    ds.mappers = pickle.loads(z["mappers"].tobytes())
+    ds.bin_offsets = list(np.concatenate(
+        [[0], np.cumsum(ds.feature_num_bins)[:-1]]).astype(int))
+    ds.num_total_bins = int(np.sum(ds.feature_num_bins))
+    md = ds.metadata
+    md.label = z["label"] if z["label"].size else None
+    md.weight = z["weight"] if z["weight"].size else None
+    md.query_boundaries = (z["query_boundaries"]
+                           if z["query_boundaries"].size else None)
+    md.init_score = z["init_score"] if z["init_score"].size else None
+    md.position = z["position"] if z["position"].size else None
+    return ds

@@ -1,0 +1,55 @@
+"""Native (C++) helpers, compiled on demand with g++ and loaded via ctypes.
+
+The reference keeps its performance-critical host IO in C++
+(reference: src/io/parser.cpp, src/io/dataset_loader.cpp); this package is
+the equivalent. Compilation is lazy and cached next to the source; if no
+compiler is available the callers fall back to Python parsing.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+from ..utils import log
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build_lib() -> Optional[str]:
+    src = os.path.join(os.path.dirname(__file__), "parser.cpp")
+    out = os.path.join(os.path.dirname(__file__), "_lg_native.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    try:
+        subprocess.run(["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                        src, "-o", out],
+                       check=True, capture_output=True, timeout=120)
+        return out
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        log.warning("Native parser build failed (%s); using Python fallback", e)
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is None and not _TRIED:
+        _TRIED = True
+        path = _build_lib()
+        if path is not None:
+            lib = ctypes.CDLL(path)
+            i64p = ctypes.POINTER(ctypes.c_int64)
+            dp = ctypes.POINTER(ctypes.c_double)
+            lib.lg_count_libsvm.argtypes = [ctypes.c_char_p, i64p, i64p]
+            lib.lg_parse_libsvm.argtypes = [ctypes.c_char_p, dp, dp,
+                                            ctypes.c_int64, ctypes.c_int64]
+            lib.lg_count_delim.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                           ctypes.c_int, i64p, i64p]
+            lib.lg_parse_delim.argtypes = [ctypes.c_char_p, ctypes.c_char,
+                                           ctypes.c_int, dp,
+                                           ctypes.c_int64, ctypes.c_int64]
+            _LIB = lib
+    return _LIB

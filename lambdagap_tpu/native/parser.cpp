@@ -1,0 +1,171 @@
+// Native text parsers for lambdagap_tpu.
+//
+// TPU-native equivalent of the reference's C++ data-path host code
+// (reference: src/io/parser.cpp CSV/TSV/LibSVM parsers + DatasetLoader's
+// two-pass text ingestion, src/io/dataset_loader.cpp:203). Python-side
+// loading would be the "slow pure-Python" path SURVEY.md §2 forbids for
+// performance-critical IO; this file is compiled once with g++ and loaded
+// via ctypes (no pybind dependency).
+//
+// Exposed C ABI:
+//   lg_count_libsvm(path, &rows, &max_feature) -> 0/err
+//   lg_parse_libsvm(path, out_matrix, out_label, rows, cols) -> 0/err
+//     out_matrix is rows*cols row-major float64, pre-filled by caller
+//     (absent features stay at the fill value, i.e. 0 for sparse semantics)
+//   lg_count_delim(path, delim, skip_header, &rows, &cols)
+//   lg_parse_delim(path, delim, skip_header, out_matrix, rows, cols)
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <cstdint>
+#include <vector>
+
+namespace {
+
+// fast locale-independent strtod wrapper; handles na/nan/inf tokens the way
+// the reference's Atof does (src/include/LightGBM/utils/common.h Atof)
+static inline double parse_double(const char* p, char** end) {
+  while (*p == ' ' || *p == '\t') ++p;
+  if ((p[0] == 'n' || p[0] == 'N') && (p[1] == 'a' || p[1] == 'A')) {
+    *end = const_cast<char*>(p + 2);
+    if (**end == 'n' || **end == 'N') ++*end;
+    return NAN;
+  }
+  return strtod(p, end);
+}
+
+struct LineReader {
+  FILE* f;
+  std::vector<char> buf;
+  explicit LineReader(const char* path) : f(fopen(path, "rb")), buf(1 << 16) {}
+  ~LineReader() { if (f) fclose(f); }
+  bool ok() const { return f != nullptr; }
+  // reads one line (arbitrary length); returns nullptr at EOF
+  char* next() {
+    if (!fgets(buf.data(), static_cast<int>(buf.size()), f)) return nullptr;
+    size_t len = strlen(buf.data());
+    while (len > 0 && buf[len - 1] != '\n' && !feof(f)) {
+      buf.resize(buf.size() * 2);
+      if (!fgets(buf.data() + len, static_cast<int>(buf.size() - len), f)) break;
+      len = strlen(buf.data());
+    }
+    return buf.data();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+int lg_count_libsvm(const char* path, int64_t* rows, int64_t* max_feature) {
+  LineReader r(path);
+  if (!r.ok()) return 1;
+  int64_t n = 0, maxf = -1;
+  char* line;
+  while ((line = r.next()) != nullptr) {
+    if (line[0] == '\n' || line[0] == '\0' || line[0] == '#') continue;
+    ++n;
+    const char* p = line;
+    // skip label
+    char* end;
+    strtod(p, &end);
+    p = end;
+    while (*p) {
+      while (*p == ' ' || *p == '\t') ++p;
+      if (*p == '\n' || *p == '\0' || *p == '\r') break;
+      char* colon = nullptr;
+      long idx = strtol(p, &colon, 10);
+      if (colon == p || *colon != ':') break;
+      if (idx > maxf) maxf = idx;
+      p = colon + 1;
+      strtod(p, &end);
+      p = end;
+    }
+  }
+  *rows = n;
+  *max_feature = maxf;
+  return 0;
+}
+
+int lg_parse_libsvm(const char* path, double* out, double* label,
+                    int64_t rows, int64_t cols) {
+  LineReader r(path);
+  if (!r.ok()) return 1;
+  int64_t i = 0;
+  char* line;
+  while ((line = r.next()) != nullptr && i < rows) {
+    if (line[0] == '\n' || line[0] == '\0' || line[0] == '#') continue;
+    char* end;
+    label[i] = parse_double(line, &end);
+    const char* p = end;
+    double* row = out + i * cols;
+    while (*p) {
+      while (*p == ' ' || *p == '\t') ++p;
+      if (*p == '\n' || *p == '\0' || *p == '\r') break;
+      char* colon = nullptr;
+      long idx = strtol(p, &colon, 10);
+      if (colon == p || *colon != ':') break;
+      p = colon + 1;
+      double v = parse_double(p, &end);
+      p = end;
+      if (idx >= 0 && idx < cols) row[idx] = v;
+    }
+    ++i;
+  }
+  return i == rows ? 0 : 2;
+}
+
+int lg_count_delim(const char* path, char delim, int skip_header,
+                   int64_t* rows, int64_t* cols) {
+  LineReader r(path);
+  if (!r.ok()) return 1;
+  int64_t n = 0, c = 0;
+  char* line;
+  int first = 1;
+  while ((line = r.next()) != nullptr) {
+    if (line[0] == '\n' || line[0] == '\0') continue;
+    if (skip_header && first) { first = 0; continue; }
+    first = 0;
+    if (c == 0) {
+      c = 1;
+      for (const char* p = line; *p && *p != '\n'; ++p)
+        if (*p == delim) ++c;
+    }
+    ++n;
+  }
+  *rows = n;
+  *cols = c;
+  return 0;
+}
+
+int lg_parse_delim(const char* path, char delim, int skip_header,
+                   double* out, int64_t rows, int64_t cols) {
+  LineReader r(path);
+  if (!r.ok()) return 1;
+  int64_t i = 0;
+  char* line;
+  int first = 1;
+  while ((line = r.next()) != nullptr && i < rows) {
+    if (line[0] == '\n' || line[0] == '\0') continue;
+    if (skip_header && first) { first = 0; continue; }
+    first = 0;
+    const char* p = line;
+    double* row = out + i * cols;
+    for (int64_t j = 0; j < cols; ++j) {
+      char* end;
+      row[j] = parse_double(p, &end);
+      if (end == p && *p != delim) {  // empty / non-numeric field -> NaN
+        row[j] = NAN;
+      }
+      p = end;
+      while (*p && *p != delim && *p != '\n') ++p;
+      if (*p == delim) ++p;
+    }
+    ++i;
+  }
+  return i == rows ? 0 : 2;
+}
+
+}  // extern "C"

@@ -1,0 +1,168 @@
+"""CLI, file loading (native parser), binary cache, sklearn wrappers, codegen
+(reference analog: tests/c_api_test, test_consistency.py CLI-vs-API checks,
+test_sklearn.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification, make_regression
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.cli import main as cli_main
+from lambdagap_tpu.config import Config
+from lambdagap_tpu.data.loader import (detect_format, load_binary,
+                                       load_data_file, save_binary)
+
+
+@pytest.fixture
+def csv_files(tmp_path, rng):
+    X = rng.randn(500, 6)
+    y = (X[:, 0] + X[:, 1] > 0).astype(float)
+    train = tmp_path / "train.csv"
+    data = np.column_stack([y, X])
+    np.savetxt(train, data, delimiter=",", fmt="%.8g")
+    return str(train), X, y
+
+
+def test_detect_and_load_csv(csv_files):
+    path, X, y = csv_files
+    assert detect_format(path) == "csv"
+    cfg = Config.from_params({"verbose": -1})
+    ds = load_data_file(path, cfg)
+    assert ds.num_data == 500
+    np.testing.assert_allclose(ds.metadata.label, y, rtol=1e-6)
+
+
+def test_load_libsvm(tmp_path, rng):
+    lines = []
+    X = np.zeros((200, 5))
+    y = rng.randint(0, 2, 200).astype(float)
+    for i in range(200):
+        feats = sorted(rng.choice(5, 3, replace=False))
+        toks = [f"{int(y[i])}"]
+        for f in feats:
+            v = round(float(rng.randn()), 4)
+            X[i, f] = v
+            toks.append(f"{f}:{v}")
+        lines.append(" ".join(toks))
+    path = tmp_path / "train.svm"
+    path.write_text("\n".join(lines) + "\n")
+    assert detect_format(str(path)) == "libsvm"
+    cfg = Config.from_params({"verbose": -1})
+    ds = load_data_file(str(path), cfg)
+    assert ds.num_data == 200
+    np.testing.assert_allclose(ds.metadata.label, y)
+
+
+def test_query_sidecar(tmp_path, rng):
+    X = rng.randn(100, 4)
+    y = rng.randint(0, 3, 100).astype(float)
+    path = tmp_path / "rank.tsv"
+    np.savetxt(path, np.column_stack([y, X]), delimiter="\t", fmt="%.6g")
+    np.savetxt(str(path) + ".query", np.asarray([25, 25, 50]), fmt="%d")
+    cfg = Config.from_params({"verbose": -1})
+    ds = load_data_file(str(path), cfg)
+    assert ds.metadata.num_queries == 3
+
+
+def test_binary_cache_roundtrip(tmp_path, csv_files):
+    path, X, y = csv_files
+    cfg = Config.from_params({"verbose": -1})
+    ds = load_data_file(path, cfg)
+    bin_path = str(tmp_path / "train.npz")
+    save_binary(ds, bin_path)
+    ds2 = load_binary(bin_path)
+    np.testing.assert_array_equal(ds.binned, ds2.binned)
+    np.testing.assert_allclose(ds.metadata.label, ds2.metadata.label)
+    assert ds.feature_num_bins == ds2.feature_num_bins
+
+
+def test_cli_train_predict(tmp_path, csv_files):
+    path, X, y = csv_files
+    model_path = str(tmp_path / "model.txt")
+    rc = cli_main([f"task=train", f"data={path}", "objective=binary",
+                   "num_iterations=5", "num_leaves=7", "verbose=-1",
+                   f"output_model={model_path}"])
+    assert rc == 0
+    assert os.path.exists(model_path)
+    out_path = str(tmp_path / "preds.txt")
+    rc = cli_main([f"task=predict", f"data={path}",
+                   f"input_model={model_path}", "verbose=-1",
+                   f"output_result={out_path}"])
+    assert rc == 0
+    preds = np.loadtxt(out_path)
+    assert preds.shape == (500,)
+    assert np.all((preds >= 0) & (preds <= 1))
+
+
+def test_cli_config_file(tmp_path, csv_files):
+    path, X, y = csv_files
+    conf = tmp_path / "train.conf"
+    model_path = str(tmp_path / "m.txt")
+    conf.write_text(f"task = train\ndata = {path}\n"
+                    "objective = binary\nnum_iterations = 3\n"
+                    f"output_model = {model_path}\nverbose = -1\n")
+    rc = cli_main([f"config={conf}"])
+    assert rc == 0
+    assert os.path.exists(model_path)
+
+
+def test_convert_model_cpp(tmp_path, csv_files):
+    path, X, y = csv_files
+    model_path = str(tmp_path / "model.txt")
+    cli_main([f"task=train", f"data={path}", "objective=regression",
+              "num_iterations=3", "num_leaves=7", "verbose=-1",
+              f"output_model={model_path}"])
+    cpp_path = str(tmp_path / "model.cpp")
+    rc = cli_main([f"task=convert_model", f"input_model={model_path}",
+                   f"convert_model={cpp_path}", "verbose=-1"])
+    assert rc == 0
+    code = open(cpp_path).read()
+    assert "PredictTree0" in code and "extern \"C\" void Predict" in code
+
+
+def test_sklearn_regressor():
+    from lambdagap_tpu.sklearn import LGBMRegressor
+    X, y = make_regression(600, 8, noise=2.0, random_state=0)
+    est = LGBMRegressor(n_estimators=15, num_leaves=15)
+    est.fit(X, y)
+    pred = est.predict(X)
+    assert np.mean((pred - y) ** 2) < 0.3 * np.var(y)
+    assert est.feature_importances_.shape == (8,)
+    assert est.n_features_ == 8
+
+
+def test_sklearn_classifier_binary():
+    from lambdagap_tpu.sklearn import LGBMClassifier
+    X, y = make_classification(800, 10, random_state=1)
+    est = LGBMClassifier(n_estimators=20)
+    est.fit(X, y)
+    proba = est.predict_proba(X)
+    assert proba.shape == (800, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-5)
+    acc = np.mean(est.predict(X) == y)
+    assert acc > 0.9
+
+
+def test_sklearn_classifier_multiclass():
+    from lambdagap_tpu.sklearn import LGBMClassifier
+    X, y = make_classification(900, 12, n_classes=3, n_informative=6,
+                               random_state=2)
+    est = LGBMClassifier(n_estimators=15)
+    est.fit(X, y)
+    assert est.n_classes_ == 3
+    assert est.predict_proba(X).shape == (900, 3)
+    assert np.mean(est.predict(X) == y) > 0.7
+
+
+def test_sklearn_ranker():
+    from lambdagap_tpu.sklearn import LGBMRanker
+    rng = np.random.RandomState(3)
+    X = rng.randn(500, 6)
+    y = rng.randint(0, 3, 500).astype(float)
+    group = np.full(20, 25)
+    est = LGBMRanker(n_estimators=5, min_child_samples=5)
+    est.fit(X, y, group=group)
+    assert est.predict(X).shape == (500,)
