@@ -1,6 +1,6 @@
 """Benchmark: HIGGS-shaped GBDT training wall-clock on TPU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
 Baseline: the reference's published HIGGS train time — 500 iterations,
 num_leaves=255, max_bin=255, 10.5M rows x 28 features — 130.094 s on a
@@ -9,13 +9,26 @@ The fork ships no CUDA numbers, so the published CPU number is the bar.
 
 To keep the bench bounded we train a slice of the full 500 iterations and
 project: steady-state time/iteration x 500 (+ measured dataset construction).
-Rows can be capped via env BENCH_ROWS (default full 10.5M).
+
+Robustness: every attempt runs in its own subprocess so a compile-transport
+failure (round 1: the fused whole-tree program broke the remote-compile
+tunnel with "Broken pipe") cannot take down the bench. The ladder tries the
+fused whole-tree-on-device learner first (with one retry), then the
+host-driven SerialTreeLearner, then ramps the row count down. The first
+success is reported, with the attempt path in "detail".
+
+Env knobs: BENCH_ROWS (default 10.5M), BENCH_ITERS (measured steady-state
+iterations, default 30), BENCH_MAX_BIN (default 255), BENCH_ATTEMPT_TIMEOUT
+(seconds per attempt, default 2400), BENCH_HOLDOUT (AUC holdout rows,
+default 200k).
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -24,6 +37,9 @@ ROWS = int(os.environ.get("BENCH_ROWS", 10_500_000))
 FEATURES = 28
 ITERS_MEASURED = int(os.environ.get("BENCH_ITERS", 30))
 ITERS_TOTAL = 500
+MAX_BIN = int(os.environ.get("BENCH_MAX_BIN", 255))
+HOLDOUT = int(os.environ.get("BENCH_HOLDOUT", 200_000))
+ATTEMPT_TIMEOUT = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 2400))
 BASELINE_S = 130.094
 
 
@@ -45,10 +61,44 @@ def make_higgs_like(n: int, d: int, seed: int = 7):
     return X, y
 
 
-def main() -> None:
+def _data_cache_path(rows: int) -> str:
+    d = os.path.join(tempfile.gettempdir(), "lambdagap_bench")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f"higgs_like_{rows}x{FEATURES}_h{HOLDOUT}.npz")
+
+
+def _ensure_data(rows: int) -> str:
+    path = _data_cache_path(rows)
+    if not os.path.exists(path):
+        X, y = make_higgs_like(rows + HOLDOUT, FEATURES)
+        np.savez(path, X=X, y=y)
+    return path
+
+
+def auc_score(y_true: np.ndarray, score: np.ndarray) -> float:
+    order = np.argsort(score, kind="stable")
+    ranks = np.empty(len(score), dtype=np.float64)
+    ranks[order] = np.arange(1, len(score) + 1)
+    # midranks for ties
+    s_sorted = score[order]
+    i = 0
+    while i < len(s_sorted):
+        j = i
+        while j + 1 < len(s_sorted) and s_sorted[j + 1] == s_sorted[i]:
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = 0.5 * (i + 1 + j + 1)
+        i = j + 1
+    pos = y_true > 0.5
+    n_pos, n_neg = int(pos.sum()), int((~pos).sum())
+    if n_pos == 0 or n_neg == 0:
+        return 0.5
+    return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+
+
+def run_attempt(rows: int, fused: bool) -> None:
+    """Child-process entry: train + measure, print one JSON line."""
     import jax
-    # persistent compilation cache: the fused tree program compiles once per
-    # (shape, config); later bench runs reuse it
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".jax_cache")
     try:
@@ -60,17 +110,20 @@ def main() -> None:
     import lambdagap_tpu as lgb
 
     t_gen0 = time.time()
-    X, y = make_higgs_like(ROWS, FEATURES)
+    z = np.load(_data_cache_path(rows))
+    X_all, y_all = z["X"], z["y"]          # one read each (npz ignores mmap)
+    X, y = X_all[:rows], y_all[:rows]
+    Xv, yv = X_all[rows:], y_all[rows:]
     t_gen = time.time() - t_gen0
 
     params = {
         "objective": "binary",
-        "metric": "auc",
         "num_leaves": 255,
         "learning_rate": 0.1,
-        "max_bin": 255,
+        "max_bin": MAX_BIN,
         "min_data_in_leaf": 100,
         "verbose": -1,
+        "tpu_fused_learner": "1" if fused else "0",
     }
 
     t0 = time.time()
@@ -87,27 +140,104 @@ def main() -> None:
     t2 = time.time()
     for _ in range(ITERS_MEASURED):
         booster.update()
+    # block on the device scores so async dispatch doesn't flatter the timing
+    np.asarray(booster._booster.scores[0][:1])
     t_meas = time.time() - t2
     per_iter = t_meas / ITERS_MEASURED
 
+    t3 = time.time()
+    pred = booster.predict(np.asarray(Xv))
+    auc = auc_score(np.asarray(yv), pred)
+    t_pred = time.time() - t3
+
     projected = t_construct + t_warm + per_iter * (ITERS_TOTAL - 2)
-    result = {
+    print(json.dumps({
+        "rows": rows,
+        "fused": fused,
+        "construct_s": round(t_construct, 3),
+        "warmup_2iter_s": round(t_warm, 3),
+        "per_iter_s": round(per_iter, 4),
+        "iters_measured": ITERS_MEASURED,
+        "projected_500iter_s": round(projected, 3),
+        "holdout_auc": round(float(auc), 5),
+        "holdout_rows": len(yv),
+        "predict_s": round(t_pred, 3),
+        "dataload_s": round(t_gen, 3),
+    }))
+
+
+def main() -> None:
+    # attempt ladder: (rows, fused, is_retry)
+    ladder = []
+    for rows in (ROWS, min(ROWS, 4_000_000), min(ROWS, 1_000_000)):
+        if not ladder or rows != ladder[-1][0]:
+            ladder.append((rows, True, False))
+            ladder.append((rows, True, True))    # one retry (transport flake)
+            ladder.append((rows, False, False))  # host-driven serial learner
+
+    seen = set()
+    attempts_log = []
+    result = None
+    for rows, fused, is_retry in ladder:
+        key = (rows, fused, is_retry)
+        if key in seen:
+            continue
+        seen.add(key)
+        _ensure_data(rows)
+        name = f"{'fused' if fused else 'serial'}@{rows}" + \
+               ("(retry)" if is_retry else "")
+        print(f"[bench] attempt {name}", file=sys.stderr, flush=True)
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--attempt", str(rows), "1" if fused else "0"]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=ATTEMPT_TIMEOUT)
+        except subprocess.TimeoutExpired:
+            attempts_log.append({"attempt": name, "error": "timeout"})
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            try:
+                result = json.loads(proc.stdout.strip().splitlines()[-1])
+                attempts_log.append({"attempt": name, "ok": True})
+                break
+            except json.JSONDecodeError:
+                attempts_log.append({"attempt": name,
+                                     "error": "bad json: " + proc.stdout[-200:]})
+        else:
+            tail = (proc.stderr or "")[-400:]
+            attempts_log.append({"attempt": name,
+                                 "error": f"rc={proc.returncode}: {tail}"})
+        print(f"[bench] attempt {name} failed", file=sys.stderr, flush=True)
+
+    if result is None:
+        print(json.dumps({
+            "metric": "higgs_500iter_train_wall_clock_projected",
+            "value": None, "unit": "seconds", "vs_baseline": None,
+            "detail": {"error": "all attempts failed",
+                       "attempts": attempts_log},
+        }))
+        sys.exit(1)
+
+    projected = result["projected_500iter_s"]
+    print(json.dumps({
         "metric": "higgs_500iter_train_wall_clock_projected",
-        "value": round(projected, 3),
+        "value": projected,
         "unit": "seconds",
         "vs_baseline": round(BASELINE_S / projected, 4),
         "detail": {
-            "rows": ROWS,
-            "construct_s": round(t_construct, 3),
-            "warmup_2iter_s": round(t_warm, 3),
-            "per_iter_s": round(per_iter, 4),
-            "iters_measured": ITERS_MEASURED,
-            "datagen_s": round(t_gen, 3),
-            "baseline": "reference CPU 130.094s (docs/Experiments.rst)",
+            **result,
+            "attempts": attempts_log,
+            "baseline": "reference CPU 130.094s @10.5M rows "
+                        "(docs/Experiments.rst:111-124)",
+            "note": ("full HIGGS size" if result["rows"] == 10_500_000 else
+                     f"reduced rows ({result['rows']}); vs_baseline not "
+                     "size-matched"),
         },
-    }
-    print(json.dumps(result))
+    }))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 4 and sys.argv[1] == "--attempt":
+        run_attempt(int(sys.argv[2]), sys.argv[3] == "1")
+    else:
+        main()

@@ -109,7 +109,7 @@ def _load_raw_matrix(path: str, cfg: Config) -> np.ndarray:
         _parse_column_spec
     fmt = detect_format(path)
     if fmt == "libsvm":
-        X, _ = _load_libsvm(path)
+        X, _, _ = _load_libsvm(path)
         return X
     delim = "," if fmt == "csv" else "\t"
     header_names = None

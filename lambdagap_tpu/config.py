@@ -364,9 +364,16 @@ class Config:
     # TPU-specific knobs (no reference analog; tuning surface for XLA/Pallas)
     tpu_hist_dtype: str = "float32"
     tpu_rows_per_block: int = 4096
-    tpu_hist_impl: str = "auto"               # auto / onehot / scatter / pallas
+    tpu_hist_impl: str = "auto"               # auto / onehot / pallas
     tpu_num_devices: int = 0                  # 0 = all visible devices
     tpu_fused_learner: str = "auto"           # auto / 1 / 0: whole-tree-on-device
+    # gradient operand precision for the MXU histogram contraction:
+    #   split — two-term bf16 (hi + residual) decomposition, ~f32-accurate
+    #           at one extra matmul row-block (default; the reference
+    #           accumulates f32/double histograms, src/io/bin.h reducers)
+    #   bf16  — raw bf16 cast (~2^-9 relative error on grad/hess; fastest)
+    #   f32   — full float32 matmul (slowest, exact)
+    tpu_hist_precision: str = "split"
 
     # unknown/passthrough params preserved verbatim
     extra: Dict[str, Any] = field(default_factory=dict)

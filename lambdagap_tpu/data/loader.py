@@ -43,46 +43,68 @@ def detect_format(path: str) -> str:
     return "csv"
 
 
-def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray]:
+def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray,
+                                     Optional[np.ndarray]]:
+    """Returns (X, label, per_row_qid_or_None). LETOR ``qid:N`` tokens become
+    query ids; any other malformed token is fatal (the reference Log::Fatal's
+    on LibSVM format errors, src/io/parser.cpp)."""
     from ..native import get_lib
     lib = get_lib()
     if lib is not None:
         rows = ctypes.c_int64()
         maxf = ctypes.c_int64()
-        if lib.lg_count_libsvm(path.encode(), ctypes.byref(rows),
-                               ctypes.byref(maxf)) != 0:
+        rc = lib.lg_count_libsvm(path.encode(), ctypes.byref(rows),
+                                 ctypes.byref(maxf))
+        if rc == 1:
             log.fatal("Cannot open data file %s", path)
+        if rc != 0:
+            log.fatal("LibSVM format error in %s: token is neither "
+                      "'<idx>:<value>' nor 'qid:<id>' (rc=%d)", path, rc)
         n, cols = rows.value, maxf.value + 1
         X = np.zeros((n, max(cols, 1)), dtype=np.float64)
         y = np.zeros(n, dtype=np.float64)
+        qid = np.full(n, -1, dtype=np.int64)
         rc = lib.lg_parse_libsvm(
             path.encode(),
             X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-            y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n, X.shape[1])
+            y.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            qid.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, X.shape[1])
         if rc != 0:
             log.fatal("Failed to parse LibSVM file %s (rc=%d)", path, rc)
-        return X, y
+        return X, y, (qid if (qid >= 0).any() else None)
     # python fallback
-    xs, ys = [], []
+    xs, ys, qids = [], [], []
     maxf = 0
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
             parts = line.split()
             ys.append(float(parts[0]))
             row = {}
+            q = -1
             for tok in parts[1:]:
-                k, v = tok.split(":")
-                row[int(k)] = float(v)
-                maxf = max(maxf, int(k))
+                k, _, v = tok.partition(":")
+                if k.lower() == "qid":
+                    q = int(v)
+                    continue
+                try:
+                    ki = int(k)
+                    row[ki] = float(v)
+                except ValueError:
+                    log.fatal("LibSVM format error at %s:%d: bad token %r",
+                              path, lineno, tok)
+                maxf = max(maxf, ki)
+            qids.append(q)
             xs.append(row)
     X = np.zeros((len(xs), maxf + 1))
     for i, row in enumerate(xs):
         for k, v in row.items():
             X[i, k] = v
-    return X, np.asarray(ys)
+    qid = np.asarray(qids, dtype=np.int64)
+    return X, np.asarray(ys), (qid if (qid >= 0).any() else None)
 
 
 def _load_delim(path: str, delim: str, header: bool) -> np.ndarray:
@@ -125,11 +147,21 @@ def load_data_file(path: str, config: Config,
     fmt = detect_format(path)
     weight = None
     group = None
+    header_names: Optional[List[str]] = None
     if fmt == "libsvm":
-        X, y = _load_libsvm(path)
+        X, y, qid = _load_libsvm(path)
+        if qid is not None:
+            if (qid < 0).any():
+                log.fatal("LibSVM file %s mixes rows with and without "
+                          "'qid:' tokens; every row needs one", path)
+            # per-row query ids -> run-length sizes (explicit: the
+            # sizes-vs-ids heuristic in Metadata.set_group can misfire when
+            # ids happen to sum to num_data)
+            change = np.nonzero(np.diff(qid))[0] + 1
+            bounds = np.concatenate([[0], change, [len(qid)]])
+            group = np.diff(bounds)
     else:
         delim = "," if fmt == "csv" else "\t"
-        header_names = None
         if config.header:
             with open(path) as f:
                 header_names = f.readline().strip().split(delim)
@@ -174,10 +206,17 @@ def load_data_file(path: str, config: Config,
     if config.categorical_feature:
         for tok in str(config.categorical_feature).split(","):
             tok = tok.strip()
-            if tok:
-                categorical.append(int(tok.replace("name:", "")
-                                       if not tok.startswith("name:")
-                                       else tok[5:]))
+            if not tok:
+                continue
+            if tok.startswith("name:"):
+                name = tok[5:]
+                if header_names and name in header_names:
+                    categorical.append(header_names.index(name))
+                else:
+                    log.fatal("categorical_feature name %r not found in "
+                              "header", name)
+            else:
+                categorical.append(int(tok))
     return BinnedDataset.from_matrix(
         X, config, label=y, weight=weight, group=qgroups,
         init_score=init_score, position=pos,

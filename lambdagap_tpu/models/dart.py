@@ -13,9 +13,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
-from ..ops.predict import predict_tree_binned, tree_to_arrays
+from ..ops.predict import forest_to_arrays, predict_forest
 from ..utils import log
-from .gbdt import GBDT, K_EPSILON, _round_depth
+from .gbdt import GBDT, K_EPSILON
 from .tree import Tree
 
 
@@ -29,20 +29,34 @@ class DART(GBDT):
         self.tree_weight: List[float] = []
         self.sum_weight = 0.0
 
-    def _tree_score_delta(self, tree: Tree, factor: float, k: int, valid: bool,
-                          vi: int = 0):
-        """Add ``factor * tree`` to a score vector via binned traversal."""
-        arrs = tree_to_arrays(tree, feature_meta=self._meta, use_inner_feature=True)
-        arrs = arrs._replace(leaf_value=arrs.leaf_value * factor)
-        depth = _round_depth(tree.max_depth + 1)
+    def _stack_dropped(self, tree_idx: List[int]):
+        """Stack the dropped trees once per iteration; the drop/renormalize
+        deltas only differ by a leaf-value scale factor."""
+        K = self.num_tree_per_iteration
+        trees = [self._tree(i) for i in tree_idx]
+        forest, depth = forest_to_arrays(trees, feature_meta=self._meta,
+                                         use_inner_feature=True)
+        tree_class = jnp.asarray([i % K for i in tree_idx], jnp.int32)
+        return forest, depth, tree_class
+
+    def _forest_score_delta(self, stacked, factor: float,
+                            valid: bool, vi: int = 0) -> None:
+        """Add ``factor * sum(stacked trees)`` to a score matrix in one
+        batched binned-forest dispatch (cost no longer grows with
+        dropped-tree count)."""
+        if stacked is None:
+            return
+        forest, depth, tree_class = stacked
+        K = self.num_tree_per_iteration
+        forest = forest._replace(leaf_value=forest.leaf_value * factor)
         if valid:
-            x = self.valid_binned[vi]
-            self.valid_scores[vi] = self.valid_scores[vi].at[k].add(
-                predict_tree_binned(x, arrs, depth))
+            self.valid_scores[vi] = self.valid_scores[vi] + predict_forest(
+                self.valid_binned[vi], forest, tree_class, K, depth,
+                binned=True)
         else:
-            self.scores = self.scores.at[k].set(
-                self.scores[k] + predict_tree_binned(self.learner.x_binned,
-                                                     arrs, depth))
+            self.scores = self.scores + predict_forest(
+                self.learner.x_binned, forest, tree_class, K, depth,
+                binned=True)
 
     def _dropping_trees(self) -> List[int]:
         cfg = self.config
@@ -67,11 +81,11 @@ class DART(GBDT):
                         drop_index.append(i)
                         if len(drop_index) >= cfg.max_drop > 0:
                             break
-        # subtract dropped trees from the training score
-        for i in drop_index:
-            for k in range(self.num_tree_per_iteration):
-                tree = self._tree(i * self.num_tree_per_iteration + k)
-                self._tree_score_delta(tree, -1.0, k, valid=False)
+        # subtract dropped trees from the training score (one dispatch)
+        K = self.num_tree_per_iteration
+        idx = [i * K + k for i in drop_index for k in range(K)]
+        self._drop_stacked = self._stack_dropped(idx) if idx else None
+        self._forest_score_delta(self._drop_stacked, -1.0, valid=False)
         k_drop = len(drop_index)
         if not cfg.xgboost_dart_mode:
             self.shrinkage_rate = cfg.learning_rate / (1.0 + k_drop)
@@ -96,18 +110,21 @@ class DART(GBDT):
         (reference: dart.hpp:149-200 Normalize)."""
         k = float(len(drop_index))
         cfg = self.config
+        K = self.num_tree_per_iteration
         factor = (k / (k + 1.0) if not cfg.xgboost_dart_mode
                   else k / (k + cfg.learning_rate))
+        idx = [i * K + kk for i in drop_index for kk in range(K)]
+        # valid scores still contain the full old tree: adjust by
+        # (factor - 1); train scores had it fully removed: add factor
+        # (the forest stacked in _dropping_trees is reused; the trees have
+        # not been mutated in between)
+        self._forest_score_delta(self._drop_stacked, factor, valid=False)
+        for vi in range(len(self.valid_sets)):
+            self._forest_score_delta(self._drop_stacked, factor - 1.0,
+                                     valid=True, vi=vi)
+        for i in idx:
+            self._tree(i).apply_shrinkage(factor)
         for i in drop_index:
-            for kk in range(self.num_tree_per_iteration):
-                tree = self._tree(i * self.num_tree_per_iteration + kk)
-                # valid scores still contain the full old tree: adjust by
-                # (factor - 1); train scores had it fully removed: add factor
-                self._tree_score_delta(tree, factor, kk, valid=False)
-                for vi in range(len(self.valid_sets)):
-                    self._tree_score_delta(tree, factor - 1.0, kk,
-                                           valid=True, vi=vi)
-                tree.apply_shrinkage(factor)
             if not cfg.uniform_drop and i < len(self.tree_weight):
                 self.sum_weight -= self.tree_weight[i] * (1.0 / (k + 1.0))
                 self.tree_weight[i] *= k / (k + 1.0)

@@ -28,6 +28,7 @@ from jax import lax
 
 from ..config import Config
 from ..data.dataset import BinnedDataset
+from ..ops.histogram import gh_contract
 from ..ops.partition import decision_go_left
 from ..ops.split import (K_MIN_SCORE, SplitParams, calculate_leaf_output,
                          leaf_gain, per_feature_best)
@@ -172,10 +173,8 @@ class FusedTreeLearner(SerialTreeLearner):
             h = jnp.where(valid, hess[rows], 0.0)
             gh = jnp.stack([g, h, valid.astype(jnp.float32)], axis=1)
             onehot = (bins[:, :, None] == bin_iota).astype(jnp.bfloat16)
-            part = lax.dot_general(
-                gh.astype(jnp.bfloat16).T, onehot.reshape(W, F * B),
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+            part = gh_contract(gh, onehot.reshape(W, F * B),
+                               self.hist_precision)
             return acc + part.reshape(HIST_C, F, B).transpose(1, 2, 0)
 
         def leaf_hist(perm, begin, count):

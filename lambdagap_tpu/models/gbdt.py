@@ -23,7 +23,9 @@ from ..config import Config
 from ..data.dataset import BinnedDataset
 from ..metrics.base import Metric, create_metrics
 from ..objectives.base import ObjectiveFunction, create_objective
-from ..ops.predict import predict_tree_binned, predict_tree_raw, tree_to_arrays
+from ..ops.predict import (_round_depth, forest_to_arrays, predict_forest,
+                           predict_forest_leaf, predict_tree_binned,
+                           tree_to_arrays)
 from ..utils import log
 from .learner import SerialTreeLearner
 from .sample_strategy import create_sample_strategy
@@ -45,11 +47,6 @@ def _add_tree_score(score, perm, leaf_begin, leaf_count, leaf_values,
     pos_leaf = order[which]
     vals = leaf_values[pos_leaf]
     return score.at[perm].add(vals)
-
-
-def _round_depth(d: int) -> int:
-    """Pad traversal depth to a multiple of 8 to bound jit specializations."""
-    return max(8, ((d + 7) // 8) * 8)
 
 
 class _LazyTree:
@@ -160,10 +157,17 @@ class GBDT:
             init = jnp.asarray(s.reshape(K, ds.num_data) if s.size == K * ds.num_data
                                else np.tile(s, (K, 1)))
         self.valid_scores.append(init)
-        # replay existing model onto the new valid set
-        for i, tree in enumerate(self.models):
-            k = i % self.num_tree_per_iteration
-            self._add_valid_tree_score(len(self.valid_sets) - 1, tree, k)
+        # replay existing model onto the new valid set (one batched dispatch)
+        if self.models:
+            vi = len(self.valid_sets) - 1
+            trees = self.host_models
+            forest, depth = forest_to_arrays(trees, feature_meta=self._meta,
+                                             use_inner_feature=True)
+            tree_class = jnp.asarray(
+                [i % K for i in range(len(trees))], jnp.int32)
+            self.valid_scores[vi] = self.valid_scores[vi] + predict_forest(
+                self.valid_binned[vi], forest, tree_class, K, depth,
+                binned=True)
 
     # ------------------------------------------------------------------
     def boosting(self) -> Tuple[jax.Array, jax.Array]:
@@ -364,47 +368,49 @@ class GBDT:
     # ------------------------------------------------------------------
     # prediction
     # ------------------------------------------------------------------
-    def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
-                    num_iteration: int = -1) -> np.ndarray:
-        """Raw scores for new data [N, D] -> [N] or [N, K]."""
-        data = np.asarray(data, dtype=np.float32)
-        x = jnp.asarray(data)
+    def _model_slice(self, start_iteration: int, num_iteration: int):
         K = self.num_tree_per_iteration
-        N = data.shape[0]
-        out = jnp.zeros((K, N), dtype=jnp.float32)
         end = len(self.models) if num_iteration < 0 else min(
             len(self.models), (start_iteration + num_iteration) * K)
-        for i in range(start_iteration * K, end):
-            tree = self._tree(i)
-            arrs = tree_to_arrays(tree, use_inner_feature=False)
-            depth = _round_depth(tree.max_depth + 1)
-            out = out.at[i % K].add(predict_tree_raw(x, arrs, depth))
+        return list(range(start_iteration * K, end))
+
+    def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+        """Raw scores for new data [N, D] -> [N] or [N, K].
+
+        The whole forest runs in one jitted dispatch (stacked TreeArrays +
+        scan; the analog of GBDT::Predict over inlined trees, reference:
+        include/LightGBM/tree.h:130-141)."""
+        data = np.asarray(data, dtype=np.float32)
+        K = self.num_tree_per_iteration
+        N = data.shape[0]
+        idx = self._model_slice(start_iteration, num_iteration)
+        if not idx:
+            res = np.zeros((K, N), dtype=np.float32)
+            return res[0] if K == 1 else res.T
+        trees = [self._tree(i) for i in idx]
+        forest, depth = forest_to_arrays(trees, use_inner_feature=False)
+        tree_class = jnp.asarray([i % K for i in idx], jnp.int32)
+        out = predict_forest(jnp.asarray(data), forest, tree_class, K, depth,
+                             binned=False)
         res = np.asarray(jax.device_get(out))
         if self.average_output:
-            n_iters = max(1, (end - start_iteration * K) // max(K, 1))
+            n_iters = max(1, len(idx) // max(K, 1))
             res = res / n_iters
         return res[0] if K == 1 else res.T
 
     def predict_leaf(self, data: np.ndarray, start_iteration: int = 0,
                      num_iteration: int = -1) -> np.ndarray:
         """Leaf index per (row, tree) (reference: predict_leaf_index path)."""
-        from ..ops.predict import predict_leaf_index_binned  # binned variant exists
         data = np.asarray(data, dtype=np.float32)
-        x = jnp.asarray(data)
-        K = self.num_tree_per_iteration
-        end = len(self.models) if num_iteration < 0 else min(
-            len(self.models), (start_iteration + num_iteration) * K)
-        cols = []
-        for i in range(start_iteration * K, end):
-            tree = self._tree(i)
-            arrs = tree_to_arrays(tree, use_inner_feature=False)
-            depth = _round_depth(tree.max_depth + 1)
-            # raw-threshold traversal, returning leaf ids
-            vals = jnp.arange(tree.num_leaves, dtype=jnp.float32)
-            arrs = arrs._replace(leaf_value=vals)
-            cols.append(np.asarray(jax.device_get(
-                predict_tree_raw(x, arrs, depth))).astype(np.int32))
-        return np.stack(cols, axis=1) if cols else np.zeros((data.shape[0], 0), np.int32)
+        idx = self._model_slice(start_iteration, num_iteration)
+        if not idx:
+            return np.zeros((data.shape[0], 0), np.int32)
+        trees = [self._tree(i) for i in idx]
+        forest, depth = forest_to_arrays(trees, use_inner_feature=False)
+        ys = predict_forest_leaf(jnp.asarray(data), forest, depth,
+                                 binned=False)
+        return np.asarray(jax.device_get(ys)).astype(np.int32).T
 
     def predict_contrib(self, data: np.ndarray, start_iteration: int = 0,
                         num_iteration: int = -1) -> np.ndarray:

@@ -92,6 +92,7 @@ class SerialTreeLearner:
             min_data_per_group=config.min_data_per_group)
 
         self.rows_per_block = config.tpu_rows_per_block
+        self.hist_precision = config.tpu_hist_precision
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
 
         # outputs of the last Train call, used for the O(1)-per-row score update
@@ -125,23 +126,33 @@ class SerialTreeLearner:
     # histogram hook points (overridden by the distributed learners) --------
     def _root_histogram(self, grad, hess, row_mask):
         return full_histogram(self.x_binned, grad, hess, row_mask, self.B,
-                              self.rows_per_block)
+                              self.rows_per_block, self.hist_precision)
 
     def _leaf_histogram(self, perm, grad, hess, begin, count, padded, row_mask):
         return leaf_histogram(self.x_binned, perm, grad, hess,
                               jnp.int32(begin), jnp.int32(count), padded,
-                              self.B, self.rows_per_block, row_mask)
+                              self.B, self.rows_per_block, row_mask,
+                              self.hist_precision)
 
     def _cat_bitset_real(self, feature_k: int, bitset_bins: np.ndarray) -> np.ndarray:
-        """Convert a bin-space bitset to raw-category space for model export."""
+        """Convert a bin-space bitset to raw-category space for model export.
+
+        The bitset is sized to the largest selected category (the reference
+        sizes these dynamically, Common::ConstructBitset /
+        src/io/tree.cpp cat_threshold_), so categories >= 256 route
+        correctly at predict time."""
         j = self.dataset.used_features[feature_k]
         mapper = self.dataset.mappers[j]
-        out = np.zeros(8, dtype=np.uint32)
+        cats = []
         for b in range(mapper.num_bin):
             if (bitset_bins[b // 32] >> (b % 32)) & 1:
                 cat = mapper.bin_2_categorical[b] if b < len(mapper.bin_2_categorical) else -1
-                if 0 <= cat < 256:
-                    out[cat // 32] |= np.uint32(1) << np.uint32(cat % 32)
+                if cat >= 0:
+                    cats.append(int(cat))
+        words = max(8, (max(cats) + 32) // 32) if cats else 8
+        out = np.zeros(words, dtype=np.uint32)
+        for cat in cats:
+            out[cat // 32] |= np.uint32(1) << np.uint32(cat % 32)
         return out
 
     # ------------------------------------------------------------------

@@ -233,8 +233,10 @@ def tree_from_string(block: str) -> Tree:
         if is_cat and cat_boundaries:
             ci = int(thresholds[i])
             lo, hi = cat_boundaries[ci], cat_boundaries[ci + 1]
-            bits = np.zeros(8, dtype=np.uint32)
-            seg = cat_threshold[lo:hi][:8]
+            # keep the full variable-length segment: reference bitsets can
+            # span arbitrarily many words (tree.cpp cat_threshold_)
+            seg = cat_threshold[lo:hi]
+            bits = np.zeros(max(8, len(seg)), dtype=np.uint32)
             bits[:len(seg)] = seg
             tree.cat_bitset_real.append(bits)
             tree.cat_bitset.append(np.zeros(8, dtype=np.uint32))

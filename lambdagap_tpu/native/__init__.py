@@ -44,7 +44,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             i64p = ctypes.POINTER(ctypes.c_int64)
             dp = ctypes.POINTER(ctypes.c_double)
             lib.lg_count_libsvm.argtypes = [ctypes.c_char_p, i64p, i64p]
-            lib.lg_parse_libsvm.argtypes = [ctypes.c_char_p, dp, dp,
+            lib.lg_parse_libsvm.argtypes = [ctypes.c_char_p, dp, dp, i64p,
                                             ctypes.c_int64, ctypes.c_int64]
             lib.lg_count_delim.argtypes = [ctypes.c_char_p, ctypes.c_char,
                                            ctypes.c_int, i64p, i64p]

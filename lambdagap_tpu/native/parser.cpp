@@ -9,9 +9,13 @@
 //
 // Exposed C ABI:
 //   lg_count_libsvm(path, &rows, &max_feature) -> 0/err
-//   lg_parse_libsvm(path, out_matrix, out_label, rows, cols) -> 0/err
+//   lg_parse_libsvm(path, out_matrix, out_label, out_qid, rows, cols) -> 0/err
 //     out_matrix is rows*cols row-major float64, pre-filled by caller
-//     (absent features stay at the fill value, i.e. 0 for sparse semantics)
+//     (absent features stay at the fill value, i.e. 0 for sparse semantics);
+//     out_qid is rows int64 (LETOR ``qid:N`` tokens; stays at the caller's
+//     fill when a line has no qid). Any other non-``idx:val`` token is a
+//     format error (rc=3) — the reference Log::Fatal's on malformed LibSVM
+//     (src/io/parser.cpp).
 //   lg_count_delim(path, delim, skip_header, &rows, &cols)
 //   lg_parse_delim(path, delim, skip_header, out_matrix, rows, cols)
 
@@ -55,6 +59,16 @@ struct LineReader {
   }
 };
 
+// true if p points at a LETOR "qid:" token; advances *out past "qid:"
+static inline bool is_qid_token(const char* p, const char** out) {
+  if ((p[0] == 'q' || p[0] == 'Q') && (p[1] == 'i' || p[1] == 'I') &&
+      (p[2] == 'd' || p[2] == 'D') && p[3] == ':') {
+    *out = p + 4;
+    return true;
+  }
+  return false;
+}
+
 }  // namespace
 
 extern "C" {
@@ -75,9 +89,15 @@ int lg_count_libsvm(const char* path, int64_t* rows, int64_t* max_feature) {
     while (*p) {
       while (*p == ' ' || *p == '\t') ++p;
       if (*p == '\n' || *p == '\0' || *p == '\r') break;
+      const char* after_qid;
+      if (is_qid_token(p, &after_qid)) {
+        strtol(after_qid, &end, 10);
+        p = end;
+        continue;
+      }
       char* colon = nullptr;
       long idx = strtol(p, &colon, 10);
-      if (colon == p || *colon != ':') break;
+      if (colon == p || *colon != ':') return 3;  // malformed token
       if (idx > maxf) maxf = idx;
       p = colon + 1;
       strtod(p, &end);
@@ -90,7 +110,7 @@ int lg_count_libsvm(const char* path, int64_t* rows, int64_t* max_feature) {
 }
 
 int lg_parse_libsvm(const char* path, double* out, double* label,
-                    int64_t rows, int64_t cols) {
+                    int64_t* qid, int64_t rows, int64_t cols) {
   LineReader r(path);
   if (!r.ok()) return 1;
   int64_t i = 0;
@@ -104,9 +124,16 @@ int lg_parse_libsvm(const char* path, double* out, double* label,
     while (*p) {
       while (*p == ' ' || *p == '\t') ++p;
       if (*p == '\n' || *p == '\0' || *p == '\r') break;
+      const char* after_qid;
+      if (is_qid_token(p, &after_qid)) {
+        long q = strtol(after_qid, &end, 10);
+        if (qid != nullptr) qid[i] = q;
+        p = end;
+        continue;
+      }
       char* colon = nullptr;
       long idx = strtol(p, &colon, 10);
-      if (colon == p || *colon != ':') break;
+      if (colon == p || *colon != ':') return 3;  // malformed token
       p = colon + 1;
       double v = parse_double(p, &end);
       p = end;

@@ -1,14 +1,17 @@
 from .histogram import (full_histogram, leaf_histogram, histogram_from_rows,
                         subtract_histogram)
 from .partition import split_partition, decision_go_left
-from .predict import (TreeArrays, predict_tree_raw, predict_tree_binned,
-                      predict_leaf_index_binned, tree_to_arrays)
+from .predict import (TreeArrays, forest_to_arrays, predict_forest,
+                      predict_forest_leaf, predict_tree_raw,
+                      predict_tree_binned, predict_leaf_index_binned,
+                      tree_to_arrays)
 from .split import SplitParams, SplitResult, find_best_split
 
 __all__ = [
     "full_histogram", "leaf_histogram", "histogram_from_rows",
     "subtract_histogram", "split_partition", "decision_go_left",
-    "TreeArrays", "predict_tree_raw", "predict_tree_binned",
+    "TreeArrays", "forest_to_arrays", "predict_forest",
+    "predict_forest_leaf", "predict_tree_raw", "predict_tree_binned",
     "predict_leaf_index_binned", "tree_to_arrays",
     "SplitParams", "SplitResult", "find_best_split",
 ]

@@ -10,11 +10,16 @@ Trees are stacked into padded arrays and traversed with a bounded
 rows are vectorized with ``vmap`` so the whole batch advances one level per
 iteration — the same shape as the CUDA tree-predict kernel
 (reference: src/io/cuda/cuda_tree.cu).
+
+Whole forests are traversed in ONE jitted dispatch: trees are stacked along a
+leading ``T`` axis and a ``lax.scan`` accumulates per-class scores without
+materializing the ``[T, N]`` intermediate (the analog of ``GBDT::Predict``
+iterating inlined trees, reference: src/boosting/gbdt_prediction.cpp).
 """
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +31,8 @@ MT_NONE, MT_ZERO, MT_NAN = 0, 1, 2
 
 
 class TreeArrays(NamedTuple):
-    """One tree in device-friendly form. M = padded internal-node count."""
+    """One tree in device-friendly form. M = padded internal-node count.
+    When stacked into a forest, every field gains a leading T axis."""
     split_feature: jax.Array   # i32 [M] — feature index (original or inner)
     threshold: jax.Array       # f32 [M] raw threshold (numerical)
     threshold_bin: jax.Array   # i32 [M] bin threshold (numerical, binned data)
@@ -38,16 +44,22 @@ class TreeArrays(NamedTuple):
     right_child: jax.Array     # i32 [M]
     is_categorical: jax.Array  # bool [M]
     cat_bitset: jax.Array      # u32 [M, 8] bin-space bitset
-    cat_bitset_real: jax.Array  # u32 [M, 8] raw-category bitset
+    cat_bitset_real: jax.Array  # u32 [M, W] raw-category bitset (W >= 8,
+    #                              sized to the largest category; reference
+    #                              sizes these dynamically via
+    #                              Common::ConstructBitset, src/io/tree.cpp)
     leaf_value: jax.Array      # f32 [L]
 
 
 def tree_to_arrays(tree, feature_meta=None, use_inner_feature: bool = False,
-                   pad_nodes: int = 0) -> TreeArrays:
+                   pad_nodes: int = 0, pad_leaves: int = 0,
+                   pad_cat_words: int = 0) -> TreeArrays:
     """Stack a host Tree into TreeArrays.
 
     feature_meta: dict from BinnedDataset.feature_arrays() — required for
     binned traversal (default_bin / num_bin per node's feature).
+    pad_nodes / pad_leaves / pad_cat_words: minimum padded sizes, used to
+    align trees before stacking them into a forest.
     """
     n = max(tree.num_internal, 1)
     M = max(n, pad_nodes)
@@ -80,14 +92,21 @@ def tree_to_arrays(tree, feature_meta=None, use_inner_feature: bool = False,
             default_bin[:len(fi)] = feature_meta["default_bins"][fi]
             num_bin[:len(fi)] = feature_meta["num_bins"][fi]
 
+    W = max(8, pad_cat_words,
+            max((len(tree.cat_bitset_real[i]) for i in range(tree.num_internal)),
+                default=0))
     bits = np.zeros((M, 8), dtype=np.uint32)
-    bits_real = np.zeros((M, 8), dtype=np.uint32)
+    bits_real = np.zeros((M, W), dtype=np.uint32)
     for i in range(tree.num_internal):
-        bits[i] = tree.cat_bitset[i]
-        bits_real[i] = tree.cat_bitset_real[i][:8] if len(tree.cat_bitset_real[i]) >= 8 \
-            else np.pad(tree.cat_bitset_real[i], (0, 8 - len(tree.cat_bitset_real[i])))
+        bb = np.asarray(tree.cat_bitset[i], dtype=np.uint32)[:8]
+        bits[i, :len(bb)] = bb
+        br = np.asarray(tree.cat_bitset_real[i], dtype=np.uint32)
+        bits_real[i, :len(br)] = br
 
-    L = max(tree.num_leaves, 1)
+    L = max(tree.num_leaves, 1, pad_leaves)
+    leaf_value = np.zeros(L, dtype=np.float32)
+    leaf_value[:max(tree.num_leaves, 1)] = \
+        tree.leaf_value[:max(tree.num_leaves, 1)]
     return TreeArrays(
         split_feature=pad_i(feats[:max(tree.num_internal, 1)]),
         threshold=pad_f(tree.threshold_real),
@@ -101,8 +120,36 @@ def tree_to_arrays(tree, feature_meta=None, use_inner_feature: bool = False,
         is_categorical=pad_i(tree.is_categorical, dtype=bool),
         cat_bitset=jnp.asarray(bits),
         cat_bitset_real=jnp.asarray(bits_real),
-        leaf_value=jnp.asarray(tree.leaf_value[:L], dtype=jnp.float32),
+        leaf_value=jnp.asarray(leaf_value),
     )
+
+
+def forest_to_arrays(trees, feature_meta=None,
+                     use_inner_feature: bool = False
+                     ) -> Tuple[TreeArrays, int]:
+    """Stack host Trees into one TreeArrays with a leading T axis, padded to
+    common node/leaf/bitset-width sizes (rounded up to bound jit retraces).
+    Returns (stacked arrays, padded max_depth)."""
+    assert trees, "forest_to_arrays needs at least one tree"
+
+    def _round32(v: int) -> int:
+        return max(32, ((v + 31) // 32) * 32)
+
+    M = _round32(max(max(t.num_internal, 1) for t in trees))
+    L = _round32(max(max(t.num_leaves, 1) for t in trees))
+    W = max([8] + [len(t.cat_bitset_real[i]) for t in trees
+                   for i in range(t.num_internal)])
+    depth = _round_depth(max(t.max_depth for t in trees) + 1)
+    per_tree = [tree_to_arrays(t, feature_meta, use_inner_feature,
+                               pad_nodes=M, pad_leaves=L, pad_cat_words=W)
+                for t in trees]
+    stacked = TreeArrays(*(jnp.stack(cols) for cols in zip(*per_tree)))
+    return stacked, depth
+
+
+def _round_depth(d: int) -> int:
+    """Pad traversal depth to a multiple of 8 to bound jit specializations."""
+    return max(8, ((d + 7) // 8) * 8)
 
 
 def _cat_go_left(cat: jax.Array, bitset_row: jax.Array) -> jax.Array:
@@ -113,82 +160,105 @@ def _cat_go_left(cat: jax.Array, bitset_row: jax.Array) -> jax.Array:
     return inb & (bit == jnp.uint32(1))
 
 
-@functools.partial(jax.jit, static_argnames=("max_depth",))
-def predict_tree_raw(x: jax.Array, t: TreeArrays, max_depth: int) -> jax.Array:
-    """Predict one tree on raw float features [N, D] -> [N] leaf values."""
+def _traverse_leaf_id(x: jax.Array, t: TreeArrays, max_depth: int,
+                      binned: bool) -> jax.Array:
+    """Vectorized traversal of one tree over all rows -> leaf index [N].
+
+    binned=True routes exactly like train-time partitioning
+    (ops.partition.decision_go_left); binned=False uses raw thresholds with
+    the reference's NaN/zero missing semantics (tree.h NumericalDecision).
+    """
 
     def traverse(row):
         def body(_, node):
             def step(n):
                 f = t.split_feature[n]
-                v = row[f]
-                nan = jnp.isnan(v)
-                mt = t.missing_type[n]
-                # NaN converted to 0 unless NaN-missing
-                # (reference: tree.h NumericalDecision)
-                v0 = jnp.where(nan & (mt != MT_NAN), 0.0, v)
-                missing = ((mt == MT_NAN) & nan) | \
-                          ((mt == MT_ZERO) & (jnp.abs(v0) <= K_ZERO_THRESHOLD))
-                go_num = jnp.where(missing, t.default_left[n], v0 <= t.threshold[n])
-                cat = jnp.where(nan, -1, v).astype(jnp.int32)
-                go_cat = _cat_go_left(cat, t.cat_bitset_real[n])
-                go = jnp.where(t.is_categorical[n], go_cat, go_num)
-                return jnp.where(go, t.left_child[n], t.right_child[n])
-            return jnp.where(node < 0, node, step(jnp.maximum(node, 0)))
-
-        node = lax.fori_loop(0, max_depth, body, jnp.int32(0))
-        return t.leaf_value[~node]
-
-    return jax.vmap(traverse)(x)
-
-
-@functools.partial(jax.jit, static_argnames=("max_depth",))
-def predict_tree_binned(x_binned: jax.Array, t: TreeArrays,
-                        max_depth: int) -> jax.Array:
-    """Predict one tree on the binned matrix [N, F] (train/valid data).
-    Exactly mirrors train-time routing (ops.partition.decision_go_left)."""
-
-    def traverse(row):
-        def body(_, node):
-            def step(n):
-                f = t.split_feature[n]
-                b = row[f].astype(jnp.int32)
-                mt = t.missing_type[n]
-                missing = ((mt == MT_ZERO) & (b == t.default_bin[n])) | \
-                          ((mt == MT_NAN) & (b == t.num_bin[n] - 1))
-                go_num = jnp.where(missing, t.default_left[n],
-                                   b <= t.threshold_bin[n])
-                go_cat = _cat_go_left(b, t.cat_bitset[n])
-                go = jnp.where(t.is_categorical[n], go_cat, go_num)
-                return jnp.where(go, t.left_child[n], t.right_child[n])
-            return jnp.where(node < 0, node, step(jnp.maximum(node, 0)))
-
-        node = lax.fori_loop(0, max_depth, body, jnp.int32(0))
-        return t.leaf_value[~node]
-
-    return jax.vmap(traverse)(x_binned)
-
-
-@functools.partial(jax.jit, static_argnames=("max_depth", "output_leaf"))
-def predict_leaf_index_binned(x_binned: jax.Array, t: TreeArrays,
-                              max_depth: int, output_leaf: bool = True) -> jax.Array:
-    """Leaf index per row (for refit / predict_leaf_index)."""
-
-    def traverse(row):
-        def body(_, node):
-            def step(n):
-                f = t.split_feature[n]
-                b = row[f].astype(jnp.int32)
-                mt = t.missing_type[n]
-                missing = ((mt == MT_ZERO) & (b == t.default_bin[n])) | \
-                          ((mt == MT_NAN) & (b == t.num_bin[n] - 1))
-                go_num = jnp.where(missing, t.default_left[n],
-                                   b <= t.threshold_bin[n])
-                go_cat = _cat_go_left(b, t.cat_bitset[n])
+                if binned:
+                    b = row[f].astype(jnp.int32)
+                    mt = t.missing_type[n]
+                    missing = ((mt == MT_ZERO) & (b == t.default_bin[n])) | \
+                              ((mt == MT_NAN) & (b == t.num_bin[n] - 1))
+                    go_num = jnp.where(missing, t.default_left[n],
+                                       b <= t.threshold_bin[n])
+                    go_cat = _cat_go_left(b, t.cat_bitset[n])
+                else:
+                    v = row[f]
+                    nan = jnp.isnan(v)
+                    mt = t.missing_type[n]
+                    # NaN converted to 0 unless NaN-missing
+                    # (reference: tree.h NumericalDecision)
+                    v0 = jnp.where(nan & (mt != MT_NAN), 0.0, v)
+                    missing = ((mt == MT_NAN) & nan) | \
+                              ((mt == MT_ZERO) & (jnp.abs(v0) <= K_ZERO_THRESHOLD))
+                    go_num = jnp.where(missing, t.default_left[n],
+                                       v0 <= t.threshold[n])
+                    cat = jnp.where(nan, -1, v).astype(jnp.int32)
+                    go_cat = _cat_go_left(cat, t.cat_bitset_real[n])
                 go = jnp.where(t.is_categorical[n], go_cat, go_num)
                 return jnp.where(go, t.left_child[n], t.right_child[n])
             return jnp.where(node < 0, node, step(jnp.maximum(node, 0)))
 
         return ~lax.fori_loop(0, max_depth, body, jnp.int32(0))
 
-    return jax.vmap(traverse)(x_binned)
+    return jax.vmap(traverse)(x)
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_tree_raw(x: jax.Array, t: TreeArrays, max_depth: int) -> jax.Array:
+    """Predict one tree on raw float features [N, D] -> [N] leaf values."""
+    return t.leaf_value[_traverse_leaf_id(x, t, max_depth, binned=False)]
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth",))
+def predict_tree_binned(x_binned: jax.Array, t: TreeArrays,
+                        max_depth: int) -> jax.Array:
+    """Predict one tree on the binned matrix [N, F] (train/valid data)."""
+    return t.leaf_value[_traverse_leaf_id(x_binned, t, max_depth, binned=True)]
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "output_leaf"))
+def predict_leaf_index_binned(x_binned: jax.Array, t: TreeArrays,
+                              max_depth: int, output_leaf: bool = True) -> jax.Array:
+    """Leaf index per row (for refit / predict_leaf_index)."""
+    del output_leaf
+    return _traverse_leaf_id(x_binned, t, max_depth, binned=True)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_class", "max_depth", "binned"))
+def predict_forest(x: jax.Array, forest: TreeArrays, tree_class: jax.Array,
+                   num_class: int, max_depth: int, binned: bool) -> jax.Array:
+    """Sum a whole forest's leaf values into per-class scores in one dispatch.
+
+    x: [N, D] raw floats (binned=False) or [N, F] binned (binned=True).
+    forest: TreeArrays stacked along a leading T axis (forest_to_arrays).
+    tree_class: i32 [T] — class index of each tree (iter-major, class-minor).
+    Returns [num_class, N] float32.
+
+    A ``lax.scan`` over trees keeps peak memory at O(N) instead of the
+    O(T·N) a tree-vmapped traversal would materialize — the device analog
+    of GBDT::Predict accumulating over inlined trees
+    (reference: src/boosting/gbdt_prediction.cpp).
+    """
+    N = x.shape[0]
+
+    def step(out, tk):
+        t, k = tk
+        vals = t.leaf_value[_traverse_leaf_id(x, t, max_depth, binned)]
+        return out.at[k].add(vals), None
+
+    out, _ = lax.scan(step, jnp.zeros((num_class, N), jnp.float32),
+                      (forest, tree_class))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("max_depth", "binned"))
+def predict_forest_leaf(x: jax.Array, forest: TreeArrays,
+                        max_depth: int, binned: bool) -> jax.Array:
+    """Leaf index per (tree, row) for a whole forest: [T, N] int32."""
+
+    def step(_, t):
+        return None, _traverse_leaf_id(x, t, max_depth, binned)
+
+    _, ys = lax.scan(step, None, forest)
+    return ys

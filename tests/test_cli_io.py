@@ -56,6 +56,34 @@ def test_load_libsvm(tmp_path, rng):
     np.testing.assert_allclose(ds.metadata.label, y)
 
 
+def test_load_libsvm_qid(tmp_path, rng):
+    """LETOR files carry ``qid:N`` tokens; they must become query boundaries
+    (reference: parser.cpp LibSVM + rank examples), not silently parse to an
+    all-zero matrix."""
+    lines = []
+    vals = []
+    for i in range(60):
+        q = i // 20
+        v = round(float(rng.randn()), 4)
+        vals.append(v)
+        lines.append(f"{i % 3} qid:{q} 0:{v} 2:1.5")
+    path = tmp_path / "letor.svm"
+    path.write_text("\n".join(lines) + "\n")
+    cfg = Config.from_params({"verbose": -1})
+    ds = load_data_file(str(path), cfg)
+    assert ds.num_data == 60
+    assert ds.metadata.num_queries == 3
+    np.testing.assert_array_equal(ds.metadata.query_boundaries, [0, 20, 40, 60])
+
+
+def test_load_libsvm_malformed_fails(tmp_path):
+    path = tmp_path / "bad.svm"
+    path.write_text("1 0:1.0 junk 2:0.5\n")
+    cfg = Config.from_params({"verbose": -1})
+    with pytest.raises(Exception):
+        load_data_file(str(path), cfg)
+
+
 def test_query_sidecar(tmp_path, rng):
     X = rng.randn(100, 4)
     y = rng.randint(0, 3, 100).astype(float)

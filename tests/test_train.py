@@ -150,6 +150,28 @@ def test_categorical_feature_training():
     assert np.mean((pred - y) ** 2) < 0.1 * np.var(y)
 
 
+def test_categorical_high_cardinality_values():
+    """Raw category values >= 256 must route correctly at predict time
+    (variable-width bitsets; reference sizes them dynamically via
+    Common::ConstructBitset)."""
+    rng = np.random.RandomState(21)
+    n = 2000
+    cat = rng.randint(300, 310, n)          # all values above the old 256 cap
+    num = rng.randn(n)
+    y = (cat == 302) * 3.0 + (cat == 308) * -2.0 + 0.5 * num + 0.05 * rng.randn(n)
+    X = np.column_stack([cat.astype(float), num])
+    booster = lgb.train({"objective": "regression", "verbose": -1,
+                         "num_leaves": 15, "min_data_in_leaf": 5},
+                        lgb.Dataset(X, label=y, categorical_feature=[0]),
+                        num_boost_round=40)
+    pred = booster.predict(X)
+    assert np.mean((pred - y) ** 2) < 0.1 * np.var(y)
+    # text round-trip keeps the wide bitsets too
+    reloaded = lgb.Booster(model_str=booster.model_to_string())
+    pred2 = reloaded.predict(X)
+    np.testing.assert_allclose(pred, pred2, rtol=1e-5, atol=1e-5)
+
+
 def test_missing_values_nan():
     rng = np.random.RandomState(12)
     n = 2000
@@ -163,6 +185,19 @@ def test_missing_values_nan():
                         num_boost_round=40)
     pred = booster.predict(X)
     assert np.mean((pred - y) ** 2) < 0.05 * np.var(y)
+
+
+def test_dart_training():
+    """DART drops + renormalizes via the batched forest path
+    (reference: dart.hpp DroppingTrees/Normalize)."""
+    X, y = _reg_data(n=800, seed=31)
+    booster = lgb.train({"objective": "regression", "boosting": "dart",
+                         "drop_rate": 0.4, "verbose": -1, "num_leaves": 15},
+                        lgb.Dataset(X, label=y), num_boost_round=20,
+                        valid_sets=[lgb.Dataset(X[:200], label=y[:200],
+                                                reference=None)])
+    pred = booster.predict(X)
+    assert np.mean((pred - y) ** 2) < 0.7 * np.var(y)
 
 
 def test_init_score():
