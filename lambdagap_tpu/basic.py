@@ -238,6 +238,17 @@ class Booster:
                                                 jnp.asarray(hess))
         return self._booster.train_one_iter()
 
+    def refit(self, data, label, weight=None, group=None,
+              decay_rate: float = 0.9, **kwargs) -> "Booster":
+        """Refit the existing tree structures to new data
+        (reference: basic.py Booster.refit -> LGBM_BoosterRefit /
+        GBDT::RefitTree). Returns a new Booster; self is unchanged."""
+        mat, _, _ = _to_matrix(data)
+        new = Booster(params=self.params, model_str=self.model_to_string())
+        new._booster.refit(mat, label, weight=weight, group=group,
+                           decay_rate=decay_rate)
+        return new
+
     def rollback_one_iter(self) -> "Booster":
         self._booster.rollback_one_iter()
         return self

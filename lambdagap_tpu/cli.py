@@ -58,11 +58,14 @@ def run_train(cfg: Config) -> None:
         log.fatal("task=train requires data=<file>")
     log.info("Loading training data from %s", cfg.data)
     train = load_data_file(cfg.data, cfg)
-    booster = (GBDT.from_model_file(cfg.input_model, cfg) if cfg.input_model
-               else create_boosting(cfg, train))
+    booster = create_boosting(cfg, train)
     if cfg.input_model:
-        log.fatal("Continued training from input_model via CLI lands with "
-                  "the refit milestone")
+        # continued training (reference: application.cpp InitTrain with
+        # input_model -> Boosting::CreateBoosting(type, filename))
+        from .models.model_text import load_model_from_string
+        with open(cfg.input_model) as f:
+            _, trees = load_model_from_string(f.read())
+        booster.resume_from(trees)
     valids = []
     if cfg.valid:
         for i, vf in enumerate(str(cfg.valid).split(",")):
@@ -105,22 +108,21 @@ def run_predict(cfg: Config) -> None:
 
 
 def _load_raw_matrix(path: str, cfg: Config) -> np.ndarray:
-    from .data.loader import detect_format, _load_delim, _load_libsvm, \
-        _parse_column_spec
-    fmt = detect_format(path)
-    if fmt == "libsvm":
-        X, _, _ = _load_libsvm(path)
-        return X
-    delim = "," if fmt == "csv" else "\t"
-    header_names = None
-    if cfg.header:
-        with open(path) as f:
-            header_names = f.readline().strip().split(delim)
-    M = _load_delim(path, delim, cfg.header)
-    label_col = (_parse_column_spec(cfg.label_column, header_names)
-                 if cfg.label_column else 0)
-    keep = [j for j in range(M.shape[1]) if j != label_col]
-    return M[:, keep]
+    from .data.loader import raw_matrix_of
+    return raw_matrix_of(path, cfg)[0]
+
+
+def run_refit(cfg: Config) -> None:
+    """Refit an existing model's leaf values on new data
+    (reference: application.cpp:254-290 ConvertModel-adjacent refit task)."""
+    if not cfg.data or not cfg.input_model:
+        log.fatal("task=refit requires data=<file> and input_model=<model>")
+    booster = GBDT.from_model_file(cfg.input_model, cfg)
+    from .data.loader import raw_matrix_of
+    X, y = raw_matrix_of(cfg.data, cfg)
+    booster.refit(X, y)
+    booster.save_model(cfg.output_model)
+    log.info("Refitted model saved to %s", cfg.output_model)
 
 
 def run_save_binary(cfg: Config) -> None:
@@ -156,7 +158,7 @@ def main(argv=None) -> int:
     elif task == "convert_model":
         run_convert_model(cfg)
     elif task == "refit":
-        log.fatal("task=refit lands with the refit milestone")
+        run_refit(cfg)
     else:
         log.fatal("Unknown task %r", task)
     return 0

@@ -88,7 +88,11 @@ def _load_libsvm(path: str) -> Tuple[np.ndarray, np.ndarray,
             for tok in parts[1:]:
                 k, _, v = tok.partition(":")
                 if k.lower() == "qid":
-                    q = int(v)
+                    try:
+                        q = int(v)
+                    except ValueError:
+                        log.fatal("LibSVM format error at %s:%d: bad qid "
+                                  "token %r", path, lineno, tok)
                     continue
                 try:
                     ki = int(k)
@@ -221,6 +225,35 @@ def load_data_file(path: str, config: Config,
         X, config, label=y, weight=weight, group=qgroups,
         init_score=init_score, position=pos,
         categorical_features=categorical, reference=reference)
+
+
+def raw_matrix_of(path: str, config: Config) -> Tuple[np.ndarray, np.ndarray]:
+    """Raw (unbinned) feature matrix + label of a text data file, with the
+    same column handling as :func:`load_data_file` (used by CLI refit,
+    reference: application.cpp:254-290)."""
+    fmt = detect_format(path)
+    if fmt == "libsvm":
+        X, y, _ = _load_libsvm(path)
+        return X, y
+    delim = "," if fmt == "csv" else "\t"
+    header_names: Optional[List[str]] = None
+    if config.header:
+        with open(path) as f:
+            header_names = f.readline().strip().split(delim)
+    M = _load_delim(path, delim, config.header)
+    label_col = (_parse_column_spec(config.label_column, header_names)
+                 if config.label_column else 0)
+    drop = {label_col}
+    if config.weight_column:
+        drop.add(_parse_column_spec(config.weight_column, header_names))
+    if config.group_column:
+        drop.add(_parse_column_spec(config.group_column, header_names))
+    if config.ignore_column:
+        for spec in config.ignore_column.split(","):
+            if spec.strip():
+                drop.add(_parse_column_spec(spec.strip(), header_names))
+    keep = [j for j in range(M.shape[1]) if j not in drop]
+    return M[:, keep], M[:, label_col]
 
 
 # ---------------------------------------------------------------------------

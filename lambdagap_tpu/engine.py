@@ -34,8 +34,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
-        log.warning("init_model continued training is not yet wired; "
-                    "starting fresh")
+        from .models.model_text import load_model_from_string
+        if isinstance(init_model, Booster):
+            model_str = init_model.model_to_string()
+        else:
+            with open(init_model) as f:
+                model_str = f.read()
+        _, trees = load_model_from_string(model_str)
+        booster._booster.resume_from(trees)
 
     valid_sets = valid_sets or []
     valid_names = valid_names or []
@@ -73,6 +79,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         for cb in cbs_before:
             cb(env0)
         stop = booster.update()
+        if cfg.snapshot_freq > 0 and (i + 1) % cfg.snapshot_freq == 0:
+            # periodic model snapshots (reference: gbdt.cpp:252-256)
+            booster.save_model(
+                f"{cfg.output_model}.snapshot_iter_{booster.current_iteration}")
 
         evals: List[Tuple[str, str, float, bool]] = []
         if valid_contains_train:

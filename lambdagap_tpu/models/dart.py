@@ -58,6 +58,16 @@ class DART(GBDT):
                 self.learner.x_binned, forest, tree_class, K, depth,
                 binned=True)
 
+    def resume_from(self, trees: List[Tree]) -> None:
+        super().resume_from(trees)
+        # reconstruct per-iteration tree weights from the cumulative
+        # shrinkage each tree carries (apply_shrinkage tracks exactly the
+        # DART weight after all past normalizations)
+        K = self.num_tree_per_iteration
+        self.tree_weight = [float(self.models[i * K].shrinkage)
+                            for i in range(self.iter_)]
+        self.sum_weight = float(sum(self.tree_weight))
+
     def _dropping_trees(self) -> List[int]:
         cfg = self.config
         drop_index: List[int] = []
@@ -151,6 +161,14 @@ class RF(GBDT):
         const_scores = jnp.asarray(
             np.tile(np.asarray(self.init_scores, np.float32)[:, None], (1, N)))
         self._rf_grad, self._rf_hess = self.objective.get_gradients(const_scores)
+
+    def resume_from(self, trees: List[Tree]) -> None:
+        super().resume_from(trees)
+        # RF scores are running averages, not sums (rf.hpp MultiplyScore)
+        if self.iter_ > 0:
+            self.scores = self.scores / self.iter_
+            for vi in range(len(self.valid_scores)):
+                self.valid_scores[vi] = self.valid_scores[vi] / self.iter_
 
     def train_one_iter(self, grad=None, hess=None) -> bool:
         if self.objective is None:

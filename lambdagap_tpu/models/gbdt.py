@@ -339,6 +339,103 @@ class GBDT:
             tree.leaf_value[leaf] = self.objective.renew_tree_output(rows, score)
 
     # ------------------------------------------------------------------
+    # continued training / refit
+    # ------------------------------------------------------------------
+    def resume_from(self, trees: List[Tree]) -> None:
+        """Continue training from a loaded model's trees: keep the tree list
+        and replay their scores onto the train/valid sets in one batched
+        dispatch (reference: Boosting::CreateBoosting(type, filename) +
+        GBDT::ResetTrainingData, src/boosting/boosting.cpp:34 / gbdt.cpp;
+        Python engine.py:109 init_model)."""
+        import copy
+        from .tree import rebind_to_dataset
+        K = self.num_tree_per_iteration
+        if len(trees) % K != 0:
+            log.fatal("init_model has %d trees, not a multiple of "
+                      "num_tree_per_iteration=%d", len(trees), K)
+        if self.train_set is None:
+            log.fatal("resume_from needs a training dataset")
+        # deep-copy: rebinding mutates bin-space (and, for missing-type
+        # mismatches, raw-space) fields — the caller's trees stay pristine
+        trees = [copy.deepcopy(t) for t in trees]
+        for t in trees:
+            rebind_to_dataset(t, self.train_set)
+        self.models = list(trees)
+        self.iter_ = len(trees) // K
+        forest, depth = forest_to_arrays(trees, feature_meta=self._meta,
+                                         use_inner_feature=True)
+        tree_class = jnp.asarray([i % K for i in range(len(trees))], jnp.int32)
+        self.scores = self.scores + predict_forest(
+            jnp.asarray(self.train_set.binned), forest, tree_class, K, depth,
+            binned=True)
+        for vi in range(len(self.valid_sets)):
+            self.valid_scores[vi] = self.valid_scores[vi] + predict_forest(
+                self.valid_binned[vi], forest, tree_class, K, depth,
+                binned=True)
+
+    def refit(self, data: np.ndarray, label: np.ndarray, weight=None,
+              group=None, decay_rate: Optional[float] = None) -> None:
+        """Refit the leaf values of the existing trees on new data, keeping
+        the tree structures (reference: GBDT::RefitTree in gbdt.cpp +
+        SerialTreeLearner::FitByExistingTree; CLI task=refit,
+        application.cpp:254-290). New leaf outputs are the regularized
+        Newton step over the rows landing in each leaf
+        (feature_histogram.hpp:198 CalculateSplittedLeafOutput), blended by
+        ``refit_decay_rate``."""
+        from ..data.dataset import Metadata
+        cfg = self.config
+        decay = cfg.refit_decay_rate if decay_rate is None else float(decay_rate)
+        X = np.ascontiguousarray(np.asarray(data, dtype=np.float32))
+        N = X.shape[0]
+        K = self.num_tree_per_iteration
+        trees = self.host_models
+        if not trees:
+            log.fatal("refit needs a trained model")
+        md = Metadata()
+        md.label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if weight is not None:
+            md.weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        md.set_group(group)
+        md.check(N)
+        obj = create_objective(cfg)
+        if obj is None:
+            log.fatal("refit requires a built-in objective")
+        obj.init(md, N)
+
+        forest, depth = forest_to_arrays(trees, use_inner_feature=False)
+        leaf_of = np.asarray(jax.device_get(predict_forest_leaf(
+            jnp.asarray(X), forest, depth, binned=False)))   # [T, N]
+
+        l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+        mds = cfg.max_delta_step
+
+        def newton_out(sg, sh):
+            num = (-np.sign(sg) * np.maximum(np.abs(sg) - l1, 0.0)
+                   if l1 > 0 else -sg)
+            out = num / (sh + l2 + K_EPSILON)
+            if mds > 0:
+                out = np.clip(out, -mds, mds)
+            return out
+
+        scores = jnp.zeros((K, N), dtype=jnp.float32)
+        for it in range(len(trees) // K):
+            grad, hess = obj.get_gradients(scores)
+            g = np.asarray(jax.device_get(grad))
+            h = np.asarray(jax.device_get(hess))
+            for k in range(K):
+                ti = it * K + k
+                t = trees[ti]
+                L = t.num_leaves
+                lf = leaf_of[ti]
+                sg = np.bincount(lf, weights=g[k], minlength=L)[:L]
+                sh = np.bincount(lf, weights=h[k], minlength=L)[:L]
+                new_out = newton_out(sg, sh) * t.shrinkage
+                old = t.leaf_value[:L].copy()
+                t.leaf_value[:L] = decay * old + (1.0 - decay) * new_out
+                scores = scores.at[k].add(
+                    jnp.asarray(t.leaf_value[lf].astype(np.float32)))
+
+    # ------------------------------------------------------------------
     # evaluation
     # ------------------------------------------------------------------
     def _converted_scores(self, raw: jax.Array) -> np.ndarray:

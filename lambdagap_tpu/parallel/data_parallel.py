@@ -93,6 +93,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
         mesh = self.mesh
         B = self.B
         rpb = self.rows_per_block
+        prec = self.config.tpu_hist_precision
 
         @functools.partial(
             shard_map, mesh=mesh,
@@ -100,7 +101,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
                       P(DATA_AXIS)),
             out_specs=P())
         def root_hist(x_l, g_l, h_l, m_l):
-            local = histogram_from_rows(x_l, g_l, h_l, m_l, B, rpb)
+            local = histogram_from_rows(x_l, g_l, h_l, m_l, B, rpb,
+                                        precision=prec)
             return jax.lax.psum(local, DATA_AXIS)
 
         self._root_hist_op = jax.jit(root_hist)
@@ -111,7 +113,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
             rows = perm_l[idx]
             valid = (lane < count_l[0]) & m_l[rows]
             local = histogram_from_rows(x_l[rows], g_l[rows], h_l[rows],
-                                        valid, B, rpb)
+                                        valid, B, rpb,
+                                        precision=prec)
             return jax.lax.psum(local, DATA_AXIS)
 
         self._leaf_hist_ops: Dict[int, callable] = {}

@@ -51,6 +51,7 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
             return self._hist_cache[padded]
         B = self.B
         rpb = self.rows_per_block
+        prec = self.config.tpu_hist_precision
         f_loc = self.f_loc
         F = self.num_features
 
@@ -62,7 +63,8 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
             valid = (lane < count) & row_mask[rows]
             block = jax.lax.dynamic_slice(
                 x[rows], (0, d * f_loc), (padded, f_loc))
-            local = histogram_from_rows(block, g[rows], h[rows], valid, B, rpb)
+            local = histogram_from_rows(block, g[rows], h[rows], valid, B, rpb,
+                                        precision=prec)
             full = jax.lax.all_gather(local, DATA_AXIS, tiled=True)
             return full[:F]
 

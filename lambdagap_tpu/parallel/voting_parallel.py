@@ -39,6 +39,7 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         mesh = self.mesh
         B = self.B
         rpb = self.rows_per_block
+        prec = self.config.tpu_hist_precision
         F = self.num_features
         top_k = max(1, min(self.config.top_k, F))
         params = self.params
@@ -51,7 +52,8 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
                       P(DATA_AXIS)),
             out_specs=P(DATA_AXIS))
         def root_hist_local(x_l, g_l, h_l, m_l):
-            return histogram_from_rows(x_l, g_l, h_l, m_l, B, rpb)
+            return histogram_from_rows(x_l, g_l, h_l, m_l, B, rpb,
+                                        precision=prec)
 
         self._root_hist_op = jax.jit(root_hist_local)
 
@@ -62,7 +64,8 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             rows = perm_l[idx]
             valid = (lane < count_l[0]) & m_l[rows]
             return histogram_from_rows(x_l[rows], g_l[rows], h_l[rows],
-                                       valid, B, rpb)
+                                       valid, B, rpb,
+                                        precision=prec)
 
         self._leaf_hist_fn = leaf_hist_local
         self._leaf_hist_ops = {}

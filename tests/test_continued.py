@@ -1,0 +1,153 @@
+"""Continued training (init_model), snapshots, and refit.
+
+Mirrors the reference's continued-training coverage
+(reference: tests/python_package_test/test_engine.py:1124+ and the CLI
+refit task, src/application/application.cpp:254-290).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+
+
+def _make_data(n=800, d=10, seed=3, classification=True):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d)
+    logits = X @ rng.randn(d) + 0.3 * X[:, 0] * X[:, 1]
+    if classification:
+        y = (logits > 0).astype(np.float64)
+    else:
+        y = logits + 0.1 * rng.randn(n)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+          "min_data_in_leaf": 5, "verbose": -1, "deterministic": True}
+
+
+def test_resume_matches_straight_training():
+    X, y = _make_data()
+    b20 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=20)
+
+    b10 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10,
+                        init_model=b10)
+    assert resumed.num_trees() == 20
+    p_straight = b20.predict(X, raw_score=True)
+    p_resumed = resumed.predict(X, raw_score=True)
+    np.testing.assert_allclose(p_resumed, p_straight, rtol=1e-4, atol=1e-5)
+
+
+def test_resume_from_file(tmp_path):
+    X, y = _make_data()
+    b10 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    path = str(tmp_path / "m.txt")
+    b10.save_model(path)
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=5,
+                        init_model=path)
+    assert resumed.num_trees() == 15
+    p = resumed.predict(X)
+    assert np.isfinite(p).all()
+
+
+def logloss(y, p):
+    p = np.clip(p, 1e-9, 1 - 1e-9)
+    return float(-np.mean(y * np.log(p) + (1 - y) * np.log(1 - p)))
+
+
+def test_resume_improves_loss():
+    X, y = _make_data()
+    b10 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    l10 = logloss(y, b10.predict(X))
+    resumed = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=15,
+                        init_model=b10)
+    l25 = logloss(y, resumed.predict(X))
+    assert l25 < l10
+
+
+def test_resume_multiclass():
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int) + (X[:, 2] > 0.5).astype(int)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbose": -1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    r = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
+                  init_model=b)
+    straight = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    np.testing.assert_allclose(r.predict(X, raw_score=True),
+                               straight.predict(X, raw_score=True),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_resume_dart():
+    # weighted dropout needs tree_weight reconstructed on resume
+    X, y = _make_data(n=400)
+    params = {**PARAMS, "boosting": "dart", "drop_rate": 0.5,
+              "uniform_drop": False}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    r = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
+                  init_model=b)
+    assert r.num_trees() == 10
+    assert np.isfinite(r.predict(X)).all()
+
+
+def test_snapshot_freq(tmp_path):
+    X, y = _make_data(n=300)
+    out = str(tmp_path / "model.txt")
+    lgb.train({**PARAMS, "snapshot_freq": 4, "output_model": out},
+              lgb.Dataset(X, label=y), num_boost_round=10)
+    assert os.path.exists(out + ".snapshot_iter_4")
+    assert os.path.exists(out + ".snapshot_iter_8")
+    snap = lgb.Booster(model_file=out + ".snapshot_iter_8")
+    assert snap.num_trees() == 8
+
+
+def test_refit_changes_leaf_values_keeps_structure():
+    X, y = _make_data(seed=1)
+    b = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=10)
+    X2, y2 = _make_data(seed=99)
+    refitted = b.refit(X2, y2)
+    assert refitted.num_trees() == b.num_trees()
+    s_old = b.model_to_string()
+    s_new = refitted.model_to_string()
+    # same split structure...
+    def _field(s, key):
+        return [ln for ln in s.splitlines() if ln.startswith(key)]
+    assert _field(s_old, "split_feature=") == _field(s_new, "split_feature=")
+    assert _field(s_old, "threshold=") == _field(s_new, "threshold=")
+    # ...different leaf values
+    assert _field(s_old, "leaf_value=") != _field(s_new, "leaf_value=")
+    # refitted model is a sane predictor of the new data
+    l_refit = logloss(y2, refitted.predict(X2))
+    l_old = logloss(y2, b.predict(X2))
+    assert l_refit < l_old
+
+
+def test_cli_refit_and_continued(tmp_path):
+    from lambdagap_tpu.cli import main as cli_main
+    X, y = _make_data(n=400, d=6)
+    data = np.column_stack([y, X])
+    train_path = str(tmp_path / "train.csv")
+    np.savetxt(train_path, data, delimiter=",", fmt="%.8g")
+    model1 = str(tmp_path / "m1.txt")
+    cli_main([f"task=train", f"data={train_path}", "objective=binary",
+              "num_iterations=5", "num_leaves=7", f"output_model={model1}",
+              "verbose=-1"])
+    # continued training via input_model
+    model2 = str(tmp_path / "m2.txt")
+    cli_main([f"task=train", f"data={train_path}", "objective=binary",
+              "num_iterations=5", "num_leaves=7", f"input_model={model1}",
+              f"output_model={model2}", "verbose=-1"])
+    b2 = lgb.Booster(model_file=model2)
+    assert b2.num_trees() == 10
+    # refit task
+    model3 = str(tmp_path / "m3.txt")
+    cli_main([f"task=refit", f"data={train_path}", f"input_model={model2}",
+              f"output_model={model3}", "objective=binary", "verbose=-1"])
+    b3 = lgb.Booster(model_file=model3)
+    assert b3.num_trees() == 10
+    p = b3.predict(X)
+    assert p.shape == (400,) and np.isfinite(p).all()
