@@ -109,7 +109,8 @@ def run_predict(cfg: Config) -> None:
 
 def _load_raw_matrix(path: str, cfg: Config) -> np.ndarray:
     from .data.loader import raw_matrix_of
-    return raw_matrix_of(path, cfg)[0]
+    X, _, _, _ = raw_matrix_of(path, cfg)
+    return X
 
 
 def run_refit(cfg: Config) -> None:
@@ -119,8 +120,8 @@ def run_refit(cfg: Config) -> None:
         log.fatal("task=refit requires data=<file> and input_model=<model>")
     booster = GBDT.from_model_file(cfg.input_model, cfg)
     from .data.loader import raw_matrix_of
-    X, y = raw_matrix_of(cfg.data, cfg)
-    booster.refit(X, y)
+    X, y, weight, group = raw_matrix_of(cfg.data, cfg)
+    booster.refit(X, y, weight=weight, group=group)
     booster.save_model(cfg.output_model)
     log.info("Refitted model saved to %s", cfg.output_model)
 

@@ -227,33 +227,52 @@ def load_data_file(path: str, config: Config,
         categorical_features=categorical, reference=reference)
 
 
-def raw_matrix_of(path: str, config: Config) -> Tuple[np.ndarray, np.ndarray]:
-    """Raw (unbinned) feature matrix + label of a text data file, with the
-    same column handling as :func:`load_data_file` (used by CLI refit,
-    reference: application.cpp:254-290)."""
+def raw_matrix_of(path: str, config: Config):
+    """Raw (unbinned) feature matrix of a text data file, with the same
+    column handling and sidecars as :func:`load_data_file` (used by CLI
+    refit/predict, reference: application.cpp:254-290).
+
+    Returns (X, label, weight_or_None, group_sizes_or_None)."""
+    weight = None
+    group = None
     fmt = detect_format(path)
     if fmt == "libsvm":
-        X, y, _ = _load_libsvm(path)
-        return X, y
-    delim = "," if fmt == "csv" else "\t"
-    header_names: Optional[List[str]] = None
-    if config.header:
-        with open(path) as f:
-            header_names = f.readline().strip().split(delim)
-    M = _load_delim(path, delim, config.header)
-    label_col = (_parse_column_spec(config.label_column, header_names)
-                 if config.label_column else 0)
-    drop = {label_col}
-    if config.weight_column:
-        drop.add(_parse_column_spec(config.weight_column, header_names))
-    if config.group_column:
-        drop.add(_parse_column_spec(config.group_column, header_names))
-    if config.ignore_column:
-        for spec in config.ignore_column.split(","):
-            if spec.strip():
-                drop.add(_parse_column_spec(spec.strip(), header_names))
-    keep = [j for j in range(M.shape[1]) if j not in drop]
-    return M[:, keep], M[:, label_col]
+        X, y, qid = _load_libsvm(path)
+        if qid is not None:
+            change = np.nonzero(np.diff(qid))[0] + 1
+            bounds = np.concatenate([[0], change, [len(qid)]])
+            group = np.diff(bounds)
+    else:
+        delim = "," if fmt == "csv" else "\t"
+        header_names: Optional[List[str]] = None
+        if config.header:
+            with open(path) as f:
+                header_names = f.readline().strip().split(delim)
+        M = _load_delim(path, delim, config.header)
+        label_col = (_parse_column_spec(config.label_column, header_names)
+                     if config.label_column else 0)
+        drop = {label_col}
+        if config.weight_column:
+            wc = _parse_column_spec(config.weight_column, header_names)
+            weight = M[:, wc]
+            drop.add(wc)
+        if config.group_column:
+            gc = _parse_column_spec(config.group_column, header_names)
+            group = M[:, gc].astype(np.int64)
+            drop.add(gc)
+        if config.ignore_column:
+            for spec in config.ignore_column.split(","):
+                if spec.strip():
+                    drop.add(_parse_column_spec(spec.strip(), header_names))
+        keep = [j for j in range(M.shape[1]) if j not in drop]
+        X, y = M[:, keep], M[:, label_col]
+    if weight is None and os.path.exists(path + ".weight"):
+        weight = np.loadtxt(path + ".weight", dtype=np.float64)
+    qpath = next((p for p in (path + ".query", path + ".group")
+                  if os.path.exists(p)), None)
+    if qpath is not None:
+        group = np.loadtxt(qpath, dtype=np.int64).reshape(-1)
+    return X, y, weight, group
 
 
 # ---------------------------------------------------------------------------

@@ -62,7 +62,14 @@ class DART(GBDT):
         super().resume_from(trees)
         # reconstruct per-iteration tree weights from the cumulative
         # shrinkage each tree carries (apply_shrinkage tracks exactly the
-        # DART weight after all past normalizations)
+        # DART weight after all past normalizations). Under
+        # xgboost_dart_mode the normalize factor applied to shrinkage
+        # (k/(k+lr)) differs from the tree-weight factor (k/(k+1)), so the
+        # reconstruction is only approximate there.
+        if self.config.xgboost_dart_mode and not self.config.uniform_drop:
+            log.warning("Resuming DART with xgboost_dart_mode: weighted "
+                        "dropout probabilities are reconstructed "
+                        "approximately from tree shrinkage")
         K = self.num_tree_per_iteration
         self.tree_weight = [float(self.models[i * K].shrinkage)
                             for i in range(self.iter_)]
@@ -164,9 +171,19 @@ class RF(GBDT):
 
     def resume_from(self, trees: List[Tree]) -> None:
         super().resume_from(trees)
-        # RF scores are running averages, not sums (rf.hpp MultiplyScore)
+        # RF scores are running averages, not sums (rf.hpp MultiplyScore);
+        # straight RF training also wipes any init_score baseline at
+        # iteration 0 (the *0 multiply), so subtract it before averaging
         if self.iter_ > 0:
-            self.scores = self.scores / self.iter_
+            K, N = self.num_tree_per_iteration, self.num_data
+            md = self.train_set.metadata
+            if md.init_score is not None:
+                s = np.asarray(md.init_score, dtype=np.float32)
+                base = jnp.asarray(s.reshape(K, N) if s.size == K * N
+                                   else np.tile(s, (K, 1)))
+                self.scores = (self.scores - base) / self.iter_
+            else:
+                self.scores = self.scores / self.iter_
             for vi in range(len(self.valid_scores)):
                 self.valid_scores[vi] = self.valid_scores[vi] / self.iter_
 

@@ -60,12 +60,6 @@ class DeviceTree(NamedTuple):
     row_leaf: jax.Array          # i32 [N] leaf id per training row
 
 
-# best-split store keys, all [L]-indexed (the device analog of
-# best_split_per_leaf_, reference: serial_tree_learner.h)
-_BKEYS = ("bgain", "bfeat", "bthr", "bdl", "bcat", "bbits",
-          "blg", "blh", "blc", "blout", "brout")
-
-
 class FusedTreeLearner(SerialTreeLearner):
     """Whole-tree-per-dispatch learner. Reuses SerialTreeLearner's dataset
     plumbing (bin meta, split params, feature sampling)."""
@@ -76,7 +70,13 @@ class FusedTreeLearner(SerialTreeLearner):
         # (the analog of CUDAColumnData next to CUDARowData,
         # reference: src/io/cuda/cuda_column_data.cpp)
         self.x_cols = jnp.asarray(np.ascontiguousarray(dataset.binned.T))
-        self.chunk = max(min(int(config.tpu_rows_per_block) * 8, 1 << 19), 1 << 12)
+        # chunk window for the while-loop'd row passes: small enough that a
+        # deep (small) leaf doesn't pay a huge padded window of gather/scan
+        # work, large enough that root-sized passes don't drown in per-trip
+        # overhead. Grows with N between 4k and 16*tpu_rows_per_block.
+        cap = max(int(config.tpu_rows_per_block) * 16, 1 << 12)
+        self.chunk = min(max(_next_pow2(max(dataset.num_data // 128, 1)),
+                             1 << 12), cap)
         self._train_jit = jax.jit(self._train_tree_impl,
                                   static_argnames=("has_mask",))
         self.last_row_leaf: Optional[jax.Array] = None
@@ -142,6 +142,22 @@ class FusedTreeLearner(SerialTreeLearner):
     # the fused program
     # ------------------------------------------------------------------
     def _train_tree_impl(self, grad, hess, row_mask, fmask, *, has_mask: bool):
+        """One whole tree as a single XLA program.
+
+        Design notes for the ``fori_loop`` body (the per-split step):
+
+        * No ``lax.cond``: an un-splittable step is expressed by masking —
+          the partition/histogram loops get a zero row count (zero trips)
+          and every state write lands on a dump row (index ``L`` / ``NODES``)
+          instead of branching. This keeps the loop body straight-line and
+          lets XLA alias the large carried buffers in place (a cond joining
+          two 20+ MB states forced copies).
+        * Per-leaf and per-node bookkeeping lives in a few consolidated
+          matrices (``leaf_f``/``leaf_i``/``node_f``/``node_i``) so one split
+          costs a handful of dynamic-update-slices instead of ~30 one-column
+          kernels — per-split fixed cost is mostly kernel-launch count.
+        * Both children's best-split scans run in one vmapped call.
+        """
         cfg = self.config
         N = self.num_data
         F = self.num_features
@@ -160,17 +176,29 @@ class FusedTreeLearner(SerialTreeLearner):
         has_cat = self.has_categorical
         lane = jnp.arange(W, dtype=jnp.int32)
         bin_iota = jnp.arange(B, dtype=x_rows.dtype)
+        # grad+hess interleaved so one random gather fetches both channels
+        gh2 = jnp.stack([grad, hess], axis=1)           # [N, 2]
+
+        def perm_slice(perm, start):
+            """Contiguous W-row window of the (N+W padded) permutation —
+            a dynamic-slice DMA, not a gather."""
+            return lax.dynamic_slice(perm, (start,), (W,))
 
         def chunk_hist(perm, begin, count, acc, c):
-            """Histogram of rows perm[begin+cW : begin+(c+1)W] (MXU one-hot)."""
-            offs = begin + c * W + lane
-            rows = perm[jnp.clip(offs, 0, N - 1)]
+            """Histogram of rows perm[begin+cW : begin+(c+1)W]."""
+            rows = perm_slice(perm, begin + c * W)
             valid = (c * W + lane) < count
             if has_mask:
                 valid = valid & row_mask[rows]
             bins = x_rows[rows]                         # [W, F]
-            g = jnp.where(valid, grad[rows], 0.0)
-            h = jnp.where(valid, hess[rows], 0.0)
+            ghr = gh2[rows]                             # [W, 2]
+            if self.hist_impl == "pallas":
+                from ..ops.hist_pallas import hist_pallas, pack_gh8
+                live = jnp.clip(count - c * W, 0, W)
+                gh8 = pack_gh8(ghr[:, 0], ghr[:, 1], valid)
+                return acc + hist_pallas(bins, gh8, B, live)
+            g = jnp.where(valid, ghr[:, 0], 0.0)
+            h = jnp.where(valid, ghr[:, 1], 0.0)
             gh = jnp.stack([g, h, valid.astype(jnp.float32)], axis=1)
             onehot = (bins[:, :, None] == bin_iota).astype(jnp.bfloat16)
             part = gh_contract(gh, onehot.reshape(W, F * B),
@@ -190,7 +218,8 @@ class FusedTreeLearner(SerialTreeLearner):
             return hist
 
         def best_of(hist, pg, ph, pc, pout, depth):
-            """Best split for one leaf, with the max_depth guard."""
+            """Best split for one leaf, with the max_depth guard.
+            Returns (gain, feat, thr, dl, cat, bits, lg, lh, lc, lout, rout)."""
             gain, thr, dl, lg, lh, lc, bits = per_feature_best(
                 hist, pg, ph, pc, pout, num_bins, default_bins,
                 missing_types, is_cat_arr, fmask, p, has_cat)
@@ -204,228 +233,220 @@ class FusedTreeLearner(SerialTreeLearner):
             lout = calculate_leaf_output(lg[f], lh[f], p, lc[f], pout)
             rout = calculate_leaf_output(pg - lg[f], ph - lh[f], p,
                                          pc - lc[f], pout)
-            return dict(bgain=jnp.where(ok, g, K_MIN_SCORE), bfeat=f,
-                        bthr=thr[f], bdl=dl[f], bcat=is_cat_arr[f],
-                        bbits=bits[f], blg=lg[f], blh=lh[f], blc=lc[f],
-                        blout=lout, brout=rout)
+            return (jnp.where(ok, g, K_MIN_SCORE), f, thr[f], dl[f],
+                    is_cat_arr[f], bits[f], lg[f], lh[f], lc[f], lout, rout)
+
+        best_children = jax.vmap(best_of, in_axes=(0, 0, 0, 0, 0, None))
 
         # ------------------------------------------------------ state init
-        perm0 = jnp.arange(N, dtype=jnp.int32)
+        # consolidated per-leaf/per-node state; row L / row NODES is the dump
+        # row that masked-off writes land on
+        # leaf_f columns: sum_g, sum_h, cnt, out, bgain, blg, blh, blc,
+        #                 blout, brout
+        # leaf_i columns: begin, count, depth, parent, is_left, bfeat, bthr,
+        #                 bdl, bcat
+        # node_f columns: gain, value, weight, count
+        # node_i columns: feature, threshold, default_left, is_cat, left, right
+        # W rows of padding let every window read be a clamped-free
+        # dynamic slice; pad rows point at row 0 and are always masked
+        perm0 = jnp.concatenate([jnp.arange(N, dtype=jnp.int32),
+                                 jnp.zeros(W, jnp.int32)])
         hist_root = leaf_hist(perm0, jnp.int32(0), jnp.int32(N))
         totals = jnp.sum(hist_root[0], axis=0)
         root_out = calculate_leaf_output(totals[0], totals[1], p, totals[2],
                                          0.0)
-        b0 = best_of(hist_root, totals[0], totals[1], totals[2], root_out,
-                     jnp.int32(0))
+        (bg0, bf0, bt0, bdl0, bcat0, bbits0, blg0, blh0, blc0, blout0,
+         brout0) = best_of(hist_root, totals[0], totals[1], totals[2],
+                           root_out, jnp.int32(0))
 
-        iota_l = jnp.arange(L, dtype=jnp.int32)
+        iota_l1 = jnp.arange(L + 1, dtype=jnp.int32)
+        f32 = jnp.float32
+        i32 = jnp.int32
+        leaf_f = jnp.zeros((L + 1, 10), f32)
+        leaf_f = leaf_f.at[:, 4].set(K_MIN_SCORE).at[0].set(jnp.stack(
+            [totals[0], totals[1], totals[2], root_out, bg0, blg0, blh0,
+             blc0, blout0, brout0]))
+        leaf_i = jnp.zeros((L + 1, 9), i32)
+        # inactive leaves carry out-of-range begins so the final
+        # position->leaf searchsorted never matches them
+        leaf_i = leaf_i.at[:, 0].set(N + iota_l1).at[:, 3].set(-1)
+        leaf_i = leaf_i.at[0].set(jnp.stack(
+            [i32(0), i32(N), i32(0), i32(-1), i32(0), bf0, bt0,
+             bdl0.astype(i32), bcat0.astype(i32)]))
+        leaf_bits = jnp.zeros((L + 1, 8), jnp.uint32).at[0].set(bbits0)
+        node_f = jnp.zeros((NODES + 1, 4), f32)
+        node_i = jnp.zeros((NODES + 1, 6), i32).at[:, 4:6].set(~0)
+        node_bits = jnp.zeros((NODES + 1, 8), jnp.uint32)
         state = dict(
             perm=perm0,
-            perm_buf=jnp.zeros(N, jnp.int32),
-            # inactive leaves carry out-of-range begins so the final
-            # position->leaf searchsorted never matches them
-            leaf_begin=jnp.where(iota_l == 0, 0, N + iota_l),
-            leaf_count=jnp.where(iota_l == 0, N, 0),
-            leaf_sum_g=jnp.zeros(L, jnp.float32).at[0].set(totals[0]),
-            leaf_value=jnp.zeros(L, jnp.float32).at[0].set(root_out),
-            leaf_weight=jnp.zeros(L, jnp.float32).at[0].set(totals[1]),
-            leaf_cnt=jnp.zeros(L, jnp.float32).at[0].set(totals[2]),
-            leaf_depth=jnp.zeros(L, jnp.int32),
-            leaf_parent=jnp.full(L, -1, jnp.int32),
-            leaf_is_left=jnp.zeros(L, bool),
-            hist=jnp.zeros((L, F, B, HIST_C), jnp.float32).at[0].set(hist_root),
-            bgain=jnp.full(L, K_MIN_SCORE, jnp.float32),
-            bfeat=jnp.zeros(L, jnp.int32),
-            bthr=jnp.zeros(L, jnp.int32),
-            bdl=jnp.zeros(L, bool),
-            bcat=jnp.zeros(L, bool),
-            bbits=jnp.zeros((L, 8), jnp.uint32),
-            blg=jnp.zeros(L, jnp.float32),
-            blh=jnp.zeros(L, jnp.float32),
-            blc=jnp.zeros(L, jnp.float32),
-            blout=jnp.zeros(L, jnp.float32),
-            brout=jnp.zeros(L, jnp.float32),
-            node_feature=jnp.zeros(NODES, jnp.int32),
-            node_threshold=jnp.zeros(NODES, jnp.int32),
-            node_default_left=jnp.zeros(NODES, bool),
-            node_is_cat=jnp.zeros(NODES, bool),
-            node_cat_bits=jnp.zeros((NODES, 8), jnp.uint32),
-            node_left=jnp.full(NODES, ~0, jnp.int32),
-            node_right=jnp.full(NODES, ~0, jnp.int32),
-            node_gain=jnp.zeros(NODES, jnp.float32),
-            node_value=jnp.zeros(NODES, jnp.float32),
-            node_weight=jnp.zeros(NODES, jnp.float32),
-            node_count=jnp.zeros(NODES, jnp.float32),
+            perm_buf=jnp.zeros(N + W, jnp.int32),
+            leaf_f=leaf_f, leaf_i=leaf_i, leaf_bits=leaf_bits,
+            node_f=node_f, node_i=node_i, node_bits=node_bits,
+            hist=jnp.zeros((L + 1, F, B, HIST_C), f32).at[0].set(hist_root),
             num_leaves=jnp.int32(1),
-            done=jnp.asarray(False),
         )
-        for key, val in b0.items():
-            state[key] = state[key].at[0].set(val)
 
         # ------------------------------------------------------ split step
         def split_step(k, st):
-            leaf = jnp.argmax(st["bgain"]).astype(jnp.int32)
-            ok = (st["bgain"][leaf] > 0.0) & (~st["done"])
+            leaf_f, leaf_i = st["leaf_f"], st["leaf_i"]
+            leaf = jnp.argmax(leaf_f[:L, 4]).astype(jnp.int32)
+            lf = leaf_f[leaf]
+            li = leaf_i[leaf]
+            ok = lf[4] > 0.0
 
-            def do_split(st):
-                feat = st["bfeat"][leaf]
-                begin = st["leaf_begin"][leaf]
-                count = st["leaf_count"][leaf]
-                col = x_cols[feat]                      # [N]
-                nch = (count + W - 1) // W
+            feat = li[5]
+            begin = li[0]
+            count_eff = jnp.where(ok, li[1], 0)
+            thrv, dlv, catv = li[6], li[7].astype(bool), li[8].astype(bool)
+            bitsv = st["leaf_bits"][leaf]
+            col = x_cols[feat]                          # [N]
+            nch = (count_eff + W - 1) // W
+            perm_in = st["perm"]
 
-                # -- chunked stable partition into perm_buf ------------
-                def pbody(s):
-                    c, lcur, rcur, pbuf = s
-                    offs = begin + c * W + lane
-                    valid = (c * W + lane) < count
-                    rows = st["perm"][jnp.clip(offs, 0, N - 1)]
-                    gl = decision_go_left(
-                        col[rows], st["bthr"][leaf], st["bdl"][leaf],
-                        default_bins[feat], missing_types[feat],
-                        num_bins[feat], st["bcat"][leaf],
-                        st["bbits"][leaf]) & valid
-                    gr = valid & ~gl
-                    nl = jnp.sum(gl, dtype=jnp.int32)
-                    nr = jnp.sum(gr, dtype=jnp.int32)
-                    lpos = lcur + jnp.cumsum(gl) - 1
-                    # rights fill backward from the slice end: stable within
-                    # a chunk, chunk order reversed on the right side — a
-                    # deterministic permutation, only affecting later gather
-                    # order
-                    rpos = rcur - jnp.cumsum(gr)
-                    pos = jnp.where(gl, lpos, jnp.where(gr, rpos, N))
-                    pbuf = pbuf.at[pos].set(rows, mode="drop")
-                    return c + 1, lcur + nl, rcur - nr, pbuf
+            # -- chunked stable partition into perm_buf ----------------
+            def pbody(s):
+                c, lcur, rcur, pbuf = s
+                live = jnp.clip(count_eff - c * W, 0, W)
+                valid = lane < live
+                rows = perm_slice(perm_in, begin + c * W)
+                gl = decision_go_left(
+                    col[rows], thrv, dlv, default_bins[feat],
+                    missing_types[feat], num_bins[feat], catv, bitsv) & valid
+                cums_gl = jnp.cumsum(gl.astype(jnp.int32))
+                nl = cums_gl[W - 1]
+                # valid lanes are a prefix, so the right-side rank needs no
+                # second cumsum
+                prefix_valid = jnp.minimum(lane + 1, live)
+                lpos = lcur + cums_gl - 1
+                # rights fill backward from the slice end: stable within a
+                # chunk, chunk order reversed on the right side — a
+                # deterministic permutation, only affecting later gather order
+                rpos = rcur - (prefix_valid - cums_gl)
+                pos = jnp.where(gl, lpos, jnp.where(valid, rpos, N))
+                pbuf = pbuf.at[pos].set(rows, mode="drop")
+                return c + 1, lcur + nl, rcur - (live - nl), pbuf
 
-                _, lend, _, pbuf = lax.while_loop(
-                    lambda s: s[0] < nch, pbody,
-                    (jnp.int32(0), begin, begin + count, st["perm_buf"]))
-                left_count = lend - begin
-                right_count = count - left_count
+            _, lend, _, pbuf = lax.while_loop(
+                lambda s: s[0] < nch, pbody,
+                (jnp.int32(0), begin, begin + count_eff, st["perm_buf"]))
+            left_count = lend - begin
+            right_count = count_eff - left_count
 
-                # copy the partitioned slice back into perm (chunked)
-                def cbody(s):
-                    c, pm = s
-                    offs = begin + c * W + lane
-                    valid = (c * W + lane) < count
-                    vals = pbuf[jnp.clip(offs, 0, N - 1)]
-                    pm = pm.at[jnp.where(valid, offs, N)].set(vals, mode="drop")
-                    return c + 1, pm
+            # copy the partitioned slice back into perm (chunked); both reads
+            # and the write are contiguous-window DMAs, with the stale tail
+            # of the last window re-written from perm itself
+            def cbody(s):
+                c, pm = s
+                start = begin + c * W
+                valid = (c * W + lane) < count_eff
+                vals = jnp.where(valid, perm_slice(pbuf, start),
+                                 perm_slice(pm, start))
+                pm = lax.dynamic_update_slice(pm, vals, (start,))
+                return c + 1, pm
 
-                _, perm = lax.while_loop(lambda s: s[0] < nch, cbody,
-                                         (jnp.int32(0), st["perm"]))
+            _, perm = lax.while_loop(lambda s: s[0] < nch, cbody,
+                                     (jnp.int32(0), perm_in))
 
-                # -- node record + leaf bookkeeping --------------------
-                new_leaf = st["num_leaves"]
-                node = k
-                pnode = st["leaf_parent"][leaf]
-                was_left = st["leaf_is_left"][leaf]
-                safe_p = jnp.clip(pnode, 0, NODES - 1)
-                node_left = st["node_left"].at[safe_p].set(
-                    jnp.where((pnode >= 0) & was_left, node,
-                              st["node_left"][safe_p]))
-                node_right = st["node_right"].at[safe_p].set(
-                    jnp.where((pnode >= 0) & ~was_left, node,
-                              st["node_right"][safe_p]))
+            # -- masked write indices (dump rows swallow no-op steps) --
+            new_leaf = st["num_leaves"]
+            wl = jnp.where(ok, leaf, L)
+            wn = jnp.where(ok, new_leaf, L)
+            wk = jnp.where(ok, k, NODES)
 
-                # parent/child aggregates
-                pg, ph, pc = (st["leaf_sum_g"][leaf], st["leaf_weight"][leaf],
-                              st["leaf_cnt"][leaf])
-                lg, lh, lc = st["blg"][leaf], st["blh"][leaf], st["blc"][leaf]
-                rg, rh, rc = pg - lg, ph - lh, pc - lc
-                lout, rout = st["blout"][leaf], st["brout"][leaf]
-                depth = st["leaf_depth"][leaf] + 1
+            # parent node's child pointer now points at node k
+            pnode = li[3]
+            was_left = li[4].astype(bool)
+            safe_p = jnp.where((pnode >= 0) & ok, pnode, NODES)
+            prow = st["node_i"][safe_p]
+            prow = jnp.where(was_left, prow.at[4].set(k), prow.at[5].set(k))
+            node_i = st["node_i"].at[safe_p].set(prow)
 
-                upd = dict(st)
-                upd.update(
-                    perm=perm, perm_buf=pbuf,
-                    leaf_begin=st["leaf_begin"].at[new_leaf].set(begin + left_count),
-                    leaf_count=st["leaf_count"].at[leaf].set(left_count)
-                                               .at[new_leaf].set(right_count),
-                    leaf_sum_g=st["leaf_sum_g"].at[leaf].set(lg)
-                                               .at[new_leaf].set(rg),
-                    leaf_value=st["leaf_value"].at[leaf].set(lout)
-                                               .at[new_leaf].set(rout),
-                    leaf_weight=st["leaf_weight"].at[leaf].set(lh)
-                                                 .at[new_leaf].set(rh),
-                    leaf_cnt=st["leaf_cnt"].at[leaf].set(lc)
-                                           .at[new_leaf].set(rc),
-                    leaf_depth=st["leaf_depth"].at[leaf].set(depth)
-                                               .at[new_leaf].set(depth),
-                    leaf_parent=st["leaf_parent"].at[leaf].set(node)
-                                                 .at[new_leaf].set(node),
-                    leaf_is_left=st["leaf_is_left"].at[leaf].set(True)
-                                                   .at[new_leaf].set(False),
-                    node_feature=st["node_feature"].at[node].set(feat),
-                    node_threshold=st["node_threshold"].at[node].set(st["bthr"][leaf]),
-                    node_default_left=st["node_default_left"].at[node].set(st["bdl"][leaf]),
-                    node_is_cat=st["node_is_cat"].at[node].set(st["bcat"][leaf]),
-                    node_cat_bits=st["node_cat_bits"].at[node].set(st["bbits"][leaf]),
-                    node_left=node_left.at[node].set(~leaf),
-                    node_right=node_right.at[node].set(~new_leaf),
-                    node_gain=st["node_gain"].at[node].set(st["bgain"][leaf]),
-                    node_value=st["node_value"].at[node].set(st["leaf_value"][leaf]),
-                    node_weight=st["node_weight"].at[node].set(ph),
-                    node_count=st["node_count"].at[node].set(pc),
-                    num_leaves=st["num_leaves"] + 1,
-                )
+            # aggregates
+            pg, ph, pc = lf[0], lf[1], lf[2]
+            lg, lh, lc = lf[5], lf[6], lf[7]
+            rg, rh, rc = pg - lg, ph - lh, pc - lc
+            lout, rout = lf[8], lf[9]
+            depth = li[2] + 1
 
-                # -- children histograms (smaller built, larger by
-                # subtraction) + their best splits ---------------------
-                small_is_left = left_count <= right_count
-                sb = jnp.where(small_is_left, begin, begin + left_count)
-                sc = jnp.where(small_is_left, left_count, right_count)
-                hist_small = leaf_hist(perm, sb, sc)
-                hist_large = st["hist"][leaf] - hist_small
-                hist_left = jnp.where(small_is_left, hist_small, hist_large)
-                hist_right = jnp.where(small_is_left, hist_large, hist_small)
-                upd["hist"] = st["hist"].at[leaf].set(hist_left) \
-                                        .at[new_leaf].set(hist_right)
+            node_f = st["node_f"].at[wk].set(
+                jnp.stack([lf[4], lf[3], ph, pc]))
+            node_i = node_i.at[wk].set(jnp.stack(
+                [feat, thrv, li[7], li[8], ~leaf, ~new_leaf]))
+            node_bits = st["node_bits"].at[wk].set(bitsv)
 
-                bl = best_of(hist_left, lg, lh, lc, lout, depth)
-                br = best_of(hist_right, rg, rh, rc, rout, depth)
-                for key in _BKEYS:
-                    upd[key] = upd[key].at[leaf].set(bl[key]) \
-                                       .at[new_leaf].set(br[key])
-                return upd
+            # -- children histograms (smaller built, larger by subtraction)
+            small_is_left = left_count <= right_count
+            sb = jnp.where(small_is_left, begin, begin + left_count)
+            sc = jnp.where(small_is_left, left_count, right_count)
+            hist_small = leaf_hist(perm, sb, sc)
+            hist_large = st["hist"][leaf] - hist_small
+            hist_left = jnp.where(small_is_left, hist_small, hist_large)
+            hist_right = jnp.where(small_is_left, hist_large, hist_small)
+            hist = st["hist"].at[wl].set(hist_left).at[wn].set(hist_right)
 
-            def no_split(st):
-                st = dict(st)
-                st["done"] = jnp.asarray(True)
-                return st
+            # -- both children's best splits in one vmapped scan -------
+            (bg2, bf2, bt2, bdl2, bcat2, bbits2, blg2, blh2, blc2, blout2,
+             brout2) = best_children(
+                jnp.stack([hist_left, hist_right]),
+                jnp.stack([lg, rg]), jnp.stack([lh, rh]),
+                jnp.stack([lc, rc]), jnp.stack([lout, rout]), depth)
 
-            return lax.cond(ok, do_split, no_split, st)
+            i32 = jnp.int32
+            lrow_f = jnp.stack([lg, lh, lc, lout, bg2[0], blg2[0], blh2[0],
+                                blc2[0], blout2[0], brout2[0]])
+            rrow_f = jnp.stack([rg, rh, rc, rout, bg2[1], blg2[1], blh2[1],
+                                blc2[1], blout2[1], brout2[1]])
+            lrow_i = jnp.stack([begin, left_count, depth, k, i32(1), bf2[0],
+                                bt2[0], bdl2[0].astype(i32),
+                                bcat2[0].astype(i32)])
+            rrow_i = jnp.stack([begin + left_count, right_count, depth, k,
+                                i32(0), bf2[1], bt2[1], bdl2[1].astype(i32),
+                                bcat2[1].astype(i32)])
+            return dict(
+                perm=perm, perm_buf=pbuf,
+                leaf_f=leaf_f.at[wl].set(lrow_f).at[wn].set(rrow_f),
+                leaf_i=leaf_i.at[wl].set(lrow_i).at[wn].set(rrow_i),
+                leaf_bits=st["leaf_bits"].at[wl].set(bbits2[0])
+                                         .at[wn].set(bbits2[1]),
+                node_f=node_f, node_i=node_i, node_bits=node_bits,
+                hist=hist,
+                num_leaves=st["num_leaves"] + ok.astype(jnp.int32),
+            )
 
         if L > 1:
             state = lax.fori_loop(0, NODES, split_step, state)
 
         # -------------------------------------------------- row -> leaf id
-        order = jnp.argsort(state["leaf_begin"])
-        sorted_begin = state["leaf_begin"][order]
+        leaf_begin = state["leaf_i"][:L, 0]
+        order = jnp.argsort(leaf_begin)
+        sorted_begin = leaf_begin[order]
         which = jnp.searchsorted(sorted_begin,
                                  jnp.arange(N, dtype=jnp.int32),
                                  side="right") - 1
         pos_leaf = order[which]
-        row_leaf = jnp.zeros(N, jnp.int32).at[state["perm"]].set(pos_leaf)
+        row_leaf = jnp.zeros(N, jnp.int32).at[state["perm"][:N]].set(pos_leaf)
 
+        node_f = state["node_f"]
+        node_i = state["node_i"]
+        leaf_f = state["leaf_f"]
+        leaf_i = state["leaf_i"]
         return DeviceTree(
-            node_feature=state["node_feature"],
-            node_threshold=state["node_threshold"],
-            node_default_left=state["node_default_left"],
-            node_is_cat=state["node_is_cat"],
-            node_cat_bits=state["node_cat_bits"],
-            node_left=state["node_left"],
-            node_right=state["node_right"],
-            node_gain=state["node_gain"],
-            node_value=state["node_value"],
-            node_weight=state["node_weight"],
-            node_count=state["node_count"],
-            leaf_value=state["leaf_value"],
-            leaf_weight=state["leaf_weight"],
-            leaf_count=state["leaf_cnt"],
-            leaf_depth=state["leaf_depth"],
-            leaf_parent_node=state["leaf_parent"],
+            node_feature=node_i[:NODES, 0],
+            node_threshold=node_i[:NODES, 1],
+            node_default_left=node_i[:NODES, 2].astype(bool),
+            node_is_cat=node_i[:NODES, 3].astype(bool),
+            node_cat_bits=state["node_bits"][:NODES],
+            node_left=node_i[:NODES, 4],
+            node_right=node_i[:NODES, 5],
+            node_gain=node_f[:NODES, 0],
+            node_value=node_f[:NODES, 1],
+            node_weight=node_f[:NODES, 2],
+            node_count=node_f[:NODES, 3],
+            leaf_value=leaf_f[:L, 3],
+            leaf_weight=leaf_f[:L, 1],
+            leaf_count=leaf_f[:L, 2],
+            leaf_depth=leaf_i[:L, 2],
+            leaf_parent_node=leaf_i[:L, 3],
             num_leaves=state["num_leaves"],
             row_leaf=row_leaf,
         )

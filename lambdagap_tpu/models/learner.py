@@ -93,12 +93,28 @@ class SerialTreeLearner:
 
         self.rows_per_block = config.tpu_rows_per_block
         self.hist_precision = config.tpu_hist_precision
+        self.hist_impl = self._resolve_hist_impl(config.tpu_hist_impl)
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
 
         # outputs of the last Train call, used for the O(1)-per-row score update
         self.last_perm: Optional[jax.Array] = None
         self.last_leaf_begin: Optional[np.ndarray] = None
         self.last_leaf_count: Optional[np.ndarray] = None
+
+    @staticmethod
+    def _resolve_hist_impl(impl: str) -> str:
+        """Pick the histogram strategy (the analog of TrainingShareStates'
+        col/row-wise probe, reference: src/io/train_share_states.cpp — here
+        the choice is XLA one-hot contraction vs the Pallas VMEM kernel;
+        'auto' = Pallas wherever Mosaic can compile, i.e. any non-CPU
+        backend)."""
+        from ..ops.hist_pallas import HAS_PALLAS
+        if impl == "auto":
+            return ("pallas" if HAS_PALLAS and jax.default_backend() != "cpu"
+                    else "onehot")
+        if impl not in ("onehot", "pallas"):
+            log.fatal("tpu_hist_impl must be auto/onehot/pallas, got %r", impl)
+        return impl
 
     # ------------------------------------------------------------------
     def _pad_size(self, count: int) -> int:

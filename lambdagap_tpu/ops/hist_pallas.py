@@ -1,0 +1,137 @@
+"""Pallas TPU histogram kernel.
+
+The performance-critical replacement for the XLA one-hot histogram
+(see :mod:`lambdagap_tpu.ops.histogram`): the CUDA analog builds per-block
+shared-memory histograms with atomics
+(reference: src/treelearner/cuda/cuda_histogram_constructor.cu:20-130).
+TPUs have no atomics; the idiomatic equivalent is a one-hot contraction on
+the MXU — but done *inside* a kernel so the one-hot operand lives only in
+VMEM, block by block, instead of being materialized to HBM by XLA (round
+1's main bandwidth sink: at HIGGS shape the XLA intermediate is ~28x the
+size of the uint8 rows it encodes).
+
+Grid layout: ``(feature_blocks, row_blocks)`` with the row dimension inner,
+revisiting one ``[8, FBLK*B]`` f32 output block per feature block — the
+Pallas accumulate-over-grid pattern. Each feature contributes one
+``[BLK, B]`` one-hot built in registers and contracted against the per-row
+channel matrix; channels are the split-precision pair
+(g_hi, g_lo, h_hi, h_lo, count, pad...) so a single bf16 matmul chain
+yields ~f32-accurate sums (same trick as ops.histogram.gh_contract
+'split'). The channel dim (8) rides the f32 sublane tile exactly.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+HIST_C = 3
+
+try:  # pallas is TPU-only at runtime; import-guarded for CPU-only setups
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    HAS_PALLAS = True
+except ImportError:  # pragma: no cover
+    HAS_PALLAS = False
+
+
+def _hist_kernel(count_ref, bins_ref, gh_ref, out_ref, *, num_bins: int,
+                 fblk: int, blk: int):
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # compute is gated on the dynamic row count: a call padded to a large
+    # static row budget only pays DMA for the dead blocks (the analog of the
+    # CUDA kernel's early-exit on out-of-range rows). Rows beyond count in
+    # the live boundary block carry zeroed gh channels.
+    @pl.when(r * blk < count_ref[0])
+    def _():
+        bins = bins_ref[:].astype(jnp.int32)                # [BLK, FBLK]
+        gh = gh_ref[:]                                      # [BLK, 8] bf16
+        iota_b = lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
+        B = num_bins
+        for f in range(fblk):
+            onehot = (bins[:, f:f + 1] == iota_b).astype(jnp.bfloat16)
+            out_ref[:, f * B:(f + 1) * B] += lax.dot_general(
+                gh, onehot,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [8, B]
+
+
+def _pick_blocks(F: int, B: int, P: int):
+    """Row block 1024 (2048 for small feature counts); feature block sized
+    so the revisited [8, FBLK*B] f32 output block stays ~2 MB VMEM."""
+    blk = 2048 if F * B <= 8192 else 1024
+    blk = min(blk, max(256, P))
+    fblk = max(1, min(F, (2 * 1024 * 1024 // 4) // (8 * B)))
+    return blk, fblk
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins",))
+def hist_pallas(bins: jax.Array, gh8: jax.Array, num_bins: int,
+                count=None) -> jax.Array:
+    """Histogram of a padded row block via the Pallas kernel.
+
+    bins : uint8/uint16 [P, F] gathered binned rows (invalid rows may hold
+           any bin value; their gh8 channels must be zero)
+    gh8  : bf16 [P, 8] — (g_hi, g_lo, h_hi, h_lo, count, 0, 0, 0),
+           see :func:`pack_gh8`
+    count: optional dynamic number of live rows (<= P); blocks past it skip
+           compute, so heavily padded calls cost ~DMA only
+    Returns f32 [F, B, 3] (sum_grad, sum_hess, count).
+    """
+    P, F = bins.shape
+    B = num_bins
+    blk, fblk = _pick_blocks(F, B, P)
+    if P % blk != 0:
+        pad = blk - P % blk
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        gh8 = jnp.pad(gh8, ((0, pad), (0, 0)))
+        P += pad
+    Fp = ((F + fblk - 1) // fblk) * fblk
+    if Fp != F:
+        # padded feature columns produce junk histograms, sliced off below
+        bins = jnp.pad(bins, ((0, 0), (0, Fp - F)))
+    count = jnp.asarray([P if count is None else count], jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Fp // fblk, P // blk),
+        in_specs=[
+            pl.BlockSpec((blk, fblk), lambda f, r, c: (r, f),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((blk, 8), lambda f, r, c: (r, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, fblk * B), lambda f, r, c: (0, f),
+                               memory_space=pltpu.VMEM),
+    )
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, num_bins=B, fblk=fblk, blk=blk),
+        out_shape=jax.ShapeDtypeStruct((8, Fp * B), jnp.float32),
+        grid_spec=grid_spec,
+    )(count, bins, gh8)
+
+    out = out.reshape(8, Fp, B)[:, :F]                      # [8, F, B]
+    sg = out[0] + out[1]
+    sh = out[2] + out[3]
+    cnt = out[4]
+    return jnp.stack([sg, sh, cnt], axis=-1)                # [F, B, 3]
+
+
+def pack_gh8(grad: jax.Array, hess: jax.Array, valid: jax.Array) -> jax.Array:
+    """Split-precision channel packing for :func:`hist_pallas`."""
+    g = jnp.where(valid, grad, 0.0)
+    h = jnp.where(valid, hess, 0.0)
+    g_hi = g.astype(jnp.bfloat16)
+    g_lo = (g - g_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    h_hi = h.astype(jnp.bfloat16)
+    h_lo = (h - h_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+    cnt = valid.astype(jnp.bfloat16)
+    zero = jnp.zeros_like(cnt)
+    return jnp.stack([g_hi, g_lo, h_hi, h_lo, cnt, zero, zero, zero], axis=1)

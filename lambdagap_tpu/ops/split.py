@@ -135,25 +135,22 @@ def _numerical_best(hist, parent_g, parent_h, parent_c, parent_output,
     # Forward scan: missing -> right (default_left=False). The missing bin's
     # content is excluded from the left accumulation so it lands on the right
     # via right = parent - left (reference: SKIP_DEFAULT_BIN / NA_AS_MISSING
-    # template args of FindBestThresholdSequentially).
+    # template args of FindBestThresholdSequentially). The reverse scan
+    # (missing -> left) uses the same exclusion, so all six prefix sums ride
+    # ONE cumsum over a packed [2, F, B, 3] tensor (launch-count matters:
+    # this runs per split step inside the fused tree program).
     excl_fwd = (is_zero_missing & is_default) | (is_nan_missing & is_nan_bin)
-    gf = jnp.where(excl_fwd, 0.0, g)
-    hf = jnp.where(excl_fwd, 0.0, h)
-    cf = jnp.where(excl_fwd, 0.0, c)
-    lg_f = jnp.cumsum(gf, axis=1)
-    lh_f = jnp.cumsum(hf, axis=1)
-    lc_f = jnp.cumsum(cf, axis=1)
-
-    # Reverse scan: missing -> left (default_left=True). Excluded missing bins
-    # stay on the left via left = parent - right.
-    excl_rev = excl_fwd
-    gr = jnp.where(excl_rev, 0.0, g)
-    hr = jnp.where(excl_rev, 0.0, h)
-    cr = jnp.where(excl_rev, 0.0, c)
+    ghc = jnp.stack([jnp.where(excl_fwd, 0.0, g),
+                     jnp.where(excl_fwd, 0.0, h),
+                     jnp.where(excl_fwd, 0.0, c)], axis=-1)    # [F, B, 3]
+    both = jnp.stack([ghc, ghc[:, ::-1]], axis=0)              # [2, F, B, 3]
+    cs = jnp.cumsum(both, axis=2)
+    lg_f, lh_f, lc_f = cs[0, ..., 0], cs[0, ..., 1], cs[0, ..., 2]
     # right sums for threshold t = sum of bins > t
-    rg_r = jnp.cumsum(gr[:, ::-1], axis=1)[:, ::-1] - gr
-    rh_r = jnp.cumsum(hr[:, ::-1], axis=1)[:, ::-1] - hr
-    rc_r = jnp.cumsum(cr[:, ::-1], axis=1)[:, ::-1] - cr
+    rev = cs[1][:, ::-1]                                       # inclusive
+    rg_r = rev[..., 0] - ghc[..., 0]
+    rh_r = rev[..., 1] - ghc[..., 1]
+    rc_r = rev[..., 2] - ghc[..., 2]
 
     def eval_dir(left_g, left_h, left_c):
         right_g = parent_g - left_g
