@@ -290,6 +290,7 @@ class Config:
     data_random_seed: int = 1
     is_enable_sparse: bool = True
     enable_bundle: bool = True
+    max_conflict_rate: float = 0.0
     use_missing: bool = True
     zero_as_missing: bool = False
     feature_pre_filter: bool = True

@@ -6,8 +6,11 @@ of per-group Bin objects with dense/sparse variants, the TPU layout is a single
 dense row-major ``uint8``/``uint16`` matrix ``[num_data, num_used_features]``
 padded to lane multiples — the analog of ``CUDARowData``'s row-wise layout
 (reference: include/LightGBM/cuda/cuda_row_data.hpp:32). EFB merges
-mutually-exclusive sparse features into shared columns before the matrix is
-built (reference: src/io/dataset.cpp:107 FindGroups, :246 FastFeatureBundling).
+mutually-exclusive sparse features into shared columns in a *second*
+bundled matrix consumed by the fused device learner (see
+:mod:`lambdagap_tpu.data.bundling`; reference: src/io/dataset.cpp:107
+FindGroups, :246 FastFeatureBundling); the public unbundled matrix stays
+authoritative for binned tree traversal.
 """
 from __future__ import annotations
 
@@ -90,6 +93,8 @@ class BinnedDataset:
 
     def __init__(self) -> None:
         self.binned: Optional[np.ndarray] = None
+        self._bundle = None            # EFB artifact (data.bundling.Bundle)
+        self._bundle_built = False
         self.mappers: List[BinMapper] = []
         self.used_features: List[int] = []
         self.feature_num_bins: List[int] = []
@@ -202,6 +207,27 @@ class BinnedDataset:
         self.binned = binned
 
     # ------------------------------------------------------------------
+    def ensure_bundle(self, config: Config):
+        """Lazily build the EFB bundled matrix (see data.bundling). Only the
+        fused device learner consumes it, so construction is deferred until
+        a consumer asks — other learners and validation sets never pay the
+        grouping scan or the second matrix."""
+        if self._bundle_built:
+            return self._bundle
+        self._bundle_built = True
+        if config.enable_bundle and self.binned is not None:
+            from .bundling import build_bundle
+            self._bundle = build_bundle(
+                self.binned, np.asarray(self.feature_num_bins, np.int32),
+                np.asarray([self.mappers[j].default_bin
+                            for j in self.used_features], np.int32),
+                config.max_conflict_rate)
+        return self._bundle
+
+    @property
+    def bundle(self):
+        return self._bundle
+
     @property
     def num_features(self) -> int:
         return len(self.used_features)

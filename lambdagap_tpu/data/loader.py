@@ -142,12 +142,18 @@ def _parse_column_spec(spec: str, header_names: Optional[List[str]]) -> int:
     return int(spec)
 
 
-def load_data_file(path: str, config: Config,
-                   reference: Optional[BinnedDataset] = None) -> BinnedDataset:
-    """Load a text data file into a BinnedDataset
-    (reference: DatasetLoader::LoadFromFile)."""
-    if path.endswith(".bin") and os.path.exists(path):
-        return load_binary(path)
+def _rows_to_sizes(per_row: np.ndarray) -> np.ndarray:
+    """Per-row query ids -> run-length sizes (explicit: the sizes-vs-ids
+    heuristic in Metadata.set_group can misfire when ids happen to sum to
+    num_data)."""
+    change = np.nonzero(np.diff(per_row))[0] + 1
+    bounds = np.concatenate([[0], change, [len(per_row)]])
+    return np.diff(bounds)
+
+
+def _parse_text_file(path: str, config: Config):
+    """Shared column handling for every text-ingest path (train, refit,
+    predict). Returns (X, label, weight_or_None, group_sizes_or_None)."""
     fmt = detect_format(path)
     weight = None
     group = None
@@ -158,12 +164,7 @@ def load_data_file(path: str, config: Config,
             if (qid < 0).any():
                 log.fatal("LibSVM file %s mixes rows with and without "
                           "'qid:' tokens; every row needs one", path)
-            # per-row query ids -> run-length sizes (explicit: the
-            # sizes-vs-ids heuristic in Metadata.set_group can misfire when
-            # ids happen to sum to num_data)
-            change = np.nonzero(np.diff(qid))[0] + 1
-            bounds = np.concatenate([[0], change, [len(qid)]])
-            group = np.diff(bounds)
+            group = _rows_to_sizes(qid)
     else:
         delim = "," if fmt == "csv" else "\t"
         if config.header:
@@ -179,7 +180,7 @@ def load_data_file(path: str, config: Config,
             drop.append(wc)
         if config.group_column:
             gc = _parse_column_spec(config.group_column, header_names)
-            group = M[:, gc].astype(np.int64)
+            group = _rows_to_sizes(M[:, gc].astype(np.int64))
             drop.append(gc)
         if config.ignore_column:
             for spec in config.ignore_column.split(","):
@@ -194,11 +195,18 @@ def load_data_file(path: str, config: Config,
         weight = np.loadtxt(path + ".weight", dtype=np.float64)
     qpath = next((p for p in (path + ".query", path + ".group")
                   if os.path.exists(p)), None)
-    qgroups = None
     if qpath is not None:
-        qgroups = np.loadtxt(qpath, dtype=np.int64).reshape(-1)
-    elif group is not None:
-        qgroups = group
+        group = np.loadtxt(qpath, dtype=np.int64).reshape(-1)
+    return X, y, weight, group
+
+
+def load_data_file(path: str, config: Config,
+                   reference: Optional[BinnedDataset] = None) -> BinnedDataset:
+    """Load a text data file into a BinnedDataset
+    (reference: DatasetLoader::LoadFromFile)."""
+    if path.endswith(".bin") and os.path.exists(path):
+        return load_binary(path)
+    X, y, weight, qgroups = _parse_text_file(path, config)
     init_score = None
     if os.path.exists(path + ".init"):
         init_score = np.loadtxt(path + ".init", dtype=np.float64)
@@ -233,46 +241,7 @@ def raw_matrix_of(path: str, config: Config):
     refit/predict, reference: application.cpp:254-290).
 
     Returns (X, label, weight_or_None, group_sizes_or_None)."""
-    weight = None
-    group = None
-    fmt = detect_format(path)
-    if fmt == "libsvm":
-        X, y, qid = _load_libsvm(path)
-        if qid is not None:
-            change = np.nonzero(np.diff(qid))[0] + 1
-            bounds = np.concatenate([[0], change, [len(qid)]])
-            group = np.diff(bounds)
-    else:
-        delim = "," if fmt == "csv" else "\t"
-        header_names: Optional[List[str]] = None
-        if config.header:
-            with open(path) as f:
-                header_names = f.readline().strip().split(delim)
-        M = _load_delim(path, delim, config.header)
-        label_col = (_parse_column_spec(config.label_column, header_names)
-                     if config.label_column else 0)
-        drop = {label_col}
-        if config.weight_column:
-            wc = _parse_column_spec(config.weight_column, header_names)
-            weight = M[:, wc]
-            drop.add(wc)
-        if config.group_column:
-            gc = _parse_column_spec(config.group_column, header_names)
-            group = M[:, gc].astype(np.int64)
-            drop.add(gc)
-        if config.ignore_column:
-            for spec in config.ignore_column.split(","):
-                if spec.strip():
-                    drop.add(_parse_column_spec(spec.strip(), header_names))
-        keep = [j for j in range(M.shape[1]) if j not in drop]
-        X, y = M[:, keep], M[:, label_col]
-    if weight is None and os.path.exists(path + ".weight"):
-        weight = np.loadtxt(path + ".weight", dtype=np.float64)
-    qpath = next((p for p in (path + ".query", path + ".group")
-                  if os.path.exists(p)), None)
-    if qpath is not None:
-        group = np.loadtxt(qpath, dtype=np.int64).reshape(-1)
-    return X, y, weight, group
+    return _parse_text_file(path, config)
 
 
 # ---------------------------------------------------------------------------

@@ -66,10 +66,34 @@ class FusedTreeLearner(SerialTreeLearner):
 
     def __init__(self, dataset: BinnedDataset, config: Config) -> None:
         super().__init__(dataset, config)
+        # EFB: histograms and partitions run over the bundled matrix when
+        # the dataset built one; histograms are un-bundled back to feature
+        # space before every split scan, and partition decisions decode the
+        # chosen feature's bin from its bundle column
+        bun = dataset.ensure_bundle(config)
+        self.bundled = bun is not None
+        if self.bundled:
+            hx = bun.cols
+            self.Bb = _next_pow2(max(bun.num_bins))
+            self.bcol = jnp.asarray(bun.col_of)
+            self.boff = jnp.asarray(bun.off_of)
+            self.bsingle = jnp.asarray(bun.single)
+            from ..data.bundling import unbundle_map
+            src, kind = unbundle_map(
+                bun, np.asarray(dataset.feature_num_bins, np.int32),
+                np.asarray([dataset.mappers[j].default_bin
+                            for j in dataset.used_features], np.int32),
+                self.B, self.Bb)
+            self.ub_src = jnp.asarray(src)
+            self.ub_kind = jnp.asarray(kind)
+        else:
+            hx = dataset.binned
+            self.Bb = self.B
+        self.hx_rows = jnp.asarray(hx)
         # column-major copy for cheap feature-column reads while partitioning
         # (the analog of CUDAColumnData next to CUDARowData,
         # reference: src/io/cuda/cuda_column_data.cpp)
-        self.x_cols = jnp.asarray(np.ascontiguousarray(dataset.binned.T))
+        self.x_cols = jnp.asarray(np.ascontiguousarray(hx.T))
         # chunk window for the while-loop'd row passes: small enough that a
         # deep (small) leaf doesn't pay a huge padded window of gather/scan
         # work, large enough that root-sized passes don't drown in per-trip
@@ -167,15 +191,20 @@ class FusedTreeLearner(SerialTreeLearner):
         W = min(self.chunk, _next_pow2(N))
         p = self.params
         max_depth = cfg.max_depth
-        x_rows = self.x_binned          # [N, F]
-        x_cols = self.x_cols            # [F, N]
+        x_rows = self.hx_rows           # [N, C] (bundled when EFB active)
+        x_cols = self.x_cols            # [C, N]
+        C = x_rows.shape[1]
+        Bb = self.Bb                    # bins per stored column
+        bundled = self.bundled
         num_bins = self.num_bins_arr
         default_bins = self.default_bins_arr
         missing_types = self.missing_types_arr
         is_cat_arr = self.is_categorical_arr
         has_cat = self.has_categorical
+        mono_on = self.mono_on
+        mono_arr = self.mono_arr
         lane = jnp.arange(W, dtype=jnp.int32)
-        bin_iota = jnp.arange(B, dtype=x_rows.dtype)
+        bin_iota = jnp.arange(Bb, dtype=x_rows.dtype)
         # grad+hess interleaved so one random gather fetches both channels
         gh2 = jnp.stack([grad, hess], axis=1)           # [N, 2]
 
@@ -190,20 +219,20 @@ class FusedTreeLearner(SerialTreeLearner):
             valid = (c * W + lane) < count
             if has_mask:
                 valid = valid & row_mask[rows]
-            bins = x_rows[rows]                         # [W, F]
+            bins = x_rows[rows]                         # [W, C]
             ghr = gh2[rows]                             # [W, 2]
             if self.hist_impl == "pallas":
                 from ..ops.hist_pallas import hist_pallas, pack_gh8
                 live = jnp.clip(count - c * W, 0, W)
                 gh8 = pack_gh8(ghr[:, 0], ghr[:, 1], valid)
-                return acc + hist_pallas(bins, gh8, B, live)
+                return acc + hist_pallas(bins, gh8, Bb, live)
             g = jnp.where(valid, ghr[:, 0], 0.0)
             h = jnp.where(valid, ghr[:, 1], 0.0)
             gh = jnp.stack([g, h, valid.astype(jnp.float32)], axis=1)
             onehot = (bins[:, :, None] == bin_iota).astype(jnp.bfloat16)
-            part = gh_contract(gh, onehot.reshape(W, F * B),
+            part = gh_contract(gh, onehot.reshape(W, C * Bb),
                                self.hist_precision)
-            return acc + part.reshape(HIST_C, F, B).transpose(1, 2, 0)
+            return acc + part.reshape(HIST_C, C, Bb).transpose(1, 2, 0)
 
         def leaf_hist(perm, begin, count):
             nch = (count + W - 1) // W
@@ -214,15 +243,21 @@ class FusedTreeLearner(SerialTreeLearner):
 
             _, hist = lax.while_loop(
                 lambda st: st[0] < nch, body,
-                (jnp.int32(0), jnp.zeros((F, B, HIST_C), jnp.float32)))
+                (jnp.int32(0), jnp.zeros((C, Bb, HIST_C), jnp.float32)))
             return hist
 
-        def best_of(hist, pg, ph, pc, pout, depth):
+        def best_of(hist, pg, ph, pc, pout, lo, hi, depth):
             """Best split for one leaf, with the max_depth guard.
             Returns (gain, feat, thr, dl, cat, bits, lg, lh, lc, lout, rout)."""
+            if bundled:
+                from ..ops.histogram import unbundle_hist
+                hist = unbundle_hist(hist, self.ub_src, self.ub_kind,
+                                     pg, ph, pc)
+            cons = (mono_arr, lo, hi) if mono_on else None
             gain, thr, dl, lg, lh, lc, bits = per_feature_best(
                 hist, pg, ph, pc, pout, num_bins, default_bins,
-                missing_types, is_cat_arr, fmask, p, has_cat)
+                missing_types, is_cat_arr, fmask, p, has_cat,
+                constraints=cons)
             parent_gain = leaf_gain(pg, ph, p, pc, pout)
             shift = parent_gain + p.min_gain_to_split
             f = jnp.argmax(gain, axis=0).astype(jnp.int32)
@@ -233,16 +268,20 @@ class FusedTreeLearner(SerialTreeLearner):
             lout = calculate_leaf_output(lg[f], lh[f], p, lc[f], pout)
             rout = calculate_leaf_output(pg - lg[f], ph - lh[f], p,
                                          pc - lc[f], pout)
+            if mono_on:
+                lout = jnp.clip(lout, lo, hi)
+                rout = jnp.clip(rout, lo, hi)
             return (jnp.where(ok, g, K_MIN_SCORE), f, thr[f], dl[f],
                     is_cat_arr[f], bits[f], lg[f], lh[f], lc[f], lout, rout)
 
-        best_children = jax.vmap(best_of, in_axes=(0, 0, 0, 0, 0, None))
+        best_children = jax.vmap(best_of,
+                                 in_axes=(0, 0, 0, 0, 0, 0, 0, None))
 
         # ------------------------------------------------------ state init
         # consolidated per-leaf/per-node state; row L / row NODES is the dump
         # row that masked-off writes land on
         # leaf_f columns: sum_g, sum_h, cnt, out, bgain, blg, blh, blc,
-        #                 blout, brout
+        #                 blout, brout, mono_min, mono_max
         # leaf_i columns: begin, count, depth, parent, is_left, bfeat, bthr,
         #                 bdl, bcat
         # node_f columns: gain, value, weight, count
@@ -255,17 +294,21 @@ class FusedTreeLearner(SerialTreeLearner):
         totals = jnp.sum(hist_root[0], axis=0)
         root_out = calculate_leaf_output(totals[0], totals[1], p, totals[2],
                                          0.0)
+        neg_inf = jnp.float32(-jnp.inf)
+        pos_inf = jnp.float32(jnp.inf)
         (bg0, bf0, bt0, bdl0, bcat0, bbits0, blg0, blh0, blc0, blout0,
          brout0) = best_of(hist_root, totals[0], totals[1], totals[2],
-                           root_out, jnp.int32(0))
+                           root_out, neg_inf, pos_inf, jnp.int32(0))
 
         iota_l1 = jnp.arange(L + 1, dtype=jnp.int32)
         f32 = jnp.float32
         i32 = jnp.int32
-        leaf_f = jnp.zeros((L + 1, 10), f32)
-        leaf_f = leaf_f.at[:, 4].set(K_MIN_SCORE).at[0].set(jnp.stack(
+        leaf_f = jnp.zeros((L + 1, 12), f32)
+        leaf_f = leaf_f.at[:, 4].set(K_MIN_SCORE) \
+                       .at[:, 10].set(-jnp.inf).at[:, 11].set(jnp.inf)
+        leaf_f = leaf_f.at[0].set(jnp.stack(
             [totals[0], totals[1], totals[2], root_out, bg0, blg0, blh0,
-             blc0, blout0, brout0]))
+             blc0, blout0, brout0, neg_inf, pos_inf]))
         leaf_i = jnp.zeros((L + 1, 9), i32)
         # inactive leaves carry out-of-range begins so the final
         # position->leaf searchsorted never matches them
@@ -282,7 +325,7 @@ class FusedTreeLearner(SerialTreeLearner):
             perm_buf=jnp.zeros(N + W, jnp.int32),
             leaf_f=leaf_f, leaf_i=leaf_i, leaf_bits=leaf_bits,
             node_f=node_f, node_i=node_i, node_bits=node_bits,
-            hist=jnp.zeros((L + 1, F, B, HIST_C), f32).at[0].set(hist_root),
+            hist=jnp.zeros((L + 1, C, Bb, HIST_C), f32).at[0].set(hist_root),
             num_leaves=jnp.int32(1),
         )
 
@@ -299,7 +342,7 @@ class FusedTreeLearner(SerialTreeLearner):
             count_eff = jnp.where(ok, li[1], 0)
             thrv, dlv, catv = li[6], li[7].astype(bool), li[8].astype(bool)
             bitsv = st["leaf_bits"][leaf]
-            col = x_cols[feat]                          # [N]
+            col = x_cols[self.bcol[feat] if bundled else feat]   # [N]
             nch = (count_eff + W - 1) // W
             perm_in = st["perm"]
 
@@ -309,8 +352,16 @@ class FusedTreeLearner(SerialTreeLearner):
                 live = jnp.clip(count_eff - c * W, 0, W)
                 valid = lane < live
                 rows = perm_slice(perm_in, begin + c * W)
+                cv = col[rows].astype(jnp.int32)
+                if bundled:
+                    # rank-decode the feature's bin out of its bundle column
+                    r = cv - self.boff[feat]
+                    d = default_bins[feat]
+                    in_r = (r >= 0) & (r < num_bins[feat] - 1)
+                    cv = jnp.where(self.bsingle[feat], cv,
+                                   jnp.where(in_r, r + (r >= d), d))
                 gl = decision_go_left(
-                    col[rows], thrv, dlv, default_bins[feat],
+                    cv, thrv, dlv, default_bins[feat],
                     missing_types[feat], num_bins[feat], catv, bitsv) & valid
                 cums_gl = jnp.cumsum(gl.astype(jnp.int32))
                 nl = cums_gl[W - 1]
@@ -368,6 +419,16 @@ class FusedTreeLearner(SerialTreeLearner):
             lout, rout = lf[8], lf[9]
             depth = li[2] + 1
 
+            # children's monotone bounds (basic method): the mid of the two
+            # constrained outputs caps the subtree on the constrained side
+            pmin, pmax = lf[10], lf[11]
+            mono_f = mono_arr[feat]
+            mid = (lout + rout) * 0.5
+            lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
+            lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
+            rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
+            rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
+
             node_f = st["node_f"].at[wk].set(
                 jnp.stack([lf[4], lf[3], ph, pc]))
             node_i = node_i.at[wk].set(jnp.stack(
@@ -389,13 +450,14 @@ class FusedTreeLearner(SerialTreeLearner):
              brout2) = best_children(
                 jnp.stack([hist_left, hist_right]),
                 jnp.stack([lg, rg]), jnp.stack([lh, rh]),
-                jnp.stack([lc, rc]), jnp.stack([lout, rout]), depth)
+                jnp.stack([lc, rc]), jnp.stack([lout, rout]),
+                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]), depth)
 
             i32 = jnp.int32
             lrow_f = jnp.stack([lg, lh, lc, lout, bg2[0], blg2[0], blh2[0],
-                                blc2[0], blout2[0], brout2[0]])
+                                blc2[0], blout2[0], brout2[0], lmin, lmax])
             rrow_f = jnp.stack([rg, rh, rc, rout, bg2[1], blg2[1], blh2[1],
-                                blc2[1], blout2[1], brout2[1]])
+                                blc2[1], blout2[1], brout2[1], rmin, rmax])
             lrow_i = jnp.stack([begin, left_count, depth, k, i32(1), bf2[0],
                                 bt2[0], bdl2[0].astype(i32),
                                 bcat2[0].astype(i32)])

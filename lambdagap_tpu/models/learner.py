@@ -96,6 +96,25 @@ class SerialTreeLearner:
         self.hist_impl = self._resolve_hist_impl(config.tpu_hist_impl)
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
 
+        # monotone constraints, mapped original-feature -> used-feature
+        # (reference: monotone_constraints.hpp; 'basic' method)
+        mono = np.zeros(self.num_features, dtype=np.int32)
+        if config.monotone_constraints:
+            mc = list(config.monotone_constraints)
+            for k, j in enumerate(dataset.used_features):
+                if j < len(mc):
+                    mono[k] = int(mc[j])
+            if (mono != 0)[meta["is_categorical"]].any():
+                log.fatal("monotone_constraints cannot be set on "
+                          "categorical features")
+            if config.monotone_constraints_method != "basic":
+                log.warning("monotone_constraints_method=%r is not "
+                            "implemented; using 'basic'",
+                            config.monotone_constraints_method)
+        self.mono_np = mono
+        self.mono_arr = jnp.asarray(mono)
+        self.mono_on = bool((mono != 0).any())
+
         # outputs of the last Train call, used for the O(1)-per-row score update
         self.last_perm: Optional[jax.Array] = None
         self.last_leaf_begin: Optional[np.ndarray] = None
@@ -131,12 +150,17 @@ class SerialTreeLearner:
         mask[chosen] = True
         return jnp.asarray(mask)
 
-    def _best(self, hist, pg, ph, pc, parent_output, fmask) -> _HostSplit:
+    def _best(self, hist, pg, ph, pc, parent_output, fmask,
+              bounds=None) -> _HostSplit:
+        cons = None
+        if self.mono_on:
+            lo, hi = bounds if bounds is not None else (-np.inf, np.inf)
+            cons = (self.mono_arr, jnp.float32(lo), jnp.float32(hi))
         res = find_best_split(
             hist, pg, ph, pc, parent_output,
             self.num_bins_arr, self.default_bins_arr, self.missing_types_arr,
             self.is_categorical_arr, fmask, self.params,
-            has_categorical=self.has_categorical)
+            has_categorical=self.has_categorical, constraints=cons)
         return _HostSplit(jax.device_get(res))
 
     # histogram hook points (overridden by the distributed learners) --------
@@ -193,8 +217,10 @@ class SerialTreeLearner:
         root_out = _leaf_output_scalar(totals[0], totals[1], totals[2], self.params)
         hists: Dict[int, jax.Array] = {0: hist_root}
         sums: Dict[int, tuple] = {0: (totals[0], totals[1], totals[2], root_out)}
+        bounds: Dict[int, tuple] = {0: (-np.inf, np.inf)}
         best: Dict[int, _HostSplit] = {
-            0: self._best(hist_root, totals[0], totals[1], totals[2], root_out, fmask)}
+            0: self._best(hist_root, totals[0], totals[1], totals[2], root_out,
+                          fmask, bounds[0])}
 
         tree.leaf_value[0] = float(jax.device_get(root_out))
         tree.leaf_weight[0] = float(jax.device_get(totals[1]))
@@ -259,6 +285,22 @@ class SerialTreeLearner:
             r_sums = (jnp.float32(s.right_sum_g), jnp.float32(s.right_sum_h),
                       jnp.float32(s.right_count), jnp.float32(s.right_output))
 
+            # children's monotone bounds (basic method: mid of the two
+            # constrained outputs caps the subtree on the constrained side)
+            plo, phi = bounds.pop(leaf, (-np.inf, np.inf))
+            m = int(self.mono_np[feat])
+            llo, lhi, rlo, rhi = plo, phi, plo, phi
+            if m != 0:
+                mid = (float(s.left_output) + float(s.right_output)) / 2.0
+                if m > 0:
+                    lhi = min(phi, mid)
+                    rlo = max(plo, mid)
+                else:
+                    llo = max(plo, mid)
+                    rhi = min(phi, mid)
+            bounds[leaf] = (llo, lhi)
+            bounds[right_leaf] = (rlo, rhi)
+
             if tree.num_leaves >= num_leaves:
                 break  # no more splits: skip children histograms
 
@@ -278,8 +320,10 @@ class SerialTreeLearner:
 
             hists[small_leaf] = hist_small
             hists[large_leaf] = hist_large
-            best[small_leaf] = self._best(hist_small, *s_sums, fmask)
-            best[large_leaf] = self._best(hist_large, *g_sums, fmask)
+            best[small_leaf] = self._best(hist_small, *s_sums, fmask,
+                                          bounds[small_leaf])
+            best[large_leaf] = self._best(hist_large, *g_sums, fmask,
+                                          bounds[large_leaf])
             sums[small_leaf] = s_sums
             sums[large_leaf] = g_sums
 

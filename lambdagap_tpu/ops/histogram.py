@@ -165,6 +165,28 @@ def leaf_histogram(x_binned: jax.Array, perm: jax.Array, grad: jax.Array,
                                precision)
 
 
+def unbundle_hist(hist_b: jax.Array, src: jax.Array, kind: jax.Array,
+                  parent_g, parent_h, parent_c) -> jax.Array:
+    """Expand a bundled-column histogram back to per-feature space.
+
+    hist_b: f32 [C, Bb, 3] histogram over EFB-bundled columns.
+    src/kind: the precomputed gather map (data.bundling.unbundle_map) —
+    COPY bins gather from the flattened bundle histogram; a bundled
+    feature's default bin is the leaf residual ``total - sum(COPY bins)``
+    (the analog of FixHistogram's sum patching, reference:
+    src/treelearner/feature_histogram.hpp GatherInfoForThreshold).
+    Returns f32 [F, B, 3].
+    """
+    flat = hist_b.reshape(-1, HIST_CHANNELS)
+    out = flat[src]                                     # [F, B, 3]
+    copy = (kind == 1)[..., None]
+    out = jnp.where(copy, out, 0.0)
+    nzsum = jnp.sum(out, axis=1)                        # [F, 3]
+    totals = jnp.stack([parent_g, parent_h, parent_c])  # [3]
+    resid = totals[None, :] - nzsum                     # [F, 3]
+    return jnp.where((kind == 2)[..., None], resid[:, None, :], out)
+
+
 def subtract_histogram(parent_hist: jax.Array, child_hist: jax.Array) -> jax.Array:
     """The histogram-subtraction trick
     (reference: src/treelearner/feature_histogram.hpp ``Subtract``)."""

@@ -115,9 +115,11 @@ def split_gains(lg, lh, rg, rh, p: SplitParams, l_cnt=None, r_cnt=None,
 
 def _numerical_best(hist, parent_g, parent_h, parent_c, parent_output,
                     num_bins, default_bins, missing_types, feature_mask,
-                    p: SplitParams):
+                    p: SplitParams, constraints=None):
     """Both-direction scan for all features at once.
 
+    ``constraints``: optional (monotone[F] in {-1,0,+1}, min_c, max_c) for
+    monotone-constrained leaves (None = unconstrained fast path).
     Returns per-feature best: (gain[F], threshold[F], default_left[F],
     left_g[F], left_h[F], left_c[F]).
     """
@@ -159,15 +161,31 @@ def _numerical_best(hist, parent_g, parent_h, parent_c, parent_output,
         ok = ((left_c >= p.min_data_in_leaf) & (right_c >= p.min_data_in_leaf)
               & (left_h >= p.min_sum_hessian_in_leaf)
               & (right_h >= p.min_sum_hessian_in_leaf))
-        gain = split_gains(left_g, left_h, right_g, right_h, p,
-                           left_c, right_c, parent_output)
-        return jnp.where(ok, gain, K_MIN_SCORE), right_g, right_h, right_c
+        if constraints is None:
+            gain = split_gains(left_g, left_h, right_g, right_h, p,
+                               left_c, right_c, parent_output)
+            return jnp.where(ok, gain, K_MIN_SCORE)
+        # monotone path (basic method): per-candidate child outputs,
+        # clamped to the leaf's inherited bounds, with a direction veto on
+        # the constrained feature (reference:
+        # src/treelearner/monotone_constraints.hpp:329 BasicLeafConstraints
+        # + feature_histogram.hpp monotone-templated scan)
+        monotone, min_c, max_c = constraints
+        lout = jnp.clip(calculate_leaf_output(left_g, left_h, p, left_c,
+                                              parent_output), min_c, max_c)
+        rout = jnp.clip(calculate_leaf_output(right_g, right_h, p, right_c,
+                                              parent_output), min_c, max_c)
+        m = monotone[:, None]
+        veto = ((m > 0) & (lout > rout)) | ((m < 0) & (lout < rout))
+        gain = (leaf_gain_given_output(left_g, left_h, lout, p)
+                + leaf_gain_given_output(right_g, right_h, rout, p))
+        return jnp.where(ok & ~veto, gain, K_MIN_SCORE)
 
-    gain_f, _, _, _ = eval_dir(lg_f, lh_f, lc_f)
+    gain_f = eval_dir(lg_f, lh_f, lc_f)
     lg_r = parent_g - rg_r
     lh_r = parent_h - rh_r
     lc_r = parent_c - rc_r
-    gain_r, _, _, _ = eval_dir(lg_r, lh_r, lc_r)
+    gain_r = eval_dir(lg_r, lh_r, lc_r)
 
     # valid threshold candidates: t in [0, num_bin-2]; Zero-missing skips the
     # default bin as a candidate (it would make train/predict placement of
@@ -200,7 +218,8 @@ def _numerical_best(hist, parent_g, parent_h, parent_c, parent_output,
 # ---------------------------------------------------------------------------
 
 def _categorical_best(hist, parent_g, parent_h, parent_c, parent_output,
-                      num_bins, feature_mask, p: SplitParams):
+                      num_bins, feature_mask, p: SplitParams,
+                      constraints=None):
     """Categorical split search
     (reference: feature_histogram.hpp FindBestThresholdCategoricalInner):
     one-vs-rest for small cardinality, otherwise scan prefixes of bins sorted
@@ -225,8 +244,24 @@ def _categorical_best(hist, parent_g, parent_h, parent_c, parent_output,
         ok = ((left_c >= p.min_data_in_leaf) & (right_c >= p.min_data_in_leaf)
               & (left_h >= p.min_sum_hessian_in_leaf)
               & (right_h >= p.min_sum_hessian_in_leaf))
-        gain = split_gains(left_g, left_h, right_g, right_h, p,
-                           left_c, right_c, parent_output, l2_extra=p.cat_l2)
+        if constraints is None:
+            gain = split_gains(left_g, left_h, right_g, right_h, p,
+                               left_c, right_c, parent_output,
+                               l2_extra=p.cat_l2)
+            return jnp.where(ok, gain, K_MIN_SCORE)
+        # no ordering veto for categorical splits, but child outputs still
+        # clamp to the leaf's inherited monotone bounds
+        _, min_c, max_c = constraints
+        lout = jnp.clip(calculate_leaf_output(
+            left_g, left_h, p, left_c, parent_output, l2_extra=p.cat_l2),
+            min_c, max_c)
+        rout = jnp.clip(calculate_leaf_output(
+            right_g, right_h, p, right_c, parent_output, l2_extra=p.cat_l2),
+            min_c, max_c)
+        gain = (leaf_gain_given_output(left_g, left_h, lout, p,
+                                       l2_extra=p.cat_l2)
+                + leaf_gain_given_output(right_g, right_h, rout, p,
+                                         l2_extra=p.cat_l2))
         return jnp.where(ok, gain, K_MIN_SCORE)
 
     # --- one-vs-rest: category k alone goes left --------------------------
@@ -303,7 +338,7 @@ def _bins_to_bitset(member: jax.Array) -> jax.Array:
 def per_feature_best(hist: jax.Array, parent_g, parent_h, parent_c,
                      parent_output, num_bins, default_bins, missing_types,
                      is_categorical, feature_mask, params: SplitParams,
-                     has_categorical: bool = False):
+                     has_categorical: bool = False, constraints=None):
     """Per-feature best split candidates (the per-feature stage of
     ``FindBestSplitsFromHistograms``), used directly by the voting-parallel
     learner's local top-k vote (reference:
@@ -313,12 +348,12 @@ def per_feature_best(hist: jax.Array, parent_g, parent_h, parent_c,
     num_gain, num_t, num_dl, num_lg, num_lh, num_lc = _numerical_best(
         hist, parent_g, parent_h, parent_c, parent_output,
         num_bins, default_bins, missing_types,
-        feature_mask & ~is_categorical, p)
+        feature_mask & ~is_categorical, p, constraints)
 
     if has_categorical:
         cat_gain, cat_t, cat_lg, cat_lh, cat_lc, cat_bits = _categorical_best(
             hist, parent_g, parent_h, parent_c, parent_output,
-            num_bins, feature_mask & is_categorical, p)
+            num_bins, feature_mask & is_categorical, p, constraints)
     else:
         cat_gain = jnp.full((F,), K_MIN_SCORE)
         cat_t = jnp.zeros((F,), jnp.int32)
@@ -341,7 +376,8 @@ def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
                     num_bins: jax.Array, default_bins: jax.Array,
                     missing_types: jax.Array, is_categorical: jax.Array,
                     feature_mask: jax.Array, params: SplitParams,
-                    has_categorical: bool = False) -> SplitResult:
+                    has_categorical: bool = False,
+                    constraints=None) -> SplitResult:
     """Best split for one leaf over all features.
 
     The analog of ``FindBestSplitsFromHistograms`` + per-leaf argmax
@@ -352,7 +388,7 @@ def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
     gain, thr, dl, lg, lh, lc, cat_bits = per_feature_best(
         hist, parent_g, parent_h, parent_c, parent_output, num_bins,
         default_bins, missing_types, is_categorical, feature_mask, params,
-        has_categorical)
+        has_categorical, constraints)
 
     # parent gain shift (reference: BeforeNumerical gain_shift + min_gain_to_split)
     parent_gain = leaf_gain(parent_g, parent_h, p, parent_c, parent_output)
@@ -371,6 +407,10 @@ def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
     num_data = parent_c
     left_out = calculate_leaf_output(left_g, left_h, p, left_c, parent_output)
     right_out = calculate_leaf_output(right_g, right_h, p, right_c, parent_output)
+    if constraints is not None:
+        _, min_c, max_c = constraints
+        left_out = jnp.clip(left_out, min_c, max_c)
+        right_out = jnp.clip(right_out, min_c, max_c)
 
     splittable = jnp.isfinite(best_gain_raw) & (split_gain > 0.0)
     return SplitResult(

@@ -48,6 +48,11 @@ class DataParallelTreeLearner(SerialTreeLearner):
     def __init__(self, dataset: BinnedDataset, config: Config,
                  mesh: Optional[Mesh] = None) -> None:
         super().__init__(dataset, config)
+        if self.mono_on:
+            log.warning("tree_learner=%s enforces monotone constraints only "
+                        "per-split (direction veto); inherited leaf bounds "
+                        "are not propagated — use the serial/fused learner "
+                        "for strict monotonicity", config.tree_learner)
         self.mesh = mesh if mesh is not None else make_mesh(config.tpu_num_devices)
         self.n_dev = int(self.mesh.devices.size)
 

@@ -101,11 +101,13 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             votes = jax.lax.all_gather(local_top.astype(jnp.int32),
                                        DATA_AXIS, tiled=True)    # [D*k]
             hist_voted = jax.lax.psum(h0[votes], DATA_AXIS)      # [D*k, B, 3]
+            cons = ((self.mono_arr[votes], jnp.float32(-jnp.inf),
+                     jnp.float32(jnp.inf)) if self.mono_on else None)
             res = find_best_split(
                 hist_voted, pg, ph, pc, pout,
                 num_bins[votes], default_bins[votes], missing_types[votes],
                 is_cat[votes], fmask[votes], params,
-                has_categorical=has_cat)
+                has_categorical=has_cat, constraints=cons)
             # remap the winning index back to the true feature id
             true_feat = votes[res.feature]
             return res._replace(feature=true_feat)

@@ -1,0 +1,71 @@
+"""Exclusive Feature Bundling.
+
+(reference: src/io/dataset.cpp:107 FindGroups / :246 FastFeatureBundling)
+"""
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.config import Config
+from lambdagap_tpu.data.dataset import BinnedDataset
+
+
+def _onehot_heavy(n=2000, groups=4, cards=(8, 6, 5, 7), seed=0):
+    """Mutually-exclusive one-hot indicator blocks + 2 dense features —
+    the classic EFB shape (bundles need low-cardinality sparse columns;
+    a 255-bin continuous column can never share a <=256-bin bundle)."""
+    rng = np.random.RandomState(seed)
+    cols = []
+    latents = []
+    for g in range(groups):
+        c = cards[g % len(cards)]
+        k = rng.randint(0, c, n)
+        latents.append(k)
+        block = np.zeros((n, c))
+        block[np.arange(n), k] = 1.0
+        cols.append(block)
+    dense = rng.randn(n, 2)
+    X = np.column_stack(cols + [dense])
+    y = (latents[0] * 0.5 - latents[1] * 0.3 + dense[:, 0]
+         + 0.05 * rng.randn(n))
+    return X, y
+
+
+def test_bundle_shrinks_columns():
+    X, y = _onehot_heavy()
+    cfg = Config.from_params({"max_bin": 255, "min_data_in_bin": 1})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    ds.ensure_bundle(cfg)
+    assert ds.bundle is not None
+    # 26 one-hot columns + 2 dense: bundles must be far fewer than features
+    assert ds.bundle.num_cols < ds.num_features
+    assert ds.bundle.num_cols <= 8
+    # every feature is mapped to exactly one column
+    assert sorted(f for g in ds.bundle.members for f in g) == \
+        list(range(ds.num_features))
+
+
+@pytest.mark.parametrize("leaves", [15, 31])
+def test_bundled_training_matches_unbundled(leaves):
+    X, y = _onehot_heavy()
+    base = {"objective": "regression", "num_leaves": leaves,
+            "min_data_in_leaf": 10, "min_data_in_bin": 1,
+            "learning_rate": 0.1, "verbose": -1,
+            "tpu_fused_learner": "1", "tpu_hist_impl": "onehot"}
+    b_off = lgb.train({**base, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=10)
+    b_on = lgb.train({**base, "enable_bundle": True},
+                     lgb.Dataset(X, label=y), num_boost_round=10)
+    p_off = b_off.predict(X)
+    p_on = b_on.predict(X)
+    # perfectly exclusive features (max_conflict_rate=0): identical trees
+    np.testing.assert_allclose(p_on, p_off, rtol=1e-4, atol=1e-5)
+
+
+def test_bundled_serial_learner_unaffected():
+    # host serial learner ignores the bundle artifact and must still work
+    X, y = _onehot_heavy(n=800)
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+              "min_data_in_bin": 1, "tpu_fused_learner": "0"}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    assert np.isfinite(b.predict(X)).all()

@@ -1,0 +1,72 @@
+"""Monotone constraints (basic method).
+
+(reference: src/treelearner/monotone_constraints.hpp BasicLeafConstraints;
+test model: tests/python_package_test/test_engine.py test_monotone_constraints)
+"""
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+
+
+def _data(n=1500, seed=7):
+    rng = np.random.RandomState(seed)
+    x_inc = rng.rand(n)          # want monotone increasing
+    x_dec = rng.rand(n)          # want monotone decreasing
+    x_free = rng.rand(n)
+    y = (5 * x_inc + np.sin(10 * np.pi * x_inc)
+         - 5 * x_dec - np.cos(10 * np.pi * x_dec)
+         + np.sin(10 * np.pi * x_free) + 0.1 * rng.randn(n))
+    return np.column_stack([x_inc, x_dec, x_free]), y
+
+
+def _is_monotone(booster, feature, sign, base_row, lo=0.0, hi=1.0):
+    grid = np.linspace(lo, hi, 200)
+    rows = np.tile(base_row, (len(grid), 1))
+    rows[:, feature] = grid
+    pred = booster.predict(rows)
+    diffs = np.diff(pred)
+    return (diffs * sign >= -1e-10).all()
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_monotone_basic(fused):
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 31,
+              "min_data_in_leaf": 10, "learning_rate": 0.1, "verbose": -1,
+              "monotone_constraints": [1, -1, 0],
+              "tpu_fused_learner": "1" if fused else "0",
+              "tpu_hist_impl": "onehot"}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=30)
+    rng = np.random.RandomState(1)
+    for _ in range(5):
+        base = rng.rand(3)
+        assert _is_monotone(b, 0, +1, base), "feature 0 must be increasing"
+        assert _is_monotone(b, 1, -1, base), "feature 1 must be decreasing"
+    # the model still learns something
+    resid = y - b.predict(X)
+    assert np.var(resid) < 0.6 * np.var(y)
+
+
+def test_unconstrained_violates():
+    # sanity: without constraints the same data does wiggle (otherwise the
+    # monotone assertions above prove nothing)
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 31,
+              "min_data_in_leaf": 10, "learning_rate": 0.1, "verbose": -1,
+              "tpu_hist_impl": "onehot"}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=30)
+    rng = np.random.RandomState(1)
+    violated = any(not _is_monotone(b, 0, +1, rng.rand(3)) for _ in range(5))
+    assert violated
+
+
+def test_monotone_on_categorical_fatal():
+    rng = np.random.RandomState(0)
+    X = np.column_stack([rng.randint(0, 5, 300), rng.rand(300)])
+    y = rng.rand(300)
+    params = {"objective": "regression", "num_leaves": 7, "verbose": -1,
+              "monotone_constraints": [1, 0]}
+    with pytest.raises(Exception):
+        lgb.train(params, lgb.Dataset(X, label=y, categorical_feature=[0]),
+                  num_boost_round=2)
