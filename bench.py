@@ -96,8 +96,7 @@ def auc_score(y_true: np.ndarray, score: np.ndarray) -> float:
     return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
 
 
-def run_attempt(rows: int, fused: bool) -> None:
-    """Child-process entry: train + measure, print one JSON line."""
+def _configure_jax_cache() -> None:
     import jax
     cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              ".jax_cache")
@@ -106,6 +105,11 @@ def run_attempt(rows: int, fused: bool) -> None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     except Exception:
         pass
+
+
+def run_attempt(rows: int, fused: bool) -> None:
+    """Child-process entry: train + measure, print one JSON line."""
+    _configure_jax_cache()
 
     import lambdagap_tpu as lgb
 
@@ -166,6 +170,58 @@ def run_attempt(rows: int, fused: bool) -> None:
     }))
 
 
+def run_rank_attempt(n_queries: int) -> None:
+    """MSLR-WEB30K-shaped lambdarank benchmark (second north star:
+    NDCG@10 ~= 0.527 bar at full size, reference docs/GPU-Performance.rst:156).
+    Child-process entry; prints one JSON line."""
+    _configure_jax_cache()
+    import lambdagap_tpu as lgb
+
+    rng = np.random.RandomState(11)
+    F = 136                       # MSLR feature count
+    sizes = rng.randint(40, 201, n_queries)           # ~120 docs/query
+    N = int(sizes.sum())
+    X = rng.randn(N, F).astype(np.float32)
+    w = rng.randn(F).astype(np.float32) * (rng.rand(F) < 0.2)
+    latent = X @ w * 0.6 + rng.randn(N).astype(np.float32)
+    # graded relevance 0..4, MSLR-like skew toward 0
+    y = np.clip(np.floor(latent - latent.mean() + 0.8), 0, 4).astype(np.float32)
+
+    n_train_q = int(n_queries * 0.9)
+    train_docs = int(sizes[:n_train_q].sum())
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "eval_at": [10], "num_leaves": 255, "learning_rate": 0.1,
+              "max_bin": 255, "min_data_in_leaf": 50, "verbose": -1}
+    t0 = time.time()
+    dtrain = lgb.Dataset(X[:train_docs], label=y[:train_docs],
+                         group=sizes[:n_train_q])
+    booster = lgb.Booster(params=params, train_set=dtrain)
+    dvalid = lgb.Dataset(X[train_docs:], label=y[train_docs:],
+                         group=sizes[n_train_q:], reference=dtrain)
+    booster.add_valid(dvalid, "valid")
+    t_construct = time.time() - t0
+    t1 = time.time()
+    booster.update()
+    booster.update()
+    t_warm = time.time() - t1
+    iters = max(ITERS_MEASURED // 2, 5)
+    t2 = time.time()
+    for _ in range(iters):
+        booster.update()
+    np.asarray(booster._booster.scores[0][:1])
+    per_iter = (time.time() - t2) / iters
+    ndcg = {m: v for (_, m, v, _) in booster.eval_valid()}
+    projected = t_construct + t_warm + per_iter * (ITERS_TOTAL - 2)
+    print(json.dumps({
+        "queries": n_queries, "docs": N, "features": F,
+        "construct_s": round(t_construct, 3),
+        "per_iter_s": round(per_iter, 4),
+        "projected_500iter_s": round(projected, 3),
+        "valid_ndcg": {k: round(float(v), 5) for k, v in ndcg.items()},
+        "iters_trained": iters + 2,
+    }))
+
+
 def main() -> None:
     # attempt ladder: (rows, fused, is_retry)
     ladder = []
@@ -218,6 +274,24 @@ def main() -> None:
         }))
         sys.exit(1)
 
+    # secondary north star: MSLR-shaped lambdarank (reference bar
+    # NDCG@10 ~= 0.527 at full size, docs/GPU-Performance.rst:156)
+    ranking = None
+    if os.environ.get("BENCH_RANK", "1") != "0":
+        nq = int(os.environ.get("BENCH_RANK_QUERIES", 2000))
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--rank-attempt", str(nq)]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=min(ATTEMPT_TIMEOUT, 1200))
+            if proc.returncode == 0 and proc.stdout.strip():
+                ranking = json.loads(proc.stdout.strip().splitlines()[-1])
+            else:
+                ranking = {"error": f"rc={proc.returncode}: "
+                                    f"{(proc.stderr or '')[-200:]}"}
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            ranking = {"error": str(e)[:200]}
+
     projected = result["projected_500iter_s"]
     print(json.dumps({
         "metric": "higgs_500iter_train_wall_clock_projected",
@@ -232,6 +306,7 @@ def main() -> None:
             "note": ("full HIGGS size" if result["rows"] == 10_500_000 else
                      f"reduced rows ({result['rows']}); vs_baseline not "
                      "size-matched"),
+            "ranking_mslr_shaped": ranking,
         },
     }))
 
@@ -239,5 +314,7 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 4 and sys.argv[1] == "--attempt":
         run_attempt(int(sys.argv[2]), sys.argv[3] == "1")
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--rank-attempt":
+        run_rank_attempt(int(sys.argv[2]))
     else:
         main()

@@ -287,6 +287,14 @@ class Booster:
         self._booster.save_model(filename, start_iteration, ni, it)
         return self
 
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0, **kwargs) -> Dict[str, Any]:
+        """JSON-serializable model dict (reference: Booster.dump_model ->
+        LGBM_BoosterDumpModel / GBDT::DumpModel)."""
+        from .models.model_text import dump_model
+        ni = -1 if num_iteration is None else num_iteration
+        return dump_model(self._booster, start_iteration, ni)
+
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0,
                         importance_type: str = "split") -> str:

@@ -94,7 +94,10 @@ def run_predict(cfg: Config) -> None:
         log.fatal("task=predict requires data=<file> and input_model=<model>")
     booster = GBDT.from_model_file(cfg.input_model, cfg)
     ds_raw = _load_raw_matrix(cfg.data, cfg)
-    if cfg.predict_leaf_index:
+    if cfg.predict_contrib:
+        out = booster.predict_contrib(ds_raw, cfg.start_iteration_predict,
+                                      cfg.num_iteration_predict)
+    elif cfg.predict_leaf_index:
         out = booster.predict_leaf(ds_raw, cfg.start_iteration_predict,
                                    cfg.num_iteration_predict)
     else:

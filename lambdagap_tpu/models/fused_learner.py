@@ -110,8 +110,8 @@ class FusedTreeLearner(SerialTreeLearner):
                      row_mask: Optional[jax.Array] = None) -> DeviceTree:
         fmask = self._feature_mask()
         mask = row_mask if row_mask is not None else jnp.ones(1, dtype=bool)
-        rec = self._train_jit(grad, hess, mask, fmask,
-                              has_mask=row_mask is not None)
+        rec = self._train_jit(grad, hess, mask, fmask, self.hx_rows,
+                              self.x_cols, has_mask=row_mask is not None)
         self.last_row_leaf = rec.row_leaf
         return rec
 
@@ -165,7 +165,8 @@ class FusedTreeLearner(SerialTreeLearner):
     # ------------------------------------------------------------------
     # the fused program
     # ------------------------------------------------------------------
-    def _train_tree_impl(self, grad, hess, row_mask, fmask, *, has_mask: bool):
+    def _train_tree_impl(self, grad, hess, row_mask, fmask, x_rows, x_cols,
+                         *, has_mask: bool):
         """One whole tree as a single XLA program.
 
         Design notes for the ``fori_loop`` body (the per-split step):
@@ -191,8 +192,10 @@ class FusedTreeLearner(SerialTreeLearner):
         W = min(self.chunk, _next_pow2(N))
         p = self.params
         max_depth = cfg.max_depth
-        x_rows = self.hx_rows           # [N, C] (bundled when EFB active)
-        x_cols = self.x_cols            # [C, N]
+        # x_rows [N, C] (bundled when EFB active) / x_cols [C, N] arrive as
+        # jit ARGUMENTS: a closed-over matrix would be inlined into the HLO
+        # as a dense constant, and at HIGGS size that 300+ MB payload
+        # overflows the remote-compile transport (round 2: HTTP 413)
         C = x_rows.shape[1]
         Bb = self.Bb                    # bins per stored column
         bundled = self.bundled

@@ -511,9 +511,29 @@ class GBDT:
 
     def predict_contrib(self, data: np.ndarray, start_iteration: int = 0,
                         num_iteration: int = -1) -> np.ndarray:
-        """SHAP feature contributions (reference: predict_contrib /
-        TreeSHAP in tree.h PredictContrib). Not yet implemented."""
-        raise NotImplementedError("pred_contrib lands with the SHAP milestone")
+        """SHAP feature contributions: [N, F+1] per class, last column the
+        expected value, rows summing to the raw prediction (reference:
+        Tree::PredictContrib / TreeSHAP, src/io/tree.cpp; native kernel in
+        native/treeshap.cpp)."""
+        from .shap import tree_shap_accumulate
+        data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        N, F_data = data.shape
+        K = self.num_tree_per_iteration
+        idx = self._model_slice(start_iteration, num_iteration)
+        trees = [self._tree(i) for i in idx]
+        max_f = max((f for t in trees
+                     for f in t.split_feature[:t.num_internal]), default=-1)
+        if max_f >= F_data:
+            log.fatal("pred_contrib input has %d features but the model "
+                      "splits on feature %d", F_data, max_f)
+        phi = np.zeros((K, N, F_data + 1), dtype=np.float64)
+        for pos, i in enumerate(idx):
+            tree_shap_accumulate(trees[pos], data, phi[i % K])
+        if self.average_output:
+            phi /= max(1, len(idx) // max(K, 1))
+        if K == 1:
+            return phi[0]
+        return phi.transpose(1, 0, 2).reshape(N, K * (F_data + 1))
 
     def predict(self, data: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1) -> np.ndarray:

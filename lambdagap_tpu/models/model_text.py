@@ -129,9 +129,10 @@ def save_model_to_string(booster, start_iteration: int = 0,
     out.append("feature_names=" + " ".join(feature_names))
     out.append("feature_infos=" + " ".join(booster.feature_infos()))
 
+    models = booster.host_models
     tree_strs = []
     for idx, i in enumerate(range(start_model, num_used)):
-        tree_strs.append(f"Tree={idx}\n" + tree_to_string(booster.host_models[i]) + "\n")
+        tree_strs.append(f"Tree={idx}\n" + tree_to_string(models[i]) + "\n")
     out.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
     body = "\n".join(out) + "\n\n" + "".join(tree_strs) + "end of trees\n"
 
@@ -273,3 +274,86 @@ def load_model_from_string(text: str):
         body = blk.split("\n", 1)[1] if "\n" in blk else ""
         trees.append(tree_from_string(body))
     return header, trees
+
+
+# ---------------------------------------------------------------------------
+# JSON dump (reference: gbdt_model_text.cpp DumpModel + tree.cpp Tree::ToJSON)
+# ---------------------------------------------------------------------------
+
+_MT_NAMES = {0: "None", 1: "Zero", 2: "NaN"}
+
+
+def _node_to_dict(tree: Tree, node: int) -> Dict:
+    if node < 0:
+        leaf = ~node
+        return {
+            "leaf_index": leaf,
+            "leaf_value": float(tree.leaf_value[leaf]),
+            "leaf_weight": float(tree.leaf_weight[leaf]),
+            "leaf_count": int(tree.leaf_count[leaf]),
+        }
+    if tree.is_categorical[node]:
+        bits = np.asarray(tree.cat_bitset_real[node], dtype=np.uint32)
+        cats = [str(32 * w + b) for w in range(len(bits))
+                for b in range(32) if (bits[w] >> b) & 1]
+        threshold = "||".join(cats)
+        decision_type = "=="
+    else:
+        threshold = tree.threshold_real[node]
+        decision_type = "<="
+    return {
+        "split_index": node,
+        "split_feature": tree.split_feature[node],
+        "split_gain": float(tree.split_gain[node]),
+        "threshold": threshold,
+        "decision_type": decision_type,
+        "default_left": bool(tree.default_left[node]),
+        "missing_type": _MT_NAMES.get(tree.missing_type[node], "None"),
+        "internal_value": float(tree.internal_value[node]),
+        "internal_weight": float(tree.internal_weight[node]),
+        "internal_count": int(tree.internal_count[node]),
+        "left_child": _node_to_dict(tree, tree.left_child[node]),
+        "right_child": _node_to_dict(tree, tree.right_child[node]),
+    }
+
+
+def dump_model(booster, start_iteration: int = 0,
+               num_iteration: int = -1) -> Dict:
+    """Model as a JSON-serializable dict
+    (reference: GBDT::DumpModel, src/boosting/gbdt_model_text.cpp;
+    Python Booster.dump_model)."""
+    K = booster.num_tree_per_iteration
+    feature_names = list(booster.feature_names)
+    total_iters = len(booster.models) // max(K, 1)
+    start_iteration = max(0, min(start_iteration, total_iters))
+    num_used = len(booster.models)
+    if num_iteration > 0:
+        num_used = min((start_iteration + num_iteration) * K, num_used)
+    trees = []
+    models = booster.host_models
+    for i in range(start_iteration * K, num_used):
+        t = models[i]
+        trees.append({
+            "tree_index": i - start_iteration * K,
+            "num_leaves": t.num_leaves,
+            "num_cat": sum(t.is_categorical[:t.num_internal]),
+            "shrinkage": float(t.shrinkage),
+            "tree_structure": _node_to_dict(
+                t, 0 if t.num_internal > 0 else ~0),
+        })
+    imp = feature_importance(booster)
+    return {
+        "name": "tree",
+        "version": MODEL_VERSION,
+        "num_class": booster.num_class if booster.num_class > 1 else 1,
+        "num_tree_per_iteration": K,
+        "label_index": 0,
+        "max_feature_idx": len(feature_names) - 1,
+        "objective": booster.objective_string(),
+        "average_output": bool(getattr(booster, "average_output", False)),
+        "feature_names": feature_names,
+        "feature_infos": booster.feature_infos(),
+        "tree_info": trees,
+        "feature_importances": {
+            feature_names[i]: int(v) for i, v in enumerate(imp) if v > 0},
+    }

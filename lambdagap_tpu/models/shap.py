@@ -1,0 +1,171 @@
+"""SHAP feature contributions (pred_contrib).
+
+The native TreeSHAP kernel (native/treeshap.cpp) implements the reference's
+per-row unique-path recursion (reference: src/io/tree.cpp TreeSHAP,
+include/LightGBM/tree.h PredictContrib); this module marshals host trees
+into its flat-array layout and provides a pure-Python fallback for
+compiler-less environments.
+"""
+from __future__ import annotations
+
+import ctypes
+import math
+from typing import List
+
+import numpy as np
+
+from ..utils import log
+from .tree import MISSING_NAN_C, MISSING_ZERO_C, Tree
+
+
+def _tree_arrays(tree: Tree):
+    n = tree.num_internal
+    L = tree.num_leaves
+    split_feature = np.asarray(tree.split_feature[:n], np.int32)
+    threshold = np.asarray(tree.threshold_real[:n], np.float64)
+    default_left = np.asarray(tree.default_left[:n], np.uint8)
+    missing_type = np.asarray(tree.missing_type[:n], np.int32)
+    left = np.asarray(tree.left_child[:n], np.int32)
+    right = np.asarray(tree.right_child[:n], np.int32)
+    is_cat = np.asarray(tree.is_categorical[:n], np.uint8)
+    offs = [0]
+    words: List[int] = []
+    for i in range(n):
+        bits = np.asarray(tree.cat_bitset_real[i], np.uint32)
+        words.extend(int(w) for w in bits)
+        offs.append(len(words))
+    cat_bits = np.asarray(words if words else [0], np.uint32)
+    cat_offs = np.asarray(offs, np.int64)
+    internal_value = np.asarray(tree.internal_value[:n], np.float64)
+    internal_count = np.asarray(tree.internal_count[:n], np.float64)
+    leaf_value = np.asarray(tree.leaf_value[:L], np.float64)
+    leaf_count = np.asarray(tree.leaf_count[:L], np.float64)
+    return (split_feature, threshold, default_left, missing_type, left,
+            right, is_cat, cat_bits, cat_offs, internal_value,
+            internal_count, leaf_value, leaf_count)
+
+
+def tree_shap_accumulate(tree: Tree, X: np.ndarray, phi: np.ndarray) -> None:
+    """Add one tree's SHAP values into phi [N, F+1] (last col = expected)."""
+    from ..native import get_lib
+    lib = get_lib()
+    arrs = _tree_arrays(tree)
+    if lib is not None:
+        X64 = np.ascontiguousarray(X, dtype=np.float64)
+        def ptr(a, ct):
+            return a.ctypes.data_as(ctypes.POINTER(ct))
+        (sf, th, dl, mt, lc, rc, ic, cb, co, iv, icnt, lv, lcnt) = arrs
+        lib.lg_tree_shap(
+            tree.num_internal,
+            ptr(sf, ctypes.c_int32), ptr(th, ctypes.c_double),
+            ptr(dl, ctypes.c_uint8), ptr(mt, ctypes.c_int32),
+            ptr(lc, ctypes.c_int32), ptr(rc, ctypes.c_int32),
+            ptr(ic, ctypes.c_uint8), ptr(cb, ctypes.c_uint32),
+            ptr(co, ctypes.c_int64), ptr(iv, ctypes.c_double),
+            ptr(icnt, ctypes.c_double), ptr(lv, ctypes.c_double),
+            ptr(lcnt, ctypes.c_double),
+            X64.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            X64.shape[0], X64.shape[1],
+            phi.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        return
+    _tree_shap_python(tree, X, phi)
+
+
+# ---------------------------------------------------------------------------
+# pure-Python fallback (same recursion; slow, for no-compiler environments)
+# ---------------------------------------------------------------------------
+
+def _tree_shap_python(tree: Tree, X: np.ndarray, phi: np.ndarray) -> None:
+    n = tree.num_internal
+    L = tree.num_leaves
+    lv = tree.leaf_value[:L]
+    lcnt = tree.leaf_count[:L].astype(np.float64)
+    expected = (float(np.dot(lv, lcnt) / lcnt.sum())
+                if n > 0 and lcnt.sum() > 0 else float(lv[0]))
+    phi[:, -1] += expected
+    if n == 0:
+        return
+
+    def cover(node):
+        return (tree.internal_count[node] if node >= 0
+                else float(tree.leaf_count[~node]))
+
+    def extend(path, zf, of, fi):
+        d = len(path)
+        path.append([fi, zf, of, 1.0 if d == 0 else 0.0])
+        for i in range(d - 1, -1, -1):
+            path[i + 1][3] += of * path[i][3] * (i + 1) / (d + 1)
+            path[i][3] = zf * path[i][3] * (d - i) / (d + 1)
+
+    def unwind(path, i0):
+        d = len(path) - 1
+        of, zf = path[i0][2], path[i0][1]
+        nop = path[d][3]
+        for i in range(d - 1, -1, -1):
+            if of != 0:
+                tmp = path[i][3]
+                path[i][3] = nop * (d + 1) / ((i + 1) * of)
+                nop = tmp - path[i][3] * zf * (d - i) / (d + 1)
+            else:
+                path[i][3] = path[i][3] * (d + 1) / (zf * (d - i))
+        for i in range(i0, d):
+            path[i][0], path[i][1], path[i][2] = \
+                path[i + 1][0], path[i + 1][1], path[i + 1][2]
+        path.pop()
+
+    def unwound_sum(path, i0):
+        d = len(path) - 1
+        of, zf = path[i0][2], path[i0][1]
+        nop = path[d][3]
+        total = 0.0
+        for i in range(d - 1, -1, -1):
+            if of != 0:
+                tmp = nop * (d + 1) / ((i + 1) * of)
+                total += tmp
+                nop = path[i][3] - tmp * zf * (d - i) / (d + 1)
+            else:
+                total += path[i][3] / (zf * (d - i) / (d + 1))
+        return total
+
+    def rec(row, phi_r, node, path, pzf, pof, pfi):
+        path = [list(e) for e in path]
+        extend(path, pzf, pof, pfi)
+        if node < 0:
+            v = float(tree.leaf_value[~node])
+            for i in range(1, len(path)):
+                w = unwound_sum(path, i)
+                phi_r[path[i][0]] += w * (path[i][2] - path[i][1]) * v
+            return
+        go_left = _decide(tree, node, row)
+        hot = tree.left_child[node] if go_left else tree.right_child[node]
+        cold = tree.right_child[node] if go_left else tree.left_child[node]
+        w = cover(node)
+        hzf, czf = cover(hot) / w, cover(cold) / w
+        izf = iof = 1.0
+        f = tree.split_feature[node]
+        k = next((i for i in range(len(path)) if path[i][0] == f), None)
+        if k is not None:
+            izf, iof = path[k][1], path[k][2]
+            unwind(path, k)
+        rec(row, phi_r, hot, path, hzf * izf, iof, f)
+        rec(row, phi_r, cold, path, czf * izf, 0.0, f)
+
+    for r in range(X.shape[0]):
+        rec(X[r], phi[r], 0, [], 1.0, 1.0, -1)
+
+
+def _decide(tree: Tree, node: int, row) -> bool:
+    v = row[tree.split_feature[node]]
+    if tree.is_categorical[node]:
+        if math.isnan(v):
+            return False
+        c = int(v)
+        bits = tree.cat_bitset_real[node]
+        return 0 <= c < len(bits) * 32 and bool((bits[c // 32] >> (c % 32)) & 1)
+    mt = tree.missing_type[node]
+    if math.isnan(v) and mt != MISSING_NAN_C:
+        v = 0.0
+    if (mt == MISSING_NAN_C and math.isnan(v)) or \
+       (mt == MISSING_ZERO_C and abs(v) <= 1e-35):
+        return bool(tree.default_left[node])
+    return v <= tree.threshold_real[node]

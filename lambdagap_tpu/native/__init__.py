@@ -20,17 +20,20 @@ _TRIED = False
 
 
 def _build_lib() -> Optional[str]:
-    src = os.path.join(os.path.dirname(__file__), "parser.cpp")
-    out = os.path.join(os.path.dirname(__file__), "_lg_native.so")
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+    here = os.path.dirname(__file__)
+    srcs = [os.path.join(here, "parser.cpp"),
+            os.path.join(here, "treeshap.cpp")]
+    out = os.path.join(here, "_lg_native.so")
+    if os.path.exists(out) and all(
+            os.path.getmtime(out) >= os.path.getmtime(s) for s in srcs):
         return out
     try:
         subprocess.run(["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                        src, "-o", out],
+                        *srcs, "-o", out],
                        check=True, capture_output=True, timeout=120)
         return out
     except (subprocess.SubprocessError, FileNotFoundError) as e:
-        log.warning("Native parser build failed (%s); using Python fallback", e)
+        log.warning("Native build failed (%s); using Python fallback", e)
         return None
 
 
@@ -51,5 +54,13 @@ def get_lib() -> Optional[ctypes.CDLL]:
             lib.lg_parse_delim.argtypes = [ctypes.c_char_p, ctypes.c_char,
                                            ctypes.c_int, dp,
                                            ctypes.c_int64, ctypes.c_int64]
+            i32p = ctypes.POINTER(ctypes.c_int32)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            u32p = ctypes.POINTER(ctypes.c_uint32)
+            lib.lg_tree_shap.argtypes = [
+                ctypes.c_int64, i32p, dp, u8p, i32p, i32p, i32p, u8p,
+                u32p, i64p, dp, dp, dp, dp, dp,
+                ctypes.c_int64, ctypes.c_int64, dp]
+            lib.lg_tree_shap.restype = None
             _LIB = lib
     return _LIB

@@ -1,0 +1,210 @@
+// TreeSHAP path-attribution (native, called via ctypes).
+//
+// Re-implementation of the reference's Tree::TreeSHAP recursion
+// (reference: src/io/tree.cpp TreeSHAP / include/LightGBM/tree.h
+// PredictContrib): the Lundberg unique-path algorithm, O(depth^2 * leaves)
+// per row, with the reference's decision semantics (NaN/zero missing,
+// categorical bitsets).
+//
+// Flat-array tree layout matches lambdagap_tpu.models.tree.Tree: child
+// pointers >= 0 are internal nodes, < 0 encode ~leaf_index.
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct PathElem {
+  int feature_index;
+  double zero_fraction;
+  double one_fraction;
+  double pweight;
+};
+
+struct TreeView {
+  int64_t num_internal;
+  const int32_t* split_feature;
+  const double* threshold;
+  const uint8_t* default_left;
+  const int32_t* missing_type;  // 0 none, 1 zero, 2 nan
+  const int32_t* left;
+  const int32_t* right;
+  const uint8_t* is_cat;
+  const uint32_t* cat_bits;     // concatenated bitset words
+  const int64_t* cat_offs;      // [num_internal+1] word offsets
+  const double* internal_value;
+  const double* internal_count;
+  const double* leaf_value;
+  const double* leaf_count;
+};
+
+const double kZeroThreshold = 1e-35;
+
+inline double node_cover(const TreeView& t, int node) {
+  return node >= 0 ? t.internal_count[node] : t.leaf_count[~node];
+}
+
+inline bool decide_left(const TreeView& t, int node, const double* row) {
+  double v = row[t.split_feature[node]];
+  if (t.is_cat[node]) {
+    if (std::isnan(v)) return false;
+    int64_t c = static_cast<int64_t>(v);
+    if (c < 0) return false;
+    int64_t w0 = t.cat_offs[node], w1 = t.cat_offs[node + 1];
+    int64_t word = c / 32;
+    if (word >= w1 - w0) return false;
+    return (t.cat_bits[w0 + word] >> (c % 32)) & 1u;
+  }
+  int mt = t.missing_type[node];
+  if (std::isnan(v) && mt != 2) v = 0.0;
+  if ((mt == 2 && std::isnan(v)) || (mt == 1 && std::fabs(v) <= kZeroThreshold))
+    return t.default_left[node];
+  return v <= t.threshold[node];
+}
+
+void extend_path(PathElem* path, int depth, double zero_fraction,
+                 double one_fraction, int feature_index) {
+  path[depth] = {feature_index, zero_fraction, one_fraction,
+                 depth == 0 ? 1.0 : 0.0};
+  for (int i = depth - 1; i >= 0; --i) {
+    path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) /
+                           static_cast<double>(depth + 1);
+    path[i].pweight = zero_fraction * path[i].pweight * (depth - i) /
+                      static_cast<double>(depth + 1);
+  }
+}
+
+void unwind_path(PathElem* path, int depth, int path_index) {
+  const double one_fraction = path[path_index].one_fraction;
+  const double zero_fraction = path[path_index].zero_fraction;
+  double next_one_portion = path[depth].pweight;
+  for (int i = depth - 1; i >= 0; --i) {
+    if (one_fraction != 0) {
+      const double tmp = path[i].pweight;
+      path[i].pweight = next_one_portion * (depth + 1) /
+                        ((i + 1) * one_fraction);
+      next_one_portion = tmp - path[i].pweight * zero_fraction * (depth - i) /
+                         static_cast<double>(depth + 1);
+    } else {
+      path[i].pweight = path[i].pweight * (depth + 1) /
+                        (zero_fraction * (depth - i));
+    }
+  }
+  for (int i = path_index; i < depth; ++i) {
+    path[i].feature_index = path[i + 1].feature_index;
+    path[i].zero_fraction = path[i + 1].zero_fraction;
+    path[i].one_fraction = path[i + 1].one_fraction;
+  }
+}
+
+double unwound_path_sum(const PathElem* path, int depth,
+                        int path_index) {
+  const double one_fraction = path[path_index].one_fraction;
+  const double zero_fraction = path[path_index].zero_fraction;
+  double next_one_portion = path[depth].pweight;
+  double total = 0;
+  for (int i = depth - 1; i >= 0; --i) {
+    if (one_fraction != 0) {
+      const double tmp = next_one_portion * (depth + 1) /
+                         ((i + 1) * one_fraction);
+      total += tmp;
+      next_one_portion = path[i].pweight - tmp * zero_fraction * (depth - i) /
+                         static_cast<double>(depth + 1);
+    } else {
+      total += path[i].pweight / (zero_fraction * (depth - i) /
+                                  static_cast<double>(depth + 1));
+    }
+  }
+  return total;
+}
+
+// parent_path points into a per-row arena (reference layout: each depth
+// level gets its own copy window, tree.cpp Tree::TreeSHAP) — no allocator
+// traffic in the hot recursion.
+void shap_rec(const TreeView& t, const double* row, double* phi, int node,
+              int depth, PathElem* parent_path, double parent_zero_fraction,
+              double parent_one_fraction, int parent_feature_index) {
+  PathElem* path = parent_path + depth;
+  std::memcpy(path, parent_path, sizeof(PathElem) * depth);
+  extend_path(path, depth, parent_zero_fraction, parent_one_fraction,
+              parent_feature_index);
+  if (node < 0) {  // leaf
+    const double v = t.leaf_value[~node];
+    for (int i = 1; i <= depth; ++i) {
+      const double w = unwound_path_sum(path, depth, i);
+      phi[path[i].feature_index] +=
+          w * (path[i].one_fraction - path[i].zero_fraction) * v;
+    }
+    return;
+  }
+  const int hot = decide_left(t, node, row) ? t.left[node] : t.right[node];
+  const int cold = decide_left(t, node, row) ? t.right[node] : t.left[node];
+  const double w = node_cover(t, node);
+  const double hot_zero_fraction = node_cover(t, hot) / w;
+  const double cold_zero_fraction = node_cover(t, cold) / w;
+  double incoming_zero_fraction = 1.0;
+  double incoming_one_fraction = 1.0;
+
+  // undo any previous split on the same feature along this path
+  int f = t.split_feature[node];
+  int path_index = 0;
+  for (; path_index <= depth; ++path_index)
+    if (path[path_index].feature_index == f) break;
+  if (path_index != depth + 1) {
+    incoming_zero_fraction = path[path_index].zero_fraction;
+    incoming_one_fraction = path[path_index].one_fraction;
+    unwind_path(path, depth, path_index);
+    depth -= 1;
+  }
+  shap_rec(t, row, phi, hot, depth + 1, path,
+           hot_zero_fraction * incoming_zero_fraction, incoming_one_fraction,
+           f);
+  shap_rec(t, row, phi, cold, depth + 1, path,
+           cold_zero_fraction * incoming_zero_fraction, 0.0, f);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Accumulate one tree's SHAP values for all rows into phi [N, F+1]
+// (last column receives the tree's expected value).
+void lg_tree_shap(int64_t num_internal, const int32_t* split_feature,
+                  const double* threshold, const uint8_t* default_left,
+                  const int32_t* missing_type, const int32_t* left,
+                  const int32_t* right, const uint8_t* is_cat,
+                  const uint32_t* cat_bits, const int64_t* cat_offs,
+                  const double* internal_value, const double* internal_count,
+                  const double* leaf_value, const double* leaf_count,
+                  const double* X, int64_t n_rows, int64_t n_features,
+                  double* phi) {
+  TreeView t{num_internal, split_feature, threshold,    default_left,
+             missing_type, left,          right,        is_cat,
+             cat_bits,     cat_offs,      internal_value, internal_count,
+             leaf_value,   leaf_count};
+  // cover-weighted mean of leaf outputs: the recursion's phi sums to
+  // f(x) - E_cover[f], so this exact E keeps sum(contribs) == prediction
+  // (reference: Tree::ExpectedValue, include/LightGBM/tree.h)
+  double expected = leaf_value[0];
+  if (num_internal > 0) {
+    double num = 0, den = 0;
+    for (int64_t l = 0; l <= num_internal; ++l) {
+      num += leaf_value[l] * leaf_count[l];
+      den += leaf_count[l];
+    }
+    expected = den > 0 ? num / den : 0.0;
+  }
+  // one arena reused across rows: level d starts at offset
+  // d*(d+1)/2 <= (D+1)(D+2)/2 elements for max depth D <= num_internal
+  const int64_t max_d = num_internal + 2;
+  std::vector<PathElem> arena((max_d + 1) * (max_d + 2) / 2);
+  for (int64_t r = 0; r < n_rows; ++r) {
+    double* phi_r = phi + r * (n_features + 1);
+    phi_r[n_features] += expected;
+    if (num_internal == 0) continue;
+    shap_rec(t, X + r * n_features, phi_r, 0, 0, arena.data(), 1.0, 1.0, -1);
+  }
+}
+
+}  // extern "C"

@@ -112,6 +112,7 @@ class RankingBase(ObjectiveFunction):
             config.lambdarank_position_bias_regularization
         self.learning_rate = config.learning_rate
         self.iter_count = 0
+        self.last_effective_pair_rate = None
 
     def init(self, metadata, num_data) -> None:
         super().init(metadata, num_data)
@@ -163,6 +164,16 @@ class RankingBase(ObjectiveFunction):
             h = h * self.weight
         if self.positions is not None:
             self._update_position_bias(g, h)
+        # the fork's per-iteration effective-pair-rate line
+        # (reference: src/objective/rank_objective.hpp:108-116) — the D2H
+        # sync is only paid when debug logging is on
+        if eff_pairs and log.debug_enabled():
+            rate_sum = float(sum(float(jnp.sum(e)) for e in eff_pairs))
+            rate = rate_sum / max(self.num_queries, 1)
+            self.last_effective_pair_rate = rate
+            log.debug("iteration %d: effective pair rate %.4f "
+                      "(mean over %d queries)",
+                      self.iter_count + 1, rate, self.num_queries)
         self.iter_count += 1
         return g[None, :], h[None, :]
 

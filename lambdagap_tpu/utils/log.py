@@ -46,6 +46,10 @@ def debug(msg: str, *args) -> None:
     _logger.debug(msg, *args)
 
 
+def debug_enabled() -> bool:
+    return _logger.isEnabledFor(logging.DEBUG)
+
+
 def info(msg: str, *args) -> None:
     _logger.info(msg, *args)
 

@@ -1,0 +1,112 @@
+"""SHAP pred_contrib and JSON model dump.
+
+(reference: Tree::PredictContrib/TreeSHAP in src/io/tree.cpp;
+GBDT::DumpModel in src/boosting/gbdt_model_text.cpp)
+"""
+import json
+
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+
+
+def _data(n=500, d=6, seed=4, with_nan=False, with_cat=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d)
+    if with_cat:
+        X[:, 0] = rng.randint(0, 9, n)
+    if with_nan:
+        X[rng.rand(n, d) < 0.1] = np.nan
+    base = np.where(np.isnan(X), 0.0, X)
+    y = base[:, 1] * 2 + np.sin(base[:, 2]) + \
+        (base[:, 0] % 3 if with_cat else base[:, 3])
+    return X, y
+
+
+@pytest.mark.parametrize("kw", [{}, {"with_nan": True}, {"with_cat": True}])
+def test_contrib_sums_to_raw(kw):
+    X, y = _data(**kw)
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, label=y,
+                     categorical_feature=[0] if kw.get("with_cat") else "auto")
+    b = lgb.train(params, ds, num_boost_round=12)
+    contrib = b.predict(X, pred_contrib=True)
+    assert contrib.shape == (len(X), X.shape[1] + 1)
+    raw = b.predict(X, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5, atol=1e-6)
+    # features the model never splits on get zero contribution
+    used = {f for t in b._booster.host_models
+            for f in t.split_feature[:t.num_internal]}
+    for f in range(X.shape[1]):
+        if f not in used:
+            np.testing.assert_allclose(contrib[:, f], 0.0, atol=1e-12)
+
+
+def test_contrib_multiclass_shape_and_sum():
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 5)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "verbose": -1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6)
+    contrib = b.predict(X, pred_contrib=True)
+    F1 = X.shape[1] + 1
+    assert contrib.shape == (400, 3 * F1)
+    raw = b.predict(X, raw_score=True)        # [N, 3]
+    for k in range(3):
+        np.testing.assert_allclose(contrib[:, k * F1:(k + 1) * F1].sum(axis=1),
+                                   raw[:, k], rtol=1e-5, atol=1e-6)
+
+
+def test_python_fallback_matches_native():
+    from lambdagap_tpu.models.shap import (_tree_shap_python,
+                                           tree_shap_accumulate)
+    from lambdagap_tpu.native import get_lib
+    if get_lib() is None:
+        pytest.skip("no native lib; fallback is the only path")
+    X, y = _data(n=60)
+    b = lgb.train({"objective": "regression", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    tree = b._booster.host_models[0]
+    X64 = np.ascontiguousarray(X, np.float64)
+    phi_n = np.zeros((60, X.shape[1] + 1))
+    tree_shap_accumulate(tree, X64, phi_n)
+    phi_p = np.zeros_like(phi_n)
+    _tree_shap_python(tree, X64, phi_p)
+    np.testing.assert_allclose(phi_n, phi_p, rtol=1e-9, atol=1e-12)
+
+
+def test_dump_model_json():
+    X, y = _data(with_cat=True)
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbose": -1},
+                  lgb.Dataset(X, label=y, categorical_feature=[0]),
+                  num_boost_round=5)
+    d = b.dump_model()
+    s = json.dumps(d)                     # must be JSON-serializable
+    d2 = json.loads(s)
+    assert d2["num_class"] == 1
+    assert len(d2["tree_info"]) == 5
+    assert d2["max_feature_idx"] == 5
+    t0 = d2["tree_info"][0]
+    assert t0["num_leaves"] >= 2
+    root = t0["tree_structure"]
+    assert "split_feature" in root and "left_child" in root
+    # find a categorical node: threshold is a "a||b" string
+    def walk(nd):
+        if "split_index" in nd:
+            yield nd
+            yield from walk(nd["left_child"])
+            yield from walk(nd["right_child"])
+    cats = [nd for ti in d2["tree_info"] for nd in walk(ti["tree_structure"])
+            if nd["decision_type"] == "=="]
+    assert cats and all("||" in nd["threshold"] or nd["threshold"].isdigit()
+                        for nd in cats)
+    # leaf count is preserved
+    def leaves(nd):
+        if "leaf_index" in nd:
+            return 1
+        return leaves(nd["left_child"]) + leaves(nd["right_child"])
+    assert leaves(t0["tree_structure"]) == t0["num_leaves"]
