@@ -101,6 +101,13 @@ class FusedTreeLearner(SerialTreeLearner):
         cap = max(int(config.tpu_rows_per_block) * 16, 1 << 12)
         self.chunk = min(max(_next_pow2(max(dataset.num_data // 128, 1)),
                              1 << 12), cap)
+        # quantized-gradient training (reference: GradientDiscretizer,
+        # src/treelearner/gradient_discretizer.hpp): int8 grad/hess levels
+        # with stochastic rounding; on TPU the histogram contraction runs
+        # as an int8 MXU matmul with exact int32 accumulation
+        self.quant = bool(config.use_quantized_grad)
+        if self.quant:
+            self._qkey = jax.random.PRNGKey(config.data_random_seed + 7919)
         self._train_jit = jax.jit(self._train_tree_impl,
                                   static_argnames=("has_mask",))
         self.last_row_leaf: Optional[jax.Array] = None
@@ -110,8 +117,18 @@ class FusedTreeLearner(SerialTreeLearner):
                      row_mask: Optional[jax.Array] = None) -> DeviceTree:
         fmask = self._feature_mask()
         mask = row_mask if row_mask is not None else jnp.ones(1, dtype=bool)
+        if self.quant:
+            from ..ops.hist_pallas import quantize_gradients
+            self._qkey, sub = jax.random.split(self._qkey)
+            gq, hq, gs, hs = quantize_gradients(
+                grad, hess, sub, self.config.num_grad_quant_bins,
+                self.config.stochastic_rounding)
+        else:
+            gq = hq = jnp.zeros(1, jnp.int8)
+            gs = hs = jnp.float32(1.0)
         rec = self._train_jit(grad, hess, mask, fmask, self.hx_rows,
-                              self.x_cols, has_mask=row_mask is not None)
+                              self.x_cols, gq, hq, gs, hs,
+                              has_mask=row_mask is not None)
         self.last_row_leaf = rec.row_leaf
         return rec
 
@@ -166,7 +183,7 @@ class FusedTreeLearner(SerialTreeLearner):
     # the fused program
     # ------------------------------------------------------------------
     def _train_tree_impl(self, grad, hess, row_mask, fmask, x_rows, x_cols,
-                         *, has_mask: bool):
+                         gq, hq, gs, hs, *, has_mask: bool):
         """One whole tree as a single XLA program.
 
         Design notes for the ``fori_loop`` body (the per-split step):
@@ -208,8 +225,10 @@ class FusedTreeLearner(SerialTreeLearner):
         mono_arr = self.mono_arr
         lane = jnp.arange(W, dtype=jnp.int32)
         bin_iota = jnp.arange(Bb, dtype=x_rows.dtype)
+        quant = self.quant
         # grad+hess interleaved so one random gather fetches both channels
-        gh2 = jnp.stack([grad, hess], axis=1)           # [N, 2]
+        gh2 = (jnp.zeros((1, 2), jnp.float32) if quant
+               else jnp.stack([grad, hess], axis=1))    # [N, 2]
 
         def perm_slice(perm, start):
             """Contiguous W-row window of the (N+W padded) permutation —
@@ -223,6 +242,21 @@ class FusedTreeLearner(SerialTreeLearner):
             if has_mask:
                 valid = valid & row_mask[rows]
             bins = x_rows[rows]                         # [W, C]
+            if quant:
+                qscale = jnp.stack([gs, hs, jnp.float32(1.0)])
+                if self.hist_impl == "pallas":
+                    from ..ops.hist_pallas import hist_pallas_q, pack_ghq8
+                    live = jnp.clip(count - c * W, 0, W)
+                    ghq = pack_ghq8(gq[rows], hq[rows], valid)
+                    hist_i = hist_pallas_q(bins, ghq, Bb, live)
+                    return acc + hist_i.astype(jnp.float32) * qscale
+                g = jnp.where(valid, gq[rows].astype(jnp.float32) * gs, 0.0)
+                h = jnp.where(valid, hq[rows].astype(jnp.float32) * hs, 0.0)
+                gh = jnp.stack([g, h, valid.astype(jnp.float32)], axis=1)
+                onehot = (bins[:, :, None] == bin_iota).astype(jnp.bfloat16)
+                part = gh_contract(gh, onehot.reshape(W, C * Bb),
+                                   self.hist_precision)
+                return acc + part.reshape(HIST_C, C, Bb).transpose(1, 2, 0)
             ghr = gh2[rows]                             # [W, 2]
             if self.hist_impl == "pallas":
                 from ..ops.hist_pallas import hist_pallas, pack_gh8
@@ -495,6 +529,17 @@ class FusedTreeLearner(SerialTreeLearner):
         node_i = state["node_i"]
         leaf_f = state["leaf_f"]
         leaf_i = state["leaf_i"]
+        leaf_value_out = leaf_f[:L, 3]
+        if quant and cfg.quant_train_renew_leaf:
+            # re-fit leaf outputs with the full-precision gradient sums
+            # (reference: GradientDiscretizer::RenewIntGradTreeOutput)
+            gsum = jax.ops.segment_sum(grad, row_leaf, num_segments=L)
+            hsum = jax.ops.segment_sum(hess, row_leaf, num_segments=L)
+            parent_out = node_f[jnp.clip(leaf_i[:L, 3], 0, NODES - 1), 1]
+            renewed = calculate_leaf_output(gsum, hsum, p, leaf_f[:L, 2],
+                                            parent_out)
+            active = jnp.arange(L, dtype=jnp.int32) < state["num_leaves"]
+            leaf_value_out = jnp.where(active, renewed, leaf_value_out)
         return DeviceTree(
             node_feature=node_i[:NODES, 0],
             node_threshold=node_i[:NODES, 1],
@@ -507,7 +552,7 @@ class FusedTreeLearner(SerialTreeLearner):
             node_value=node_f[:NODES, 1],
             node_weight=node_f[:NODES, 2],
             node_count=node_f[:NODES, 3],
-            leaf_value=leaf_f[:L, 3],
+            leaf_value=leaf_value_out,
             leaf_weight=leaf_f[:L, 1],
             leaf_count=leaf_f[:L, 2],
             leaf_depth=leaf_i[:L, 2],

@@ -151,12 +151,16 @@ def save_model_to_string(booster, start_iteration: int = 0,
     return body
 
 
-def feature_importance(booster, importance_type: int = 0) -> np.ndarray:
-    """0 = split counts, 1 = total gains
+def feature_importance(booster, importance_type: int = 0,
+                       start: int = 0, end: int = -1) -> np.ndarray:
+    """0 = split counts, 1 = total gains, over trees [start, end)
     (reference: GBDT::FeatureImportance, gbdt.cpp)."""
     n = len(booster.feature_names)
     imp = np.zeros(n, dtype=np.float64)
-    for tree in booster.host_models:
+    models = booster.host_models
+    if end < 0:
+        end = len(models)
+    for tree in models[start:end]:
         for i in range(tree.num_internal):
             f = tree.split_feature[i]
             if importance_type == 0:
@@ -341,7 +345,7 @@ def dump_model(booster, start_iteration: int = 0,
             "tree_structure": _node_to_dict(
                 t, 0 if t.num_internal > 0 else ~0),
         })
-    imp = feature_importance(booster)
+    imp = feature_importance(booster, start=start_iteration * K, end=num_used)
     return {
         "name": "tree",
         "version": MODEL_VERSION,

@@ -6,8 +6,14 @@ src/boosting/bagging.hpp:14, src/boosting/goss.hpp:18.)
 TPU design: instead of compacting a ``bag_data_indices`` array (the
 reference's subset path), sampling produces a boolean in-bag mask [N] on
 device. Out-of-bag rows keep flowing through the partition with zeroed
-grad/hess and are excluded from histogram counts via the mask — index
-compaction would fight XLA's static shapes for no bandwidth win.
+grad/hess and are excluded from histogram counts via the mask.
+
+Measured negative result (round 2, 500k rows x 255 leaves on one chip):
+compacting the permutation to in-bag rows and assigning out-of-bag leaves
+with one end-of-tree traversal was 2.4x SLOWER (570ms vs 242ms/iter at
+bagging_fraction=0.3) — the traversal costs N x max_depth while keeping
+OOB rows in the partition costs N x avg_depth, and leaf-wise max depth is
+far above the average. Don't re-attempt without changing that calculus.
 """
 from __future__ import annotations
 

@@ -135,3 +135,115 @@ def pack_gh8(grad: jax.Array, hess: jax.Array, valid: jax.Array) -> jax.Array:
     cnt = valid.astype(jnp.bfloat16)
     zero = jnp.zeros_like(cnt)
     return jnp.stack([g_hi, g_lo, h_hi, h_lo, cnt, zero, zero, zero], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# quantized-gradient path: int8 one-hot matmul with exact int32 accumulation
+# (reference: src/treelearner/gradient_discretizer.hpp + the 16/32-bit
+# integer histogram variants of feature_histogram.hpp)
+#
+# Measured (round 2, 500k rows x 255 leaves, one throttled chip): AUC parity
+# with fp32 at qb=64, per-iter 233ms vs 216ms fp32 — the discretize pass
+# costs more than the int8 matmul saves while per-split fixed costs
+# dominate. Expected to win once histogram FLOPs are the bottleneck
+# (larger N/F or full-speed MXU).
+# ---------------------------------------------------------------------------
+
+def _hist_kernel_q(count_ref, bins_ref, gh_ref, out_ref, *, num_bins: int,
+                   fblk: int, blk: int):
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(r * blk < count_ref[0])
+    def _():
+        bins = bins_ref[:].astype(jnp.int32)                # [BLK, FBLK]
+        gh = gh_ref[:]                                      # [BLK, 8] int8
+        iota_b = lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
+        B = num_bins
+        for f in range(fblk):
+            onehot = (bins[:, f:f + 1] == iota_b).astype(jnp.int8)
+            out_ref[:, f * B:(f + 1) * B] += lax.dot_general(
+                gh, onehot,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)           # [8, B] i32
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins",))
+def hist_pallas_q(bins: jax.Array, ghq8: jax.Array, num_bins: int,
+                  count=None) -> jax.Array:
+    """Quantized histogram: int8 channels, exact int32 accumulation.
+
+    ghq8: int8 [P, 8] — (g_q, h_q, in_bag, 0...), see :func:`pack_ghq8`.
+    Returns int32 [F, B, 3] (sum_gq, sum_hq, count).
+    """
+    P, F = bins.shape
+    B = num_bins
+    blk, fblk = _pick_blocks(F, B, P)
+    if P % blk != 0:
+        pad = blk - P % blk
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        ghq8 = jnp.pad(ghq8, ((0, pad), (0, 0)))
+        P += pad
+    Fp = ((F + fblk - 1) // fblk) * fblk
+    if Fp != F:
+        bins = jnp.pad(bins, ((0, 0), (0, Fp - F)))
+    count = jnp.asarray([P if count is None else count], jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Fp // fblk, P // blk),
+        in_specs=[
+            pl.BlockSpec((blk, fblk), lambda f, r, c: (r, f),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((blk, 8), lambda f, r, c: (r, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, fblk * B), lambda f, r, c: (0, f),
+                               memory_space=pltpu.VMEM),
+    )
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_q, num_bins=B, fblk=fblk, blk=blk),
+        out_shape=jax.ShapeDtypeStruct((8, Fp * B), jnp.int32),
+        grid_spec=grid_spec,
+    )(count, bins, ghq8)
+    out = out.reshape(8, Fp, B)[:, :F]
+    return jnp.stack([out[0], out[1], out[2]], axis=-1)     # [F, B, 3] i32
+
+
+def pack_ghq8(gq: jax.Array, hq: jax.Array, valid: jax.Array) -> jax.Array:
+    """Channel packing for :func:`hist_pallas_q` (int8 quantized grads)."""
+    v8 = valid.astype(jnp.int8)
+    g = gq.astype(jnp.int8) * v8
+    h = hq.astype(jnp.int8) * v8
+    zero = jnp.zeros_like(v8)
+    return jnp.stack([g, h, v8, zero, zero, zero, zero, zero], axis=1)
+
+
+def quantize_gradients(grad: jax.Array, hess: jax.Array, key,
+                       num_bins: int, stochastic: bool = True):
+    """Discretize grad/hess to signed int8 levels with stochastic rounding
+    (reference: GradientDiscretizer::DiscretizeGradients,
+    src/treelearner/gradient_discretizer.cpp). Returns
+    (g_q i8, h_q i8, g_scale, h_scale)."""
+    qb = max(2, min(num_bins, 127))   # int8 hessian levels reach qb
+    half = max(qb // 2, 1)
+    gmax = jnp.maximum(jnp.max(jnp.abs(grad)), 1e-12)
+    hmax = jnp.maximum(jnp.max(hess), 1e-12)
+    gs = gmax / half
+    hs = hmax / qb
+    g = grad / gs
+    h = hess / hs
+    if stochastic:
+        import jax.random as jrandom
+        k1, k2 = jrandom.split(key)
+        g = jnp.floor(g + jrandom.uniform(k1, g.shape))
+        h = jnp.floor(h + jrandom.uniform(k2, h.shape))
+    else:
+        g = jnp.round(g)
+        h = jnp.round(h)
+    gq = jnp.clip(g, -127, 127).astype(jnp.int8)
+    hq = jnp.clip(h, 0, 127).astype(jnp.int8)
+    return gq, hq, gs, hs
