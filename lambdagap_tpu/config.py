@@ -510,14 +510,11 @@ class Config:
 
 def _parse_interaction_constraints(val: Any) -> List[List[int]]:
     if isinstance(val, str):
-        out: List[List[int]] = []
-        buf = val.strip()
-        # format like "[0,1,2],[2,3]"
-        for part in buf.replace("][", "]|[").strip("[]").split("]|["):
-            part = part.strip("[] ")
-            if part:
-                out.append([int(x) for x in part.split(",")])
-        return out
+        import re
+        # CLI format like "[0,1,2],[2,3]" (reference: config.cpp
+        # Str2FeatureInteractionVector)
+        return [[int(x) for x in grp.split(",") if x.strip()]
+                for grp in re.findall(r"\[([^\]]*)\]", val)]
     if isinstance(val, (list, tuple)):
         return [[int(x) for x in g] for g in val]
     return []

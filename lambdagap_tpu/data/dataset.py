@@ -172,6 +172,16 @@ class BinnedDataset:
                       else np.sort(rng.choice(n, sample_cnt, replace=False)))
         sample = data[sample_idx]
 
+        # forced bin boundaries (reference: DatasetLoader
+        # forced_bin_bounds_, examples/regression/forced_bins.json)
+        forced: Dict[int, List[float]] = {}
+        if config.forcedbins_filename:
+            import json
+            with open(config.forcedbins_filename) as f:
+                for entry in json.load(f):
+                    forced[int(entry["feature"])] = \
+                        [float(v) for v in entry["bin_upper_bound"]]
+
         self.mappers = []
         self.used_features = []
         self.feature_num_bins = []
@@ -187,7 +197,8 @@ class BinnedDataset:
                 min_data_in_bin=config.min_data_in_bin,
                 bin_type=bin_type,
                 use_missing=config.use_missing,
-                zero_as_missing=config.zero_as_missing)
+                zero_as_missing=config.zero_as_missing,
+                forced_bounds=forced.get(j, ()))
             self.mappers.append(mapper)
             if not mapper.is_trivial:
                 self.used_features.append(j)

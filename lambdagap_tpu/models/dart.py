@@ -237,8 +237,24 @@ class RF(GBDT):
                 tree.leaf_value[leaf] = self.objective.renew_tree_output(
                     rows, const_score)
 
+def _warn_unsupported(config: Config) -> None:
+    """Loudly flag accepted-but-unimplemented parameters — a silently
+    ignored option is worse than a missing one (the reference fails fast
+    on unsupported combinations)."""
+    if config.linear_tree:
+        log.warning("linear_tree=true is NOT implemented; training plain "
+                    "constant-leaf trees")
+    if config.forcedsplits_filename:
+        log.warning("forcedsplits_filename is NOT implemented and will be "
+                    "ignored (forcedbins_filename IS supported)")
+    if config.monotone_penalty > 0:
+        log.warning("monotone_penalty is NOT implemented; constraints are "
+                    "enforced without the split-depth penalty")
+
+
 def create_boosting(config: Config, train_set) -> GBDT:
     """(reference: Boosting::CreateBoosting, src/boosting/boosting.cpp:34)"""
+    _warn_unsupported(config)
     if config.boosting == "dart":
         return DART(config, train_set)
     if config.boosting == "rf":

@@ -131,9 +131,31 @@ class GBDT:
         SerialTreeLearner remains for debugging / explicit opt-out."""
         tl = self.config.tree_learner
         if tl == "serial":
-            mode = self.config.tpu_fused_learner
+            cfg = self.config
+            mode = cfg.tpu_fused_learner
             use_fused = (jax.default_backend() != "cpu" if mode == "auto"
                          else mode in ("1", "true", "on", "yes", True))
+            # niche tree options live on the host-orchestrated learner (the
+            # same shape as the reference's CUDA learner deferring
+            # unsupported combos to the CPU path)
+            host_only = []
+            if cfg.interaction_constraints:
+                host_only.append("interaction_constraints")
+            if cfg.feature_fraction_bynode < 1.0:
+                host_only.append("feature_fraction_bynode")
+            if cfg.cegb_tradeoff > 0 and (
+                    cfg.cegb_penalty_split > 0
+                    or cfg.cegb_penalty_feature_coupled
+                    or cfg.cegb_penalty_feature_lazy):
+                host_only.append("cegb")
+            if use_fused and host_only:
+                log.info("Using the host-driven serial learner for: %s",
+                         ", ".join(host_only))
+                use_fused = False
+            if cfg.use_quantized_grad and not use_fused:
+                log.warning("use_quantized_grad is only implemented by the "
+                            "fused device learner; training runs in full "
+                            "precision")
             if use_fused:
                 from .fused_learner import FusedTreeLearner
                 return FusedTreeLearner(ds, self.config)
@@ -488,8 +510,19 @@ class GBDT:
         trees = [self._tree(i) for i in idx]
         forest, depth = forest_to_arrays(trees, use_inner_feature=False)
         tree_class = jnp.asarray([i % K for i in idx], jnp.int32)
+        # margin-based prediction early stop, classification only
+        # (reference: src/boosting/prediction_early_stop.cpp)
+        # freq counts boosting iterations; trees are iter-major, so the
+        # per-tree check interval is freq*K (keeps checks on iteration
+        # boundaries — all classes equally updated)
+        es_freq = (self.config.pred_early_stop_freq * K
+                   if self.config.pred_early_stop and self.objective is not None
+                   and self.objective.name in ("binary", "multiclass",
+                                               "multiclassova") else 0)
         out = predict_forest(jnp.asarray(data), forest, tree_class, K, depth,
-                             binned=False)
+                             binned=False, early_stop_freq=es_freq,
+                             early_stop_margin=float(
+                                 self.config.pred_early_stop_margin))
         res = np.asarray(jax.device_get(out))
         if self.average_output:
             n_iters = max(1, len(idx) // max(K, 1))

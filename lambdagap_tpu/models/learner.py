@@ -115,6 +115,31 @@ class SerialTreeLearner:
         self.mono_arr = jnp.asarray(mono)
         self.mono_on = bool((mono != 0).any())
 
+        # CEGB (reference: src/treelearner/cost_effective_gradient_boosting.hpp)
+        c = config
+        self.cegb_on = c.cegb_tradeoff > 0 and (
+            c.cegb_penalty_split > 0 or len(c.cegb_penalty_feature_coupled) > 0)
+        if c.cegb_penalty_feature_lazy:
+            log.warning("cegb_penalty_feature_lazy (per-datum on-demand "
+                        "costs) is not supported; the coupled penalty and "
+                        "split penalty are applied")
+        coupled = np.zeros(self.num_features, dtype=np.float32)
+        for k, j in enumerate(dataset.used_features):
+            if j < len(c.cegb_penalty_feature_coupled):
+                coupled[k] = c.cegb_penalty_feature_coupled[j]
+        self._cegb_coupled = jnp.asarray(c.cegb_tradeoff * coupled)
+        self._cegb_split_pen = float(c.cegb_tradeoff * c.cegb_penalty_split)
+        self._cegb_used = np.zeros(self.num_features, dtype=bool)
+
+        # interaction constraints (reference: src/treelearner/col_sampler.hpp
+        # interaction-set filtering): groups of ORIGINAL feature indices
+        self.ic_groups = None
+        if c.interaction_constraints:
+            inner_of = {j: k for k, j in enumerate(dataset.used_features)}
+            self.ic_groups = [frozenset(inner_of[j] for j in g
+                                        if j in inner_of)
+                              for g in c.interaction_constraints]
+
         # outputs of the last Train call, used for the O(1)-per-row score update
         self.last_perm: Optional[jax.Array] = None
         self.last_leaf_begin: Optional[np.ndarray] = None
@@ -150,17 +175,45 @@ class SerialTreeLearner:
         mask[chosen] = True
         return jnp.asarray(mask)
 
+    def _node_fmask(self, fmask, path_feats):
+        """Per-node feature availability: interaction-constraint filtering +
+        by-node column sampling (reference: col_sampler.hpp
+        GetByNode / interaction sets)."""
+        frac = self.config.feature_fraction_bynode
+        if self.ic_groups is None and frac >= 1.0:
+            return fmask
+        m = np.asarray(jax.device_get(fmask)).copy()
+        if self.ic_groups is not None:
+            allowed = np.zeros(self.num_features, dtype=bool)
+            for g in self.ic_groups:
+                if path_feats <= g:
+                    allowed[list(g)] = True
+            m &= allowed
+        if frac < 1.0 and m.any():
+            avail = np.nonzero(m)[0]
+            k = max(1, int(np.ceil(frac * len(avail))))
+            keep = self._col_rng.choice(avail, k, replace=False)
+            m[:] = False
+            m[keep] = True
+        return jnp.asarray(m)
+
     def _best(self, hist, pg, ph, pc, parent_output, fmask,
-              bounds=None) -> _HostSplit:
+              bounds=None, path_feats=frozenset()) -> _HostSplit:
         cons = None
         if self.mono_on:
             lo, hi = bounds if bounds is not None else (-np.inf, np.inf)
             cons = (self.mono_arr, jnp.float32(lo), jnp.float32(hi))
+        pen = None
+        if self.cegb_on:
+            pen = (self._cegb_split_pen * pc
+                   + self._cegb_coupled * jnp.asarray(~self._cegb_used))
         res = find_best_split(
             hist, pg, ph, pc, parent_output,
             self.num_bins_arr, self.default_bins_arr, self.missing_types_arr,
-            self.is_categorical_arr, fmask, self.params,
-            has_categorical=self.has_categorical, constraints=cons)
+            self.is_categorical_arr,
+            self._node_fmask(fmask, path_feats), self.params,
+            has_categorical=self.has_categorical, constraints=cons,
+            gain_penalty=pen)
         return _HostSplit(jax.device_get(res))
 
     # histogram hook points (overridden by the distributed learners) --------
@@ -218,9 +271,10 @@ class SerialTreeLearner:
         hists: Dict[int, jax.Array] = {0: hist_root}
         sums: Dict[int, tuple] = {0: (totals[0], totals[1], totals[2], root_out)}
         bounds: Dict[int, tuple] = {0: (-np.inf, np.inf)}
+        paths: Dict[int, frozenset] = {0: frozenset()}
         best: Dict[int, _HostSplit] = {
             0: self._best(hist_root, totals[0], totals[1], totals[2], root_out,
-                          fmask, bounds[0])}
+                          fmask, bounds[0], paths[0])}
 
         tree.leaf_value[0] = float(jax.device_get(root_out))
         tree.leaf_weight[0] = float(jax.device_get(totals[1]))
@@ -300,6 +354,11 @@ class SerialTreeLearner:
                     rhi = min(phi, mid)
             bounds[leaf] = (llo, lhi)
             bounds[right_leaf] = (rlo, rhi)
+            child_path = paths.pop(leaf, frozenset()) | {feat}
+            paths[leaf] = child_path
+            paths[right_leaf] = child_path
+            if self.cegb_on:
+                self._cegb_used[feat] = True
 
             if tree.num_leaves >= num_leaves:
                 break  # no more splits: skip children histograms
@@ -321,9 +380,11 @@ class SerialTreeLearner:
             hists[small_leaf] = hist_small
             hists[large_leaf] = hist_large
             best[small_leaf] = self._best(hist_small, *s_sums, fmask,
-                                          bounds[small_leaf])
+                                          bounds[small_leaf],
+                                          paths[small_leaf])
             best[large_leaf] = self._best(hist_large, *g_sums, fmask,
-                                          bounds[large_leaf])
+                                          bounds[large_leaf],
+                                          paths[large_leaf])
             sums[small_leaf] = s_sums
             sums[large_leaf] = g_sums
 

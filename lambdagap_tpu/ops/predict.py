@@ -225,14 +225,22 @@ def predict_leaf_index_binned(x_binned: jax.Array, t: TreeArrays,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("num_class", "max_depth", "binned"))
+                   static_argnames=("num_class", "max_depth", "binned",
+                                    "early_stop_freq"))
 def predict_forest(x: jax.Array, forest: TreeArrays, tree_class: jax.Array,
-                   num_class: int, max_depth: int, binned: bool) -> jax.Array:
+                   num_class: int, max_depth: int, binned: bool,
+                   early_stop_freq: int = 0,
+                   early_stop_margin: float = 0.0) -> jax.Array:
     """Sum a whole forest's leaf values into per-class scores in one dispatch.
 
     x: [N, D] raw floats (binned=False) or [N, F] binned (binned=True).
     forest: TreeArrays stacked along a leading T axis (forest_to_arrays).
     tree_class: i32 [T] — class index of each tree (iter-major, class-minor).
+    early_stop_freq/margin: margin-based prediction early stopping — every
+    ``freq`` trees, rows whose decision margin exceeds ``margin`` stop
+    accumulating further trees (reference:
+    src/boosting/prediction_early_stop.cpp; binary margin = |score|,
+    multiclass = top1 - top2).
     Returns [num_class, N] float32.
 
     A ``lax.scan`` over trees keeps peak memory at O(N) instead of the
@@ -242,13 +250,38 @@ def predict_forest(x: jax.Array, forest: TreeArrays, tree_class: jax.Array,
     """
     N = x.shape[0]
 
-    def step(out, tk):
+    if early_stop_freq <= 0:
+        def step(out, tk):
+            t, k = tk
+            vals = t.leaf_value[_traverse_leaf_id(x, t, max_depth, binned)]
+            return out.at[k].add(vals), None
+
+        out, _ = lax.scan(step, jnp.zeros((num_class, N), jnp.float32),
+                          (forest, tree_class))
+        return out
+
+    def margin_of(out):
+        if num_class == 1:
+            # reference binary margin is 2*|raw score|
+            # (src/boosting/prediction_early_stop.cpp)
+            return 2.0 * jnp.abs(out[0])
+        top2 = lax.top_k(out.T, 2)[0]          # [N, 2]
+        return top2[:, 0] - top2[:, 1]
+
+    def step(carry, tk):
+        out, stopped, i = carry
         t, k = tk
         vals = t.leaf_value[_traverse_leaf_id(x, t, max_depth, binned)]
-        return out.at[k].add(vals), None
+        out = out.at[k].add(jnp.where(stopped, 0.0, vals))
+        i = i + 1
+        check = (i % early_stop_freq) == 0
+        stopped = jnp.where(check, stopped | (margin_of(out)
+                                              > early_stop_margin), stopped)
+        return (out, stopped, i), None
 
-    out, _ = lax.scan(step, jnp.zeros((num_class, N), jnp.float32),
-                      (forest, tree_class))
+    init = (jnp.zeros((num_class, N), jnp.float32),
+            jnp.zeros(N, dtype=bool), jnp.int32(0))
+    (out, _, _), _ = lax.scan(step, init, (forest, tree_class))
     return out
 
 

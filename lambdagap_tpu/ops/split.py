@@ -338,7 +338,8 @@ def _bins_to_bitset(member: jax.Array) -> jax.Array:
 def per_feature_best(hist: jax.Array, parent_g, parent_h, parent_c,
                      parent_output, num_bins, default_bins, missing_types,
                      is_categorical, feature_mask, params: SplitParams,
-                     has_categorical: bool = False, constraints=None):
+                     has_categorical: bool = False, constraints=None,
+                     gain_penalty=None):
     """Per-feature best split candidates (the per-feature stage of
     ``FindBestSplitsFromHistograms``), used directly by the voting-parallel
     learner's local top-k vote (reference:
@@ -362,6 +363,10 @@ def per_feature_best(hist: jax.Array, parent_g, parent_h, parent_c,
 
     use_cat = is_categorical
     gain = jnp.where(use_cat, cat_gain, num_gain)
+    if gain_penalty is not None:
+        # CEGB: per-feature gain penalty (reference:
+        # src/treelearner/cost_effective_gradient_boosting.hpp:23 DetlaGain)
+        gain = jnp.where(jnp.isfinite(gain), gain - gain_penalty, gain)
     thr = jnp.where(use_cat, cat_t, num_t)
     dl = jnp.where(use_cat, False, num_dl)
     lg = jnp.where(use_cat, cat_lg, num_lg)
@@ -377,7 +382,7 @@ def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
                     missing_types: jax.Array, is_categorical: jax.Array,
                     feature_mask: jax.Array, params: SplitParams,
                     has_categorical: bool = False,
-                    constraints=None) -> SplitResult:
+                    constraints=None, gain_penalty=None) -> SplitResult:
     """Best split for one leaf over all features.
 
     The analog of ``FindBestSplitsFromHistograms`` + per-leaf argmax
@@ -388,7 +393,7 @@ def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
     gain, thr, dl, lg, lh, lc, cat_bits = per_feature_best(
         hist, parent_g, parent_h, parent_c, parent_output, num_bins,
         default_bins, missing_types, is_categorical, feature_mask, params,
-        has_categorical, constraints)
+        has_categorical, constraints, gain_penalty)
 
     # parent gain shift (reference: BeforeNumerical gain_shift + min_gain_to_split)
     parent_gain = leaf_gain(parent_g, parent_h, p, parent_c, parent_output)

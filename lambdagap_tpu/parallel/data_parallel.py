@@ -53,6 +53,13 @@ class DataParallelTreeLearner(SerialTreeLearner):
                         "per-split (direction veto); inherited leaf bounds "
                         "are not propagated — use the serial/fused learner "
                         "for strict monotonicity", config.tree_learner)
+        if config.interaction_constraints:
+            log.fatal("interaction_constraints are not supported with "
+                      "tree_learner=%s; use the serial learner",
+                      config.tree_learner)
+        if self.cegb_on or config.feature_fraction_bynode < 1.0:
+            log.warning("cegb/feature_fraction_bynode are not applied by "
+                        "tree_learner=%s", config.tree_learner)
         self.mesh = mesh if mesh is not None else make_mesh(config.tpu_num_devices)
         self.n_dev = int(self.mesh.devices.size)
 

@@ -1,0 +1,118 @@
+"""CEGB, interaction constraints, per-node feature sampling, prediction
+early stop.
+
+(reference: src/treelearner/cost_effective_gradient_boosting.hpp;
+src/treelearner/col_sampler.hpp; src/boosting/prediction_early_stop.cpp;
+test models: tests/python_package_test/test_basic.py:407 CEGB cases,
+test_engine.py interaction_constraints cases)
+"""
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+
+
+def _data(n=1200, d=6, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d)
+    w = np.asarray([1.0, 0.9, 0.8, 0.7, 0.6, 0.5])[:d]
+    y = X @ w + 0.4 * X[:, 2] * X[:, 3] + 0.1 * rng.randn(n)
+    return X, y
+
+
+BASE = {"objective": "regression", "num_leaves": 15, "min_data_in_leaf": 10,
+        "learning_rate": 0.1, "verbose": -1}
+
+
+def _used_features(b):
+    return {f for t in b._booster.host_models
+            for f in t.split_feature[:t.num_internal]}
+
+
+def test_cegb_coupled_penalty_limits_features():
+    X, y = _data()
+    plain = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=10)
+    assert len(_used_features(plain)) >= 4
+    # huge coupled penalty on all but features 0/1: model should avoid them
+    pen = [0.0, 0.0] + [1e6] * 4
+    b = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                   "cegb_penalty_feature_coupled": pen},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    assert _used_features(b) <= {0, 1}
+
+
+def test_cegb_split_penalty_reduces_splits():
+    X, y = _data()
+    plain = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=5)
+    b = lgb.train({**BASE, "cegb_tradeoff": 1.0, "cegb_penalty_split": 10.0},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    n_plain = sum(t.num_internal for t in plain._booster.host_models)
+    n_pen = sum(t.num_internal for t in b._booster.host_models)
+    assert n_pen < n_plain
+
+
+def test_interaction_constraints_respected():
+    X, y = _data()
+    b = lgb.train({**BASE, "interaction_constraints": [[0, 1], [2, 3, 4, 5]]},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    # every root->leaf path must stay within one constraint group
+    groups = [frozenset([0, 1]), frozenset([2, 3, 4, 5])]
+    for t in b._booster.host_models:
+        def walk(node, path):
+            if node < 0:
+                if path:
+                    assert any(path <= g for g in groups), path
+                return
+            p2 = path | {t.split_feature[node]}
+            walk(t.left_child[node], p2)
+            walk(t.right_child[node], p2)
+        if t.num_internal:
+            walk(0, frozenset())
+
+
+def test_feature_fraction_bynode_trains():
+    X, y = _data()
+    b = lgb.train({**BASE, "feature_fraction_bynode": 0.5},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    resid = y - b.predict(X)
+    assert np.var(resid) < 0.5 * np.var(y)
+    # different nodes see different feature subsets -> more diverse features
+    assert len(_used_features(b)) >= 3
+
+
+def test_pred_early_stop_binary():
+    rng = np.random.RandomState(0)
+    X = rng.randn(800, 5)
+    y = (X[:, 0] > 0).astype(float)          # easily separable
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=40)
+    full = b.predict(X, raw_score=True)
+    b._booster.config.pred_early_stop = True
+    b._booster.config.pred_early_stop_freq = 5
+    b._booster.config.pred_early_stop_margin = 2.0
+    es = b.predict(X, raw_score=True)
+    # confident rows froze early: their |score| is capped near the margin
+    changed = np.abs(es) < np.abs(full)
+    assert changed.any()
+    # decisions unchanged for confidently classified rows
+    assert ((es > 0) == (full > 0))[np.abs(full) > 2.5].all()
+    # with an infinite margin the result is identical
+    b._booster.config.pred_early_stop_margin = 1e30
+    np.testing.assert_allclose(b.predict(X, raw_score=True), full, rtol=1e-6)
+
+
+def test_forced_bins(tmp_path):
+    import json
+    fb = str(tmp_path / "forced.json")
+    with open(fb, "w") as f:
+        json.dump([{"feature": 0, "bin_upper_bound": [0.3, 0.35, 0.4]}], f)
+    rng = np.random.RandomState(1)
+    X = rng.rand(1000, 3)
+    y = (X[:, 0] > 0.35).astype(float) + 0.01 * rng.randn(1000)
+    from lambdagap_tpu.config import Config
+    from lambdagap_tpu.data.dataset import BinnedDataset
+    cfg = Config.from_params({"max_bin": 16, "forcedbins_filename": fb})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    bounds = ds.mappers[0].bin_upper_bound
+    for b in (0.3, 0.35, 0.4):
+        assert any(abs(x - b) < 1e-9 for x in bounds), (b, bounds)
