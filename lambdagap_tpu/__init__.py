@@ -6,7 +6,7 @@ extended LambdaGap ranking objective family, running its compute core as
 XLA/Pallas programs on TPU and its distributed learners over
 ``jax.sharding`` meshes.
 """
-from .basic import Booster, Dataset
+from .basic import Booster, Dataset, Sequence
 from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
 from .config import Config
 from .data import BinnedDataset, Metadata
@@ -16,7 +16,16 @@ from .utils.log import register_logger
 
 __version__ = "0.1.0"
 
-__all__ = ["Booster", "Dataset", "Config", "BinnedDataset", "Metadata",
-           "GBDT", "Tree", "train", "cv", "CVBooster",
+__all__ = ["Booster", "Dataset", "Sequence", "Config", "BinnedDataset",
+           "Metadata", "GBDT", "Tree", "train", "cv", "CVBooster",
            "early_stopping", "log_evaluation", "record_evaluation",
            "reset_parameter", "register_logger", "__version__"]
+
+try:  # matplotlib/graphviz are optional
+    from .plotting import (create_tree_digraph, plot_importance, plot_metric,
+                           plot_split_value_histogram, plot_tree)
+    __all__ += ["plot_importance", "plot_metric",
+                "plot_split_value_histogram", "plot_tree",
+                "create_tree_digraph"]
+except ImportError:  # pragma: no cover
+    pass

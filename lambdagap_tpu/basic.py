@@ -7,7 +7,7 @@ framework's own classes directly.)
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+from typing import Any, Dict, Iterable, List, Optional, Union
 
 import numpy as np
 
@@ -21,6 +21,25 @@ try:  # pandas optional
     _PANDAS = True
 except ImportError:  # pragma: no cover
     _PANDAS = False
+
+
+class Sequence:
+    """Generic data access interface for streaming Dataset construction
+    (reference: basic.py:903 lightgbm.Sequence + the C-API streaming push,
+    include/LightGBM/dataset.h:593 PushOneRow).
+
+    Subclass with ``__len__`` and ``__getitem__`` (row index or slice ->
+    numpy rows); ``batch_size`` controls push granularity. The full float
+    matrix never materializes in memory.
+    """
+
+    batch_size = 4096
+
+    def __getitem__(self, idx):
+        raise NotImplementedError("Sequence.__getitem__")
+
+    def __len__(self):
+        raise NotImplementedError("Sequence.__len__")
 
 
 def _to_matrix(data) -> tuple:
@@ -72,6 +91,28 @@ class Dataset:
         if self._constructed is not None:
             return self._constructed
         cfg = config or Config.from_params(self.params)
+        seqs = None
+        if isinstance(self.data, Sequence):
+            seqs = [self.data]
+        elif (isinstance(self.data, list) and self.data
+              and all(isinstance(s, Sequence) for s in self.data)):
+            seqs = self.data
+        if seqs is not None:
+            cats = (list(self.categorical_feature)
+                    if isinstance(self.categorical_feature, (list, tuple))
+                    else ())
+            names = (list(self.feature_name)
+                     if isinstance(self.feature_name, (list, tuple)) else None)
+            ref = (self.reference.construct(config)
+                   if self.reference is not None else None)
+            self._constructed = BinnedDataset.from_sequences(
+                seqs, cfg, label=self.label, weight=self.weight,
+                group=self.group, init_score=self.init_score,
+                position=self.position, categorical_features=cats,
+                feature_names=names, reference=ref)
+            if self.free_raw_data:
+                self.data = None
+            return self._constructed
         mat, auto_names, cat_from_dtype = _to_matrix(self.data)
         names = None
         if isinstance(self.feature_name, (list, tuple)):
@@ -159,7 +200,7 @@ class Dataset:
         mat, _, _ = _to_matrix(self.data)
         return mat.shape[1]
 
-    def subset(self, used_indices: Sequence[int], params=None) -> "Dataset":
+    def subset(self, used_indices, params=None) -> "Dataset":
         """Row subset sharing this dataset's bin mappers (used by cv)."""
         if self.data is None:
             log.fatal("Cannot subset: raw data freed (set free_raw_data=False)")

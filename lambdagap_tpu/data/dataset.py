@@ -105,6 +105,7 @@ class BinnedDataset:
         self.metadata = Metadata()
         self.feature_names: List[str] = []
         self.max_bin: int = 255
+        self.raw: Optional[np.ndarray] = None   # retained when linear_tree
         self._device_cache: Dict[Any, Any] = {}
 
     # ------------------------------------------------------------------
@@ -146,6 +147,10 @@ class BinnedDataset:
         else:
             ds._find_bins(data, config, set(categorical_features))
         ds._push_data(data)
+        if config.linear_tree:
+            # linear leaves re-fit against raw numeric values
+            # (reference: Dataset raw_data retention under linear_tree)
+            ds.raw = data.astype(np.float32)
 
         md = ds.metadata
         if label is not None:
@@ -158,6 +163,102 @@ class BinnedDataset:
             md.position = np.asarray(position, dtype=np.int32).reshape(-1)
         md.set_group(group)
         md.check(ds.num_data)
+        return ds
+
+    @classmethod
+    def from_sequences(cls, seqs, config: Config,
+                       label=None, weight=None, group=None,
+                       init_score=None, position=None,
+                       categorical_features: Sequence = (),
+                       feature_names=None,
+                       reference: Optional["BinnedDataset"] = None
+                       ) -> "BinnedDataset":
+        """Streaming construction from row-batch readers: bins are found on
+        a row sample, then batches are pushed straight into the uint8
+        matrix — the full float matrix never materializes (the analog of
+        the C-API streaming push path, reference:
+        include/LightGBM/dataset.h:593 PushOneRow / tests/cpp_tests/
+        test_stream.cpp; Python lightgbm.Sequence, basic.py:903)."""
+        lens = [len(s) for s in seqs]
+        total = int(sum(lens))
+        if total == 0:
+            log.fatal("Cannot construct Dataset from empty sequences")
+        probe = np.asarray(seqs[0][0:1], dtype=np.float64)
+        F = probe.shape[1]
+
+        ds = cls()
+        ds.num_data = total
+        ds.num_total_features = F
+        ds.max_bin = config.max_bin
+        ds.feature_names = (list(feature_names) if feature_names
+                            else [f"Column_{i}" for i in range(F)])
+
+        if reference is not None:
+            # validation sequences align to the training bins
+            ds.mappers = reference.mappers
+            ds.used_features = reference.used_features
+            ds.feature_num_bins = reference.feature_num_bins
+            ds.bin_offsets = reference.bin_offsets
+            ds.num_total_bins = reference.num_total_bins
+            ds.feature_names = reference.feature_names
+            ds.max_bin = reference.max_bin
+        else:
+            # sample rows across sequences for bin finding, reading only
+            # batch-bounded contiguous windows
+            sample_cnt = min(config.bin_construct_sample_cnt, total)
+            rng = np.random.RandomState(config.data_random_seed)
+            picks = np.sort(rng.choice(total, sample_cnt, replace=False))
+            sample = np.empty((sample_cnt, F), dtype=np.float64)
+            offset = 0
+            si = 0
+            for s, ln in zip(seqs, lens):
+                bs = max(int(getattr(s, "batch_size", 4096)), 1)
+                in_seq = picks[(picks >= offset) & (picks < offset + ln)]                     - offset
+                i = 0
+                while i < len(in_seq):
+                    j = i
+                    while j + 1 < len(in_seq) and                             in_seq[j + 1] - in_seq[i] < bs:
+                        j += 1
+                    rows = np.asarray(s[int(in_seq[i]):int(in_seq[j]) + 1],
+                                      dtype=np.float64)
+                    sample[si:si + (j - i + 1)] = rows[in_seq[i:j + 1]
+                                                       - in_seq[i]]
+                    si += j - i + 1
+                    i = j + 1
+                offset += ln
+            # _find_bins samples over self.num_data rows of its argument;
+            # the sample matrix IS the sample, so scope num_data to it
+            ds.num_data = sample_cnt
+            ds._find_bins(sample, config, set(categorical_features))
+            ds.num_data = total
+
+        # push batches straight into the binned matrix
+        dtype = np.uint8 if max(ds.feature_num_bins, default=2) <= 256 \
+            else np.uint16
+        binned = np.empty((total, len(ds.used_features)), dtype=dtype)
+        row0 = 0
+        for s, ln in zip(seqs, lens):
+            bs = max(int(getattr(s, "batch_size", 4096)), 1)
+            for lo in range(0, ln, bs):
+                hi = min(lo + bs, ln)
+                mat = np.asarray(s[lo:hi], dtype=np.float64)
+                for k, j in enumerate(ds.used_features):
+                    binned[row0 + lo:row0 + hi, k] = \
+                        ds.mappers[j].values_to_bins(mat[:, j]).astype(dtype)
+            row0 += ln
+        ds.binned = binned
+
+        md = ds.metadata
+        if label is not None:
+            md.label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if weight is not None:
+            md.weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if init_score is not None:
+            md.init_score = np.asarray(init_score, np.float64).reshape(-1)
+        if position is not None:
+            md.position = np.asarray(position, np.int32).reshape(-1)
+        md.set_group(group)
+        md.check(total)
         return ds
 
     def _find_bins(self, data: np.ndarray, config: Config,

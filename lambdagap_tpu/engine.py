@@ -109,6 +109,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if booster.best_iteration < 0:
         for d, m, v, _ in evals if num_boost_round > 0 else []:
             booster.best_score.setdefault(d, {})[m] = v
+    from .utils.timer import _ENABLED as _timing, global_timer
+    if _timing:
+        # the reference prints its USE_TIMETAG table at exit
+        # (include/LightGBM/utils/common.h:1017)
+        log.info("%s", global_timer.report())
     return booster
 
 
@@ -206,12 +211,31 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                                    stratified and cfg.objective in
                                    ("binary", "multiclass", "multiclassova"),
                                    shuffle))
+    elif hasattr(folds, "split"):
+        # sklearn splitter objects (KFold & friends)
+        ds = train_set.construct(cfg)
+        X_idx = np.zeros((ds.num_data, 1))
+        y = (np.asarray(ds.metadata.label)
+             if ds.metadata.label is not None else None)
+        groups = None
+        if ds.metadata.query_boundaries is not None:
+            qb = ds.metadata.query_boundaries
+            groups = np.searchsorted(qb, np.arange(ds.num_data),
+                                     side="right") - 1
+        folds = list(folds.split(X_idx, y, groups))
+
     cvbooster = CVBooster()
     fold_data = []
     for train_rows, test_rows in folds:
         tr = train_set.subset(train_rows)
         te = train_set.subset(test_rows)
         b = Booster(params=params, train_set=tr)
+        if eval_train_metric:
+            b._booster.config.is_provide_training_metric = True
+            from .metrics.base import create_metrics
+            tds = tr.construct(b.config)
+            b._booster.train_metrics = create_metrics(
+                b.config, tds.metadata, tds.num_data)
         b.add_valid(te, "valid")
         fold_data.append(b)
         cvbooster.append(b)
@@ -223,19 +247,31 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
         best_iter = [0]
     else:
         best = best_iter = None
+    first_metric: Optional[str] = None
 
     for i in range(num_boost_round):
         agg: Dict[Tuple[str, str, bool], List[float]] = {}
         for b in fold_data:
             b.update()
-            for (d, m, v, g) in b._booster.eval_valid():
+            evals = list(b._booster.eval_valid())
+            if eval_train_metric:
+                evals.extend(("train", m, v, g)
+                             for (_, m, v, g) in b._booster.eval_train())
+            for (d, m, v, g) in evals:
                 agg.setdefault((d, m, g), []).append(v)
         stop_now = False
+        if first_metric is None:
+            # early stopping tracks the FIRST configured metric on the
+            # validation folds (reference: engine.py cv + _agg_cv_result)
+            for (d, m, g) in agg:
+                if d == "valid":
+                    first_metric = m
+                    break
         for (d, m, g), vals in agg.items():
             mean, std = float(np.mean(vals)), float(np.std(vals))
             results.setdefault(f"{d} {m}-mean", []).append(mean)
             results.setdefault(f"{d} {m}-stdv", []).append(std)
-            if best is not None and m == list(agg)[0][1]:
+            if best is not None and d == "valid" and m == first_metric:
                 score = -mean if g else mean
                 if score < best[0]:
                     best[0] = score

@@ -241,9 +241,9 @@ def _warn_unsupported(config: Config) -> None:
     """Loudly flag accepted-but-unimplemented parameters — a silently
     ignored option is worse than a missing one (the reference fails fast
     on unsupported combinations)."""
-    if config.linear_tree:
-        log.warning("linear_tree=true is NOT implemented; training plain "
-                    "constant-leaf trees")
+    if config.linear_tree and config.boosting != "gbdt":
+        log.warning("linear_tree is only supported with boosting=gbdt; "
+                    "training constant-leaf trees")
     if config.forcedsplits_filename:
         log.warning("forcedsplits_filename is NOT implemented and will be "
                     "ignored (forcedbins_filename IS supported)")

@@ -27,6 +27,7 @@ from ..ops.predict import (_round_depth, forest_to_arrays, predict_forest,
                            predict_forest_leaf, predict_tree_binned,
                            tree_to_arrays)
 from ..utils import log
+from ..utils.timer import global_timer
 from .learner import SerialTreeLearner
 from .sample_strategy import create_sample_strategy
 from .tree import Tree
@@ -100,6 +101,11 @@ class GBDT:
     def _setup_training(self, ds: BinnedDataset) -> None:
         self.num_data = ds.num_data
         if self.objective is not None:
+            if self.config.linear_tree and self.objective.is_renew_tree_output:
+                # (reference: config check "Cannot use regression_l1
+                # objective when fitting linear trees")
+                log.fatal("Cannot use the %s objective with linear_tree",
+                          self.objective.name)
             self.objective.init(ds.metadata, ds.num_data)
         self.learner = self._create_learner(ds)
         self.sample_strategy = create_sample_strategy(
@@ -143,6 +149,8 @@ class GBDT:
                 host_only.append("interaction_constraints")
             if cfg.feature_fraction_bynode < 1.0:
                 host_only.append("feature_fraction_bynode")
+            if cfg.linear_tree:
+                host_only.append("linear_tree")
             if cfg.cegb_tradeoff > 0 and (
                     cfg.cegb_penalty_split > 0
                     or cfg.cegb_penalty_feature_coupled
@@ -160,6 +168,10 @@ class GBDT:
                 from .fused_learner import FusedTreeLearner
                 return FusedTreeLearner(ds, self.config)
             return SerialTreeLearner(ds, self.config)
+        if self.config.linear_tree:
+            log.warning("linear_tree is not supported with tree_learner=%s; "
+                        "training constant-leaf trees", tl)
+            self.config.linear_tree = False
         from ..parallel import (DataParallelTreeLearner,
                                 FeatureParallelTreeLearner,
                                 VotingParallelTreeLearner)
@@ -217,9 +229,12 @@ class GBDT:
                         for vi in range(len(self.valid_scores)):
                             self.valid_scores[vi] = self.valid_scores[vi].at[k].add(init)
                         log.info("Start training from score %f", init)
-            grad, hess = self.boosting()
+            with global_timer.scope("boosting: gradients"):
+                grad, hess = self.boosting()
 
-        grad, hess, mask = self.sample_strategy.sample(self.iter_, grad, hess)
+        with global_timer.scope("boosting: sampling"):
+            grad, hess, mask = self.sample_strategy.sample(self.iter_, grad,
+                                                           hess)
 
         from .fused_learner import FusedTreeLearner
         fast = (isinstance(self.learner, FusedTreeLearner)
@@ -232,9 +247,12 @@ class GBDT:
             # leaves" stop check is skipped to avoid a per-iteration D2H —
             # converged training just appends constant trees.
             for k in range(self.num_tree_per_iteration):
-                rec = self.learner.train_device(grad[k], hess[k], row_mask=mask)
-                lv = rec.leaf_value * self.shrinkage_rate
-                self.scores = self.scores.at[k].add(lv[rec.row_leaf])
+                with global_timer.scope("tree: fused train"):
+                    rec = self.learner.train_device(grad[k], hess[k],
+                                                    row_mask=mask)
+                with global_timer.scope("score: update"):
+                    lv = rec.leaf_value * self.shrinkage_rate
+                    self.scores = self.scores.at[k].add(lv[rec.row_leaf])
                 lazy = _LazyTree(self.learner, rec, self.shrinkage_rate,
                                  init_scores[k])
                 self.models.append(lazy)
@@ -247,9 +265,13 @@ class GBDT:
 
         should_continue = False
         for k in range(self.num_tree_per_iteration):
-            tree = self.learner.train(grad[k], hess[k], row_mask=mask)
+            with global_timer.scope("tree: train"):
+                tree = self.learner.train(grad[k], hess[k], row_mask=mask)
             if tree.num_leaves > 1:
                 should_continue = True
+                if cfg.linear_tree and type(self) is GBDT \
+                        and type(self.learner) is SerialTreeLearner:
+                    self._fit_linear_tree(tree, k, grad[k], hess[k])
                 if self.objective is not None and self.objective.is_renew_tree_output:
                     self._renew_tree_output(tree, k, mask)
                 tree.apply_shrinkage(self.shrinkage_rate)
@@ -279,11 +301,52 @@ class GBDT:
         self.iter_ += 1
         return False
 
+    def _host_leaf_index(self, tree: Tree) -> np.ndarray:
+        """Per-row leaf assignment from the serial learner's partition."""
+        perm = np.asarray(jax.device_get(self.learner.last_perm))
+        begins = self.learner.last_leaf_begin
+        counts = self.learner.last_leaf_count
+        leaf_idx = np.zeros(self.num_data, dtype=np.int32)
+        for leaf in range(tree.num_leaves):
+            b, c = int(begins[leaf]), int(counts[leaf])
+            leaf_idx[perm[b:b + c]] = leaf
+        return leaf_idx
+
+    def _fit_linear_tree(self, tree: Tree, k: int, grad, hess) -> None:
+        """Fit linear leaf models on the raw features of the leaf paths
+        (reference: LinearTreeLearner::CalculateLinear,
+        src/treelearner/linear_tree_learner.cpp)."""
+        from .tree import fit_linear_leaves
+        ds = self.train_set
+        if ds.raw is None:
+            log.warning("linear_tree needs the retained raw matrix; "
+                        "skipping linear fit")
+            return
+        numeric = np.ones(ds.num_total_features, dtype=bool)
+        for j, m in enumerate(ds.mappers):
+            from ..data.binning import BIN_CATEGORICAL
+            if m.bin_type == BIN_CATEGORICAL:
+                numeric[j] = False
+        g = np.asarray(jax.device_get(grad))
+        h = np.asarray(jax.device_get(hess))
+        perm = np.asarray(jax.device_get(self.learner.last_perm))
+        begins = self.learner.last_leaf_begin
+        counts = self.learner.last_leaf_count
+
+        def rows_of(leaf):
+            b, c = int(begins[leaf]), int(counts[leaf])
+            return perm[b:b + c]
+
+        fit_linear_leaves(tree, ds.raw, rows_of, g, h,
+                          self.config.linear_lambda, numeric)
+
     def _tree_add_bias(self, tree: Tree, bias: float, k: int) -> None:
         """Fold the boost-from-average init into the first tree
         (reference: Tree::AddBias via gbdt.cpp:421)."""
         tree.leaf_value[:tree.num_leaves] += bias
         tree.internal_value = [v + bias for v in tree.internal_value]
+        if getattr(tree, "is_linear", False):
+            tree.leaf_const[:tree.num_leaves] += bias
 
     def _tree(self, i: int) -> Tree:
         m = self.models[i]
@@ -297,6 +360,13 @@ class GBDT:
         return [self._tree(i) for i in range(len(self.models))]
 
     def _update_train_score(self, tree: Tree, k: int) -> None:
+        if getattr(tree, "is_linear", False):
+            from .tree import linear_leaf_outputs
+            leaf_idx = self._host_leaf_index(tree)
+            add = linear_leaf_outputs(tree, self.train_set.raw, leaf_idx)
+            self.scores = self.scores.at[k].add(
+                jnp.asarray(add.astype(np.float32)))
+            return
         if getattr(self.learner, "last_row_leaf", None) is not None:
             # fused learner: leaf membership is row_leaf (device)
             lv = jnp.asarray(
@@ -319,6 +389,17 @@ class GBDT:
         x = self.valid_binned[vi]
         arrs = tree_to_arrays(tree, feature_meta=self._meta, use_inner_feature=True)
         depth = _round_depth(tree.max_depth + 1)
+        if getattr(tree, "is_linear", False):
+            from ..ops.predict import predict_leaf_index_binned
+            from .tree import linear_leaf_outputs
+            vraw = self.valid_sets[vi][1].raw
+            if vraw is not None:
+                leaf_idx = np.asarray(jax.device_get(
+                    predict_leaf_index_binned(x, arrs, depth)))
+                add = linear_leaf_outputs(tree, vraw, leaf_idx)
+                self.valid_scores[vi] = self.valid_scores[vi].at[k].add(
+                    jnp.asarray(add.astype(np.float32)))
+                return
         add = predict_tree_binned(x, arrs, depth)
         self.valid_scores[vi] = self.valid_scores[vi].at[k].add(add)
 
@@ -519,11 +600,22 @@ class GBDT:
                    if self.config.pred_early_stop and self.objective is not None
                    and self.objective.name in ("binary", "multiclass",
                                                "multiclassova") else 0)
-        out = predict_forest(jnp.asarray(data), forest, tree_class, K, depth,
-                             binned=False, early_stop_freq=es_freq,
-                             early_stop_margin=float(
-                                 self.config.pred_early_stop_margin))
-        res = np.asarray(jax.device_get(out))
+        if any(getattr(t, "is_linear", False) for t in trees):
+            from .tree import linear_leaf_outputs
+            leaf_T = np.asarray(jax.device_get(predict_forest_leaf(
+                jnp.asarray(data), forest, depth, binned=False)))
+            res = np.zeros((K, N), dtype=np.float64)
+            for pos, i in enumerate(idx):
+                res[i % K] += linear_leaf_outputs(trees[pos], data,
+                                                  leaf_T[pos])
+            res = res.astype(np.float32)
+        else:
+            out = predict_forest(jnp.asarray(data), forest, tree_class, K,
+                                 depth, binned=False,
+                                 early_stop_freq=es_freq,
+                                 early_stop_margin=float(
+                                     self.config.pred_early_stop_margin))
+            res = np.asarray(jax.device_get(out))
         if self.average_output:
             n_iters = max(1, len(idx) // max(K, 1))
             res = res / n_iters

@@ -94,7 +94,18 @@ def tree_to_string(tree: Tree) -> str:
     if num_cat > 0:
         lines.append("cat_boundaries=" + _arr_str(cat_boundaries))
         lines.append("cat_threshold=" + _arr_str(cat_threshold))
-    lines.append("is_linear=0")
+    if getattr(tree, "is_linear", False):
+        # (reference: tree.cpp ToString linear-tree block)
+        lines.append("is_linear=1")
+        lines.append("leaf_const=" + _arr_str(tree.leaf_const[:L], _fmt))
+        nfs = [len(tree.leaf_features[i]) for i in range(L)]
+        lines.append("num_features=" + _arr_str(nfs))
+        flat_f = [f for i in range(L) for f in tree.leaf_features[i]]
+        flat_c = [c for i in range(L) for c in tree.leaf_coeff[i]]
+        lines.append("leaf_features=" + _arr_str(flat_f))
+        lines.append("leaf_coeff=" + _arr_str(flat_c, _fmt))
+    else:
+        lines.append("is_linear=0")
     lines.append("shrinkage=" + _fmt(tree.shrinkage))
     return "\n".join(lines) + "\n"
 
@@ -250,6 +261,21 @@ def tree_from_string(block: str) -> Tree:
             tree.cat_bitset_real.append(np.zeros(8, dtype=np.uint32))
             tree.cat_bitset.append(np.zeros(8, dtype=np.uint32))
             tree.threshold_real.append(thresholds[i] if i < len(thresholds) else 0.0)
+
+    if kv.get("is_linear", "0").strip() == "1":
+        tree.is_linear = True
+        tree.leaf_const = np.asarray(floats("leaf_const"), np.float64)
+        nfs = ints("num_features")
+        flat_f = ints("leaf_features")
+        flat_c = floats("leaf_coeff")
+        tree.leaf_features = []
+        tree.leaf_coeff = []
+        off = 0
+        for cnt in nfs:
+            tree.leaf_features.append(flat_f[off:off + cnt])
+            tree.leaf_coeff.append(np.asarray(flat_c[off:off + cnt],
+                                              np.float64))
+            off += cnt
 
     # recompute leaf depths/parents from children arrays
     tree.leaf_parent[:] = -1

@@ -46,6 +46,12 @@ class Tree:
     internal_weight: List[float] = field(default_factory=list)
     internal_count: List[int] = field(default_factory=list)
 
+    # linear-tree payload (reference: tree.h is_linear_ / leaf_coeff_)
+    is_linear: bool = False
+    leaf_features: Optional[list] = None
+    leaf_coeff: Optional[list] = None
+    leaf_const: Optional[np.ndarray] = None
+
     # per leaf
     leaf_value: Optional[np.ndarray] = None
     leaf_weight: Optional[np.ndarray] = None
@@ -123,6 +129,10 @@ class Tree:
         """(reference: tree.h Shrinkage)"""
         self.leaf_value[:self.num_leaves] *= rate
         self.internal_value = [v * rate for v in self.internal_value]
+        if self.is_linear:
+            self.leaf_const[:self.num_leaves] *= rate
+            for leaf in range(self.num_leaves):
+                self.leaf_coeff[leaf] = self.leaf_coeff[leaf] * rate
         self.shrinkage *= rate
 
     def set_leaf_values(self, values: np.ndarray) -> None:
@@ -137,11 +147,19 @@ class Tree:
         """Reference-semantics single-row traversal (host, for testing/export;
         reference: tree.h:130-141 Predict/NumericalDecision)."""
         if self.num_leaves == 1:
-            return float(self.leaf_value[0])
-        node = 0
-        while node >= 0:
-            node = self._decision(row, node)
-        return float(self.leaf_value[~node])
+            leaf = 0
+        else:
+            node = 0
+            while node >= 0:
+                node = self._decision(row, node)
+            leaf = ~node
+        if self.is_linear:
+            feats = self.leaf_features[leaf]
+            vals = row[feats] if feats else np.empty(0)
+            if not np.isnan(vals).any():
+                return float(self.leaf_const[leaf]
+                             + (vals @ self.leaf_coeff[leaf] if feats else 0.0))
+        return float(self.leaf_value[leaf])
 
     def _decision(self, row: np.ndarray, node: int) -> int:
         fval = row[self.split_feature[node]]
@@ -248,3 +266,86 @@ def rebind_to_dataset(tree: Tree, ds) -> None:
                 log.debug("Feature %d: model expects NaN missing but dataset "
                           "has none; NaN handling folded away", f)
                 tree.missing_type[i] = MISSING_NONE_C
+
+
+def fit_linear_leaves(tree: Tree, X_raw: np.ndarray, rows_per_leaf,
+                      grad: np.ndarray, hess: np.ndarray,
+                      linear_lambda: float,
+                      numeric_mask: np.ndarray) -> None:
+    """Fit a ridge-regularized linear model in every leaf over the numeric
+    features used along its path (reference:
+    src/treelearner/linear_tree_learner.cpp CalculateLinear — XTHX/XTg
+    normal equations per leaf; rows with NaN in the leaf's features fall
+    back to the constant output, as does a singular system).
+
+    Mutates the tree in place: sets ``is_linear``, per-leaf
+    ``leaf_features``/``leaf_coeff``/``leaf_const``.
+    """
+    L = tree.num_leaves
+    tree.is_linear = True
+    tree.leaf_features = [[] for _ in range(L)]
+    tree.leaf_coeff = [np.zeros(0, np.float64) for _ in range(L)]
+    tree.leaf_const = np.asarray(tree.leaf_value[:L], np.float64).copy()
+
+    # features on each leaf's path (numeric only)
+    path_feats = [[] for _ in range(L)]
+    if tree.num_internal:
+        def walk(node, feats):
+            if node < 0:
+                path_feats[~node] = feats
+                return
+            f = tree.split_feature[node]
+            nxt = feats if (tree.is_categorical[node]
+                            or not numeric_mask[f]) else feats + [f]
+            walk(tree.left_child[node], nxt)
+            walk(tree.right_child[node], nxt)
+        walk(0, [])
+
+    for leaf in range(L):
+        feats = sorted(set(path_feats[leaf]))
+        rows = rows_per_leaf(leaf)
+        if not feats or len(rows) < len(feats) + 1:
+            continue
+        Xl = X_raw[np.asarray(rows)][:, feats].astype(np.float64)
+        ok = ~np.isnan(Xl).any(axis=1)
+        if ok.sum() < len(feats) + 1:
+            continue
+        Xl = Xl[ok]
+        g = grad[np.asarray(rows)][ok].astype(np.float64)
+        h = hess[np.asarray(rows)][ok].astype(np.float64)
+        A = np.column_stack([Xl, np.ones(len(Xl))])
+        M = A.T @ (A * h[:, None])
+        M[np.arange(len(feats)), np.arange(len(feats))] += linear_lambda
+        b = -A.T @ g
+        try:
+            sol = np.linalg.solve(M, b)
+        except np.linalg.LinAlgError:
+            continue
+        if not np.isfinite(sol).all():
+            continue
+        tree.leaf_features[leaf] = list(feats)
+        tree.leaf_coeff[leaf] = sol[:-1]
+        tree.leaf_const[leaf] = float(sol[-1])
+
+
+def linear_leaf_outputs(tree: Tree, X_raw: np.ndarray,
+                        leaf_idx: np.ndarray) -> np.ndarray:
+    """Per-row outputs of a linear tree given each row's leaf index
+    (rows with NaN in the leaf's features get the constant leaf value,
+    reference: linear_tree_learner.cpp / tree.cpp PredictLinear)."""
+    out = np.asarray(tree.leaf_value[leaf_idx], np.float64).copy()
+    if not getattr(tree, "is_linear", False):
+        return out
+    for leaf in range(tree.num_leaves):
+        feats = tree.leaf_features[leaf]
+        sel = leaf_idx == leaf
+        if not sel.any():
+            continue
+        if not feats:
+            out[sel] = tree.leaf_const[leaf]
+            continue
+        Xs = X_raw[sel][:, feats].astype(np.float64)
+        nan = np.isnan(Xs).any(axis=1)
+        lin = tree.leaf_const[leaf] + Xs @ tree.leaf_coeff[leaf]
+        out[sel] = np.where(nan, tree.leaf_value[leaf], lin)
+    return out

@@ -1,0 +1,195 @@
+"""Plotting utilities (matplotlib-gated).
+
+(reference: python-package/lightgbm/plotting.py — plot_importance,
+plot_metric, plot_split_value_histogram, create_tree_digraph.)
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+import numpy as np
+
+from .basic import Booster
+from .utils import log
+
+
+def _check_matplotlib():
+    try:
+        import matplotlib.pyplot as plt
+        return plt
+    except ImportError:
+        log.fatal("matplotlib is required for plotting; install it first")
+
+
+def plot_importance(booster: Booster, ax=None, height: float = 0.2,
+                    xlim=None, ylim=None, title: str = "Feature importance",
+                    xlabel: str = "Feature importance",
+                    ylabel: str = "Features",
+                    importance_type: str = "split",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: int = 3, **kwargs):
+    """Horizontal-bar feature importances (reference: plotting.py
+    plot_importance)."""
+    plt = _check_matplotlib()
+    imp = booster.feature_importance(importance_type)
+    names = booster.feature_name()
+    pairs = [(n, v) for n, v in zip(names, imp)
+             if not (ignore_zero and v == 0)]
+    pairs.sort(key=lambda p: p[1])
+    if max_num_features is not None and max_num_features > 0:
+        pairs = pairs[-max_num_features:]
+    if not pairs:
+        log.fatal("No features with non-zero importance to plot")
+    labels, values = zip(*pairs)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(x + 1, y,
+                f"{x:.{precision}f}" if isinstance(x, float) else str(int(x)),
+                va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster: Union[Dict[str, Any], "Booster"],
+                metric: Optional[str] = None,
+                dataset_names=None, ax=None, xlim=None, ylim=None,
+                title: str = "Metric during training",
+                xlabel: str = "Iterations", ylabel: str = "@metric@",
+                figsize=None, dpi=None, grid: bool = True):
+    """Plot recorded eval results (reference: plotting.py plot_metric).
+
+    ``booster`` is the dict produced by ``callback.record_evaluation``.
+    """
+    plt = _check_matplotlib()
+    if not isinstance(booster, dict):
+        log.fatal("plot_metric needs the eval-results dict collected by "
+                  "record_evaluation()")
+    eval_results = booster
+    if not eval_results:
+        log.fatal("eval results are empty; pass record_evaluation to train()")
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    names = dataset_names or list(eval_results.keys())
+    picked = None
+    for name in names:
+        metrics = eval_results[name]
+        m = metric or next(iter(metrics))
+        picked = m
+        if m not in metrics:
+            continue
+        vals = metrics[m]
+        ax.plot(np.arange(1, len(vals) + 1), vals, label=name)
+    ax.legend(loc="best")
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel.replace("@metric@", picked or "metric"))
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster: Booster, feature, bins=None, ax=None,
+                               width_coef: float = 0.8, xlim=None, ylim=None,
+                               title="Split value histogram for feature with "
+                                     "@index/name@ @feature@",
+                               xlabel="Feature split value", ylabel="Count",
+                               figsize=None, dpi=None, grid: bool = True):
+    """Histogram of a feature's split thresholds across the model
+    (reference: plotting.py plot_split_value_histogram)."""
+    plt = _check_matplotlib()
+    names = booster.feature_name()
+    fidx = names.index(feature) if isinstance(feature, str) else int(feature)
+    values = [t.threshold_real[i]
+              for t in booster._booster.host_models
+              for i in range(t.num_internal)
+              if t.split_feature[i] == fidx and not t.is_categorical[i]]
+    if not values:
+        log.fatal("Feature %s was not used in any numerical split", feature)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.hist(values, bins=bins or min(len(set(values)), 20), rwidth=width_coef)
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    ax.set_title(title.replace("@index/name@",
+                               "name" if isinstance(feature, str) else "index")
+                 .replace("@feature@", str(feature)))
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def create_tree_digraph(booster: Booster, tree_index: int = 0,
+                        show_info=None, precision: int = 3, **kwargs):
+    """Graphviz digraph of one tree (reference: plotting.py
+    create_tree_digraph). Requires the ``graphviz`` package."""
+    try:
+        import graphviz
+    except ImportError:
+        log.fatal("graphviz is required for create_tree_digraph")
+    tree = booster._booster.host_models[tree_index]
+    names = booster.feature_name()
+    g = graphviz.Digraph(**kwargs)
+
+    def node_label(i):
+        f = names[tree.split_feature[i]]
+        if tree.is_categorical[i]:
+            return f"{f} in set"
+        return f"{f} <= {tree.threshold_real[i]:.{precision}g}"
+
+    def add(node):
+        if node < 0:
+            leaf = ~node
+            g.node(f"leaf{leaf}",
+                   f"leaf {leaf}: {tree.leaf_value[leaf]:.{precision}g}")
+            return f"leaf{leaf}"
+        nid = f"split{node}"
+        g.node(nid, node_label(node))
+        for child, lbl in ((tree.left_child[node], "yes"),
+                           (tree.right_child[node], "no")):
+            cid = add(child)
+            g.edge(nid, cid, label=lbl)
+        return nid
+
+    if tree.num_internal:
+        add(0)
+    else:
+        add(~0)
+    return g
+
+
+def plot_tree(booster: Booster, tree_index: int = 0, ax=None, figsize=None,
+              dpi=None, **kwargs):
+    """Render one tree via graphviz into a matplotlib axes
+    (reference: plotting.py plot_tree)."""
+    plt = _check_matplotlib()
+    g = create_tree_digraph(booster, tree_index, **kwargs)
+    import io
+    try:
+        image = g.pipe(format="png")
+    except Exception as e:  # graphviz binary missing
+        log.fatal("graphviz rendering failed: %s", e)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    img = plt.imread(io.BytesIO(image), format="png")
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

@@ -1,0 +1,115 @@
+"""Streaming Sequence construction, cv details, timers, plotting.
+
+(reference: basic.py:903 Sequence + test_basic.py:139-234 Sequence cases;
+engine.py cv; USE_TIMETAG timer table; plotting.py)
+"""
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+
+
+def _data(n=900, d=5, seed=2):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d)
+    y = X @ rng.randn(d) + 0.1 * rng.randn(n)
+    return X, y
+
+
+class _NpSequence(lgb.Sequence):
+    batch_size = 128
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def __getitem__(self, idx):
+        return self.arr[idx]
+
+    def __len__(self):
+        return len(self.arr)
+
+
+def test_sequence_matches_matrix():
+    X, y = _data()
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1}
+    b_mat = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    seqs = [_NpSequence(X[:400]), _NpSequence(X[400:])]
+    b_seq = lgb.train(params, lgb.Dataset(seqs, label=y), num_boost_round=5)
+    np.testing.assert_allclose(b_seq.predict(X), b_mat.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_cv_sklearn_splitter_and_train_metric():
+    pytest.importorskip("sklearn")
+    from sklearn.model_selection import KFold
+    X, y = _data()
+    res = lgb.cv({"objective": "regression", "num_leaves": 7, "verbose": -1,
+                  "metric": "l2"},
+                 lgb.Dataset(X, label=y, free_raw_data=False),
+                 num_boost_round=5, folds=KFold(n_splits=3),
+                 eval_train_metric=True)
+    assert "valid l2-mean" in res
+    assert "train l2-mean" in res
+    assert len(res["valid l2-mean"]) == 5
+    # train error below valid error on average (sanity)
+    assert np.mean(res["train l2-mean"]) <= np.mean(res["valid l2-mean"]) + 1e-9
+
+
+def test_cv_early_stopping_uses_first_metric():
+    X, y = _data()
+    res = lgb.cv({"objective": "regression", "num_leaves": 7, "verbose": -1,
+                  "metric": ["l2", "l1"], "early_stopping_round": 3},
+                 lgb.Dataset(X, label=y, free_raw_data=False),
+                 num_boost_round=30, nfold=3)
+    # converged training stops early and truncates consistently
+    lens = {len(v) for v in res.values()}
+    assert len(lens) == 1
+
+
+def test_timer_report(monkeypatch):
+    from lambdagap_tpu.utils import timer as T
+    monkeypatch.setattr(T, "_ENABLED", True)
+    T.global_timer.reset()
+    X, y = _data(n=300)
+    lgb.train({"objective": "regression", "num_leaves": 7, "verbose": -1},
+              lgb.Dataset(X, label=y), num_boost_round=3)
+    rep = T.global_timer.report()
+    assert "tree:" in rep and "boosting: gradients" in rep
+    T.global_timer.reset()
+
+
+def test_plot_importance_without_display():
+    mpl = pytest.importorskip("matplotlib")
+    mpl.use("Agg")
+    X, y = _data()
+    b = lgb.train({"objective": "regression", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    ax = lgb.plot_importance(b)
+    assert len(ax.patches) > 0
+    recorded = {}
+    b2 = lgb.train({"objective": "regression", "num_leaves": 7, "verbose": -1,
+                    "metric": "l2"},
+                   lgb.Dataset(X, label=y), num_boost_round=5,
+                   valid_sets=[lgb.Dataset(X[:200], label=y[:200],
+                                           reference=None)],
+                   callbacks=[lgb.record_evaluation(recorded)])
+    ax2 = lgb.plot_metric(recorded)
+    assert ax2.get_lines()
+
+
+def test_sequence_subsampled_binning_and_reference():
+    # total rows > bin_construct_sample_cnt exercises the sampled-binning
+    # path; a reference-aligned Sequence valid set must share bins
+    X, y = _data(n=3000)
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+              "bin_construct_sample_cnt": 500}
+    dtrain = lgb.Dataset(_NpSequence(X[:2500]), label=y[:2500], params=params)
+    dvalid = lgb.Dataset(_NpSequence(X[2500:]), label=y[2500:],
+                         reference=dtrain)
+    rec = {}
+    lgb.train(params, dtrain, num_boost_round=5, valid_sets=[dvalid],
+              callbacks=[lgb.record_evaluation(rec)])
+    vals = rec["valid_0"]["l2"]
+    assert vals[-1] < vals[0]
+    tds, vds = dtrain.construct(), dvalid.construct()
+    assert tds.mappers is vds.mappers

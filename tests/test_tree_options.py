@@ -116,3 +116,42 @@ def test_forced_bins(tmp_path):
     bounds = ds.mappers[0].bin_upper_bound
     for b in (0.3, 0.35, 0.4):
         assert any(abs(x - b) < 1e-9 for x in bounds), (b, bounds)
+
+
+def test_linear_tree():
+    rng = np.random.RandomState(8)
+    X = rng.rand(1500, 4) * 4
+    y = 2.0 * X[:, 0] - 1.5 * X[:, 1] + np.where(X[:, 2] > 2, 3.0, 0.0) \
+        + 0.05 * rng.randn(1500)
+    base = {"objective": "regression", "num_leaves": 4, "learning_rate": 0.5,
+            "min_data_in_leaf": 20, "verbose": -1}
+    b_const = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=5)
+    b_lin = lgb.train({**base, "linear_tree": True, "linear_lambda": 1e-4},
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    rmse_c = float(np.sqrt(np.mean((b_const.predict(X) - y) ** 2)))
+    rmse_l = float(np.sqrt(np.mean((b_lin.predict(X) - y) ** 2)))
+    # piecewise-linear target: linear leaves should crush constant leaves
+    assert rmse_l < 0.5 * rmse_c, (rmse_l, rmse_c)
+    # text round trip preserves linear payloads
+    b2 = lgb.Booster(model_str=b_lin.model_to_string())
+    np.testing.assert_allclose(b2.predict(X), b_lin.predict(X),
+                               rtol=1e-6, atol=1e-7)
+    # NaN rows fall back to the constant leaf value (finite predictions)
+    Xn = X.copy()
+    Xn[:10, 0] = np.nan
+    assert np.isfinite(b_lin.predict(Xn)).all()
+
+
+def test_linear_tree_with_valid_set():
+    rng = np.random.RandomState(9)
+    X = rng.rand(800, 3)
+    y = 3 * X[:, 0] + X[:, 1]
+    dtrain = lgb.Dataset(X[:600], label=y[:600])
+    dvalid = lgb.Dataset(X[600:], label=y[600:], reference=dtrain)
+    rec = {}
+    lgb.train({"objective": "regression", "num_leaves": 4, "verbose": -1,
+               "linear_tree": True, "metric": "l2"},
+              dtrain, num_boost_round=8, valid_sets=[dvalid],
+              callbacks=[lgb.record_evaluation(rec)])
+    vals = rec["valid_0"]["l2"]
+    assert vals[-1] < vals[0] * 0.5
