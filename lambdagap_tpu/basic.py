@@ -98,11 +98,19 @@ class Dataset:
               and all(isinstance(s, Sequence) for s in self.data)):
             seqs = self.data
         if seqs is not None:
-            cats = (list(self.categorical_feature)
-                    if isinstance(self.categorical_feature, (list, tuple))
-                    else ())
             names = (list(self.feature_name)
                      if isinstance(self.feature_name, (list, tuple)) else None)
+            cats = []
+            if isinstance(self.categorical_feature, (list, tuple)):
+                for c in self.categorical_feature:
+                    if isinstance(c, str):
+                        if names and c in names:
+                            cats.append(names.index(c))
+                        else:
+                            log.fatal("categorical_feature name %r needs a "
+                                      "matching feature_name list", c)
+                    else:
+                        cats.append(int(c))
             ref = (self.reference.construct(config)
                    if self.reference is not None else None)
             self._constructed = BinnedDataset.from_sequences(

@@ -92,6 +92,7 @@ _alias("input_model", "model_input", "model_in")
 _alias("output_model", "model_output", "model_out")
 _alias("saved_feature_importance_type", "save_feature_importance_type")
 _alias("snapshot_freq", "save_period")
+_alias("machine_rank", "process_id", "rank")
 _alias("max_bin", "max_bins")
 _alias("min_data_in_bin", "min_data_per_bin")
 _alias("bin_construct_sample_cnt", "subsample_for_bin")
@@ -351,6 +352,7 @@ class Config:
 
     # -- network (TPU: mesh axes instead of sockets) ----------------------
     num_machines: int = 1
+    machine_rank: int = -1
     local_listen_port: int = 12400
     time_out: int = 120
     machine_list_filename: str = ""

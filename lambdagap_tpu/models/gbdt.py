@@ -339,6 +339,13 @@ class GBDT:
 
         fit_linear_leaves(tree, ds.raw, rows_of, g, h,
                           self.config.linear_lambda, numeric)
+        # cache the per-row leaf map for the score update (saves a second
+        # full-permutation D2H per iteration)
+        leaf_idx = np.zeros(self.num_data, dtype=np.int32)
+        for leaf in range(tree.num_leaves):
+            b, c = int(begins[leaf]), int(counts[leaf])
+            leaf_idx[perm[b:b + c]] = leaf
+        self._linear_leaf_idx = leaf_idx
 
     def _tree_add_bias(self, tree: Tree, bias: float, k: int) -> None:
         """Fold the boost-from-average init into the first tree
@@ -362,7 +369,10 @@ class GBDT:
     def _update_train_score(self, tree: Tree, k: int) -> None:
         if getattr(tree, "is_linear", False):
             from .tree import linear_leaf_outputs
-            leaf_idx = self._host_leaf_index(tree)
+            leaf_idx = (self._linear_leaf_idx
+                        if getattr(self, "_linear_leaf_idx", None) is not None
+                        else self._host_leaf_index(tree))
+            self._linear_leaf_idx = None
             add = linear_leaf_outputs(tree, self.train_set.raw, leaf_idx)
             self.scores = self.scores.at[k].add(
                 jnp.asarray(add.astype(np.float32)))
@@ -393,6 +403,11 @@ class GBDT:
             from ..ops.predict import predict_leaf_index_binned
             from .tree import linear_leaf_outputs
             vraw = self.valid_sets[vi][1].raw
+            if vraw is None:
+                log.warning("Valid set %r has no retained raw matrix; "
+                            "linear-tree eval falls back to constant leaf "
+                            "values (metrics will not match predict())",
+                            self.valid_sets[vi][0])
             if vraw is not None:
                 leaf_idx = np.asarray(jax.device_get(
                     predict_leaf_index_binned(x, arrs, depth)))

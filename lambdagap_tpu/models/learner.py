@@ -30,6 +30,12 @@ from ..utils import log
 from .tree import Tree
 
 
+import os
+
+# USE_DEBUG analog: heavy self-checks, off unless explicitly requested
+_DEBUG_CHECKS = os.environ.get("LAMBDAGAP_DEBUG", "0") not in ("0", "", "false")
+
+
 def _next_pow2(n: int) -> int:
     p = 1
     while p < n:
@@ -304,6 +310,16 @@ class SerialTreeLearner:
                 jnp.asarray(s.cat_bitset), P)
             left_cnt = int(jax.device_get(left_cnt_dev))
             right_cnt = count - left_cnt
+            if _DEBUG_CHECKS and row_mask is None:
+                # re-check the partition against the histogram's split
+                # counts (the analog of SerialTreeLearner::CheckSplit's
+                # partition re-walk under USE_DEBUG,
+                # reference: serial_tree_learner.cpp:1071+)
+                expect = int(round(float(s.left_count)))
+                if left_cnt != expect:
+                    log.fatal("CheckSplit failed on leaf %d feature %d: "
+                              "partition left=%d but histogram left=%d",
+                              leaf, feat, left_cnt, expect)
             if left_cnt == 0 or right_cnt == 0:
                 # numerically degenerate split; drop this leaf from candidates
                 log.warning("Degenerate split on leaf %d (feature %d): "

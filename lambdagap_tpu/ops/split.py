@@ -268,7 +268,11 @@ def _categorical_best(hist, parent_g, parent_h, parent_c, parent_output,
     onehot_gain = jnp.where(valid_bin & feature_mask[:, None],
                             gains_for(g, h, c), K_MIN_SCORE)
 
-    # --- sorted-subset: order bins by g/(h + cat_smooth) ------------------
+    # --- sorted-subset: order bins by g/(h + cat_smooth); scan BOTH
+    # directions (prefixes and suffixes of the order), mirroring the
+    # reference's dir = +1/-1 loop so subsets taken from the high end of
+    # the order remain candidates under the max_cat_threshold cap
+    # (reference: FindBestThresholdCategoricalInner) ----------------------
     score = g / (h + p.cat_smooth)
     score = jnp.where(valid_bin, score, jnp.inf)
     order = jnp.argsort(score, axis=1)                          # [F, B]
@@ -287,11 +291,31 @@ def _categorical_best(hist, parent_g, parent_h, parent_c, parent_output,
     sorted_gain = jnp.where(cap_ok & v_s & feature_mask[:, None],
                             gains_for(csum_g, csum_h, csum_c), K_MIN_SCORE)
 
+    # suffix direction: left set = bins AFTER position t in the order
+    # (computed from totals minus the inclusive prefix at t)
+    tot_g = csum_g[:, -1:]
+    tot_h = csum_h[:, -1:]
+    tot_c = csum_c[:, -1:]
+    sfx_g = tot_g - csum_g
+    sfx_h = tot_h - csum_h
+    sfx_c = tot_c - csum_c
+    n_valid = prefix_len[:, -1:]
+    sfx_len = n_valid - prefix_len
+    sfx_cap = (sfx_len <= p.max_cat_threshold) & (sfx_len > 0)
+    suffix_gain = jnp.where(sfx_cap & v_s & feature_mask[:, None],
+                            gains_for(sfx_g, sfx_h, sfx_c), K_MIN_SCORE)
+
     # choose between strategies per feature
     best_onehot = jnp.max(onehot_gain, axis=1)
     t_onehot = jnp.argmax(onehot_gain, axis=1).astype(jnp.int32)
-    best_sorted = jnp.max(sorted_gain, axis=1)
-    t_sorted = jnp.argmax(sorted_gain, axis=1).astype(jnp.int32)
+    best_pref = jnp.max(sorted_gain, axis=1)
+    t_pref = jnp.argmax(sorted_gain, axis=1).astype(jnp.int32)
+    best_sfx = jnp.max(suffix_gain, axis=1)
+    t_sfx = jnp.argmax(suffix_gain, axis=1).astype(jnp.int32)
+
+    use_sfx = best_sfx > best_pref
+    best_sorted = jnp.maximum(best_pref, best_sfx)
+    t_sorted = jnp.where(use_sfx, t_sfx, t_pref)
 
     small = num_bins <= p.max_cat_to_onehot
     use_onehot = small | (best_onehot >= best_sorted)
@@ -303,15 +327,24 @@ def _categorical_best(hist, parent_g, parent_h, parent_c, parent_output,
         w = (t // 32).astype(jnp.uint32)
         bit = jnp.left_shift(jnp.uint32(1), (t % 32).astype(jnp.uint32))
         return jnp.where(words == w[:, None], bit[:, None], jnp.uint32(0))
-    in_prefix = (jnp.cumsum(jnp.ones_like(order), axis=1) - 1) <= t_sorted[:, None]
-    member = _scatter_rows(order, in_prefix & v_s)
+    pos = jnp.cumsum(jnp.ones_like(order), axis=1) - 1
+    in_pref = pos <= t_sorted[:, None]
+    in_sfx = pos > t_sorted[:, None]
+    member = _scatter_rows(order,
+                           jnp.where(use_sfx[:, None], in_sfx, in_pref) & v_s)
     sorted_bits = _bins_to_bitset(member)
     bits = jnp.where(use_onehot[:, None], onehot_bits(t_onehot), sorted_bits)
 
-    take_left = lambda csA, t: jnp.take_along_axis(csA, t[:, None], axis=1)[:, 0]
-    left_g = jnp.where(use_onehot, take_left(g, t_onehot), take_left(csum_g, t_sorted))
-    left_h = jnp.where(use_onehot, take_left(h, t_onehot), take_left(csum_h, t_sorted))
-    left_c = jnp.where(use_onehot, take_left(c, t_onehot), take_left(csum_c, t_sorted))
+    take_at = lambda csA, t: jnp.take_along_axis(csA, t[:, None], axis=1)[:, 0]
+    sort_g = jnp.where(use_sfx, take_at(sfx_g, t_sorted),
+                       take_at(csum_g, t_sorted))
+    sort_h = jnp.where(use_sfx, take_at(sfx_h, t_sorted),
+                       take_at(csum_h, t_sorted))
+    sort_c = jnp.where(use_sfx, take_at(sfx_c, t_sorted),
+                       take_at(csum_c, t_sorted))
+    left_g = jnp.where(use_onehot, take_at(g, t_onehot), sort_g)
+    left_h = jnp.where(use_onehot, take_at(h, t_onehot), sort_h)
+    left_c = jnp.where(use_onehot, take_at(c, t_onehot), sort_c)
     threshold = jnp.where(use_onehot, t_onehot, t_sorted)
     return gain, threshold, left_g, left_h, left_c, bits
 

@@ -85,13 +85,18 @@ def plot_metric(booster: Union[Dict[str, Any], "Booster"],
     names = dataset_names or list(eval_results.keys())
     picked = None
     for name in names:
+        if name not in eval_results:
+            log.warning("Dataset %r not found in eval results; skipping", name)
+            continue
         metrics = eval_results[name]
         m = metric or next(iter(metrics))
-        picked = m
         if m not in metrics:
             continue
+        picked = m
         vals = metrics[m]
         ax.plot(np.arange(1, len(vals) + 1), vals, label=name)
+    if picked is None:
+        log.fatal("No matching (dataset, metric) pair to plot")
     ax.legend(loc="best")
     if xlim is not None:
         ax.set_xlim(xlim)

@@ -1,0 +1,101 @@
+"""Two-process distributed smoke test (DistributedMockup analog).
+
+The reference tests distributed training by launching CLI subprocesses on
+localhost (reference: tests/distributed/_test_distributed.py:53-120
+DistributedMockup). Here two JAX processes join one runtime over a local
+coordinator and run the core distributed primitive — a cross-process
+histogram psum over a global mesh — verifying the DCN communication
+backend end to end. (Full multi-device training parity is covered on the
+virtual 8-device mesh in test_distributed.py.)
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_CHILD = r"""
+import os, sys
+import numpy as np
+sys.path.insert(0, os.getcwd())
+# distributed init MUST precede any backend initialization (so before the
+# package import, whose module-level jnp constants touch the backend)
+import jax
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=rank)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4          # 2 processes x 2 local cpu devices
+from lambdagap_tpu.parallel.multiprocess import global_array_from_local
+
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+from lambdagap_tpu.ops.histogram import histogram_from_rows
+
+mesh = Mesh(np.asarray(jax.devices()).reshape(-1), ("data",))
+# every process holds its own 8-row block of the 16-row dataset
+rng = np.random.RandomState(0)
+full_bins = rng.randint(0, 8, (16, 3)).astype(np.uint8)
+full_g = rng.randn(16).astype(np.float32)
+lo, hi = rank * 8, rank * 8 + 8
+x = global_array_from_local(full_bins[lo:hi], mesh, P("data", None))
+g = global_array_from_local(full_g[lo:hi], mesh, P("data"))
+h = global_array_from_local(np.ones(8, np.float32), mesh, P("data"))
+m = global_array_from_local(np.ones(8, bool), mesh, P("data"))
+
+def hist(x_l, g_l, h_l, m_l):
+    local = histogram_from_rows(x_l, g_l, h_l, m_l, 8, 4096, "f32")
+    return jax.lax.psum(local, "data")
+
+op = jax.jit(shard_map(hist, mesh=mesh,
+                       in_specs=(P("data", None), P("data"), P("data"),
+                                 P("data")),
+                       out_specs=P()))
+out = np.asarray(op(x, g, h, m))
+# verify against the full-data histogram computed locally
+expect = np.zeros((3, 8, 3), np.float32)
+for f in range(3):
+    for r in range(16):
+        b = full_bins[r, f]
+        expect[f, b, 0] += full_g[r]
+        expect[f, b, 1] += 1.0
+        expect[f, b, 2] += 1.0
+np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-5)
+print(f"RANK{rank}_OK")
+"""
+
+
+def test_two_process_histogram_psum(tmp_path):
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    # strip the axon TPU-tunnel shim (PYTHONPATH site hook + env): the
+    # children must run stock multi-process CPU jax
+    env = {k: v for k, v in os.environ.items()
+           if "AXON" not in k and k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs = [subprocess.Popen([sys.executable, str(script), str(r), port],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              cwd=os.getcwd(), env=env)
+             for r in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process smoke test timed out")
+        outs.append(out)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out[-2000:]}"
+        assert f"RANK{r}_OK" in out
