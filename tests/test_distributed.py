@@ -38,7 +38,7 @@ def test_data_parallel_matches_serial():
     p2 = b_data.predict(X)
     # same splits up to reduction-order float noise
     assert roc_auc_score(y, p2) > 0.95
-    np.testing.assert_allclose(p1, p2, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(p1, p2, rtol=1e-3, atol=1e-4)
 
 
 def test_feature_parallel_matches_serial():
@@ -46,7 +46,7 @@ def test_feature_parallel_matches_serial():
     b_serial = _train(X, y, "serial", 1)
     b_feat = _train(X, y, "feature", min(4, len(jax.devices())))
     np.testing.assert_allclose(b_serial.predict(X), b_feat.predict(X),
-                               rtol=2e-2, atol=2e-2)
+                               rtol=1e-3, atol=1e-4)
 
 
 def test_voting_parallel_learns():
