@@ -106,6 +106,14 @@ class FusedTreeLearner(SerialTreeLearner):
         # with stochastic rounding; on TPU the histogram contraction runs
         # as an int8 MXU matmul with exact int32 accumulation
         self.quant = bool(config.use_quantized_grad)
+        # int8-level histograms accumulate into int32 only WITHIN one
+        # W-row chunk (cross-chunk accumulation is float32, chunk_hist), so
+        # the worst in-chunk sum is chunk*127 — overflow would need a chunk
+        # of ~16.9M rows; guard the configurable chunk width, not num_data
+        if self.chunk * 127 >= 2**31 - 1:
+            from ..utils import log
+            log.fatal("tpu_rows_per_block=%d makes the histogram chunk too "
+                      "large for int32 accumulation", config.tpu_rows_per_block)
         if self.quant:
             self._qkey = jax.random.PRNGKey(config.data_random_seed + 7919)
         self._train_jit = jax.jit(self._train_tree_impl,

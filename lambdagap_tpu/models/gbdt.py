@@ -197,11 +197,41 @@ class GBDT:
             trees = self.host_models
             forest, depth = forest_to_arrays(trees, feature_meta=self._meta,
                                              use_inner_feature=True)
+            if any(getattr(t, "is_linear", False) for t in trees):
+                if ds.raw is None:
+                    log.fatal("Valid set %r needs the raw feature matrix "
+                              "retained to replay a linear_tree model", name)
+                self.valid_scores[vi] = self._replay_linear_forest(
+                    trees, forest, depth, self.valid_binned[vi], ds.raw,
+                    self.valid_scores[vi])
+                return
             tree_class = jnp.asarray(
                 [i % K for i in range(len(trees))], jnp.int32)
             self.valid_scores[vi] = self.valid_scores[vi] + predict_forest(
                 self.valid_binned[vi], forest, tree_class, K, depth,
                 binned=True)
+
+    def _linear_forest_outputs(self, trees, forest, depth, x, raw,
+                               binned: bool) -> np.ndarray:
+        """[K, N] float64 outputs of a linear-tree forest: leaf index per
+        (tree, row) + host-side linear leaf models. The single copy of this
+        loop — resume/valid replay and predict() must agree exactly."""
+        from .tree import linear_leaf_outputs
+        K = self.num_tree_per_iteration
+        leaf_T = np.asarray(jax.device_get(predict_forest_leaf(
+            x, forest, depth, binned=binned)))
+        add = np.zeros((K, raw.shape[0]), dtype=np.float64)
+        for i, t in enumerate(trees):
+            add[i % K] += linear_leaf_outputs(t, raw, leaf_T[i])
+        return add
+
+    def _replay_linear_forest(self, trees, forest, depth, binned, raw,
+                              scores) -> jax.Array:
+        """Add a linear-tree forest's outputs to ``scores`` (constant-leaf
+        replay would silently diverge from predict())."""
+        add = self._linear_forest_outputs(trees, forest, depth, binned, raw,
+                                          binned=True)
+        return scores + jnp.asarray(add.astype(np.float32))
 
     # ------------------------------------------------------------------
     def boosting(self) -> Tuple[jax.Array, jax.Array]:
@@ -483,6 +513,32 @@ class GBDT:
         forest, depth = forest_to_arrays(trees, feature_meta=self._meta,
                                          use_inner_feature=True)
         tree_class = jnp.asarray([i % K for i in range(len(trees))], jnp.int32)
+        if any(getattr(t, "is_linear", False) for t in trees):
+            # linear trees predict leaf_const + leaf_coeff·x, not leaf_value;
+            # replaying with constant leaves would silently train all later
+            # gradients against wrong scores. Replay host-side on raw rows.
+            # (valid sets are added AFTER resume in engine.py/cli.py; their
+            # linear replay lives in add_valid_set)
+            if type(self) is not GBDT:
+                # DART's dropout replays dropped trees with constant leaf
+                # values — resumed linear trees would corrupt scores on the
+                # first drop; RF averaging has the same blind spot
+                log.fatal("Continued training from a linear_tree model is "
+                          "only supported with boosting=gbdt")
+            if self.train_set.raw is None or any(
+                    ds.raw is None for _, ds in self.valid_sets):
+                log.fatal("Continued training from a linear_tree model needs "
+                          "the raw feature matrix retained on every dataset "
+                          "(train a linear_tree Dataset or disable "
+                          "init_model)")
+            self.scores = self._replay_linear_forest(
+                trees, forest, depth, jnp.asarray(self.train_set.binned),
+                self.train_set.raw, self.scores)
+            for vi, (_, vds) in enumerate(self.valid_sets):
+                self.valid_scores[vi] = self._replay_linear_forest(
+                    trees, forest, depth, self.valid_binned[vi], vds.raw,
+                    self.valid_scores[vi])
+            return
         self.scores = self.scores + predict_forest(
             jnp.asarray(self.train_set.binned), forest, tree_class, K, depth,
             binned=True)
@@ -509,6 +565,14 @@ class GBDT:
         trees = self.host_models
         if not trees:
             log.fatal("refit needs a trained model")
+        if any(getattr(t, "is_linear", False) for t in trees):
+            # refit rewrites leaf_value only; predict() would keep preferring
+            # the stale linear payload. Drop it so the refitted constant
+            # leaves actually drive predictions.
+            log.warning("refit drops linear-leaf models; the refitted trees "
+                        "predict with constant leaf values")
+            for t in trees:
+                t.is_linear = False
         md = Metadata()
         md.label = np.asarray(label, dtype=np.float32).reshape(-1)
         if weight is not None:
@@ -616,14 +680,9 @@ class GBDT:
                    and self.objective.name in ("binary", "multiclass",
                                                "multiclassova") else 0)
         if any(getattr(t, "is_linear", False) for t in trees):
-            from .tree import linear_leaf_outputs
-            leaf_T = np.asarray(jax.device_get(predict_forest_leaf(
-                jnp.asarray(data), forest, depth, binned=False)))
-            res = np.zeros((K, N), dtype=np.float64)
-            for pos, i in enumerate(idx):
-                res[i % K] += linear_leaf_outputs(trees[pos], data,
-                                                  leaf_T[pos])
-            res = res.astype(np.float32)
+            res = self._linear_forest_outputs(
+                trees, forest, depth, jnp.asarray(data), data,
+                binned=False).astype(np.float32)
         else:
             out = predict_forest(jnp.asarray(data), forest, tree_class, K,
                                  depth, binned=False,
@@ -661,6 +720,11 @@ class GBDT:
         K = self.num_tree_per_iteration
         idx = self._model_slice(start_iteration, num_iteration)
         trees = [self._tree(i) for i in idx]
+        if any(getattr(t, "is_linear", False) for t in trees):
+            # TreeSHAP over constant leaf values would break the "rows sum to
+            # the raw prediction" invariant for linear leaves (the reference
+            # rejects pred_contrib for linear trees too)
+            log.fatal("pred_contrib is not supported for linear_tree models")
         max_f = max((f for t in trees
                      for f in t.split_feature[:t.num_internal]), default=-1)
         if max_f >= F_data:

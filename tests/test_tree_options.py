@@ -155,3 +155,43 @@ def test_linear_tree_with_valid_set():
               callbacks=[lgb.record_evaluation(rec)])
     vals = rec["valid_0"]["l2"]
     assert vals[-1] < vals[0] * 0.5
+
+
+def test_linear_tree_resume_refit_contrib_guards():
+    """ADVICE r2: continued training replays the linear path, refit drops
+    linear payloads, pred_contrib rejects linear trees."""
+    rng = np.random.RandomState(11)
+    X = rng.rand(900, 4) * 4
+    y = 2.0 * X[:, 0] - 1.5 * X[:, 1] + 0.05 * rng.randn(900)
+    params = {"objective": "regression", "num_leaves": 4, "verbose": -1,
+              "learning_rate": 0.3, "linear_tree": True,
+              "linear_lambda": 1e-4, "min_data_in_leaf": 20}
+    b10 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    b5 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    resumed = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5,
+                        init_model=b5)
+    # a wrong (constant-leaf) replay would leave later gradients computed
+    # against wrong scores and visibly diverge from straight training
+    np.testing.assert_allclose(resumed.predict(X), b10.predict(X),
+                               rtol=1e-3, atol=1e-4)
+
+    # pred_contrib must refuse linear trees (sum invariant breaks)
+    with pytest.raises(RuntimeError):
+        b10.predict(X, pred_contrib=True)
+
+    # refit drops the linear payload so refitted constants drive predictions
+    b_ref = b10.refit(X, y)
+    assert np.isfinite(b_ref.predict(X)).all()
+    assert not any(getattr(t, "is_linear", False)
+                   for t in b_ref._booster.host_models)
+
+    # valid sets added after resume must replay the linear path too
+    # (add_valid_set runs AFTER resume_from in engine.py)
+    Xv, yv = X[:200] + 0.1, y[:200]
+    dtrain = lgb.Dataset(X, label=y)
+    dvalid = lgb.Dataset(Xv, label=yv, reference=dtrain)
+    rb = lgb.train(params, dtrain, num_boost_round=2, init_model=b5,
+                   valid_sets=[dvalid])
+    replayed = np.asarray(rb._booster.valid_scores[0][0])
+    np.testing.assert_allclose(replayed, rb.predict(Xv, raw_score=True),
+                               rtol=1e-4, atol=1e-4)
