@@ -89,18 +89,8 @@ class FusedTreeLearner(SerialTreeLearner):
         else:
             hx = dataset.binned
             self.Bb = self.B
-        self.hx_rows = jnp.asarray(hx)
-        # column-major copy for cheap feature-column reads while partitioning
-        # (the analog of CUDAColumnData next to CUDARowData,
-        # reference: src/io/cuda/cuda_column_data.cpp)
-        self.x_cols = jnp.asarray(np.ascontiguousarray(hx.T))
-        # chunk window for the while-loop'd row passes: small enough that a
-        # deep (small) leaf doesn't pay a huge padded window of gather/scan
-        # work, large enough that root-sized passes don't drown in per-trip
-        # overhead. Grows with N between 4k and 16*tpu_rows_per_block.
-        cap = max(int(config.tpu_rows_per_block) * 16, 1 << 12)
-        self.chunk = min(max(_next_pow2(max(dataset.num_data // 128, 1)),
-                             1 << 12), cap)
+        self._place_binned(np.asarray(hx))
+        self.chunk = self._pick_chunk()
         # quantized-gradient training (reference: GradientDiscretizer,
         # src/treelearner/gradient_discretizer.hpp): int8 grad/hess levels
         # with stochastic rounding; on TPU the histogram contraction runs
@@ -114,11 +104,55 @@ class FusedTreeLearner(SerialTreeLearner):
             from ..utils import log
             log.fatal("tpu_rows_per_block=%d makes the histogram chunk too "
                       "large for int32 accumulation", config.tpu_rows_per_block)
+        # exact integer histogram reduction (reference: the 16/32-bit integer
+        # reduce paths, src/treelearner/data_parallel_tree_learner.cpp:283-298):
+        # accumulate RAW int levels across chunks (int32 under Pallas,
+        # integer-valued f32 under the one-hot path) and apply the gradient
+        # scales only after the cross-shard psum. Integer sums are
+        # order-independent, so the distributed reduction is deterministic
+        # for any shard count. Falls back to per-chunk scaled f32 when the
+        # worst-case level sum could overflow the accumulator.
+        if self.quant:
+            qb = max(2, min(config.num_grad_quant_bins, 127))
+            limit = 2**31 - 1 if self.hist_impl == "pallas" else 2**24
+            self.quant_exact = dataset.num_data * qb < limit
+            if not self.quant_exact:
+                from ..utils import log
+                log.warning("quantized histogram level sums may exceed the "
+                            "exact accumulator range (%d rows x %d levels); "
+                            "using per-chunk scaled float32 accumulation",
+                            dataset.num_data, qb)
+        else:
+            self.quant_exact = False
         if self.quant:
             self._qkey = jax.random.PRNGKey(config.data_random_seed + 7919)
+        # when set (FusedDataParallelTreeLearner), _train_tree_impl runs as
+        # the per-shard body of a shard_map over this mesh axis: rows are
+        # sharded, histograms are psum-ed over ICI after each chunked local
+        # accumulation, and everything derived from histograms (gains, split
+        # choices, leaf values) is replicated-by-construction
+        self.axis: Optional[str] = None
         self._train_jit = jax.jit(self._train_tree_impl,
                                   static_argnames=("has_mask",))
         self.last_row_leaf: Optional[jax.Array] = None
+
+    # device-layout hooks (overridden by FusedDataParallelTreeLearner) ----
+    def _place_binned(self, hx: np.ndarray) -> None:
+        """Upload the row-major binned matrix plus a column-major copy for
+        cheap feature-column reads while partitioning (the analog of
+        CUDAColumnData next to CUDARowData,
+        reference: src/io/cuda/cuda_column_data.cpp)."""
+        self.hx_rows = jnp.asarray(hx)
+        self.x_cols = jnp.asarray(np.ascontiguousarray(hx.T))
+
+    def _pick_chunk(self) -> int:
+        """Chunk window for the while-loop'd row passes: small enough that a
+        deep (small) leaf doesn't pay a huge padded window of gather/scan
+        work, large enough that root-sized passes don't drown in per-trip
+        overhead. Grows with N between 4k and 16*tpu_rows_per_block."""
+        cap = max(int(self.config.tpu_rows_per_block) * 16, 1 << 12)
+        return min(max(_next_pow2(max(self.num_data // 128, 1)), 1 << 12),
+                   cap)
 
     # ------------------------------------------------------------------
     def train_device(self, grad: jax.Array, hess: jax.Array,
@@ -209,7 +243,7 @@ class FusedTreeLearner(SerialTreeLearner):
         * Both children's best-split scans run in one vmapped call.
         """
         cfg = self.config
-        N = self.num_data
+        N = x_rows.shape[0]       # LOCAL rows (== num_data unless sharded)
         F = self.num_features
         B = self.B
         L = cfg.num_leaves
@@ -234,6 +268,7 @@ class FusedTreeLearner(SerialTreeLearner):
         lane = jnp.arange(W, dtype=jnp.int32)
         bin_iota = jnp.arange(Bb, dtype=x_rows.dtype)
         quant = self.quant
+        qexact = self.quant_exact
         # grad+hess interleaved so one random gather fetches both channels
         gh2 = (jnp.zeros((1, 2), jnp.float32) if quant
                else jnp.stack([grad, hess], axis=1))    # [N, 2]
@@ -257,9 +292,13 @@ class FusedTreeLearner(SerialTreeLearner):
                     live = jnp.clip(count - c * W, 0, W)
                     ghq = pack_ghq8(gq[rows], hq[rows], valid)
                     hist_i = hist_pallas_q(bins, ghq, Bb, live)
+                    if qexact:          # raw level sums; scaled post-psum
+                        return acc + hist_i
                     return acc + hist_i.astype(jnp.float32) * qscale
-                g = jnp.where(valid, gq[rows].astype(jnp.float32) * gs, 0.0)
-                h = jnp.where(valid, hq[rows].astype(jnp.float32) * hs, 0.0)
+                gsc = jnp.float32(1.0) if qexact else gs
+                hsc = jnp.float32(1.0) if qexact else hs
+                g = jnp.where(valid, gq[rows].astype(jnp.float32) * gsc, 0.0)
+                h = jnp.where(valid, hq[rows].astype(jnp.float32) * hsc, 0.0)
                 gh = jnp.stack([g, h, valid.astype(jnp.float32)], axis=1)
                 onehot = (bins[:, :, None] == bin_iota).astype(jnp.bfloat16)
                 part = gh_contract(gh, onehot.reshape(W, C * Bb),
@@ -286,9 +325,23 @@ class FusedTreeLearner(SerialTreeLearner):
                 c, acc = st
                 return c + 1, chunk_hist(perm, begin, count, acc, c)
 
+            acc_dtype = (jnp.int32 if qexact and self.hist_impl == "pallas"
+                         else jnp.float32)
             _, hist = lax.while_loop(
                 lambda st: st[0] < nch, body,
-                (jnp.int32(0), jnp.zeros((C, Bb, HIST_C), jnp.float32)))
+                (jnp.int32(0), jnp.zeros((C, Bb, HIST_C), acc_dtype)))
+            if self.axis is not None:
+                # the one collective per split: local chunk loops may run
+                # different trip counts per shard (local leaf sizes differ),
+                # but every shard reaches this psum exactly once per step.
+                # In quant_exact mode the reduction is over raw integer level
+                # sums — order-independent, hence deterministic for any shard
+                # count (reference: the 16/32-bit integer ReduceScatter at
+                # data_parallel_tree_learner.cpp:283-298)
+                hist = lax.psum(hist, self.axis)
+            if qexact:
+                hist = hist.astype(jnp.float32) * jnp.stack(
+                    [gs, hs, jnp.float32(1.0)])
             return hist
 
         def best_of(hist, pg, ph, pc, pout, lo, hi, depth):
@@ -481,7 +534,13 @@ class FusedTreeLearner(SerialTreeLearner):
             node_bits = st["node_bits"].at[wk].set(bitsv)
 
             # -- children histograms (smaller built, larger by subtraction)
-            small_is_left = left_count <= right_count
+            if self.axis is None:
+                small_is_left = left_count <= right_count
+            else:
+                # the side choice must be identical on every shard (each
+                # shard's local hist feeds one psum); local partition counts
+                # differ per shard, the scan's global (in-bag) counts do not
+                small_is_left = lc <= pc - lc
             sb = jnp.where(small_is_left, begin, begin + left_count)
             sc = jnp.where(small_is_left, left_count, right_count)
             hist_small = leaf_hist(perm, sb, sc)
@@ -524,7 +583,12 @@ class FusedTreeLearner(SerialTreeLearner):
             state = lax.fori_loop(0, NODES, split_step, state)
 
         # -------------------------------------------------- row -> leaf id
-        leaf_begin = state["leaf_i"][:L, 0]
+        # leaves with zero (local) rows would duplicate another leaf's begin
+        # offset — push them past the end so searchsorted never picks them
+        # (common under sharding: a leaf can be empty on one shard)
+        leaf_begin = jnp.where(state["leaf_i"][:L, 1] > 0,
+                               state["leaf_i"][:L, 0],
+                               N + jnp.arange(L, dtype=jnp.int32))
         order = jnp.argsort(leaf_begin)
         sorted_begin = leaf_begin[order]
         which = jnp.searchsorted(sorted_begin,
@@ -543,6 +607,9 @@ class FusedTreeLearner(SerialTreeLearner):
             # (reference: GradientDiscretizer::RenewIntGradTreeOutput)
             gsum = jax.ops.segment_sum(grad, row_leaf, num_segments=L)
             hsum = jax.ops.segment_sum(hess, row_leaf, num_segments=L)
+            if self.axis is not None:
+                gsum = lax.psum(gsum, self.axis)
+                hsum = lax.psum(hsum, self.axis)
             parent_out = node_f[jnp.clip(leaf_i[:L, 3], 0, NODES - 1), 1]
             renewed = calculate_leaf_output(gsum, hsum, p, leaf_f[:L, 2],
                                             parent_out)

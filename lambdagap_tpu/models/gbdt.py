@@ -35,6 +35,12 @@ from .tree import Tree
 K_EPSILON = 1e-15
 
 
+def _fused_mode_enabled(mode) -> bool:
+    """tpu_fused_learner truthiness ('auto' counts as enabled; the serial
+    branch additionally gates 'auto' on the backend)."""
+    return mode == "auto" or mode in ("1", "true", "on", "yes", True)
+
+
 @functools.partial(jax.jit, static_argnames=("num_leaves",))
 def _add_tree_score(score, perm, leaf_begin, leaf_count, leaf_values,
                     num_leaves: int):
@@ -140,7 +146,7 @@ class GBDT:
             cfg = self.config
             mode = cfg.tpu_fused_learner
             use_fused = (jax.default_backend() != "cpu" if mode == "auto"
-                         else mode in ("1", "true", "on", "yes", True))
+                         else _fused_mode_enabled(mode))
             # niche tree options live on the host-orchestrated learner (the
             # same shape as the reference's CUDA learner deferring
             # unsupported combos to the CPU path)
@@ -172,6 +178,33 @@ class GBDT:
             log.warning("linear_tree is not supported with tree_learner=%s; "
                         "training constant-leaf trees", tl)
             self.config.linear_tree = False
+        if self.config.interaction_constraints:
+            # no distributed learner implements per-node interaction
+            # filtering; silently dropping a constraint is worse than failing
+            log.fatal("interaction_constraints are not supported with "
+                      "tree_learner=%s; use the serial learner", tl)
+        if tl == "data":
+            # the fused whole-tree shard_map program is the production
+            # multi-chip path (one psum per split, zero per-split host
+            # syncs); the host-loop learner is the explicit opt-out
+            # (tpu_fused_learner=0). Options no distributed learner applies
+            # are warned, not silently swallowed.
+            cfg = self.config
+            not_applied = []
+            if cfg.feature_fraction_bynode < 1.0:
+                not_applied.append("feature_fraction_bynode")
+            if cfg.cegb_tradeoff > 0 and (
+                    cfg.cegb_penalty_split > 0
+                    or cfg.cegb_penalty_feature_coupled
+                    or cfg.cegb_penalty_feature_lazy):
+                not_applied.append("cegb")
+            if not_applied:
+                log.warning("%s are not applied by tree_learner=data",
+                            ", ".join(not_applied))
+            if _fused_mode_enabled(cfg.tpu_fused_learner):
+                from ..parallel.fused_parallel import \
+                    FusedDataParallelTreeLearner
+                return FusedDataParallelTreeLearner(ds, self.config)
         from ..parallel import (DataParallelTreeLearner,
                                 FeatureParallelTreeLearner,
                                 VotingParallelTreeLearner)

@@ -1,7 +1,9 @@
 from .data_parallel import DataParallelTreeLearner
 from .feature_parallel import FeatureParallelTreeLearner
+from .fused_parallel import FusedDataParallelTreeLearner
 from .mesh import DATA_AXIS, make_mesh
 from .voting_parallel import VotingParallelTreeLearner
 
 __all__ = ["DataParallelTreeLearner", "FeatureParallelTreeLearner",
-           "VotingParallelTreeLearner", "make_mesh", "DATA_AXIS"]
+           "FusedDataParallelTreeLearner", "VotingParallelTreeLearner",
+           "make_mesh", "DATA_AXIS"]

@@ -30,15 +30,77 @@ def _train(X, y, tree_learner, n_dev, rounds=10, extra=None):
 def test_data_parallel_matches_serial():
     """Distributed-vs-single parity (the reference asserts per-rank models
     agree and match accuracy; exact equality holds here because the psum-ed
-    histogram equals the serial histogram up to float addition order)."""
+    histogram equals the serial histogram up to float addition order).
+
+    tree_learner=data defaults to the FUSED shard_map whole-tree program —
+    this is the multi-chip production path under test."""
+    from lambdagap_tpu.parallel.fused_parallel import \
+        FusedDataParallelTreeLearner
     X, y = _data()
     b_serial = _train(X, y, "serial", 1)
     b_data = _train(X, y, "data", min(NEED, len(jax.devices())))
+    assert isinstance(b_data._booster.learner, FusedDataParallelTreeLearner)
     p1 = b_serial.predict(X)
     p2 = b_data.predict(X)
     # same splits up to reduction-order float noise
     assert roc_auc_score(y, p2) > 0.95
     np.testing.assert_allclose(p1, p2, rtol=1e-3, atol=1e-4)
+
+
+def test_host_loop_data_parallel_opt_out():
+    """tpu_fused_learner=0 falls back to the host-orchestrated learner and
+    still matches."""
+    from lambdagap_tpu.parallel import DataParallelTreeLearner
+    from lambdagap_tpu.parallel.fused_parallel import \
+        FusedDataParallelTreeLearner
+    X, y = _data(seed=4)
+    nd = min(NEED, len(jax.devices()))
+    b_host = _train(X, y, "data", nd, extra={"tpu_fused_learner": "0"})
+    lrn = b_host._booster.learner
+    assert isinstance(lrn, DataParallelTreeLearner)
+    assert not isinstance(lrn, FusedDataParallelTreeLearner)
+    b_fused = _train(X, y, "data", nd)
+    np.testing.assert_allclose(b_host.predict(X), b_fused.predict(X),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_fused_data_parallel_bagging_and_uneven_rows():
+    """Bagging masks + a row count not divisible by the mesh (pad rows must
+    stay out of histograms and scores)."""
+    X, y = _data(seed=5)
+    X, y = X[:1157], y[:1157]        # 1157 % 8 != 0
+    nd = min(NEED, len(jax.devices()))
+    b = _train(X, y, "data", nd, rounds=8,
+               extra={"bagging_fraction": 0.7, "bagging_freq": 1})
+    assert roc_auc_score(y, b.predict(X)) > 0.9
+
+
+def test_fused_data_parallel_quantized():
+    """use_quantized_grad under the fused distributed learner."""
+    X, y = _data(seed=6)
+    nd = min(NEED, len(jax.devices()))
+    b_q = _train(X, y, "data", nd, extra={"use_quantized_grad": True})
+    b_f = _train(X, y, "data", nd)
+    auc_q = roc_auc_score(y, b_q.predict(X))
+    auc_f = roc_auc_score(y, b_f.predict(X))
+    assert auc_q > auc_f - 0.01, (auc_q, auc_f)
+
+
+def test_quantized_distributed_reduction_is_exact():
+    """quant_exact mode psums RAW integer level sums (scales applied after
+    the collective), so quantized serial and 8-shard training see identical
+    histograms — the shard count cannot change the model (the deterministic
+    analog of the reference's integer ReduceScatter,
+    data_parallel_tree_learner.cpp:283-298)."""
+    X, y = _data(seed=7)
+    nd = min(NEED, len(jax.devices()))
+    extra = {"use_quantized_grad": True, "tpu_fused_learner": "1"}
+    b_serial = _train(X, y, "serial", 1, rounds=5, extra=extra)
+    b_dp = _train(X, y, "data", nd, rounds=5,
+                  extra={"use_quantized_grad": True})
+    np.testing.assert_allclose(b_serial.predict(X, raw_score=True),
+                               b_dp.predict(X, raw_score=True),
+                               rtol=1e-6, atol=1e-7)
 
 
 def test_feature_parallel_matches_serial():

@@ -1,0 +1,52 @@
+"""Relative per-iter wall: serial fused vs fused data-parallel on the
+virtual 8-CPU mesh (VERDICT r2 item 1 done-criterion: within ~1.5x).
+
+Run: python tools/bench_fused_dp.py [rows] [iters]
+"""
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import lambdagap_tpu as lgb  # noqa: E402
+
+
+def run(tl_params, X, y, iters):
+    params = {"objective": "binary", "verbose": -1, "num_leaves": 31,
+              "min_data_in_leaf": 20, **tl_params}
+    ds = lgb.Dataset(X, label=y)
+    # warmup: 2 rounds (compile)
+    booster = lgb.Booster(params=params, train_set=ds)
+    for _ in range(2):
+        booster.update()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        booster.update()
+    # force everything: predictions fold all trees
+    float(np.sum(booster.predict(X[:256], raw_score=True)))
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    rng = np.random.RandomState(0)
+    X = rng.randn(rows, 20).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(rows) > 0)
+    t_serial = run({"tpu_fused_learner": "1"}, X, y, iters)
+    t_fdp = run({"tree_learner": "data", "tpu_num_devices": 8}, X, y, iters)
+    print(f"rows={rows} serial_fused={t_serial*1e3:.1f}ms/iter "
+          f"fused_dp8={t_fdp*1e3:.1f}ms/iter ratio={t_fdp/t_serial:.2f}")
+
+
+if __name__ == "__main__":
+    main()
