@@ -244,9 +244,17 @@ def _warn_unsupported(config: Config) -> None:
     if config.linear_tree and config.boosting != "gbdt":
         log.warning("linear_tree is only supported with boosting=gbdt; "
                     "training constant-leaf trees")
-    if config.forcedsplits_filename:
-        log.warning("forcedsplits_filename is NOT implemented and will be "
-                    "ignored (forcedbins_filename IS supported)")
+    if config.deterministic:
+        # the reference pins OpenMP reduction order under this flag
+        # (include/LightGBM/config.h:268); under XLA every reduction
+        # compiles to a fixed order and all RNG is explicitly seeded, so
+        # repeat runs are bit-identical for a fixed device count / data
+        # order / library version without extra action. Cross-shard-count
+        # reproducibility of histogram sums additionally holds under
+        # use_quantized_grad (exact integer psum).
+        log.info("deterministic=true: runs are bit-reproducible for a fixed "
+                 "device count (integer-exact cross-shard sums additionally "
+                 "require use_quantized_grad)")
     if config.monotone_penalty > 0:
         log.warning("monotone_penalty is NOT implemented; constraints are "
                     "enforced without the split-depth penalty")

@@ -31,7 +31,7 @@ from ..data.dataset import BinnedDataset
 from ..ops.histogram import gh_contract
 from ..ops.partition import decision_go_left
 from ..ops.split import (K_MIN_SCORE, SplitParams, calculate_leaf_output,
-                         leaf_gain, per_feature_best)
+                         gather_threshold_split, leaf_gain, per_feature_best)
 from .learner import SerialTreeLearner, _next_pow2
 from .tree import Tree
 
@@ -126,6 +126,17 @@ class FusedTreeLearner(SerialTreeLearner):
             self.quant_exact = False
         if self.quant:
             self._qkey = jax.random.PRNGKey(config.data_random_seed + 7919)
+        # forced splits (reference: serial_tree_learner.cpp:624 ForceSplits):
+        # the BFS order fixes which leaf id each forced node splits (root=0;
+        # the split at step k hands its right child leaf id k+1), so the
+        # whole forcing schedule is three static arrays consumed by the
+        # fused program's step loop; an invalid forced split flips the
+        # carried `forcing` flag off (the abort_last_forced_split analog)
+        self.forced_seq = None
+        if self.forced_json is not None:
+            self.forced_seq = self._build_forced_seq(config.num_leaves - 1)
+        if self.extra_on:
+            self._ekey = jax.random.PRNGKey(config.extra_seed)
         # when set (FusedDataParallelTreeLearner), _train_tree_impl runs as
         # the per-shard body of a shard_map over this mesh axis: rows are
         # sharded, histograms are psum-ed over ICI after each chunked local
@@ -135,6 +146,35 @@ class FusedTreeLearner(SerialTreeLearner):
         self._train_jit = jax.jit(self._train_tree_impl,
                                   static_argnames=("has_mask",))
         self.last_row_leaf: Optional[jax.Array] = None
+
+    def _build_forced_seq(self, nodes: int):
+        """Flatten the forced-split JSON into per-step (leaf, feature, bin)
+        arrays in BFS order. Truncates at the first unmappable node."""
+        fl, ff, ft = [], [], []
+        q = [(self.forced_json, 0)]
+        while q and len(fl) < nodes:
+            node, leaf = q.pop(0)
+            fb = self._forced_bin(node)
+            if fb is None:
+                break
+            k, thr_bin = fb
+            step = len(fl)
+            fl.append(leaf)
+            ff.append(k)
+            ft.append(thr_bin)
+            for key, child in (("left", leaf), ("right", step + 1)):
+                ch = node.get(key)
+                if (isinstance(ch, dict) and "feature" in ch
+                        and "threshold" in ch):
+                    q.append((ch, child))
+        if not fl:
+            return None
+        on = np.zeros(nodes, dtype=bool)
+        on[:len(fl)] = True
+        pad = nodes - len(fl)
+        return (np.asarray(fl + [0] * pad, np.int32),
+                np.asarray(ff + [0] * pad, np.int32),
+                np.asarray(ft + [0] * pad, np.int32), on)
 
     # device-layout hooks (overridden by FusedDataParallelTreeLearner) ----
     def _place_binned(self, hx: np.ndarray) -> None:
@@ -168,8 +208,12 @@ class FusedTreeLearner(SerialTreeLearner):
         else:
             gq = hq = jnp.zeros(1, jnp.int8)
             gs = hs = jnp.float32(1.0)
+        if self.extra_on:
+            self._ekey, ekey = jax.random.split(self._ekey)
+        else:
+            ekey = jnp.zeros(2, jnp.uint32)
         rec = self._train_jit(grad, hess, mask, fmask, self.hx_rows,
-                              self.x_cols, gq, hq, gs, hs,
+                              self.x_cols, gq, hq, gs, hs, ekey,
                               has_mask=row_mask is not None)
         self.last_row_leaf = rec.row_leaf
         return rec
@@ -225,7 +269,7 @@ class FusedTreeLearner(SerialTreeLearner):
     # the fused program
     # ------------------------------------------------------------------
     def _train_tree_impl(self, grad, hess, row_mask, fmask, x_rows, x_cols,
-                         gq, hq, gs, hs, *, has_mask: bool):
+                         gq, hq, gs, hs, ekey, *, has_mask: bool):
         """One whole tree as a single XLA program.
 
         Design notes for the ``fori_loop`` body (the per-split step):
@@ -344,7 +388,11 @@ class FusedTreeLearner(SerialTreeLearner):
                     [gs, hs, jnp.float32(1.0)])
             return hist
 
-        def best_of(hist, pg, ph, pc, pout, lo, hi, depth):
+        extra_on = self.extra_on
+        contri = self.contri_arr
+        nb_m1 = self.nb_minus1_arr
+
+        def best_of(hist, pg, ph, pc, pout, lo, hi, depth, rkey):
             """Best split for one leaf, with the max_depth guard.
             Returns (gain, feat, thr, dl, cat, bits, lg, lh, lc, lout, rout)."""
             if bundled:
@@ -352,12 +400,20 @@ class FusedTreeLearner(SerialTreeLearner):
                 hist = unbundle_hist(hist, self.ub_src, self.ub_kind,
                                      pg, ph, pc)
             cons = (mono_arr, lo, hi) if mono_on else None
+            rand_t = None
+            if extra_on:
+                rand_t = jax.random.randint(rkey, (F,), 0, 1 << 30) % nb_m1
             gain, thr, dl, lg, lh, lc, bits = per_feature_best(
                 hist, pg, ph, pc, pout, num_bins, default_bins,
                 missing_types, is_cat_arr, fmask, p, has_cat,
-                constraints=cons)
+                constraints=cons, rand_thresholds=rand_t)
             parent_gain = leaf_gain(pg, ph, p, pc, pout)
             shift = parent_gain + p.min_gain_to_split
+            if contri is not None:
+                # feature_contri scales the post-shift gain (reference:
+                # feature_histogram.hpp:174 output->gain *= penalty)
+                gain = jnp.where(jnp.isfinite(gain),
+                                 (gain - shift) * contri + shift, gain)
             f = jnp.argmax(gain, axis=0).astype(jnp.int32)
             g = gain[f] - shift
             ok = jnp.isfinite(gain[f]) & (g > 0.0)
@@ -373,7 +429,7 @@ class FusedTreeLearner(SerialTreeLearner):
                     is_cat_arr[f], bits[f], lg[f], lh[f], lc[f], lout, rout)
 
         best_children = jax.vmap(best_of,
-                                 in_axes=(0, 0, 0, 0, 0, 0, 0, None))
+                                 in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0))
 
         # ------------------------------------------------------ state init
         # consolidated per-leaf/per-node state; row L / row NODES is the dump
@@ -394,9 +450,11 @@ class FusedTreeLearner(SerialTreeLearner):
                                          0.0)
         neg_inf = jnp.float32(-jnp.inf)
         pos_inf = jnp.float32(jnp.inf)
+        root_key = jax.random.fold_in(ekey, NODES) if extra_on else ekey
         (bg0, bf0, bt0, bdl0, bcat0, bbits0, blg0, blh0, blc0, blout0,
          brout0) = best_of(hist_root, totals[0], totals[1], totals[2],
-                           root_out, neg_inf, pos_inf, jnp.int32(0))
+                           root_out, neg_inf, pos_inf, jnp.int32(0),
+                           root_key)
 
         iota_l1 = jnp.arange(L + 1, dtype=jnp.int32)
         f32 = jnp.float32
@@ -427,19 +485,77 @@ class FusedTreeLearner(SerialTreeLearner):
             num_leaves=jnp.int32(1),
         )
 
+        forced = self.forced_seq
+        if forced is not None:
+            f_leaf = jnp.asarray(forced[0])
+            f_feat = jnp.asarray(forced[1])
+            f_thr = jnp.asarray(forced[2])
+            f_on = jnp.asarray(forced[3])
+            state["forcing"] = jnp.asarray(True)
+
         # ------------------------------------------------------ split step
         def split_step(k, st):
             leaf_f, leaf_i = st["leaf_f"], st["leaf_i"]
             leaf = jnp.argmax(leaf_f[:L, 4]).astype(jnp.int32)
+            forcing_next = None
+            fon = use_f = None
+            if forced is not None:
+                # gather the forced split's stats from the forced leaf's
+                # histogram; if it is invalid (no positive gain / depth),
+                # forcing aborts and THIS step falls back to the argmax best
+                # split, so an abort costs no split budget (matching the
+                # serial ForceSplits abort_last_forced_split behavior)
+                fon = f_on[k] & st["forcing"]
+                fleaf = f_leaf[k]
+                flf = leaf_f[fleaf]
+                fli = leaf_i[fleaf]
+                hist_leaf = st["hist"][fleaf]
+                if bundled:
+                    from ..ops.histogram import unbundle_hist
+                    histF = unbundle_hist(hist_leaf, self.ub_src, self.ub_kind,
+                                          flf[0], flf[1], flf[2])
+                else:
+                    histF = hist_leaf
+                fk = f_feat[k]
+                res = gather_threshold_split(
+                    histF[fk], flf[0], flf[1], flf[2], flf[3], fk, f_thr[k],
+                    num_bins[fk], default_bins[fk], missing_types[fk],
+                    is_cat_arr[fk], p,
+                    bounds=(flf[10], flf[11]) if mono_on else None)
+                fok = res.gain > 0.0
+                if max_depth > 0:
+                    fok = fok & (fli[2] < max_depth)
+                forcing_next = st["forcing"] & jnp.where(f_on[k], fok, True)
+                use_f = fon & fok
+                leaf = jnp.where(use_f, fleaf, leaf)
             lf = leaf_f[leaf]
             li = leaf_i[leaf]
             ok = lf[4] > 0.0
 
+            # the chosen split: the leaf's stored best, unless this step is
+            # a (valid) forced one — then the gathered fixed split
+            bgain = lf[4]
             feat = li[5]
-            begin = li[0]
-            count_eff = jnp.where(ok, li[1], 0)
             thrv, dlv, catv = li[6], li[7].astype(bool), li[8].astype(bool)
             bitsv = st["leaf_bits"][leaf]
+            blg, blh, blc = lf[5], lf[6], lf[7]
+            blout, brout = lf[8], lf[9]
+            if forced is not None:
+                ok = jnp.where(use_f, True, ok)
+                bgain = jnp.where(use_f, res.gain, bgain)
+                feat = jnp.where(use_f, fk, feat)
+                thrv = jnp.where(use_f, f_thr[k], thrv)
+                dlv = jnp.where(use_f, res.default_left, dlv)
+                catv = jnp.where(use_f, res.is_categorical, catv)
+                bitsv = jnp.where(use_f, res.cat_bitset, bitsv)
+                blg = jnp.where(use_f, res.left_sum_g, blg)
+                blh = jnp.where(use_f, res.left_sum_h, blh)
+                blc = jnp.where(use_f, res.left_count, blc)
+                blout = jnp.where(use_f, res.left_output, blout)
+                brout = jnp.where(use_f, res.right_output, brout)
+
+            begin = li[0]
+            count_eff = jnp.where(ok, li[1], 0)
             col = x_cols[self.bcol[feat] if bundled else feat]   # [N]
             nch = (count_eff + W - 1) // W
             perm_in = st["perm"]
@@ -497,24 +613,29 @@ class FusedTreeLearner(SerialTreeLearner):
                                      (jnp.int32(0), perm_in))
 
             # -- masked write indices (dump rows swallow no-op steps) --
+            # nodes are indexed by the number of REALIZED splits, not the
+            # loop counter: a no-op step (e.g. an aborted forced split)
+            # must not leave a hole in the node array
             new_leaf = st["num_leaves"]
+            nidx = new_leaf - 1
             wl = jnp.where(ok, leaf, L)
             wn = jnp.where(ok, new_leaf, L)
-            wk = jnp.where(ok, k, NODES)
+            wk = jnp.where(ok, nidx, NODES)
 
             # parent node's child pointer now points at node k
             pnode = li[3]
             was_left = li[4].astype(bool)
             safe_p = jnp.where((pnode >= 0) & ok, pnode, NODES)
             prow = st["node_i"][safe_p]
-            prow = jnp.where(was_left, prow.at[4].set(k), prow.at[5].set(k))
+            prow = jnp.where(was_left, prow.at[4].set(nidx),
+                             prow.at[5].set(nidx))
             node_i = st["node_i"].at[safe_p].set(prow)
 
             # aggregates
             pg, ph, pc = lf[0], lf[1], lf[2]
-            lg, lh, lc = lf[5], lf[6], lf[7]
+            lg, lh, lc = blg, blh, blc
             rg, rh, rc = pg - lg, ph - lh, pc - lc
-            lout, rout = lf[8], lf[9]
+            lout, rout = blout, brout
             depth = li[2] + 1
 
             # children's monotone bounds (basic method): the mid of the two
@@ -528,9 +649,10 @@ class FusedTreeLearner(SerialTreeLearner):
             rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
 
             node_f = st["node_f"].at[wk].set(
-                jnp.stack([lf[4], lf[3], ph, pc]))
+                jnp.stack([bgain, lf[3], ph, pc]))
             node_i = node_i.at[wk].set(jnp.stack(
-                [feat, thrv, li[7], li[8], ~leaf, ~new_leaf]))
+                [feat, thrv, dlv.astype(jnp.int32), catv.astype(jnp.int32),
+                 ~leaf, ~new_leaf]))
             node_bits = st["node_bits"].at[wk].set(bitsv)
 
             # -- children histograms (smaller built, larger by subtraction)
@@ -550,25 +672,32 @@ class FusedTreeLearner(SerialTreeLearner):
             hist = st["hist"].at[wl].set(hist_left).at[wn].set(hist_right)
 
             # -- both children's best splits in one vmapped scan -------
+            if extra_on:
+                step_key = jax.random.fold_in(ekey, k)
+                child_keys = jnp.stack([jax.random.fold_in(step_key, 0),
+                                        jax.random.fold_in(step_key, 1)])
+            else:
+                child_keys = jnp.zeros((2,) + ekey.shape, ekey.dtype)
             (bg2, bf2, bt2, bdl2, bcat2, bbits2, blg2, blh2, blc2, blout2,
              brout2) = best_children(
                 jnp.stack([hist_left, hist_right]),
                 jnp.stack([lg, rg]), jnp.stack([lh, rh]),
                 jnp.stack([lc, rc]), jnp.stack([lout, rout]),
-                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]), depth)
+                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]), depth,
+                child_keys)
 
             i32 = jnp.int32
             lrow_f = jnp.stack([lg, lh, lc, lout, bg2[0], blg2[0], blh2[0],
                                 blc2[0], blout2[0], brout2[0], lmin, lmax])
             rrow_f = jnp.stack([rg, rh, rc, rout, bg2[1], blg2[1], blh2[1],
                                 blc2[1], blout2[1], brout2[1], rmin, rmax])
-            lrow_i = jnp.stack([begin, left_count, depth, k, i32(1), bf2[0],
-                                bt2[0], bdl2[0].astype(i32),
+            lrow_i = jnp.stack([begin, left_count, depth, nidx, i32(1),
+                                bf2[0], bt2[0], bdl2[0].astype(i32),
                                 bcat2[0].astype(i32)])
-            rrow_i = jnp.stack([begin + left_count, right_count, depth, k,
+            rrow_i = jnp.stack([begin + left_count, right_count, depth, nidx,
                                 i32(0), bf2[1], bt2[1], bdl2[1].astype(i32),
                                 bcat2[1].astype(i32)])
-            return dict(
+            out = dict(
                 perm=perm, perm_buf=pbuf,
                 leaf_f=leaf_f.at[wl].set(lrow_f).at[wn].set(rrow_f),
                 leaf_i=leaf_i.at[wl].set(lrow_i).at[wn].set(rrow_i),
@@ -578,6 +707,9 @@ class FusedTreeLearner(SerialTreeLearner):
                 hist=hist,
                 num_leaves=st["num_leaves"] + ok.astype(jnp.int32),
             )
+            if forced is not None:
+                out["forcing"] = forcing_next
+            return out
 
         if L > 1:
             state = lax.fori_loop(0, NODES, split_step, state)

@@ -25,7 +25,7 @@ from ..config import Config
 from ..data.dataset import BinnedDataset
 from ..ops.histogram import full_histogram, leaf_histogram
 from ..ops.partition import split_partition
-from ..ops.split import SplitParams, find_best_split
+from ..ops.split import SplitParams, find_best_split, gather_threshold_split
 from ..utils import log
 from .tree import Tree
 
@@ -137,14 +137,47 @@ class SerialTreeLearner:
         self._cegb_split_pen = float(c.cegb_tradeoff * c.cegb_penalty_split)
         self._cegb_used = np.zeros(self.num_features, dtype=bool)
 
+        # original-feature -> used-feature index map
+        self._inner_of = {j: k for k, j in enumerate(dataset.used_features)}
+
         # interaction constraints (reference: src/treelearner/col_sampler.hpp
         # interaction-set filtering): groups of ORIGINAL feature indices
         self.ic_groups = None
         if c.interaction_constraints:
-            inner_of = {j: k for k, j in enumerate(dataset.used_features)}
-            self.ic_groups = [frozenset(inner_of[j] for j in g
-                                        if j in inner_of)
+            self.ic_groups = [frozenset(self._inner_of[j] for j in g
+                                        if j in self._inner_of)
                               for g in c.interaction_constraints]
+
+        # extra_trees: each scan considers ONE uniform-random threshold per
+        # feature (reference: feature_histogram.hpp:192-205 USE_RAND)
+        self.extra_on = bool(config.extra_trees)
+        self._extra_rng = np.random.RandomState(config.extra_seed)
+        self._nb_minus1 = np.maximum(meta["num_bins"].astype(np.int64) - 1, 1)
+        self.nb_minus1_arr = jnp.asarray(self._nb_minus1.astype(np.int32))
+        # feature_contri: per-feature multiplier on the post-shift gain
+        # (reference: feature_histogram.hpp:174 output->gain *= penalty)
+        self.contri_arr = None
+        if config.feature_contri:
+            fc = list(config.feature_contri)
+            contri = np.ones(self.num_features, dtype=np.float32)
+            for k, j in enumerate(dataset.used_features):
+                if j < len(fc):
+                    contri[k] = fc[j]
+            self.contri_arr = jnp.asarray(contri)
+
+        # forced splits (reference: serial_tree_learner.cpp:624 ForceSplits;
+        # the JSON schema of examples/binary_classification/forced_splits.json)
+        self.forced_json = None
+        if config.forcedsplits_filename:
+            import json
+            try:
+                with open(config.forcedsplits_filename) as fh:
+                    fj = json.load(fh)
+            except (OSError, ValueError) as e:
+                log.fatal("cannot read forcedsplits_filename=%r: %s",
+                          config.forcedsplits_filename, e)
+            if fj:
+                self.forced_json = fj
 
         # outputs of the last Train call, used for the O(1)-per-row score update
         self.last_perm: Optional[jax.Array] = None
@@ -213,13 +246,19 @@ class SerialTreeLearner:
         if self.cegb_on:
             pen = (self._cegb_split_pen * pc
                    + self._cegb_coupled * jnp.asarray(~self._cegb_used))
+        rand_t = None
+        if self.extra_on:
+            rand_t = jnp.asarray(
+                (self._extra_rng.randint(0, 1 << 30, self.num_features)
+                 % self._nb_minus1).astype(np.int32))
         res = find_best_split(
             hist, pg, ph, pc, parent_output,
             self.num_bins_arr, self.default_bins_arr, self.missing_types_arr,
             self.is_categorical_arr,
             self._node_fmask(fmask, path_feats), self.params,
             has_categorical=self.has_categorical, constraints=cons,
-            gain_penalty=pen)
+            gain_penalty=pen, rand_thresholds=rand_t,
+            gain_contri=self.contri_arr)
         return _HostSplit(jax.device_get(res))
 
     # histogram hook points (overridden by the distributed learners) --------
@@ -254,6 +293,35 @@ class SerialTreeLearner:
             out[cat // 32] |= np.uint32(1) << np.uint32(cat % 32)
         return out
 
+    def _forced_bin(self, node) -> Optional[tuple]:
+        """Map a forced-split JSON node to (inner_feature, threshold_bin).
+        Returns None (→ abort forcing) when the feature is unused or the
+        threshold maps to no bin (the analog of InnerFeatureIndex +
+        BinThreshold in ForceSplits)."""
+        try:
+            j = int(node["feature"])
+            thr = float(node["threshold"])
+        except (KeyError, TypeError, ValueError):
+            log.warning("Malformed forced-split node %r; aborting forced "
+                        "splits", node)
+            return None
+        k = self._inner_of.get(j)
+        if k is None:
+            log.warning("Forced split on unused feature %d; aborting forced "
+                        "splits", j)
+            return None
+        mapper = self.dataset.mappers[j]
+        if self.meta_host["is_categorical"][k]:
+            thr_bin = mapper.categorical_2_bin.get(int(thr))
+            if thr_bin is None:
+                log.warning("Forced categorical split on unseen category %d "
+                            "of feature %d; aborting forced splits",
+                            int(thr), j)
+                return None
+        else:
+            thr_bin = mapper._value_to_bin_scalar(thr)
+        return k, int(thr_bin)
+
     # ------------------------------------------------------------------
     def train(self, grad: jax.Array, hess: jax.Array,
               row_mask: Optional[jax.Array] = None) -> Tree:
@@ -286,17 +354,12 @@ class SerialTreeLearner:
         tree.leaf_weight[0] = float(jax.device_get(totals[1]))
         tree.leaf_count[0] = int(float(jax.device_get(totals[2])))
 
-        for _ in range(num_leaves - 1):
-            # pick the leaf with max gain (ArgMax over best_split_per_leaf_,
-            # reference: serial_tree_learner.cpp:225)
-            cand = [(s.gain_f, leaf) for leaf, s in best.items()
-                    if np.isfinite(s.gain_f) and s.gain_f > 0
-                    and (max_depth <= 0 or tree.leaf_depth[leaf] < max_depth)]
-            if not cand:
-                break
-            _, leaf = max(cand)
-            s = best.pop(leaf)
-
+        def apply_split(leaf: int, s: _HostSplit) -> Optional[int]:
+            """Partition + record split ``s`` on ``leaf``, then compute both
+            children's histograms and best splits (the loop body shared by
+            the forced-splits phase and the gain-driven main loop). Returns
+            the right child's leaf id, or None when numerically degenerate."""
+            nonlocal perm
             begin, count = int(leaf_begin[leaf]), int(leaf_count[leaf])
             P = self._pad_size(count)
             feat = int(s.feature)
@@ -324,7 +387,7 @@ class SerialTreeLearner:
                 # numerically degenerate split; drop this leaf from candidates
                 log.warning("Degenerate split on leaf %d (feature %d): "
                             "left=%d right=%d; skipping", leaf, feat, left_cnt, right_cnt)
-                continue
+                return None
 
             j = self.dataset.used_features[feat]
             mapper = self.dataset.mappers[j]
@@ -377,7 +440,7 @@ class SerialTreeLearner:
                 self._cegb_used[feat] = True
 
             if tree.num_leaves >= num_leaves:
-                break  # no more splits: skip children histograms
+                return right_leaf  # no more splits: skip children histograms
 
             # smaller child gets a fresh histogram; sibling by subtraction
             # (reference: serial_tree_learner.cpp:408-476)
@@ -403,6 +466,59 @@ class SerialTreeLearner:
                                           paths[large_leaf])
             sums[small_leaf] = s_sums
             sums[large_leaf] = g_sums
+            return right_leaf
+
+        # ---- forced-splits phase (reference: serial_tree_learner.cpp:624
+        # ForceSplits): BFS over the JSON tree, splitting each named node at
+        # its fixed (feature, threshold) before any gain-driven search; a
+        # non-positive forced gain aborts the remaining forcing
+        if self.forced_json is not None:
+            from collections import deque
+            q = deque([(self.forced_json, 0)])
+            while q and tree.num_leaves < num_leaves:
+                node, leaf = q.popleft()
+                fb = self._forced_bin(node)
+                if fb is None:
+                    break
+                k, thr_bin = fb
+                if max_depth > 0 and tree.leaf_depth[leaf] >= max_depth:
+                    break
+                pg, ph, pc, pout = sums[leaf]
+                fbounds = None
+                if self.mono_on:
+                    lo, hi = bounds.get(leaf, (-np.inf, np.inf))
+                    fbounds = (jnp.float32(lo), jnp.float32(hi))
+                res = gather_threshold_split(
+                    hists[leaf][k], pg, ph, pc, pout, jnp.int32(k),
+                    jnp.int32(thr_bin), self.num_bins_arr[k],
+                    self.default_bins_arr[k], self.missing_types_arr[k],
+                    self.is_categorical_arr[k], self.params, bounds=fbounds)
+                s = _HostSplit(jax.device_get(res))
+                if not np.isfinite(s.gain_f) or s.gain_f <= 0:
+                    log.warning("Forced split on feature %d ignored (gain "
+                                "not positive); aborting remaining forced "
+                                "splits", int(node["feature"]))
+                    break
+                best.pop(leaf, None)
+                right_leaf = apply_split(leaf, s)
+                if right_leaf is None:
+                    break
+                for key, child in (("left", leaf), ("right", right_leaf)):
+                    ch = node.get(key)
+                    if (isinstance(ch, dict) and "feature" in ch
+                            and "threshold" in ch):
+                        q.append((ch, child))
+
+        # ---- gain-driven main loop: pick the leaf with max gain (ArgMax
+        # over best_split_per_leaf_, reference: serial_tree_learner.cpp:225)
+        while tree.num_leaves < num_leaves:
+            cand = [(s.gain_f, leaf) for leaf, s in best.items()
+                    if np.isfinite(s.gain_f) and s.gain_f > 0
+                    and (max_depth <= 0 or tree.leaf_depth[leaf] < max_depth)]
+            if not cand:
+                break
+            _, leaf = max(cand)
+            apply_split(leaf, best.pop(leaf))
 
         self.last_perm = perm
         self.last_leaf_begin = leaf_begin[:tree.num_leaves].copy()

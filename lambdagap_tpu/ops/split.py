@@ -115,11 +115,14 @@ def split_gains(lg, lh, rg, rh, p: SplitParams, l_cnt=None, r_cnt=None,
 
 def _numerical_best(hist, parent_g, parent_h, parent_c, parent_output,
                     num_bins, default_bins, missing_types, feature_mask,
-                    p: SplitParams, constraints=None):
+                    p: SplitParams, constraints=None, rand_thresholds=None):
     """Both-direction scan for all features at once.
 
     ``constraints``: optional (monotone[F] in {-1,0,+1}, min_c, max_c) for
     monotone-constrained leaves (None = unconstrained fast path).
+    ``rand_thresholds``: optional [F] i32 — extra_trees mode, each feature
+    considers ONLY its random threshold (reference:
+    feature_histogram.hpp:192-205 USE_RAND / rand_threshold).
     Returns per-feature best: (gain[F], threshold[F], default_left[F],
     left_g[F], left_h[F], left_c[F]).
     """
@@ -193,6 +196,8 @@ def _numerical_best(hist, parent_g, parent_h, parent_c, parent_output,
     # NaN bin alone on the right (it must stay left), so t = num_bin-2 is
     # excluded there (reference: reverse loop starts at num_bin-2-NA_AS_MISSING).
     cand = (bin_idx < nb - 1) & (feature_mask[:, None])
+    if rand_thresholds is not None:
+        cand = cand & (bin_idx == rand_thresholds[:, None])
     cand_f = cand & ~(is_zero_missing & is_default)
     cand_r = cand_f & ~(is_nan_missing & (bin_idx == nb - 2))
     gain_f = jnp.where(cand_f, gain_f, K_MIN_SCORE)
@@ -219,7 +224,7 @@ def _numerical_best(hist, parent_g, parent_h, parent_c, parent_output,
 
 def _categorical_best(hist, parent_g, parent_h, parent_c, parent_output,
                       num_bins, feature_mask, p: SplitParams,
-                      constraints=None):
+                      constraints=None, rand_thresholds=None):
     """Categorical split search
     (reference: feature_histogram.hpp FindBestThresholdCategoricalInner):
     one-vs-rest for small cardinality, otherwise scan prefixes of bins sorted
@@ -264,9 +269,19 @@ def _categorical_best(hist, parent_g, parent_h, parent_c, parent_output,
                                          l2_extra=p.cat_l2))
         return jnp.where(ok, gain, K_MIN_SCORE)
 
+    # extra_trees: one random candidate position per feature (reference:
+    # the USE_RAND checks inside FindBestThresholdCategoricalInner,
+    # feature_histogram.hpp:1152,1269); here the same random draw indexes
+    # both the one-hot bin and the sorted-order position
+    rand_pos = None
+    if rand_thresholds is not None:
+        rand_pos = (rand_thresholds[:, None] % jnp.maximum(nb - 1, 1))
+
     # --- one-vs-rest: category k alone goes left --------------------------
-    onehot_gain = jnp.where(valid_bin & feature_mask[:, None],
-                            gains_for(g, h, c), K_MIN_SCORE)
+    onehot_cand = valid_bin & feature_mask[:, None]
+    if rand_pos is not None:
+        onehot_cand = onehot_cand & (bin_idx == rand_pos)
+    onehot_gain = jnp.where(onehot_cand, gains_for(g, h, c), K_MIN_SCORE)
 
     # --- sorted-subset: order bins by g/(h + cat_smooth); scan BOTH
     # directions (prefixes and suffixes of the order), mirroring the
@@ -288,7 +303,10 @@ def _categorical_best(hist, parent_g, parent_h, parent_c, parent_output,
     csum_c = jnp.cumsum(c_s, axis=1)
     prefix_len = jnp.cumsum(v_s.astype(jnp.int32), axis=1)
     cap_ok = prefix_len <= p.max_cat_threshold
-    sorted_gain = jnp.where(cap_ok & v_s & feature_mask[:, None],
+    sorted_cand = cap_ok & v_s & feature_mask[:, None]
+    if rand_pos is not None:
+        sorted_cand = sorted_cand & (bin_idx == rand_pos)
+    sorted_gain = jnp.where(sorted_cand,
                             gains_for(csum_g, csum_h, csum_c), K_MIN_SCORE)
 
     # suffix direction: left set = bins AFTER position t in the order
@@ -302,7 +320,10 @@ def _categorical_best(hist, parent_g, parent_h, parent_c, parent_output,
     n_valid = prefix_len[:, -1:]
     sfx_len = n_valid - prefix_len
     sfx_cap = (sfx_len <= p.max_cat_threshold) & (sfx_len > 0)
-    suffix_gain = jnp.where(sfx_cap & v_s & feature_mask[:, None],
+    sfx_cand = sfx_cap & v_s & feature_mask[:, None]
+    if rand_pos is not None:
+        sfx_cand = sfx_cand & (bin_idx == rand_pos)
+    suffix_gain = jnp.where(sfx_cand,
                             gains_for(sfx_g, sfx_h, sfx_c), K_MIN_SCORE)
 
     # choose between strategies per feature
@@ -372,7 +393,7 @@ def per_feature_best(hist: jax.Array, parent_g, parent_h, parent_c,
                      parent_output, num_bins, default_bins, missing_types,
                      is_categorical, feature_mask, params: SplitParams,
                      has_categorical: bool = False, constraints=None,
-                     gain_penalty=None):
+                     gain_penalty=None, rand_thresholds=None):
     """Per-feature best split candidates (the per-feature stage of
     ``FindBestSplitsFromHistograms``), used directly by the voting-parallel
     learner's local top-k vote (reference:
@@ -382,12 +403,13 @@ def per_feature_best(hist: jax.Array, parent_g, parent_h, parent_c,
     num_gain, num_t, num_dl, num_lg, num_lh, num_lc = _numerical_best(
         hist, parent_g, parent_h, parent_c, parent_output,
         num_bins, default_bins, missing_types,
-        feature_mask & ~is_categorical, p, constraints)
+        feature_mask & ~is_categorical, p, constraints, rand_thresholds)
 
     if has_categorical:
         cat_gain, cat_t, cat_lg, cat_lh, cat_lc, cat_bits = _categorical_best(
             hist, parent_g, parent_h, parent_c, parent_output,
-            num_bins, feature_mask & is_categorical, p, constraints)
+            num_bins, feature_mask & is_categorical, p, constraints,
+            rand_thresholds)
     else:
         cat_gain = jnp.full((F,), K_MIN_SCORE)
         cat_t = jnp.zeros((F,), jnp.int32)
@@ -408,6 +430,101 @@ def per_feature_best(hist: jax.Array, parent_g, parent_h, parent_c,
     return gain, thr, dl, lg, lh, lc, cat_bits
 
 
+def monotone_split_penalty(depth, penalization: float):
+    """Gain multiplier for splits on monotone-constrained features at a
+    given leaf depth (reference: monotone_constraints.hpp:357
+    ComputeMonotoneSplitGainPenalty): ~0 for the first
+    floor(penalization) levels, then a decaying penalty."""
+    d = jnp.asarray(depth, jnp.float32)
+    p = float(penalization)
+    pen = jnp.where(p <= 1.0,
+                    1.0 - p / jnp.exp2(d) + K_EPSILON,
+                    1.0 - jnp.exp2(p - 1.0 - d) + K_EPSILON)
+    return jnp.where(p >= d + 1.0, K_EPSILON, pen)
+
+
+def gather_threshold_split(hist_f, parent_g, parent_h, parent_c,
+                           parent_output, feature, threshold, num_bin,
+                           default_bin, missing_type, is_cat,
+                           params: SplitParams, bounds=None) -> SplitResult:
+    """Split info at a FIXED (feature, threshold) — the forced-splits path
+    (reference: src/treelearner/feature_histogram.hpp:474-609
+    GatherInfoForThresholdNumerical/Categorical).
+
+    Numerical semantics match the reference gather: right = bins in
+    (threshold, num_bin) excluding the missing bin's content, so missing
+    values always ride LEFT and ``default_left`` is True. Categorical is the
+    one-hot form: bin == threshold goes left, ``default_left`` False. The
+    gain is shifted by the parent gain + min_gain_to_split and set to
+    ``kMinScore`` when the forced split is worse than not splitting (the
+    caller aborts forcing then, like ForceSplits'
+    ``abort_last_forced_split``).
+    """
+    p = params
+    g = hist_f[:, 0].astype(jnp.float32)
+    h = hist_f[:, 1].astype(jnp.float32)
+    c = hist_f[:, 2].astype(jnp.float32)
+    B = hist_f.shape[0]
+    bin_idx = jnp.arange(B, dtype=jnp.int32)
+    in_range = bin_idx < num_bin
+    excl = (((missing_type == MT_ZERO) & (bin_idx == default_bin))
+            | ((missing_type == MT_NAN) & (bin_idx == num_bin - 1)))
+    right_mask = (bin_idx > threshold) & in_range & ~excl
+    rg = jnp.sum(jnp.where(right_mask, g, 0.0))
+    rh = jnp.sum(jnp.where(right_mask, h, 0.0))
+    rc = jnp.sum(jnp.where(right_mask, c, 0.0))
+    lg_num, lh_num, lc_num = parent_g - rg, parent_h - rh, parent_c - rc
+    sel = (bin_idx == threshold) & in_range
+    lg_cat = jnp.sum(jnp.where(sel, g, 0.0))
+    lh_cat = jnp.sum(jnp.where(sel, h, 0.0))
+    lc_cat = jnp.sum(jnp.where(sel, c, 0.0))
+    lg = jnp.where(is_cat, lg_cat, lg_num)
+    lh = jnp.where(is_cat, lh_cat, lh_num)
+    lc = jnp.where(is_cat, lc_cat, lc_num)
+    rg2, rh2, rc2 = parent_g - lg, parent_h - lh, parent_c - lc
+
+    gain_num = split_gains(lg, lh, rg2, rh2, p, lc, rc2, parent_output)
+    gain_cat = split_gains(lg, lh, rg2, rh2, p, lc, rc2, parent_output,
+                           l2_extra=p.cat_l2)
+    gain_raw = jnp.where(is_cat, gain_cat, gain_num)
+    shift = leaf_gain(parent_g, parent_h, p, parent_c, parent_output) \
+        + p.min_gain_to_split
+    # a split that leaves either side without hessian mass is degenerate
+    usable = (lh > 0) & (rh2 > 0) & (lc > 0) & (rc2 > 0)
+    splittable = usable & jnp.isfinite(gain_raw) & (gain_raw > shift)
+
+    lout_n = calculate_leaf_output(lg, lh, p, lc, parent_output)
+    rout_n = calculate_leaf_output(rg2, rh2, p, rc2, parent_output)
+    lout_c = calculate_leaf_output(lg, lh, p, lc, parent_output,
+                                   l2_extra=p.cat_l2)
+    rout_c = calculate_leaf_output(rg2, rh2, p, rc2, parent_output,
+                                   l2_extra=p.cat_l2)
+    lout = jnp.where(is_cat, lout_c, lout_n)
+    rout = jnp.where(is_cat, rout_c, rout_n)
+    if bounds is not None:
+        min_c, max_c = bounds
+        lout = jnp.clip(lout, min_c, max_c)
+        rout = jnp.clip(rout, min_c, max_c)
+
+    thr32 = threshold.astype(jnp.uint32) if hasattr(threshold, "astype") \
+        else jnp.uint32(threshold)
+    words = jnp.arange(8, dtype=jnp.uint32)
+    cat_bits = jnp.where(words == thr32 // 32,
+                         jnp.left_shift(jnp.uint32(1), thr32 % 32),
+                         jnp.uint32(0))
+    return SplitResult(
+        gain=jnp.where(splittable, gain_raw - shift, K_MIN_SCORE),
+        feature=jnp.int32(feature),
+        threshold=jnp.int32(threshold),
+        default_left=~is_cat,
+        left_sum_g=lg, left_sum_h=lh, left_count=lc,
+        right_sum_g=rg2, right_sum_h=rh2, right_count=rc2,
+        left_output=lout, right_output=rout,
+        is_categorical=jnp.asarray(is_cat),
+        cat_bitset=jnp.where(jnp.asarray(is_cat), cat_bits, jnp.uint32(0)),
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("params", "has_categorical"))
 def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
                     parent_c: jax.Array, parent_output: jax.Array,
@@ -415,23 +532,31 @@ def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
                     missing_types: jax.Array, is_categorical: jax.Array,
                     feature_mask: jax.Array, params: SplitParams,
                     has_categorical: bool = False,
-                    constraints=None, gain_penalty=None) -> SplitResult:
+                    constraints=None, gain_penalty=None,
+                    rand_thresholds=None, gain_contri=None) -> SplitResult:
     """Best split for one leaf over all features.
 
     The analog of ``FindBestSplitsFromHistograms`` + per-leaf argmax
     (reference: src/treelearner/serial_tree_learner.cpp:477+, :225).
+
+    ``gain_contri``: optional [F] multiplier on the post-shift gain
+    (feature_contri — reference: feature_histogram.hpp:174 ``output->gain
+    *= meta_->penalty``).
     """
     p = params
     use_cat = is_categorical
     gain, thr, dl, lg, lh, lc, cat_bits = per_feature_best(
         hist, parent_g, parent_h, parent_c, parent_output, num_bins,
         default_bins, missing_types, is_categorical, feature_mask, params,
-        has_categorical, constraints, gain_penalty)
+        has_categorical, constraints, gain_penalty, rand_thresholds)
 
     # parent gain shift (reference: BeforeNumerical gain_shift + min_gain_to_split)
     parent_gain = leaf_gain(parent_g, parent_h, p, parent_c, parent_output)
     shift = parent_gain + p.min_gain_to_split
 
+    if gain_contri is not None:
+        gain = jnp.where(jnp.isfinite(gain),
+                         (gain - shift) * gain_contri + shift, gain)
     best_f = jnp.argmax(gain, axis=0).astype(jnp.int32)
     best_gain_raw = gain[best_f]
     split_gain = best_gain_raw - shift

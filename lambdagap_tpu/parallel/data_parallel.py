@@ -201,6 +201,12 @@ class DataParallelTreeLearner(SerialTreeLearner):
     def train(self, grad: jax.Array, hess: jax.Array,
               row_mask: Optional[jax.Array] = None) -> Tree:
         cfg = self.config
+        if self.forced_json is not None:
+            from ..utils import log
+            log.warning("forcedsplits_filename is not supported by the "
+                        "host-loop data/voting-parallel learners (use the "
+                        "fused data-parallel learner); forced splits ignored")
+            self.forced_json = None
         num_leaves = cfg.num_leaves
         max_depth = cfg.max_depth
         tree = Tree(max_leaves=num_leaves)

@@ -73,7 +73,7 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
         qspec = P(DATA_AXIS) if self.quant else P()
         in_specs = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(),
                     P(DATA_AXIS, None), P(None, DATA_AXIS),
-                    qspec, qspec, P(), P())
+                    qspec, qspec, P(), P(), P())
         out_specs = DeviceTree(
             node_feature=P(), node_threshold=P(), node_default_left=P(),
             node_is_cat=P(), node_cat_bits=P(), node_left=P(),
@@ -125,8 +125,12 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
         else:
             gq = hq = jnp.zeros(1, jnp.int8)
             gs = hs = jnp.float32(1.0)
+        if self.extra_on:
+            self._ekey, ekey = jax.random.split(self._ekey)
+        else:
+            ekey = jnp.zeros(2, jnp.uint32)
         rec = self._train_jit_dp(g, h, m, fmask, self.hx_rows, self.x_cols,
-                                 gq, hq, gs, hs)
+                                 gq, hq, gs, hs, ekey)
         # consumers (score update, leaf renewal) see an unpadded [N] leaf map
         rec = rec._replace(row_leaf=rec.row_leaf[:self.num_data])
         self.last_row_leaf = rec.row_leaf

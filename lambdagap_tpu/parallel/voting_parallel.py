@@ -36,6 +36,10 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
 
     def _build_ops(self) -> None:
         super()._build_ops()
+        if self.config.extra_trees:
+            from ..utils import log
+            log.fatal("extra_trees is not supported with "
+                      "tree_learner=voting (use serial or data)")
         mesh = self.mesh
         B = self.B
         rpb = self.rows_per_block
@@ -107,7 +111,9 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
                 hist_voted, pg, ph, pc, pout,
                 num_bins[votes], default_bins[votes], missing_types[votes],
                 is_cat[votes], fmask[votes], params,
-                has_categorical=has_cat, constraints=cons)
+                has_categorical=has_cat, constraints=cons,
+                gain_contri=(self.contri_arr[votes]
+                             if self.contri_arr is not None else None))
             # remap the winning index back to the true feature id
             true_feat = votes[res.feature]
             return res._replace(feature=true_feat)

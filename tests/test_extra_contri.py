@@ -1,0 +1,74 @@
+"""extra_trees (random-threshold scans), feature_contri (per-feature gain
+scaling), and the deterministic contract (reference:
+feature_histogram.hpp:192-205 USE_RAND, :174 penalty;
+include/LightGBM/config.h:268 deterministic)."""
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification
+
+import lambdagap_tpu as lgb
+
+
+def _data(seed=0):
+    return make_classification(2000, 8, n_informative=5, random_state=seed)
+
+
+def _train(X, y, rounds=10, **params):
+    p = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+         "min_data_in_leaf": 5}
+    p.update(params)
+    return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_extra_trees_learns_and_differs(fused):
+    X, y = _data()
+    f = "1" if fused else "0"
+    base = _train(X, y, tpu_fused_learner=f)
+    extra = _train(X, y, extra_trees=True, tpu_fused_learner=f)
+    # randomized thresholds -> different model than exhaustive scan
+    assert extra.model_to_string() != base.model_to_string()
+    # but it still learns the signal
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, extra.predict(X)) > 0.85
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_extra_trees_seed_reproducible(fused):
+    X, y = _data(seed=1)
+    f = "1" if fused else "0"
+    a = _train(X, y, extra_trees=True, extra_seed=11, tpu_fused_learner=f)
+    b = _train(X, y, extra_trees=True, extra_seed=11, tpu_fused_learner=f)
+    c = _train(X, y, extra_trees=True, extra_seed=12, tpu_fused_learner=f)
+    assert a.model_to_string() == b.model_to_string()
+    assert a.model_to_string() != c.model_to_string()
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_feature_contri_steers_root_split(fused):
+    X, y = _data(seed=2)
+    f = "1" if fused else "0"
+    base = _train(X, y, rounds=1, tpu_fused_learner=f)
+    root_feat = base.dump_model()["tree_info"][0]["tree_structure"][
+        "split_feature"]
+    # crush the natural winner's gain; the root must pick something else
+    contri = [1.0] * X.shape[1]
+    contri[root_feat] = 1e-4
+    steered = _train(X, y, rounds=1, feature_contri=contri,
+                     tpu_fused_learner=f)
+    new_root = steered.dump_model()["tree_info"][0]["tree_structure"][
+        "split_feature"]
+    assert new_root != root_feat
+    # all-ones contri is a no-op
+    same = _train(X, y, rounds=1, feature_contri=[1.0] * X.shape[1],
+                  tpu_fused_learner=f)
+    assert same.model_to_string() == base.model_to_string()
+
+
+def test_deterministic_repeat_runs_identical():
+    X, y = _data(seed=3)
+    a = _train(X, y, deterministic=True, bagging_fraction=0.8,
+               bagging_freq=1, feature_fraction=0.8)
+    b = _train(X, y, deterministic=True, bagging_fraction=0.8,
+               bagging_freq=1, feature_fraction=0.8)
+    assert a.model_to_string() == b.model_to_string()

@@ -1,0 +1,107 @@
+"""Forced splits (reference: src/treelearner/serial_tree_learner.cpp:624
+ForceSplits + examples/binary_classification/forced_splits.json): the JSON
+tree of (feature, threshold) pairs is applied BFS before the gain-driven
+search, in both the host serial learner and the fused device learner."""
+import json
+import os
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification
+
+import lambdagap_tpu as lgb
+
+REF_BIN = "/root/reference/examples/binary_classification"
+
+
+def _data(seed=0):
+    X, y = make_classification(2000, 8, n_informative=5, random_state=seed)
+    return X, y
+
+
+def _train(X, y, forced, tmp_path, rounds=3, **params):
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(json.dumps(forced))
+    p = {"objective": "binary", "num_leaves": 8, "verbose": -1,
+         "forcedsplits_filename": str(fpath)}
+    p.update(params)
+    return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+def _root(booster, i=0):
+    return booster.dump_model()["tree_info"][i]["tree_structure"]
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_forced_root_and_children(tmp_path, fused):
+    X, y = _data()
+    # feature 7 is noise — never the natural best split; force it at the
+    # median, then force feature 6 on both children
+    med = float(np.median(X[:, 7]))
+    forced = {"feature": 7, "threshold": med,
+              "left": {"feature": 6, "threshold": 0.0},
+              "right": {"feature": 6, "threshold": 0.0}}
+    bst = _train(X, y, forced, tmp_path,
+                 tpu_fused_learner="1" if fused else "0")
+    for i in range(3):   # every tree gets the same forced prefix
+        root = _root(bst, i)
+        assert root["split_feature"] == 7
+        assert abs(root["threshold"] - med) < 0.5
+        assert root["left_child"]["split_feature"] == 6
+        assert root["right_child"]["split_feature"] == 6
+
+
+def test_forced_serial_fused_agree(tmp_path):
+    X, y = _data(seed=1)
+    forced = {"feature": 0, "threshold": 0.2,
+              "left": {"feature": 1, "threshold": -0.1}}
+    b0 = _train(X, y, forced, tmp_path, tpu_fused_learner="0")
+    b1 = _train(X, y, forced, tmp_path, tpu_fused_learner="1")
+    p0 = b0.predict(X[:300])
+    p1 = b1.predict(X[:300])
+    np.testing.assert_allclose(p0, p1, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_forced_abort_on_bad_split(tmp_path, fused):
+    """A forced split with no positive gain aborts forcing; training
+    continues with gain-driven splits (abort_last_forced_split analog)."""
+    X, y = _data(seed=2)
+    # threshold below the minimum puts every row on one side -> no gain
+    forced = {"feature": 0, "threshold": float(X[:, 0].min()) - 100.0,
+              "left": {"feature": 1, "threshold": 0.0}}
+    bst = _train(X, y, forced, tmp_path,
+                 tpu_fused_learner="1" if fused else "0")
+    root = _root(bst)
+    assert "split_feature" in root          # tree still grew
+    preds = bst.predict(X)
+    assert np.all(np.isfinite(preds))
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_BIN),
+                    reason="reference checkout not present")
+def test_forced_reference_example(tmp_path):
+    """The reference's shipped forced-splits config trains against its own
+    binary_classification data with the forced prefix in place."""
+    data = np.loadtxt(os.path.join(REF_BIN, "binary.train"))
+    y, X = data[:, 0], data[:, 1:]
+    forced = json.load(open(os.path.join(REF_BIN, "forced_splits.json")))
+    bst = _train(X, y, forced, tmp_path, rounds=10, num_leaves=31,
+                 metric="auc")
+    root = _root(bst)
+    assert root["split_feature"] == 25
+    assert abs(root["threshold"] - 1.3) < 0.3
+    assert root["left_child"]["split_feature"] == 26
+    assert root["right_child"]["split_feature"] == 26
+    # the model still learns
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.8
+
+
+def test_forced_fused_data_parallel(tmp_path):
+    """Forced splits ride the fused data-parallel (multi-chip) path too."""
+    X, y = _data(seed=3)
+    forced = {"feature": 7, "threshold": float(np.median(X[:, 7]))}
+    bst = _train(X, y, forced, tmp_path, tree_learner="data",
+                 tpu_num_devices=4, min_data_in_leaf=5)
+    assert _root(bst)["split_feature"] == 7
