@@ -7,6 +7,7 @@ framework's own classes directly.)
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Iterable, List, Optional, Union
 
 import numpy as np
@@ -91,6 +92,37 @@ class Dataset:
         if self._constructed is not None:
             return self._constructed
         cfg = config or Config.from_params(self.params)
+        if isinstance(self.data, (str, os.PathLike)):
+            # data straight from a file, sidecars (.weight/.query/.init)
+            # auto-loaded (reference: Dataset accepts a path →
+            # DatasetLoader::LoadFromFile)
+            from .data.loader import load_data_file
+            if isinstance(self.categorical_feature, (list, tuple)):
+                # constructor argument takes the place of the params key,
+                # same as the matrix path
+                cfg.categorical_feature = ",".join(
+                    str(int(c)) for c in self.categorical_feature
+                    if not isinstance(c, str))
+            ref = (self.reference.construct(config)
+                   if self.reference is not None else None)
+            self._constructed = load_data_file(str(self.data), cfg,
+                                               reference=ref)
+            if isinstance(self.feature_name, (list, tuple)):
+                self._constructed.feature_names = [str(n)
+                                                   for n in self.feature_name]
+            md = self._constructed.metadata
+            if self.label is not None:
+                md.label = np.asarray(self.label, np.float32).reshape(-1)
+            if self.weight is not None:
+                md.weight = np.asarray(self.weight, np.float32).reshape(-1)
+            if self.init_score is not None:
+                md.init_score = np.asarray(self.init_score,
+                                           np.float64).reshape(-1)
+            if self.group is not None:
+                md.set_group(self.group)
+            if self.free_raw_data:
+                self.data = None
+            return self._constructed
         seqs = None
         if isinstance(self.data, Sequence):
             seqs = [self.data]
@@ -319,6 +351,12 @@ class Booster:
     def predict(self, data, raw_score: bool = False, start_iteration: int = 0,
                 num_iteration: int = -1, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        if isinstance(data, (str, os.PathLike)):
+            # prediction straight from a data file, label column stripped
+            # (reference: Booster.predict accepts a path; c_api
+            # LGBM_BoosterPredictForFile)
+            from .data.loader import _parse_text_file
+            data, _, _, _ = _parse_text_file(str(data), self._booster.config)
         mat, _, _ = _to_matrix(data)
         if pred_leaf:
             return self._booster.predict_leaf(mat, start_iteration, num_iteration)

@@ -57,7 +57,17 @@ def run_train(cfg: Config) -> None:
     if not cfg.data:
         log.fatal("task=train requires data=<file>")
     log.info("Loading training data from %s", cfg.data)
-    train = load_data_file(cfg.data, cfg)
+    if cfg.pre_partition and cfg.num_machines > 1:
+        # distributed per-rank file loading: join the multi-process runtime
+        # first, then sync bin mappers across ranks (reference:
+        # application.cpp InitTrain -> Network::Init +
+        # dataset_loader.cpp:1072 pre-partitioned construction)
+        from .parallel.multiprocess import (init_distributed,
+                                            load_pre_partitioned)
+        init_distributed(config=cfg)
+        train = load_pre_partitioned(cfg.data, cfg)
+    else:
+        train = load_data_file(cfg.data, cfg)
     booster = create_boosting(cfg, train)
     if cfg.input_model:
         # continued training (reference: application.cpp InitTrain with

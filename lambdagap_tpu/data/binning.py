@@ -152,22 +152,54 @@ def _find_bin_zero_as_one_bin(distinct_values: np.ndarray, counts: np.ndarray,
 def _find_bin_with_forced(distinct_values: np.ndarray, counts: np.ndarray,
                           max_bin: int, total_cnt: int, min_data_in_bin: int,
                           forced_bounds: Sequence[float]) -> List[float]:
-    forced = sorted(set(float(b) for b in forced_bounds))
-    forced = forced[:max_bin - 1]
-    bounds = list(forced)
-    # distribute remaining bins among the forced intervals proportionally to count
-    edges = [-np.inf] + forced + [np.inf]
-    free = max_bin - 1 - len(forced)
+    """(reference: src/io/bin.cpp:157-243 FindBinWithPredefinedBin.)
+
+    The +-kZeroThreshold zero bounds are inserted FIRST (when values exist
+    on that side), before any forced bound, so zero rows never share a bin
+    with nonzero values; forced bounds inside the zero band are dropped for
+    the same reason."""
+    bounds: List[float] = []
+    has_left = bool((distinct_values <= -K_ZERO_THRESHOLD).any())
+    has_right = bool((distinct_values > K_ZERO_THRESHOLD).any())
+    if max_bin == 2:
+        bounds.append(K_ZERO_THRESHOLD if not has_left else -K_ZERO_THRESHOLD)
+    elif max_bin >= 3:
+        if has_left:
+            bounds.append(-K_ZERO_THRESHOLD)
+        if has_right:
+            bounds.append(K_ZERO_THRESHOLD)
+
+    # forced bounds, excluding the zero band (already bounded above)
+    forced = sorted(set(float(b) for b in forced_bounds
+                        if abs(float(b)) > K_ZERO_THRESHOLD))
+    max_to_insert = max_bin - 1 - len(bounds)
+    bounds.extend(forced[:max(max_to_insert, 0)])
+    bounds = sorted(set(bounds))
+
+    # distribute remaining bins among the fixed intervals by sample count
+    free = max_bin - 1 - len(bounds)
     if free > 0:
-        for lo, hi in zip(edges[:-1], edges[1:]):
+        edges = [-np.inf] + bounds + [np.inf]
+        extra: List[float] = []
+        for i, (lo, hi) in enumerate(zip(edges[:-1], edges[1:])):
             seg = (distinct_values > lo) & (distinct_values <= hi)
             if not seg.any():
                 continue
             seg_cnt = int(counts[seg].sum())
-            seg_bins = max(1, int(round(free * seg_cnt / max(total_cnt, 1))))
+            remaining = free - len(extra)
+            if i == len(edges) - 2:
+                seg_bins = remaining + 1
+            else:
+                seg_bins = min(int(round(free * seg_cnt
+                                         / max(total_cnt, 1))),
+                               remaining) + 1
+            if seg_bins <= 1:
+                continue
             seg_bounds = _greedy_find_bin(distinct_values[seg], counts[seg],
                                           seg_bins, seg_cnt, min_data_in_bin)
-            bounds.extend(b for b in seg_bounds if b != np.inf and lo < b <= hi)
+            extra.extend(b for b in seg_bounds
+                         if b != np.inf and lo < b <= hi)
+        bounds.extend(extra)
     bounds = sorted(set(bounds))
     bounds.append(np.inf)
     return bounds

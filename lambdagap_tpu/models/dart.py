@@ -255,9 +255,6 @@ def _warn_unsupported(config: Config) -> None:
         log.info("deterministic=true: runs are bit-reproducible for a fixed "
                  "device count (integer-exact cross-shard sums additionally "
                  "require use_quantized_grad)")
-    if config.monotone_penalty > 0:
-        log.warning("monotone_penalty is NOT implemented; constraints are "
-                    "enforced without the split-depth penalty")
 
 
 def create_boosting(config: Config, train_set) -> GBDT:

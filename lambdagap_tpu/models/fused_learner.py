@@ -409,11 +409,21 @@ class FusedTreeLearner(SerialTreeLearner):
                 constraints=cons, rand_thresholds=rand_t)
             parent_gain = leaf_gain(pg, ph, p, pc, pout)
             shift = parent_gain + p.min_gain_to_split
-            if contri is not None:
-                # feature_contri scales the post-shift gain (reference:
-                # feature_histogram.hpp:174 output->gain *= penalty)
+            mult = contri
+            if mono_on and self.mono_penalty > 0:
+                # depth-dependent monotone split penalty (reference:
+                # serial_tree_learner.cpp:998)
+                from ..ops.split import monotone_split_penalty
+                mp = jnp.where(mono_arr != 0,
+                               monotone_split_penalty(depth,
+                                                      self.mono_penalty),
+                               1.0)
+                mult = mp if mult is None else mult * mp
+            if mult is not None:
+                # feature_contri / monotone penalty scale the post-shift
+                # gain (reference: feature_histogram.hpp:174)
                 gain = jnp.where(jnp.isfinite(gain),
-                                 (gain - shift) * contri + shift, gain)
+                                 (gain - shift) * mult + shift, gain)
             f = jnp.argmax(gain, axis=0).astype(jnp.int32)
             g = gain[f] - shift
             ok = jnp.isfinite(gain[f]) & (g > 0.0)

@@ -142,6 +142,42 @@ class GBDT:
         production path (auto on accelerators); the host-orchestrated
         SerialTreeLearner remains for debugging / explicit opt-out."""
         tl = self.config.tree_learner
+        if getattr(ds, "process_sharded", False):
+            # pre_partition=true multi-process data: only the fused
+            # data-parallel learner consumes process-local row blocks
+            # (reference: pre-partitioned loading feeds the distributed
+            # learners, src/io/dataset_loader.cpp:1072)
+            cfg = self.config
+            if tl not in ("serial", "data"):
+                log.fatal("pre-partitioned multi-process training supports "
+                          "tree_learner=data (got %r)", tl)
+            if cfg.interaction_constraints:
+                log.fatal("interaction_constraints are not supported with "
+                          "pre-partitioned multi-process training")
+            if cfg.linear_tree:
+                log.warning("linear_tree is not supported with "
+                            "pre-partitioned training; training "
+                            "constant-leaf trees")
+                cfg.linear_tree = False
+            if (cfg.monotone_constraints
+                    and cfg.monotone_constraints_method != "basic"):
+                log.warning("monotone_constraints_method=%s is not available "
+                            "on the fused data-parallel learner; using "
+                            "'basic'", cfg.monotone_constraints_method)
+                cfg.monotone_constraints_method = "basic"
+            not_applied = []
+            if cfg.feature_fraction_bynode < 1.0:
+                not_applied.append("feature_fraction_bynode")
+            if cfg.cegb_tradeoff > 0 and (
+                    cfg.cegb_penalty_split > 0
+                    or cfg.cegb_penalty_feature_coupled
+                    or cfg.cegb_penalty_feature_lazy):
+                not_applied.append("cegb")
+            if not_applied:
+                log.warning("%s are not applied by pre-partitioned training",
+                            ", ".join(not_applied))
+            from ..parallel.fused_parallel import FusedDataParallelTreeLearner
+            return FusedDataParallelTreeLearner(ds, self.config)
         if tl == "serial":
             cfg = self.config
             mode = cfg.tpu_fused_learner
@@ -153,6 +189,13 @@ class GBDT:
             host_only = []
             if cfg.interaction_constraints:
                 host_only.append("interaction_constraints")
+            if (cfg.monotone_constraints
+                    and cfg.monotone_constraints_method != "basic"):
+                # intermediate needs cross-leaf constraint propagation +
+                # re-scans — host-orchestrated only (the fused program's
+                # straight-line step has no re-scan slot)
+                host_only.append("monotone_constraints_method="
+                                 + cfg.monotone_constraints_method)
             if cfg.feature_fraction_bynode < 1.0:
                 host_only.append("feature_fraction_bynode")
             if cfg.linear_tree:
@@ -270,7 +313,7 @@ class GBDT:
     def boosting(self) -> Tuple[jax.Array, jax.Array]:
         """Compute gradients at current scores
         (reference: GBDT::Boosting, gbdt.cpp:222-237)."""
-        return self.objective.get_gradients(self.scores)
+        return self.objective.get_gradients_fast(self.scores)
 
     def train_one_iter(self, grad: Optional[jax.Array] = None,
                        hess: Optional[jax.Array] = None) -> bool:
@@ -284,8 +327,22 @@ class GBDT:
             # boost from average once, before the first gradient computation
             if not self.models and not self.has_init_score \
                     and cfg.boost_from_average:
+                init_obj = self.objective
+                ts = self.train_set
+                if (getattr(ts, "process_sharded", False)
+                        and getattr(ts, "global_label", None) is not None):
+                    # the init score must come from GLOBAL label stats or
+                    # each rank bakes a different constant into tree 0
+                    # (reference: BoostFromAverage syncs over Network)
+                    from ..data.dataset import Metadata
+                    from ..objectives.base import create_objective
+                    md_g = Metadata()
+                    md_g.label = ts.global_label
+                    md_g.weight = ts.global_weight
+                    init_obj = create_objective(cfg)
+                    init_obj.init(md_g, len(ts.global_label))
                 for k in range(self.num_tree_per_iteration):
-                    init = self.objective.boost_from_score(k)
+                    init = init_obj.boost_from_score(k)
                     if abs(init) > K_EPSILON:
                         init_scores[k] = init
                         self.scores = self.scores.at[k].add(init)
@@ -590,6 +647,7 @@ class GBDT:
         (feature_histogram.hpp:198 CalculateSplittedLeafOutput), blended by
         ``refit_decay_rate``."""
         from ..data.dataset import Metadata
+        self._fast_cache = None     # leaf values change in place
         cfg = self.config
         decay = cfg.refit_decay_rate if decay_rate is None else float(decay_rate)
         X = np.ascontiguousarray(np.asarray(data, dtype=np.float32))
@@ -686,6 +744,20 @@ class GBDT:
             len(self.models), (start_iteration + num_iteration) * K)
         return list(range(start_iteration * K, end))
 
+    def _fast_forest(self, idx, trees):
+        """Cached flat forest for the native low-latency predictor; None
+        when the native lib is unavailable."""
+        from ..native import FastForest, get_lib
+        if get_lib() is None:
+            return None
+        key = (len(self.models), idx[0], idx[-1], len(idx))
+        cache = getattr(self, "_fast_cache", None)
+        if cache is None or cache[0] != key:
+            K = self.num_tree_per_iteration
+            self._fast_cache = (key, FastForest(trees, [i % K for i in idx],
+                                                K))
+        return self._fast_cache[1]
+
     def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1) -> np.ndarray:
         """Raw scores for new data [N, D] -> [N] or [N, K].
@@ -701,8 +773,6 @@ class GBDT:
             res = np.zeros((K, N), dtype=np.float32)
             return res[0] if K == 1 else res.T
         trees = [self._tree(i) for i in idx]
-        forest, depth = forest_to_arrays(trees, use_inner_feature=False)
-        tree_class = jnp.asarray([i % K for i in idx], jnp.int32)
         # margin-based prediction early stop, classification only
         # (reference: src/boosting/prediction_early_stop.cpp)
         # freq counts boosting iterations; trees are iter-major, so the
@@ -712,7 +782,19 @@ class GBDT:
                    if self.config.pred_early_stop and self.objective is not None
                    and self.objective.name in ("binary", "multiclass",
                                                "multiclassova") else 0)
-        if any(getattr(t, "is_linear", False) for t in trees):
+        has_linear = any(getattr(t, "is_linear", False) for t in trees)
+        if N <= 512 and not has_linear and es_freq == 0:
+            # serving-shaped call: native host traversal, no jit dispatch
+            # (reference: src/c_api.cpp:63 SingleRowPredictorInner)
+            ff = self._fast_forest(idx, trees)
+            if ff is not None and data.shape[1] > ff.max_feat:
+                res = ff.predict(data).astype(np.float32).T      # [K, N]
+                if self.average_output:
+                    res = res / max(1, len(idx) // max(K, 1))
+                return res[0] if K == 1 else res.T
+        forest, depth = forest_to_arrays(trees, use_inner_feature=False)
+        tree_class = jnp.asarray([i % K for i in idx], jnp.int32)
+        if has_linear:
             res = self._linear_forest_outputs(
                 trees, forest, depth, jnp.asarray(data), data,
                 binned=False).astype(np.float32)
@@ -777,8 +859,14 @@ class GBDT:
         raw = self.predict_raw(data, start_iteration, num_iteration)
         if raw_score or self.objective is None:
             return raw
-        dev = jnp.asarray(raw.T if raw.ndim == 2 else raw[None, :])
-        conv = np.asarray(jax.device_get(self.objective.convert_output(dev)))
+        stacked = raw.T if raw.ndim == 2 else raw[None, :]
+        if raw.shape[0] <= 512:
+            # serving-size batch: transform on host, no device dispatch
+            conv = np.asarray(self.objective.convert_output_np(
+                np.asarray(stacked)))
+        else:
+            conv = np.asarray(jax.device_get(
+                self.objective.convert_output(jnp.asarray(stacked))))
         return conv[0] if self.num_tree_per_iteration == 1 else conv.T
 
     # ------------------------------------------------------------------

@@ -25,7 +25,8 @@ from ..config import Config
 from ..data.dataset import BinnedDataset
 from ..ops.histogram import full_histogram, leaf_histogram
 from ..ops.partition import split_partition
-from ..ops.split import SplitParams, find_best_split, gather_threshold_split
+from ..ops.split import (SplitParams, find_best_split, gather_threshold_split,
+                         monotone_split_penalty)
 from ..utils import log
 from .tree import Tree
 
@@ -103,8 +104,10 @@ class SerialTreeLearner:
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
 
         # monotone constraints, mapped original-feature -> used-feature
-        # (reference: monotone_constraints.hpp; 'basic' method)
+        # (reference: monotone_constraints.hpp — 'basic' and 'intermediate'
+        # methods; 'advanced' falls back to intermediate)
         mono = np.zeros(self.num_features, dtype=np.int32)
+        self.mono_method = config.monotone_constraints_method
         if config.monotone_constraints:
             mc = list(config.monotone_constraints)
             for k, j in enumerate(dataset.used_features):
@@ -113,13 +116,17 @@ class SerialTreeLearner:
             if (mono != 0)[meta["is_categorical"]].any():
                 log.fatal("monotone_constraints cannot be set on "
                           "categorical features")
-            if config.monotone_constraints_method != "basic":
-                log.warning("monotone_constraints_method=%r is not "
-                            "implemented; using 'basic'",
-                            config.monotone_constraints_method)
+            if self.mono_method == "advanced":
+                log.warning("monotone_constraints_method=advanced is not "
+                            "implemented; using 'intermediate'")
+                self.mono_method = "intermediate"
+            elif self.mono_method not in ("basic", "intermediate"):
+                log.fatal("unknown monotone_constraints_method %r",
+                          self.mono_method)
         self.mono_np = mono
         self.mono_arr = jnp.asarray(mono)
         self.mono_on = bool((mono != 0).any())
+        self.mono_penalty = float(config.monotone_penalty)
 
         # CEGB (reference: src/treelearner/cost_effective_gradient_boosting.hpp)
         c = config
@@ -237,7 +244,7 @@ class SerialTreeLearner:
         return jnp.asarray(m)
 
     def _best(self, hist, pg, ph, pc, parent_output, fmask,
-              bounds=None, path_feats=frozenset()) -> _HostSplit:
+              bounds=None, path_feats=frozenset(), depth=0) -> _HostSplit:
         cons = None
         if self.mono_on:
             lo, hi = bounds if bounds is not None else (-np.inf, np.inf)
@@ -251,6 +258,13 @@ class SerialTreeLearner:
             rand_t = jnp.asarray(
                 (self._extra_rng.randint(0, 1 << 30, self.num_features)
                  % self._nb_minus1).astype(np.int32))
+        contri = self.contri_arr
+        if self.mono_on and self.mono_penalty > 0:
+            # depth-dependent gain penalty on monotone features (reference:
+            # serial_tree_learner.cpp:998 + monotone_constraints.hpp:357)
+            mp = monotone_split_penalty(int(depth), self.mono_penalty)
+            mono_pen = jnp.where(self.mono_arr != 0, mp, 1.0)
+            contri = mono_pen if contri is None else contri * mono_pen
         res = find_best_split(
             hist, pg, ph, pc, parent_output,
             self.num_bins_arr, self.default_bins_arr, self.missing_types_arr,
@@ -258,7 +272,7 @@ class SerialTreeLearner:
             self._node_fmask(fmask, path_feats), self.params,
             has_categorical=self.has_categorical, constraints=cons,
             gain_penalty=pen, rand_thresholds=rand_t,
-            gain_contri=self.contri_arr)
+            gain_contri=contri)
         return _HostSplit(jax.device_get(res))
 
     # histogram hook points (overridden by the distributed learners) --------
@@ -354,12 +368,19 @@ class SerialTreeLearner:
         tree.leaf_weight[0] = float(jax.device_get(totals[1]))
         tree.leaf_count[0] = int(float(jax.device_get(totals[2])))
 
+        # intermediate monotone method: per-tree node topology + subtree
+        # markers (reference: IntermediateLeafConstraints state)
+        inter_on = self.mono_on and self.mono_method == "intermediate"
+        node_parent: List[int] = []
+        leaf_mono: Dict[int, bool] = {}
+
         def apply_split(leaf: int, s: _HostSplit) -> Optional[int]:
             """Partition + record split ``s`` on ``leaf``, then compute both
             children's histograms and best splits (the loop body shared by
             the forced-splits phase and the gain-driven main loop). Returns
             the right child's leaf id, or None when numerically degenerate."""
             nonlocal perm
+            pnode_before = int(tree.leaf_parent[leaf])
             begin, count = int(leaf_begin[leaf]), int(leaf_count[leaf])
             P = self._pad_size(count)
             feat = int(s.feature)
@@ -394,6 +415,9 @@ class SerialTreeLearner:
             cat_real = (self._cat_bitset_real(feat, s.cat_bitset)
                         if s.is_categorical else None)
             mt_code = {"None": 0, "Zero": 1, "NaN": 2}[mapper.missing_type]
+            # recorded counts are the IN-BAG histogram counts (the partition
+            # routes out-of-bag rows too, but the reference's bagging counts
+            # only used indices — and the fused learner records in-bag)
             right_leaf = tree.split(
                 leaf, feature=j, feature_inner=feat,
                 threshold_bin=int(s.threshold),
@@ -402,10 +426,19 @@ class SerialTreeLearner:
                 gain=s.gain_f,
                 left_value=float(s.left_output), right_value=float(s.right_output),
                 left_weight=float(s.left_sum_h), right_weight=float(s.right_sum_h),
-                left_count=left_cnt, right_count=right_cnt,
+                left_count=int(round(float(s.left_count))),
+                right_count=int(round(float(s.right_count))),
                 is_categorical=bool(s.is_categorical),
                 cat_bitset=np.asarray(s.cat_bitset),
                 cat_bitset_real=cat_real)
+
+            if inter_on:
+                # BeforeSplit analog: record the new node's parent and mark
+                # the monotone subtree membership of both children
+                node_parent.append(pnode_before)
+                if int(self.mono_np[feat]) != 0 or leaf_mono.get(leaf, False):
+                    leaf_mono[leaf] = True
+                    leaf_mono[right_leaf] = True
 
             leaf_begin[leaf] = begin
             leaf_count[leaf] = left_cnt
@@ -418,19 +451,32 @@ class SerialTreeLearner:
             r_sums = (jnp.float32(s.right_sum_g), jnp.float32(s.right_sum_h),
                       jnp.float32(s.right_count), jnp.float32(s.right_output))
 
-            # children's monotone bounds (basic method: mid of the two
-            # constrained outputs caps the subtree on the constrained side)
+            # children's monotone bounds. basic: the mid of the two outputs
+            # caps the subtree on the constrained side; intermediate: each
+            # child is capped by its SIBLING's output — looser, recovered
+            # accuracy is the method's point (reference:
+            # UpdateConstraintsWithOutputs, monotone_constraints.hpp:545)
             plo, phi = bounds.pop(leaf, (-np.inf, np.inf))
             m = int(self.mono_np[feat])
             llo, lhi, rlo, rhi = plo, phi, plo, phi
             if m != 0:
-                mid = (float(s.left_output) + float(s.right_output)) / 2.0
-                if m > 0:
-                    lhi = min(phi, mid)
-                    rlo = max(plo, mid)
+                lout_f = float(s.left_output)
+                rout_f = float(s.right_output)
+                if inter_on:
+                    if m > 0:
+                        lhi = min(phi, rout_f)
+                        rlo = max(plo, lout_f)
+                    else:
+                        llo = max(plo, rout_f)
+                        rhi = min(phi, lout_f)
                 else:
-                    llo = max(plo, mid)
-                    rhi = min(phi, mid)
+                    mid = (lout_f + rout_f) / 2.0
+                    if m > 0:
+                        lhi = min(phi, mid)
+                        rlo = max(plo, mid)
+                    else:
+                        llo = max(plo, mid)
+                        rhi = min(phi, mid)
             bounds[leaf] = (llo, lhi)
             bounds[right_leaf] = (rlo, rhi)
             child_path = paths.pop(leaf, frozenset()) | {feat}
@@ -458,14 +504,28 @@ class SerialTreeLearner:
 
             hists[small_leaf] = hist_small
             hists[large_leaf] = hist_large
+            child_depth = int(tree.leaf_depth[leaf])
             best[small_leaf] = self._best(hist_small, *s_sums, fmask,
                                           bounds[small_leaf],
-                                          paths[small_leaf])
+                                          paths[small_leaf], child_depth)
             best[large_leaf] = self._best(hist_large, *g_sums, fmask,
                                           bounds[large_leaf],
-                                          paths[large_leaf])
+                                          paths[large_leaf], child_depth)
             sums[small_leaf] = s_sums
             sums[large_leaf] = g_sums
+
+            if inter_on and leaf_mono.get(leaf, False):
+                # tighten bounds of contiguous leaves in monotone ancestors'
+                # opposite subtrees, then refresh their cached best splits
+                upd = _intermediate_propagate(
+                    tree, node_parent, tree.num_leaves - 2, feat,
+                    int(s.threshold), s, bounds, self.mono_np,
+                    lambda lf_: lf_ in best and np.isfinite(best[lf_].gain_f))
+                for ul in set(upd):
+                    if ul in hists:
+                        best[ul] = self._best(hists[ul], *sums[ul], fmask,
+                                              bounds[ul], paths[ul],
+                                              int(tree.leaf_depth[ul]))
             return right_leaf
 
         # ---- forced-splits phase (reference: serial_tree_learner.cpp:624
@@ -529,3 +589,102 @@ class SerialTreeLearner:
 def _leaf_output_scalar(g, h, c, params: SplitParams):
     from ..ops.split import calculate_leaf_output
     return calculate_leaf_output(g, h, params, c, 0.0)
+
+
+def _intermediate_propagate(tree: Tree, node_parent: List[int],
+                            start_node: int, split_feat: int, thr_bin: int,
+                            s, bounds: Dict[int, tuple], mono_np: np.ndarray,
+                            splittable) -> List[int]:
+    """Intermediate-method constraint propagation: walk up from the new
+    split node; in every monotone ancestor's opposite subtree, tighten the
+    min/max bound of each leaf contiguous to the new children using the new
+    children's outputs (reference: monotone_constraints.hpp:560-850
+    IntermediateLeafConstraints::Update / GoUpToFindLeavesToUpdate /
+    GoDownToFindLeavesToUpdate / ShouldKeepGoingLeftRight). Mutates
+    ``bounds`` in place; returns the leaves whose bounds tightened (their
+    cached best splits must be recomputed)."""
+    updated: List[int] = []
+    up_feats: List[int] = []
+    up_thrs: List[int] = []
+    up_was_right: List[bool] = []
+    lout, rout = float(s.left_output), float(s.right_output)
+
+    def go_down(nidx: int, update_max: bool, use_left: bool,
+                use_right: bool) -> None:
+        if nidx < 0:
+            leaf = ~nidx
+            # unsplittable leaves never split again, so their (already
+            # clamped) outputs need no tighter bound
+            if not splittable(leaf):
+                return
+            if use_left and use_right:
+                lo_v, hi_v = min(lout, rout), max(lout, rout)
+            elif use_right:
+                lo_v = hi_v = rout
+            else:
+                lo_v = hi_v = lout
+            plo, phi = bounds.get(leaf, (-np.inf, np.inf))
+            if update_max:
+                new_hi = min(phi, lo_v)
+                if new_hi < phi:
+                    bounds[leaf] = (plo, new_hi)
+                    updated.append(leaf)
+            else:
+                new_lo = max(plo, hi_v)
+                if new_lo > plo:
+                    bounds[leaf] = (new_lo, phi)
+                    updated.append(leaf)
+            return
+        inner_f = tree.split_feature_inner[nidx]
+        thr = tree.threshold_bin[nidx]
+        is_num = not tree.is_categorical[nidx]
+        # contiguity pruning against the recorded up-path splits
+        keep_left = keep_right = True
+        if is_num:
+            for f_i, t_i, r_i in zip(up_feats, up_thrs, up_was_right):
+                if f_i == inner_f:
+                    if thr >= t_i and not r_i:
+                        keep_right = False
+                    if thr <= t_i and r_i:
+                        keep_left = False
+        # same-feature splits below decide which new leaf stays contiguous
+        use_l_for_right = use_r_for_left = True
+        if is_num and inner_f == split_feat:
+            if thr >= thr_bin:
+                use_l_for_right = False
+            if thr <= thr_bin:
+                use_r_for_left = False
+        if keep_left:
+            go_down(tree.left_child[nidx], update_max,
+                    use_left, use_right and use_r_for_left)
+        if keep_right:
+            go_down(tree.right_child[nidx], update_max,
+                    use_left and use_l_for_right, use_right)
+
+    node = start_node
+    while True:
+        parent = node_parent[node] if 0 <= node < len(node_parent) else -1
+        if parent < 0:
+            break
+        inner_f = tree.split_feature_inner[parent]
+        is_right = tree.right_child[parent] == node
+        is_num_parent = not tree.is_categorical[parent]
+        # only branches contiguous to the original leaf can need updates:
+        # for a feature already crossed in the same direction going up,
+        # the opposite child cannot be contiguous
+        opposite_ok = is_num_parent and all(
+            not (f_i == inner_f and r_i == is_right)
+            for f_i, r_i in zip(up_feats, up_was_right))
+        if opposite_ok:
+            if mono_np[inner_f] != 0:
+                left_is_curr = tree.left_child[parent] == node
+                opposite = (tree.right_child[parent] if left_is_curr
+                            else tree.left_child[parent])
+                update_max = (left_is_curr if mono_np[inner_f] < 0
+                              else not left_is_curr)
+                go_down(opposite, update_max, True, True)
+            up_was_right.append(is_right)
+            up_thrs.append(tree.threshold_bin[parent])
+            up_feats.append(inner_f)
+        node = parent
+    return updated

@@ -1,0 +1,196 @@
+// Native matrix binner: raw values -> bin indices in one pass.
+//
+// The TPU-framework analog of the reference's multi-threaded dataset push
+// (reference: src/io/dataset_loader.cpp:203 ConstructFromSampleData +
+// the OpenMP push loops): binning the full matrix is host-side latency on
+// the critical path to the first boosting iteration. The numpy route pays
+// ~6 full-size temporaries per column (f64 cast, nan mask, where, bins,
+// clip, astype); this loop reads each value once and writes one byte.
+//
+// Semantics must match BinMapper.values_to_bins (data/binning.py):
+//   - NaN -> nan_bin when missing_type == NAN (2), else treated as 0.0
+//   - bin = lower_bound(bounds, v)  (numpy searchsorted side='left'),
+//     clipped to num_bounds - 1
+// Bounds arrays exclude the trailing NaN sentinel, exactly as the python
+// path's `bounds` local does.
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// branchless lower_bound (compiles to cmov): first idx with b[idx] >= v
+inline int64_t lower_idx(const double* b, int64_t nb, double v) {
+  const double* base = b;
+  int64_t len = nb;
+  while (len > 1) {
+    int64_t half = len >> 1;
+    base = (base[half - 1] < v) ? base + half : base;
+    len -= half;
+  }
+  return (base - b) + (base[0] < v ? 1 : 0);
+}
+
+template <typename T>
+inline int bin_of(T raw, const double* b, int64_t nb, int8_t missing_type,
+                  int32_t nan_bin) {
+  double v = static_cast<double>(raw);
+  if (std::isnan(v)) {
+    if (missing_type == 2) return nan_bin;
+    v = 0.0;
+  }
+  int64_t idx = lower_idx(b, nb, v);
+  if (idx >= nb) idx = nb - 1;
+  return static_cast<int>(idx);
+}
+
+// Per-feature acceleration grid: table[c] = lower_bound index of the cell's
+// left edge over a uniform grid spanning the finite bound range. A value
+// jumps to its cell's start index and advances past the (typically 0-2)
+// bounds inside the cell — O(1) average instead of a ~8-step dependent-load
+// binary search per value (measured 4x on the bench host).
+struct FeatureGrid {
+  double lo, inv;          // cell = (v - lo) * inv
+  std::vector<int32_t> start;
+};
+
+constexpr int kGridCells = 2048;
+
+inline void build_grid(const double* b, int64_t nb, FeatureGrid* g) {
+  // finite span: bounds end with +inf; nb >= 2 here
+  double lo = b[0];
+  double hi = b[nb - 2];
+  if (!(hi > lo) || !std::isfinite(lo) || !std::isfinite(hi)) {
+    g->start.clear();
+    return;
+  }
+  g->lo = lo;
+  g->inv = kGridCells / (hi - lo);
+  g->start.resize(kGridCells);
+  double width = (hi - lo) / kGridCells;
+  for (int c = 0; c < kGridCells; ++c) {
+    double edge = lo + c * width;
+    g->start[c] = static_cast<int32_t>(lower_idx(b, nb, edge));
+  }
+}
+
+template <typename T, typename OutT>
+inline void bin_col_block(const T* col, int64_t f_total, int64_t b0,
+                          int64_t b1, const double* b, int64_t nb, int8_t mt,
+                          int32_t nanb, OutT* out, int64_t n_used,
+                          const FeatureGrid& g) {
+  if (g.start.empty()) {          // degenerate span: plain binary search
+    for (int64_t i = b0; i < b1; ++i)
+      out[i * n_used] = static_cast<OutT>(bin_of(col[i * f_total], b, nb, mt,
+                                                 nanb));
+    return;
+  }
+  const int32_t* start = g.start.data();
+  const double lo = g.lo, inv = g.inv;
+  for (int64_t i = b0; i < b1; ++i) {
+    double v = static_cast<double>(col[i * f_total]);
+    int64_t idx;
+    if (std::isnan(v)) {
+      if (mt == 2) {
+        out[i * n_used] = static_cast<OutT>(nanb);
+        continue;
+      }
+      v = 0.0;
+    }
+    double c = (v - lo) * inv;
+    if (c < 0.0) {
+      idx = 0;                     // v <= first bound
+    } else {
+      // the >= compare (not a post-cast clamp) also catches +inf and
+      // values past int64 range, where the cast itself would be UB
+      int64_t cell = (c >= static_cast<double>(kGridCells - 1))
+                         ? kGridCells - 1
+                         : static_cast<int64_t>(c);
+      idx = start[cell];
+      while (idx < nb && b[idx] < v) ++idx;
+      // guard the rare rounding case where the cell edge lands above v
+      while (idx > 0 && b[idx - 1] >= v) --idx;
+    }
+    if (idx >= nb) idx = nb - 1;
+    out[i * n_used] = static_cast<OutT>(idx);
+  }
+}
+
+template <typename T, typename OutT>
+void bin_matrix(const T* data, int64_t n, int64_t f_total, int64_t n_used,
+                const int64_t* used_idx, const double* bounds_flat,
+                const int64_t* bounds_off, const int8_t* missing_types,
+                const int32_t* nan_bins, const uint8_t* skip, OutT* out,
+                int n_threads) {
+  std::vector<FeatureGrid> grids(n_used);
+  for (int64_t k = 0; k < n_used; ++k) {
+    if (skip[k]) continue;
+    int64_t nb = bounds_off[k + 1] - bounds_off[k];
+    if (nb >= 2) build_grid(bounds_flat + bounds_off[k], nb, &grids[k]);
+  }
+  // feature-major within row blocks: the block's data stays in L2 across
+  // feature passes while each feature's bounds + grid stay hot in L1
+  constexpr int64_t kBlock = 1024;
+  auto work = [&](int64_t r0, int64_t r1) {
+    for (int64_t b0 = r0; b0 < r1; b0 += kBlock) {
+      int64_t b1 = std::min(r1, b0 + kBlock);
+      for (int64_t k = 0; k < n_used; ++k) {
+        if (skip[k]) continue;
+        bin_col_block(data + used_idx[k], f_total, b0, b1,
+                      bounds_flat + bounds_off[k],
+                      bounds_off[k + 1] - bounds_off[k], missing_types[k],
+                      nan_bins[k], out + k, n_used, grids[k]);
+      }
+    }
+  };
+  if (n_threads <= 0) {
+    n_threads = static_cast<int>(std::thread::hardware_concurrency());
+    if (n_threads <= 0) n_threads = 1;
+  }
+  if (n_threads == 1 || n < (int64_t)n_threads * 4096) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t r0 = t * chunk;
+    int64_t r1 = std::min(n, r0 + chunk);
+    if (r0 >= r1) break;
+    ts.emplace_back(work, r0, r1);
+  }
+  for (auto& th : ts) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// dtype_code: 0 = float64, 1 = float32; out16: 0 = uint8, 1 = uint16
+void lg_bin_matrix(const void* data, int dtype_code, int64_t n,
+                   int64_t f_total, int64_t n_used, const int64_t* used_idx,
+                   const double* bounds_flat, const int64_t* bounds_off,
+                   const int8_t* missing_types, const int32_t* nan_bins,
+                   const uint8_t* skip, void* out, int out16,
+                   int n_threads) {
+  if (dtype_code == 0 && !out16)
+    bin_matrix(static_cast<const double*>(data), n, f_total, n_used,
+               used_idx, bounds_flat, bounds_off, missing_types, nan_bins,
+               skip, static_cast<uint8_t*>(out), n_threads);
+  else if (dtype_code == 0)
+    bin_matrix(static_cast<const double*>(data), n, f_total, n_used,
+               used_idx, bounds_flat, bounds_off, missing_types, nan_bins,
+               skip, static_cast<uint16_t*>(out), n_threads);
+  else if (!out16)
+    bin_matrix(static_cast<const float*>(data), n, f_total, n_used,
+               used_idx, bounds_flat, bounds_off, missing_types, nan_bins,
+               skip, static_cast<uint8_t*>(out), n_threads);
+  else
+    bin_matrix(static_cast<const float*>(data), n, f_total, n_used,
+               used_idx, bounds_flat, bounds_off, missing_types, nan_bins,
+               skip, static_cast<uint16_t*>(out), n_threads);
+}
+
+}  // extern "C"

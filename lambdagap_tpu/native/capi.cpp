@@ -1,0 +1,317 @@
+// Standalone C serving ABI: load a saved LambdaGap/LightGBM-format text
+// model and predict from C/C++ with no Python or JAX in the process.
+//
+// This is the TPU framework's answer to the reference's C API surface for
+// the serving-side use cases (reference: src/c_api.cpp — model load +
+// LGBM_BoosterPredictForMat / the thread-safe single-row fast predictor at
+// src/c_api.cpp:63). Training stays behind the Python API (the compute path
+// is JAX/XLA); what a C consumer needs at run time is model loading and
+// low-latency prediction, which live here with reference-compatible
+// function names. Build standalone:
+//   g++ -O2 -shared -fPIC -std=c++17 capi.cpp -o liblambdagap_c.so
+// (also compiled into the package's _lg_native.so).
+//
+// Supported: numerical/categorical splits, all three missing types, linear
+// trees, binary/multiclass/regression/poisson-family output transforms,
+// random-forest average_output. Predict types: 0 = transformed, 1 = raw.
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct CTree {
+  int num_leaves = 1;
+  std::vector<int32_t> split_feature;
+  std::vector<double> threshold;
+  std::vector<uint8_t> decision_type;
+  std::vector<int32_t> left_child, right_child;
+  std::vector<double> leaf_value;
+  std::vector<int32_t> cat_boundaries;
+  std::vector<uint32_t> cat_threshold;
+  bool is_linear = false;
+  std::vector<double> leaf_const;
+  std::vector<int32_t> leaf_feat_off;      // [L+1]
+  std::vector<int32_t> leaf_feat;
+  std::vector<double> leaf_coeff;
+
+  double predict_row(const double* row) const {
+    int leaf = 0;
+    if (num_leaves > 1) {
+      int node = 0;
+      while (node >= 0) {
+        const uint8_t dt = decision_type[node];
+        const double fv = row[split_feature[node]];
+        bool go_left;
+        if (dt & 1) {  // categorical
+          go_left = false;
+          if (!std::isnan(fv)) {
+            int64_t cat = static_cast<int64_t>(fv);
+            int lo = cat_boundaries[static_cast<int>(threshold[node])];
+            int hi = cat_boundaries[static_cast<int>(threshold[node]) + 1];
+            if (cat >= 0 && cat < (int64_t)(hi - lo) * 32)
+              go_left = (cat_threshold[lo + (cat >> 5)] >> (cat & 31)) & 1u;
+          }
+        } else {
+          double v = fv;
+          const int mt = (dt >> 2) & 3;
+          if (std::isnan(v) && mt != 2) v = 0.0;
+          if ((mt == 2 && std::isnan(v)) ||
+              (mt == 1 && std::fabs(v) <= 1e-35)) {
+            go_left = (dt & 2) != 0;
+          } else {
+            go_left = v <= threshold[node];
+          }
+        }
+        node = go_left ? left_child[node] : right_child[node];
+      }
+      leaf = ~node;
+    }
+    if (is_linear) {
+      bool ok = true;
+      double out = leaf_const[leaf];
+      for (int i = leaf_feat_off[leaf]; i < leaf_feat_off[leaf + 1]; ++i) {
+        double v = row[leaf_feat[i]];
+        if (std::isnan(v)) { ok = false; break; }
+        out += v * leaf_coeff[i];
+      }
+      if (ok) return out;
+    }
+    return leaf_value[leaf];
+  }
+};
+
+struct CModel {
+  int num_class = 1;
+  int max_feature_idx = 0;
+  bool average_output = false;
+  std::string objective = "regression";
+  double sigmoid = 1.0;
+  std::vector<CTree> trees;
+
+  void predict(const double* row, int predict_type, double* out) const {
+    for (int k = 0; k < num_class; ++k) out[k] = 0.0;
+    for (size_t t = 0; t < trees.size(); ++t)
+      out[t % num_class] += trees[t].predict_row(row);
+    if (average_output && !trees.empty()) {
+      const double inv = static_cast<double>(num_class) / trees.size();
+      for (int k = 0; k < num_class; ++k) out[k] *= inv;
+    }
+    if (predict_type == 1) return;   // raw scores
+    if (objective == "binary" || objective == "cross_entropy" ||
+        objective == "multiclassova") {
+      for (int k = 0; k < num_class; ++k)
+        out[k] = 1.0 / (1.0 + std::exp(-sigmoid * out[k]));
+    } else if (objective == "multiclass") {
+      double mx = out[0];
+      for (int k = 1; k < num_class; ++k) mx = std::max(mx, out[k]);
+      double s = 0.0;
+      for (int k = 0; k < num_class; ++k) s += (out[k] = std::exp(out[k] - mx));
+      for (int k = 0; k < num_class; ++k) out[k] /= s;
+    } else if (objective == "poisson" || objective == "gamma" ||
+               objective == "tweedie") {
+      for (int k = 0; k < num_class; ++k) out[k] = std::exp(out[k]);
+    } else if (objective == "cross_entropy_lambda") {
+      for (int k = 0; k < num_class; ++k)
+        out[k] = std::log1p(std::exp(out[k]));
+    }
+  }
+};
+
+thread_local std::string g_last_error;
+
+template <typename T, typename F>
+std::vector<T> parse_arr(const std::string& s, F conv) {
+  std::vector<T> out;
+  std::istringstream is(s);
+  std::string tok;
+  while (is >> tok) out.push_back(conv(tok));
+  return out;
+}
+
+bool parse_tree(const std::map<std::string, std::string>& kv, CTree* t) {
+  auto get = [&](const char* k) -> const std::string& {
+    static const std::string empty;
+    auto it = kv.find(k);
+    return it == kv.end() ? empty : it->second;
+  };
+  auto to_i = [](const std::string& x) { return (int32_t)std::stol(x); };
+  auto to_d = [](const std::string& x) { return std::stod(x); };
+  auto to_u8 = [](const std::string& x) { return (uint8_t)std::stoul(x); };
+  auto to_u32 = [](const std::string& x) { return (uint32_t)std::stoul(x); };
+  t->num_leaves = std::stoi(get("num_leaves"));
+  t->split_feature = parse_arr<int32_t>(get("split_feature"), to_i);
+  t->threshold = parse_arr<double>(get("threshold"), to_d);
+  t->decision_type = parse_arr<uint8_t>(get("decision_type"), to_u8);
+  t->left_child = parse_arr<int32_t>(get("left_child"), to_i);
+  t->right_child = parse_arr<int32_t>(get("right_child"), to_i);
+  t->leaf_value = parse_arr<double>(get("leaf_value"), to_d);
+  t->cat_boundaries = parse_arr<int32_t>(get("cat_boundaries"), to_i);
+  t->cat_threshold = parse_arr<uint32_t>(get("cat_threshold"), to_u32);
+  if ((int)t->leaf_value.size() < t->num_leaves) return false;
+  if (get("is_linear") == "1") {
+    t->is_linear = true;
+    t->leaf_const = parse_arr<double>(get("leaf_const"), to_d);
+    auto nf = parse_arr<int32_t>(get("num_features"), to_i);
+    t->leaf_feat = parse_arr<int32_t>(get("leaf_features"), to_i);
+    t->leaf_coeff = parse_arr<double>(get("leaf_coeff"), to_d);
+    t->leaf_feat_off.assign(1, 0);
+    for (int32_t n : nf) t->leaf_feat_off.push_back(t->leaf_feat_off.back() + n);
+    if ((int)t->leaf_feat_off.size() < t->num_leaves + 1) return false;
+  }
+  return true;
+}
+
+CModel* parse_model(const std::string& text) {
+  std::unique_ptr<CModel> m(new CModel());
+  std::istringstream is(text);
+  std::string line;
+  std::map<std::string, std::string> kv;
+  bool in_tree = false;
+  auto flush_tree = [&]() -> bool {
+    if (!in_tree) return true;
+    CTree t;
+    if (!parse_tree(kv, &t)) return false;
+    m->trees.push_back(std::move(t));
+    kv.clear();
+    return true;
+  };
+  while (std::getline(is, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    if (line.rfind("Tree=", 0) == 0) {
+      if (!flush_tree()) return nullptr;
+      in_tree = true;
+      continue;
+    }
+    if (line == "end of trees") {
+      if (!flush_tree()) return nullptr;
+      in_tree = false;
+      continue;
+    }
+    if (line == "average_output") {
+      m->average_output = true;
+      continue;
+    }
+    auto eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    std::string k = line.substr(0, eq), v = line.substr(eq + 1);
+    if (in_tree) {
+      kv[k] = v;
+    } else if (k == "num_class") {
+      m->num_class = std::stoi(v);
+    } else if (k == "max_feature_idx") {
+      m->max_feature_idx = std::stoi(v);
+    } else if (k == "objective") {
+      std::istringstream ov(v);
+      ov >> m->objective;
+      std::string tok;
+      while (ov >> tok)
+        if (tok.rfind("sigmoid:", 0) == 0)
+          m->sigmoid = std::stod(tok.substr(8));
+    }
+  }
+  if (!flush_tree()) return nullptr;
+  if (m->num_class < 1) return nullptr;
+  return m.release();
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* BoosterHandle;
+
+const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  try {
+    CModel* m = parse_model(model_str);
+    if (m == nullptr) {
+      g_last_error = "malformed model string";
+      return -1;
+    }
+    if (out_num_iterations != nullptr)
+      *out_num_iterations = (int)(m->trees.size() / m->num_class);
+    *out = m;
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return -1;
+  }
+}
+
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out) {
+  std::ifstream f(filename);
+  if (!f) {
+    g_last_error = std::string("cannot open ") + filename;
+    return -1;
+  }
+  std::stringstream ss;
+  ss << f.rdbuf();
+  return LGBM_BoosterLoadModelFromString(ss.str().c_str(),
+                                         out_num_iterations, out);
+}
+
+int LGBM_BoosterFree(BoosterHandle handle) {
+  delete static_cast<CModel*>(handle);
+  return 0;
+}
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out) {
+  *out = static_cast<CModel*>(handle)->num_class;
+  return 0;
+}
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out) {
+  *out = static_cast<CModel*>(handle)->max_feature_idx + 1;
+  return 0;
+}
+
+// predict_type: 0 = transformed output, 1 = raw score
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
+                                       const double* row, int ncol,
+                                       int predict_type, double* out) {
+  const CModel* m = static_cast<const CModel*>(handle);
+  if (ncol <= m->max_feature_idx) {
+    g_last_error = "row has fewer features than the model";
+    return -1;
+  }
+  m->predict(row, predict_type, out);
+  return 0;
+}
+
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const double* data,
+                              int32_t nrow, int32_t ncol, int is_row_major,
+                              int predict_type, double* out_result) {
+  const CModel* m = static_cast<const CModel*>(handle);
+  if (ncol <= m->max_feature_idx) {
+    g_last_error = "matrix has fewer features than the model";
+    return -1;
+  }
+  std::vector<double> buf;
+  for (int32_t r = 0; r < nrow; ++r) {
+    const double* row;
+    if (is_row_major) {
+      row = data + (int64_t)r * ncol;
+    } else {
+      buf.resize(ncol);
+      for (int32_t c = 0; c < ncol; ++c) buf[c] = data[(int64_t)c * nrow + r];
+      row = buf.data();
+    }
+    m->predict(row, predict_type, out_result + (int64_t)r * m->num_class);
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,75 @@
+// Low-latency host forest traversal for small prediction batches.
+//
+// The TPU batched predictor (ops/predict.py) amortizes a jit dispatch over
+// thousands of rows; a serving-style call with 1..few hundred rows pays the
+// ~ms dispatch + transfer for microseconds of work. This is the analog of
+// the reference's thread-safe single-row fast predictor
+// (reference: src/c_api.cpp:63 SingleRowPredictorInner +
+// include/LightGBM/tree.h:130-141 Predict/Decision): read-only flat arrays,
+// no allocation, safe for concurrent callers.
+//
+// Decision semantics mirror models/tree.py Tree._decision exactly:
+//   numerical: NaN with missing_type != NaN is treated as 0.0; missing
+//     (NaN-missing NaN, or Zero-missing |v| <= 1e-35) routes default_left;
+//     otherwise v <= threshold goes left. Thresholds arrive as f32 (the
+//     device path compares f32), values are f32 — compares are exact.
+//   categorical: NaN goes right; bit `cat` of the node's raw-category
+//     bitset decides.
+#include <cmath>
+#include <cstdint>
+
+namespace {
+
+inline bool go_left(float fv, float thr, uint8_t dl, uint8_t mt,
+                    uint8_t is_cat, const uint32_t* bits, int32_t nwords) {
+  if (is_cat) {
+    if (std::isnan(fv)) return false;
+    int64_t cat = static_cast<int64_t>(fv);
+    if (cat < 0 || cat >= static_cast<int64_t>(nwords) * 32) return false;
+    return (bits[cat >> 5] >> (cat & 31)) & 1u;
+  }
+  double v = fv;
+  if (std::isnan(v) && mt != 2) v = 0.0;
+  if ((mt == 2 && std::isnan(v)) || (mt == 1 && std::fabs(v) <= 1e-35))
+    return dl != 0;
+  return v <= static_cast<double>(thr);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Flat forest: nodes/leaves concatenated per tree via tree_node_off /
+// tree_leaf_off; child pointers are tree-local (>=0 node, <0 ~leaf).
+// out[n_class] per row accumulates raw scores (caller zero-initializes).
+void lg_fast_predict(
+    int64_t n_trees, const int64_t* tree_node_off,
+    const int64_t* tree_leaf_off, const int32_t* feat, const float* thr,
+    const uint8_t* default_left, const uint8_t* missing_type,
+    const uint8_t* is_cat, const int64_t* cat_off, const int32_t* cat_len,
+    const uint32_t* cat_bits, const int32_t* left, const int32_t* right,
+    const double* leaf_val, const int32_t* tree_class, int64_t n_class,
+    const float* X, int64_t n_rows, int64_t n_cols, double* out) {
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const float* row = X + r * n_cols;
+    double* orow = out + r * n_class;
+    for (int64_t t = 0; t < n_trees; ++t) {
+      const int64_t n0 = tree_node_off[t];
+      int64_t leaf = 0;
+      if (tree_node_off[t + 1] > n0) {
+        int32_t node = 0;
+        while (node >= 0) {
+          const int64_t g = n0 + node;
+          bool gl = go_left(row[feat[g]], thr[g], default_left[g],
+                            missing_type[g], is_cat[g], cat_bits + cat_off[g],
+                            cat_len[g]);
+          node = gl ? left[g] : right[g];
+        }
+        leaf = ~node;
+      }
+      orow[tree_class[t]] += leaf_val[tree_leaf_off[t] + leaf];
+    }
+  }
+}
+
+}  // extern "C"

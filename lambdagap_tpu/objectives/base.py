@@ -49,6 +49,36 @@ class ObjectiveFunction:
         """scores: [K, N] -> (grad, hess) each [K, N]."""
         raise NotImplementedError
 
+    # jnp-array attributes read by get_gradients; subclasses declare them
+    # so the jitted wrapper can pass them as ARGUMENTS (closing over device
+    # arrays would inline them into the HLO as constants — at 10M rows that
+    # payload breaks the remote-compile transport, see fused_learner notes)
+    _GRAD_ARRAY_FIELDS: Tuple[str, ...] = ()
+
+    def get_gradients_fast(self, scores: jax.Array
+                           ) -> Tuple[jax.Array, jax.Array]:
+        """Jitted gradient computation for the boosting loop: eager
+        ``get_gradients`` pays one dispatch per jnp op, which at ~1 ms per
+        op over a remote-device link dwarfs the arithmetic. Falls back to
+        the eager path for objectives that don't declare their array
+        fields (e.g. the rank family, which jits internally)."""
+        fields = tuple(f for f in self._GRAD_ARRAY_FIELDS
+                       if getattr(self, f, None) is not None)
+        if not fields:
+            return self.get_gradients(scores)
+        if getattr(self, "_grad_jit", None) is None:
+            def fn(scores, *arrs):
+                saved = [getattr(self, f) for f in fields]
+                for f, a in zip(fields, arrs):
+                    setattr(self, f, a)
+                try:
+                    return self.get_gradients(scores)
+                finally:
+                    for f, s in zip(fields, saved):
+                        setattr(self, f, s)
+            self._grad_jit = jax.jit(fn)
+        return self._grad_jit(scores, *[getattr(self, f) for f in fields])
+
     def boost_from_score(self, class_id: int) -> float:
         """Initial score (reference: BoostFromScore per objective)."""
         return 0.0
@@ -56,6 +86,19 @@ class ObjectiveFunction:
     def convert_output(self, scores: jax.Array) -> jax.Array:
         """Raw score -> output space (e.g. sigmoid/exp/softmax)."""
         return scores
+
+    def convert_output_np(self, scores):
+        """Host (numpy) transform for serving-size batches — must match
+        ``convert_output`` (the fast-predict path avoids any device
+        dispatch, like the reference's single-row predictor). The default
+        delegates to the jax version so a subclass overriding only
+        ``convert_output`` can never diverge; subclasses with non-identity
+        transforms provide a pure-numpy override."""
+        if type(self).convert_output is ObjectiveFunction.convert_output:
+            return scores
+        import numpy as _np
+        return _np.asarray(jax.device_get(
+            self.convert_output(jax.numpy.asarray(scores))))
 
     # -- leaf renewal (L1 family) ---------------------------------------
     @property

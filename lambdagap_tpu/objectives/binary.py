@@ -50,6 +50,8 @@ class BinaryLogloss(ObjectiveFunction):
             lw = lw * self.weight_np
         self.label_weight = jnp.asarray(lw)
 
+    _GRAD_ARRAY_FIELDS = ("label_signed", "label_weight")
+
     def get_gradients(self, scores):
         """(reference: binary_objective.hpp:105-134)"""
         s = self.sigmoid
@@ -77,6 +79,9 @@ class BinaryLogloss(ObjectiveFunction):
 
     def convert_output(self, scores):
         return 1.0 / (1.0 + jnp.exp(-self.sigmoid * scores))
+
+    def convert_output_np(self, scores):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * scores))
 
     @property
     def is_constant_hessian(self) -> bool:

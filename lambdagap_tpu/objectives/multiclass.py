@@ -44,6 +44,8 @@ class MulticlassSoftmax(ObjectiveFunction):
                 probs[k] = np.mean(y == k)
         self.class_init_probs = probs
 
+    _GRAD_ARRAY_FIELDS = ("label_int", "weight")
+
     def get_gradients(self, scores):
         """scores [K, N] -> softmax over K
         (reference: multiclass_objective.hpp:85-130)."""
@@ -63,6 +65,11 @@ class MulticlassSoftmax(ObjectiveFunction):
 
     def convert_output(self, scores):
         return _softmax0(scores)
+
+    def convert_output_np(self, scores):
+        m = scores - np.max(scores, axis=0, keepdims=True)
+        e = np.exp(m)
+        return e / np.sum(e, axis=0, keepdims=True)
 
 
 def _softmax0(scores):
@@ -108,3 +115,6 @@ class MulticlassOVA(ObjectiveFunction):
 
     def convert_output(self, scores):
         return 1.0 / (1.0 + jnp.exp(-self.sigmoid * scores))
+
+    def convert_output_np(self, scores):
+        return 1.0 / (1.0 + np.exp(-self.sigmoid * scores))

@@ -39,6 +39,8 @@ class RegressionL2(ObjectiveFunction):
                              * np.sqrt(np.abs(self.label_np))).astype(np.float32)
             self.label = jnp.asarray(self.label_np)
 
+    _GRAD_ARRAY_FIELDS = ("label", "weight")
+
     def get_gradients(self, scores):
         grad = _w(scores - self.label[None, :], self.weight)
         hess = (jnp.ones_like(scores) if self.weight is None
@@ -52,6 +54,11 @@ class RegressionL2(ObjectiveFunction):
             return float(np.sum(self.label_np * self.weight_np)
                          / max(np.sum(self.weight_np), K_EPSILON))
         return float(np.mean(self.label_np))
+
+    def convert_output_np(self, scores):
+        if self.sqrt:
+            return np.sign(scores) * scores * scores
+        return scores
 
     def convert_output(self, scores):
         if self.sqrt:
@@ -171,6 +178,9 @@ class RegressionPoisson(RegressionL2):
     def convert_output(self, scores):
         return jnp.exp(scores)
 
+    def convert_output_np(self, scores):
+        return np.exp(scores)
+
     @property
     def is_constant_hessian(self) -> bool:
         return False
@@ -220,6 +230,8 @@ class RegressionMAPE(RegressionL1):
             lw = lw * self.weight_np
         self.label_weight_np = lw.astype(np.float32)
         self.label_weight = jnp.asarray(self.label_weight_np)
+
+    _GRAD_ARRAY_FIELDS = ("label", "label_weight")
 
     def get_gradients(self, scores):
         diff = scores - self.label[None, :]

@@ -23,6 +23,8 @@ class CrossEntropy(ObjectiveFunction):
         if np.any((self.label_np < 0) | (self.label_np > 1)):
             log.fatal("[cross_entropy]: labels must be in [0, 1]")
 
+    _GRAD_ARRAY_FIELDS = ("label", "weight")
+
     def get_gradients(self, scores):
         p = 1.0 / (1.0 + jnp.exp(-scores))
         grad = p - self.label[None, :]
@@ -44,6 +46,9 @@ class CrossEntropy(ObjectiveFunction):
     def convert_output(self, scores):
         return 1.0 / (1.0 + jnp.exp(-scores))
 
+    def convert_output_np(self, scores):
+        return 1.0 / (1.0 + np.exp(-scores))
+
 
 @register_objective
 class CrossEntropyLambda(ObjectiveFunction):
@@ -55,6 +60,8 @@ class CrossEntropyLambda(ObjectiveFunction):
         super().init(metadata, num_data)
         if np.any((self.label_np < 0) | (self.label_np > 1)):
             log.fatal("[cross_entropy_lambda]: labels must be in [0, 1]")
+
+    _GRAD_ARRAY_FIELDS = ("label", "weight")
 
     def get_gradients(self, scores):
         y = self.label[None, :]
@@ -83,3 +90,6 @@ class CrossEntropyLambda(ObjectiveFunction):
 
     def convert_output(self, scores):
         return jnp.log1p(jnp.exp(scores))
+
+    def convert_output_np(self, scores):
+        return np.log1p(np.exp(scores))

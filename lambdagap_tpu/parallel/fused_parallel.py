@@ -41,6 +41,7 @@ from ..data.dataset import BinnedDataset
 from ..models.fused_learner import DeviceTree, FusedTreeLearner
 from ..models.learner import _next_pow2
 from .mesh import DATA_AXIS, make_mesh, shard_rows
+from .multiprocess import global_array_from_local
 
 
 class FusedDataParallelTreeLearner(FusedTreeLearner):
@@ -50,19 +51,46 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
                  mesh: Optional[Mesh] = None) -> None:
         # mesh geometry first: the base-class init places the binned matrix
         # through _place_binned, which shards it directly (no host round-trip)
-        self.mesh = mesh if mesh is not None else make_mesh(config.tpu_num_devices)
-        self.n_dev = int(self.mesh.devices.size)
-        N = dataset.num_data
-        pad = (-N) % self.n_dev
-        self.n_pad = N + pad
-        self.n_loc = self.n_pad // self.n_dev
-        super().__init__(dataset, config)
-        self.axis = DATA_AXIS
+        self.proc_sharded = bool(getattr(dataset, "process_sharded", False))
+        if self.proc_sharded:
+            # pre_partition=true: this process holds only its own rows;
+            # every process is padded to a common per-process block so the
+            # global leading axis splits evenly over all devices
+            # (reference: per-rank data with synced mappers,
+            # src/io/dataset_loader.cpp:1072)
+            if config.use_quantized_grad:
+                from ..utils import log
+                log.fatal("use_quantized_grad is not supported with "
+                          "pre-partitioned multi-process training "
+                          "(gradient scales would differ per rank)")
+            self.mesh = mesh if mesh is not None else make_mesh(0)
+            self.n_dev = int(self.mesh.devices.size)
+            n_proc = jax.process_count()
+            ldev = max(self.n_dev // n_proc, 1)
+            max_cnt = int(np.max(dataset.global_row_counts))
+            self.proc_pad = -(-max_cnt // ldev) * ldev
+            self.n_pad = self.proc_pad * n_proc
+            self.n_loc = self.proc_pad // ldev
+            super().__init__(dataset, config)
+            self.axis = DATA_AXIS
+            real = np.zeros(self.proc_pad, dtype=bool)
+            real[:dataset.num_data] = True
+            self.real_mask = global_array_from_local(real, self.mesh,
+                                                     P(DATA_AXIS))
+        else:
+            self.mesh = mesh if mesh is not None else make_mesh(config.tpu_num_devices)
+            self.n_dev = int(self.mesh.devices.size)
+            N = dataset.num_data
+            pad = (-N) % self.n_dev
+            self.n_pad = N + pad
+            self.n_loc = self.n_pad // self.n_dev
+            super().__init__(dataset, config)
+            self.axis = DATA_AXIS
 
-        real = np.ones(self.n_pad, dtype=bool)
-        real[N:] = False
-        self.real_mask = jax.device_put(
-            jnp.asarray(real), NamedSharding(self.mesh, P(DATA_AXIS)))
+            real = np.ones(self.n_pad, dtype=bool)
+            real[N:] = False
+            self.real_mask = jax.device_put(
+                jnp.asarray(real), NamedSharding(self.mesh, P(DATA_AXIS)))
 
         # the whole-tree program as a shard_map body. check_vma off: the
         # replicated outputs (split structure, leaf values) are replicated
@@ -87,6 +115,15 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
 
     # -- device-layout hooks -------------------------------------------
     def _place_binned(self, hx: np.ndarray) -> None:
+        if self.proc_sharded:
+            pad = self.proc_pad - hx.shape[0]
+            if pad:
+                hx = np.pad(hx, ((0, pad), (0, 0)))
+            self.hx_rows = global_array_from_local(hx, self.mesh,
+                                                   P(DATA_AXIS, None))
+            self.x_cols = global_array_from_local(
+                np.ascontiguousarray(hx.T), self.mesh, P(None, DATA_AXIS))
+            return
         pad = self.n_pad - hx.shape[0]
         if pad:
             hx = np.pad(hx, ((0, pad), (0, 0)))
@@ -106,6 +143,14 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
 
     # ------------------------------------------------------------------
     def _shard_vec(self, v: jax.Array) -> jax.Array:
+        if self.proc_sharded:
+            # v is this process's LOCAL rows (boosting state is per-rank,
+            # like the reference's per-machine Boosting object)
+            loc = np.asarray(jax.device_get(v))
+            pad = self.proc_pad - loc.shape[0]
+            if pad:
+                loc = np.pad(loc, [(0, pad)] + [(0, 0)] * (loc.ndim - 1))
+            return global_array_from_local(loc, self.mesh, P(DATA_AXIS))
         return shard_rows(self.mesh, v)[0]
 
     def train_device(self, grad: jax.Array, hess: jax.Array,
@@ -132,6 +177,13 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
         rec = self._train_jit_dp(g, h, m, fmask, self.hx_rows, self.x_cols,
                                  gq, hq, gs, hs, ekey)
         # consumers (score update, leaf renewal) see an unpadded [N] leaf map
-        rec = rec._replace(row_leaf=rec.row_leaf[:self.num_data])
+        if self.proc_sharded:
+            # hand back this process's LOCAL rows: the booster's score
+            # update stays rank-local (one D2H per tree, not per split)
+            from .multiprocess import local_block
+            rec = rec._replace(row_leaf=jnp.asarray(
+                local_block(rec.row_leaf, self.num_data)))
+        else:
+            rec = rec._replace(row_leaf=rec.row_leaf[:self.num_data])
         self.last_row_leaf = rec.row_leaf
         return rec

@@ -67,3 +67,95 @@ def global_array_from_local(local: np.ndarray, mesh, spec):
     from jax.sharding import NamedSharding
     sharding = NamedSharding(mesh, spec)
     return jax.make_array_from_process_local_data(sharding, local)
+
+
+def local_block(global_arr, n_real: Optional[int] = None) -> np.ndarray:
+    """This process's contiguous row block of a leading-axis-sharded global
+    array (inverse of :func:`global_array_from_local`)."""
+    shards = sorted(global_arr.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    block = np.concatenate([np.asarray(s.data) for s in shards], axis=0)
+    return block[:n_real] if n_real is not None else block
+
+
+def load_pre_partitioned(path: str, config: Config):
+    """``pre_partition=true`` ingestion: each process loads ITS OWN data
+    file; every rank draws an equal-size local sample, the samples are
+    allgathered, and bin mappers are built from the union — so all ranks
+    bin identically without ever materializing the full dataset anywhere
+    (reference: src/io/dataset_loader.cpp:1072
+    ConstructBinMappersFromTextData + the GlobalSyncUp of bin boundaries).
+
+    Returns a local BinnedDataset carrying the process-sharding metadata
+    (``process_sharded`` / ``global_row_counts`` / ``global_num_data``)
+    that routes training onto the fused data-parallel learner over the
+    multi-process mesh. Boosting state (scores, gradients, bagging) stays
+    process-local, exactly like the reference's per-rank Boosting object;
+    only histogram reduction crosses processes.
+    """
+    from ..data.dataset import BinnedDataset
+    from ..data.loader import _parse_text_file
+    from jax.experimental import multihost_utils
+
+    X, y, weight, qgroups = _parse_text_file(path, config)
+    n_local = len(X)
+    if n_local == 0:
+        log.fatal("pre_partition: %s holds no rows for process %d",
+                  path, jax.process_index())
+    nproc = jax.process_count()
+    per_rank = max(64, config.bin_construct_sample_cnt // max(nproc, 1))
+    rng = np.random.RandomState(config.data_random_seed
+                                + 7919 * jax.process_index())
+    idx = (rng.choice(n_local, size=per_rank, replace=False)
+           if n_local >= per_rank
+           else rng.choice(n_local, size=per_rank, replace=True))
+    sample_local = np.ascontiguousarray(X[idx], dtype=np.float64)
+    sample_global = np.asarray(
+        multihost_utils.process_allgather(sample_local)).reshape(
+            -1, X.shape[1])
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.asarray([n_local], np.int64))).reshape(-1)
+
+    categorical = []
+    if config.categorical_feature:
+        for tok in str(config.categorical_feature).split(","):
+            tok = tok.strip()
+            if tok:
+                categorical.append(int(tok))
+
+    # identical global sample on every rank -> identical mappers
+    mapper_ref = BinnedDataset.from_matrix(
+        sample_global, config,
+        label=np.zeros(len(sample_global), np.float32),
+        categorical_features=categorical)
+    ds = BinnedDataset.from_matrix(
+        X, config, label=y, weight=weight, group=qgroups,
+        categorical_features=categorical, reference=mapper_ref)
+    ds.process_sharded = True
+    ds.global_row_counts = counts
+    ds.global_num_data = int(counts.sum())
+    # global label/weight vectors (small): boost_from_average must use the
+    # GLOBAL statistics or ranks bake different init scores into tree 0
+    # (reference: GBDT::BoostFromAverage syncs sums over Network)
+    max_cnt = int(counts.max())
+
+    def _gather_ragged(v, dtype):
+        pad = np.zeros(max_cnt, dtype=dtype)
+        pad[:n_local] = v
+        g = np.asarray(multihost_utils.process_allgather(pad))
+        return np.concatenate([g[r, :counts[r]] for r in range(nproc)])
+
+    ds.global_label = _gather_ragged(y, np.float32)
+    has_w = np.asarray(multihost_utils.process_allgather(
+        np.asarray([0 if weight is None else 1], np.int64))).reshape(-1)
+    if has_w.any() and not has_w.all():
+        # every rank sees the same allgathered flags, so ALL ranks fail
+        # together — an asymmetric exit would leave the others hanging in
+        # the next collective
+        log.fatal("pre_partition: weight sidecar present on some ranks "
+                  "but not others")
+    ds.global_weight = (_gather_ragged(weight, np.float32)
+                        if weight is not None else None)
+    log.info("pre_partition: process %d/%d holds %d of %d rows",
+             jax.process_index(), nproc, n_local, ds.global_num_data)
+    return ds

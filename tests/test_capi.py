@@ -1,0 +1,138 @@
+"""Standalone C serving ABI (native/capi.cpp — the reference-c_api-shaped
+model-load + predict surface, reference: src/c_api.cpp). A C consumer loads
+a saved text model and predicts with no Python/JAX in the loop; here the
+ABI is driven through ctypes and checked against Booster.predict."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification, make_regression
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu import native
+
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None,
+                                reason="native lib unavailable")
+
+
+def _capi():
+    lib = ctypes.CDLL(native._build_lib())
+    lib.LGBM_BoosterCreateFromModelfile.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.LGBM_BoosterLoadModelFromString.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_void_p)]
+    lib.LGBM_BoosterPredictForMat.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double)]
+    lib.LGBM_BoosterPredictForMatSingleRow.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _load(lib, model_str: str):
+    h = ctypes.c_void_p()
+    it = ctypes.c_int()
+    rc = lib.LGBM_BoosterLoadModelFromString(model_str.encode(),
+                                             ctypes.byref(it),
+                                             ctypes.byref(h))
+    assert rc == 0, lib.LGBM_GetLastError()
+    return h, int(it.value)
+
+
+def _predict(lib, h, X, num_class=1, predict_type=0):
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    out = np.zeros((len(X), num_class), dtype=np.float64)
+    rc = lib.LGBM_BoosterPredictForMat(
+        h, X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(X), X.shape[1], 1, predict_type,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0, lib.LGBM_GetLastError()
+    return out[:, 0] if num_class == 1 else out
+
+
+def test_binary_with_categorical_and_missing(tmp_path):
+    X, y = make_classification(2500, 8, n_informative=5, random_state=0)
+    Xc = np.column_stack([X[:, :7], np.abs(X[:, 7] * 4).astype(int)])
+    Xc[::13, 2] = np.nan
+    bst = lgb.train({"objective": "binary", "num_leaves": 31, "verbose": -1,
+                     "categorical_feature": [7]},
+                    lgb.Dataset(Xc, label=y), num_boost_round=12)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    lib = _capi()
+    h = ctypes.c_void_p()
+    it = ctypes.c_int()
+    rc = lib.LGBM_BoosterCreateFromModelfile(path.encode(), ctypes.byref(it),
+                                             ctypes.byref(h))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert it.value == 12
+    got = _predict(lib, h, Xc[:400])
+    np.testing.assert_allclose(got, bst.predict(Xc[:400]), rtol=1e-6,
+                               atol=1e-9)
+    raw = _predict(lib, h, Xc[:400], predict_type=1)
+    np.testing.assert_allclose(raw, bst.predict(Xc[:400], raw_score=True),
+                               rtol=1e-5, atol=1e-5)
+    # single-row entry
+    out = np.zeros(1)
+    row = np.ascontiguousarray(Xc[5], dtype=np.float64)
+    rc = lib.LGBM_BoosterPredictForMatSingleRow(
+        h, row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        Xc.shape[1], 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0
+    np.testing.assert_allclose(out[0], got[5], rtol=1e-12)
+    lib.LGBM_BoosterFree(h)
+
+
+def test_multiclass_and_column_major():
+    X, y = make_classification(2000, 10, n_informative=6, n_classes=3,
+                               random_state=1)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=8)
+    lib = _capi()
+    h, it = _load(lib, bst.model_to_string())
+    assert it == 8
+    got = _predict(lib, h, X[:300], num_class=3)
+    np.testing.assert_allclose(got, bst.predict(X[:300]), rtol=1e-6,
+                               atol=1e-9)
+    # column-major input
+    Xc = np.asfortranarray(X[:300].astype(np.float64))
+    out = np.zeros((300, 3))
+    rc = lib.LGBM_BoosterPredictForMat(
+        h, Xc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 300,
+        X.shape[1], 0, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0
+    np.testing.assert_allclose(out, got, rtol=1e-12)
+    lib.LGBM_BoosterFree(h)
+
+
+def test_linear_tree_model():
+    rng = np.random.RandomState(2)
+    X = rng.rand(1500, 4) * 4
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(1500)
+    bst = lgb.train({"objective": "regression", "num_leaves": 6,
+                     "linear_tree": True, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    lib = _capi()
+    h, _ = _load(lib, bst.model_to_string())
+    got = _predict(lib, h, X[:200])
+    np.testing.assert_allclose(got, bst.predict(X[:200]), rtol=1e-5,
+                               atol=1e-6)
+    lib.LGBM_BoosterFree(h)
+
+
+def test_malformed_model_fails_loudly():
+    lib = _capi()
+    h = ctypes.c_void_p()
+    it = ctypes.c_int()
+    rc = lib.LGBM_BoosterLoadModelFromString(
+        b"tree\nTree=0\nnum_leaves=5\n", ctypes.byref(it), ctypes.byref(h))
+    assert rc != 0
+    assert lib.LGBM_GetLastError()

@@ -1,0 +1,57 @@
+"""Native low-latency predict path (reference: src/c_api.cpp:63
+SingleRowPredictorInner): small batches route through the host forest
+traversal and must agree exactly with the device batched predictor."""
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification, make_regression
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu import native
+
+
+pytestmark = pytest.mark.skipif(native.get_lib() is None,
+                                reason="native lib unavailable")
+
+
+def test_binary_small_batch_matches_device():
+    X, y = make_classification(3000, 12, n_informative=6, random_state=0)
+    X[::11, 3] = np.nan
+    b = lgb.train({"objective": "binary", "num_leaves": 31, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=15)
+    full = b.predict(X[:600])                 # > 512 rows -> device path
+    parts = np.concatenate([b.predict(X[i:i + 100])
+                            for i in range(0, 600, 100)])
+    np.testing.assert_allclose(full, parts, rtol=1e-6, atol=1e-7)
+    one = np.array([b.predict(X[i:i + 1])[0] for i in range(20)])
+    np.testing.assert_allclose(full[:20], one, rtol=1e-6, atol=1e-7)
+
+
+def test_multiclass_and_categorical():
+    X, y = make_classification(3000, 10, n_informative=6, n_classes=3,
+                               random_state=1)
+    Xc = np.column_stack([X[:, :9], np.abs(X[:, 9] * 5).astype(int)])
+    b = lgb.train({"objective": "multiclass", "num_class": 3, "verbose": -1,
+                   "categorical_feature": [9]},
+                  lgb.Dataset(Xc, label=y), num_boost_round=10)
+    full = b.predict(Xc[:600])
+    parts = np.vstack([b.predict(Xc[i:i + 64]) for i in range(0, 600, 64)])
+    np.testing.assert_allclose(full, parts[:600], rtol=1e-5, atol=1e-6)
+
+
+def test_raw_score_and_refit_invalidation():
+    X, y = make_regression(2000, 8, noise=3.0, random_state=2)
+    b = lgb.train({"objective": "regression", "num_leaves": 15,
+                   "verbose": -1}, lgb.Dataset(X, label=y),
+                  num_boost_round=10)
+    raw_small = b.predict(X[:10], raw_score=True)
+    raw_full = b.predict(X[:600], raw_score=True)[:10]
+    # device path accumulates in f32, native in f64 — ordering noise only
+    np.testing.assert_allclose(raw_small, raw_full, rtol=1e-5, atol=1e-5)
+    # refit rewrites leaf values in place; the cached flat forest must not
+    # serve stale values
+    before = b.predict(X[:5])
+    b2 = b.refit(X, y + 100.0)
+    after = b2.predict(X[:5])
+    assert not np.allclose(before, after)
+    np.testing.assert_allclose(after, b2.predict(X[:600])[:5], rtol=1e-5,
+                               atol=1e-5)

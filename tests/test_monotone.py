@@ -70,3 +70,58 @@ def test_monotone_on_categorical_fatal():
     with pytest.raises(Exception):
         lgb.train(params, lgb.Dataset(X, label=y, categorical_feature=[0]),
                   num_boost_round=2)
+
+
+def test_monotone_intermediate_holds_and_beats_basic():
+    """Intermediate method (reference: monotone_constraints.hpp:516
+    IntermediateLeafConstraints): the property still holds, and the looser
+    bounds recover accuracy vs basic on the same task."""
+    X, y = _data(n=3000)
+    common = {"objective": "regression", "num_leaves": 63,
+              "min_data_in_leaf": 5, "learning_rate": 0.1, "verbose": -1,
+              "monotone_constraints": [1, -1, 0],
+              "tpu_hist_impl": "onehot"}
+    basic = lgb.train({**common, "monotone_constraints_method": "basic"},
+                      lgb.Dataset(X, label=y), num_boost_round=40)
+    inter = lgb.train({**common,
+                       "monotone_constraints_method": "intermediate"},
+                      lgb.Dataset(X, label=y), num_boost_round=40)
+    rng = np.random.RandomState(2)
+    for _ in range(5):
+        base = rng.rand(3)
+        assert _is_monotone(inter, 0, +1, base)
+        assert _is_monotone(inter, 1, -1, base)
+    mse_basic = np.mean((y - basic.predict(X)) ** 2)
+    mse_inter = np.mean((y - inter.predict(X)) ** 2)
+    assert mse_inter <= mse_basic * 1.001, (mse_inter, mse_basic)
+    # over-constraining differs: models should not be identical
+    assert inter.model_to_string() != basic.model_to_string()
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_monotone_penalty_pushes_splits_down(fused):
+    """monotone_penalty >= depth+1 forbids monotone splits at that depth
+    (reference: ComputeMonotoneSplitGainPenalty) — with penalty 2, levels
+    0 and 1 must split on the unconstrained feature."""
+    X, y = _data(n=2000)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "learning_rate": 0.1, "verbose": -1,
+              "monotone_constraints": [1, -1, 0],
+              "monotone_penalty": 2.0,
+              "tpu_fused_learner": "1" if fused else "0",
+              "tpu_hist_impl": "onehot"}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+    dump = b.dump_model()
+    for ti in dump["tree_info"]:
+        def walk(node, depth):
+            if "split_feature" not in node:
+                return
+            if depth < 2:
+                assert node["split_feature"] == 2, \
+                    f"monotone split at depth {depth}"
+            walk(node["left_child"], depth + 1)
+            walk(node["right_child"], depth + 1)
+        walk(ti["tree_structure"], 0)
+    # monotonicity still enforced
+    rng = np.random.RandomState(3)
+    assert _is_monotone(b, 0, +1, rng.rand(3))

@@ -195,3 +195,28 @@ def test_linear_tree_resume_refit_contrib_guards():
     replayed = np.asarray(rb._booster.valid_scores[0][0])
     np.testing.assert_allclose(replayed, rb.predict(Xv, raw_score=True),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_forced_bins_zero_bounds(tmp_path):
+    """Zero rows must never share a bin with nonzero values under forced
+    bins (reference: bin.cpp:178-198 FindBinWithPredefinedBin inserts the
+    +-kZeroThreshold bounds before any forced bound)."""
+    import json
+    fb = str(tmp_path / "forced.json")
+    with open(fb, "w") as f:
+        json.dump([{"feature": 0, "bin_upper_bound": [-0.5, 0.5]}], f)
+    rng = np.random.RandomState(2)
+    col = rng.randn(2000)
+    col[::4] = 0.0                       # 25% exact zeros
+    X = np.column_stack([col, rng.rand(2000)])
+    y = rng.rand(2000)
+    from lambdagap_tpu.config import Config
+    from lambdagap_tpu.data.dataset import BinnedDataset
+    cfg = Config.from_params({"max_bin": 16, "forcedbins_filename": fb})
+    ds = BinnedDataset.from_matrix(X, cfg, label=y)
+    m = ds.mappers[0]
+    zero_bin = m.values_to_bins(np.asarray([0.0]))[0]
+    neg = m.values_to_bins(col[np.abs(col) > 1e-6])
+    assert zero_bin not in set(neg.tolist())
+    for b in (-0.5, 0.5):
+        assert any(abs(x - b) < 1e-9 for x in m.bin_upper_bound)
