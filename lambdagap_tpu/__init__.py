@@ -7,19 +7,23 @@ XLA/Pallas programs on TPU and its distributed learners over
 ``jax.sharding`` meshes.
 """
 from .basic import Booster, Dataset, Sequence
-from .callback import early_stopping, log_evaluation, record_evaluation, reset_parameter
+from .callback import (EarlyStopException, early_stopping, log_evaluation,
+                       record_evaluation, reset_parameter)
 from .config import Config
 from .data import BinnedDataset, Metadata
 from .engine import CVBooster, cv, train
 from .models import GBDT, Tree
+from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 from .utils.log import register_logger
 
 __version__ = "0.1.0"
 
 __all__ = ["Booster", "Dataset", "Sequence", "Config", "BinnedDataset",
            "Metadata", "GBDT", "Tree", "train", "cv", "CVBooster",
-           "early_stopping", "log_evaluation", "record_evaluation",
-           "reset_parameter", "register_logger", "__version__"]
+           "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+           "early_stopping", "EarlyStopException", "log_evaluation",
+           "record_evaluation", "reset_parameter", "register_logger",
+           "__version__"]
 
 try:  # matplotlib/graphviz are optional
     from .plotting import (create_tree_digraph, plot_importance, plot_metric,

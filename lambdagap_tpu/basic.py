@@ -367,8 +367,13 @@ class Booster:
                                      num_iteration=num_iteration)
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
-                   start_iteration: int = 0, importance_type: str = "split"
-                   ) -> "Booster":
+                   start_iteration: int = 0,
+                   importance_type: str = None) -> "Booster":
+        if importance_type is None:
+            # config default (reference: saved_feature_importance_type)
+            importance_type = ("gain" if getattr(
+                self._booster.config, "saved_feature_importance_type", 0)
+                else "split")
         it = {"split": 0, "gain": 1}.get(importance_type, 0)
         ni = -1 if num_iteration is None else num_iteration
         self._booster.save_model(filename, start_iteration, ni, it)
@@ -403,3 +408,170 @@ class Booster:
 
     def num_model_per_iteration(self) -> int:
         return self._booster.num_tree_per_iteration
+
+    # -- reference Booster API parity ----------------------------------
+    def eval(self, data: "Dataset", name: str, feval=None):
+        """Evaluate the configured metrics on an arbitrary dataset
+        (reference: basic.py Booster.eval). Registered train/valid sets use
+        their cached scores; anything else predicts raw scores and runs the
+        metric set directly."""
+        gb = self._booster
+        if data._constructed is not None:
+            if data._constructed is gb.train_set:
+                out = [(name, m, v, g) for (_, m, v, g) in gb.eval_train()]
+                if out:
+                    return out
+                # no training metrics configured: run the metric set over
+                # the cached training scores
+                from .metrics import create_metrics
+                md = gb.train_set.metadata
+                metrics = create_metrics(self.config, md,
+                                         gb.train_set.num_data)
+                conv = (gb.objective.convert_output(gb.scores)
+                        if gb.objective is not None else gb.scores)
+                s = np.asarray(conv)
+                scores = s[0] if s.shape[0] == 1 else s
+                return [(name, mn, float(v), m.greater_is_better)
+                        for m in metrics for mn, v in m.eval(scores)]
+            for vi, (vn, vds) in enumerate(getattr(gb, "valid_sets", [])):
+                if vds is data._constructed:
+                    return [(name, m, v, g) for (d, m, v, g)
+                            in gb.eval_valid() if d == vn]
+            if data.data is None:
+                log.fatal("Booster.eval needs the raw data: this Dataset "
+                          "was constructed with free_raw_data=True and is "
+                          "not a registered train/valid set")
+        if isinstance(data.data, (str, os.PathLike)):
+            from .data.loader import _parse_text_file
+            X, label, weight, group = _parse_text_file(
+                str(data.data), self.config)
+        else:
+            X, _, _ = _to_matrix(data.data)
+            label, weight, group = data.label, data.weight, data.group
+        from .data.dataset import Metadata
+        md = Metadata()
+        if label is not None:
+            md.label = np.asarray(label, np.float32).reshape(-1)
+        if weight is not None:
+            md.weight = np.asarray(weight, np.float32).reshape(-1)
+        if group is not None:
+            md.set_group(group)
+        from .metrics import create_metrics
+        metrics = create_metrics(self.config, md, len(X))
+        # metrics consume output-space scores, exactly what the training
+        # loop hands them (objective.convert_output applied)
+        raw = self.predict(X)
+        # single-class metrics take [N]; multiclass metrics take [K, N]
+        scores = raw if raw.ndim == 1 else raw.T
+        out = []
+        for m in metrics:
+            for mn, v in m.eval(scores):
+                out.append((name, mn, float(v), m.greater_is_better))
+        if feval is not None:
+            res = feval(np.asarray(raw), data)
+            res = [res] if isinstance(res, tuple) else res
+            for mn, v, gib in res:
+                out.append((name, mn, float(v), gib))
+        return out
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """(reference: LGBM_BoosterGetLeafValue)"""
+        return float(self._booster._tree(tree_id).leaf_value[leaf_id])
+
+    def set_leaf_output(self, tree_id: int, leaf_id: int,
+                        value: float) -> "Booster":
+        """(reference: LGBM_BoosterSetLeafValue)"""
+        tree = self._booster._tree(tree_id)
+        tree.leaf_value[leaf_id] = float(value)
+        self._booster._fast_cache = None
+        return self
+
+    def lower_bound(self) -> float:
+        """Smallest possible raw prediction: sum of per-tree minimum leaf
+        values (reference: GBDT::GetLowerBoundValue)."""
+        b = self._booster
+        return float(sum(np.min(b._tree(i).leaf_value[:max(
+            b._tree(i).num_leaves, 1)]) for i in range(len(b.models))))
+
+    def upper_bound(self) -> float:
+        """(reference: GBDT::GetUpperBoundValue)"""
+        b = self._booster
+        return float(sum(np.max(b._tree(i).leaf_value[:max(
+            b._tree(i).num_leaves, 1)]) for i in range(len(b.models))))
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of the split thresholds used for one feature
+        (reference: basic.py Booster.get_split_value_histogram)."""
+        if isinstance(feature, str):
+            feature = self._booster.feature_names.index(feature)
+        vals = []
+        b = self._booster
+        for i in range(len(b.models)):
+            t = b._tree(i)
+            for k in range(t.num_internal):
+                if t.split_feature[k] == feature and not t.is_categorical[k]:
+                    vals.append(t.threshold_real[k])
+        vals = np.asarray(vals, np.float64)
+        if bins is None:
+            bins = max(min(len(vals), 32), 1)
+        hist, edges = np.histogram(vals, bins=bins)
+        if xgboost_style:
+            return np.column_stack([edges[1:], hist])
+        return hist, edges
+
+    def model_from_string(self, model_str: str) -> "Booster":
+        """Load a model into this booster (reference: Booster.model_from_string)."""
+        self._booster = GBDT.from_model_string(model_str, self.config)
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        self._train_data_name = name
+        return self
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Shuffle tree order (reference: GBDT::ShuffleModels; only
+        meaningful for rf/dart ensembles)."""
+        b = self._booster
+        K = b.num_tree_per_iteration
+        lo = start_iteration * K
+        hi = len(b.models) if end_iteration < 0 else end_iteration * K
+        seg = b.models[lo:hi]
+        np.random.shuffle(seg)
+        b.models[lo:hi] = seg
+        b._fast_cache = None
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """API-compat no-op: datasets are garbage-collected."""
+        return self
+
+    def free_network(self) -> "Booster":
+        """API-compat no-op: the mesh has no persistent connections."""
+        return self
+
+    # pickling via the text-model round trip (reference: Booster
+    # __getstate__/__setstate__ serialize the model string)
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        # only the model string travels: the booster, the binned training
+        # data, and valid sets would serialize GBs at real data sizes
+        # (reference Booster pickles the model string alone)
+        state["_booster"] = None
+        state["train_set"] = None
+        state["_pickled_model"] = self.model_to_string()
+        return state
+
+    def __setstate__(self, state):
+        model_str = state.pop("_pickled_model", "")
+        self.__dict__.update(state)
+        self._booster = GBDT.from_model_string(model_str, self.config)
+
+    def __copy__(self):
+        return self.__deepcopy__({})
+
+    def __deepcopy__(self, memo):
+        new = Booster.__new__(Booster)
+        new.__setstate__(self.__getstate__())
+        return new

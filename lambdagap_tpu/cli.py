@@ -148,6 +148,9 @@ def run_save_binary(cfg: Config) -> None:
 
 def run_convert_model(cfg: Config) -> None:
     from .models.model_codegen import model_to_cpp
+    if cfg.convert_model_language not in ("", "cpp"):
+        log.fatal("convert_model_language=%r is not supported (only cpp)",
+                  cfg.convert_model_language)
     booster = GBDT.from_model_file(cfg.input_model, cfg)
     code = model_to_cpp(booster)
     with open(cfg.convert_model, "w") as f:

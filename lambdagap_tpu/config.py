@@ -365,7 +365,6 @@ class Config:
     num_gpu: int = 1
 
     # TPU-specific knobs (no reference analog; tuning surface for XLA/Pallas)
-    tpu_hist_dtype: str = "float32"
     tpu_rows_per_block: int = 4096
     tpu_hist_impl: str = "auto"               # auto / onehot / pallas
     tpu_num_devices: int = 0                  # 0 = all visible devices

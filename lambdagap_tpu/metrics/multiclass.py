@@ -50,6 +50,21 @@ class AucMuMetric(Metric):
         K = s.shape[0]
         y = self.label.astype(np.int64)
         w = self.weight if self.weight is not None else np.ones(len(y))
+        # auc_mu_weights: flat K*K row-major misclassification-cost matrix
+        # (reference: config.cpp:218-236 auc_mu_weights_matrix; default all
+        # ones off-diagonal). For pair (a, b) the separating direction is
+        # t1 * (v . scores) with v = W[a] - W[b], t1 = v[a] - v[b]
+        # (reference: multiclass_metric.hpp AucMuMetric::Eval, following
+        # Kleiman & Page 2019).
+        amw = list(self.config.auc_mu_weights or [])
+        if amw:
+            if len(amw) != K * K:
+                from ..utils import log
+                log.fatal("auc_mu_weights must have num_class^2 = %d "
+                          "entries, got %d", K * K, len(amw))
+            W = np.asarray(amw, np.float64).reshape(K, K)
+        else:
+            W = 1.0 - np.eye(K)
         total = 0.0
         pairs = 0
         for a in range(K):
@@ -58,9 +73,9 @@ class AucMuMetric(Metric):
                 if not mask.any():
                     continue
                 ya = (y[mask] == a).astype(np.float64)
-                # decision value: s_a - s_b partition direction
-                # (reference uses auc_mu_weights matrix; default: difference)
-                sv = s[a][mask] - s[b][mask]
+                v = W[a] - W[b]
+                t1 = v[a] - v[b]
+                sv = t1 * (v @ s[:, mask])
                 from .binary import _weighted_auc
                 auc = _weighted_auc(ya, sv, w[mask])
                 total += auc

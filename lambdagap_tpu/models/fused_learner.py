@@ -189,10 +189,17 @@ class FusedTreeLearner(SerialTreeLearner):
         """Chunk window for the while-loop'd row passes: small enough that a
         deep (small) leaf doesn't pay a huge padded window of gather/scan
         work, large enough that root-sized passes don't drown in per-trip
-        overhead. Grows with N between 4k and 16*tpu_rows_per_block."""
+        overhead.
+
+        Sized off the AVERAGE leaf population N/num_leaves, not N: padding
+        waste across one tree is ~num_leaves * W/2 rows against ~N*log2(L)
+        total row-touches, so W near the deep-leaf size keeps waste ~10%
+        where an N-scaled window pays ~40% at the HIGGS shape (10.5M rows,
+        255 leaves). Inside one compiled program extra while-loop trips
+        cost only loop control, not kernel launches."""
         cap = max(int(self.config.tpu_rows_per_block) * 16, 1 << 12)
-        return min(max(_next_pow2(max(self.num_data // 128, 1)), 1 << 12),
-                   cap)
+        per_leaf = self.num_data // max(self.config.num_leaves, 8)
+        return min(max(_next_pow2(max(per_leaf, 1)), 1 << 12), cap)
 
     # ------------------------------------------------------------------
     def train_device(self, grad: jax.Array, hess: jax.Array,

@@ -744,6 +744,35 @@ class GBDT:
             len(self.models), (start_iteration + num_iteration) * K)
         return list(range(start_iteration * K, end))
 
+    def _check_predict_shape(self, data: np.ndarray) -> np.ndarray:
+        """A matrix with fewer columns than the model's max split feature
+        would silently mis-gather (clipped indices); fail loudly unless
+        predict_disable_shape_check pads the missing columns with NaN
+        (reference: c_api predict shape check + the override flag,
+        include/LightGBM/config.h predict_disable_shape_check)."""
+        key = len(self.models)
+        cached = getattr(self, "_need_feats", None)
+        if cached is None or cached[0] != key:
+            need = 1 + max(
+                (max(t.split_feature[:t.num_internal], default=0)
+                 for t in (self._tree(i) for i in range(key))),
+                default=0) if self.models else 0
+            self._need_feats = (key, need)
+        need = self._need_feats[1]
+        if data.ndim != 2:
+            log.fatal("predict expects a 2-D matrix, got shape %s",
+                      (data.shape,))
+        if data.shape[1] >= need:
+            return data
+        if not self.config.predict_disable_shape_check:
+            log.fatal("The number of features in data (%d) is less than the "
+                      "model needs (%d); set predict_disable_shape_check="
+                      "true to pad missing features with NaN",
+                      data.shape[1], need)
+        pad = np.full((data.shape[0], need - data.shape[1]), np.nan,
+                      dtype=data.dtype)
+        return np.concatenate([data, pad], axis=1)
+
     def _fast_forest(self, idx, trees):
         """Cached flat forest for the native low-latency predictor; None
         when the native lib is unavailable."""
@@ -766,6 +795,7 @@ class GBDT:
         scan; the analog of GBDT::Predict over inlined trees, reference:
         include/LightGBM/tree.h:130-141)."""
         data = np.asarray(data, dtype=np.float32)
+        data = self._check_predict_shape(data)
         K = self.num_tree_per_iteration
         N = data.shape[0]
         idx = self._model_slice(start_iteration, num_iteration)
@@ -814,6 +844,7 @@ class GBDT:
                      num_iteration: int = -1) -> np.ndarray:
         """Leaf index per (row, tree) (reference: predict_leaf_index path)."""
         data = np.asarray(data, dtype=np.float32)
+        data = self._check_predict_shape(data)
         idx = self._model_slice(start_iteration, num_iteration)
         if not idx:
             return np.zeros((data.shape[0], 0), np.int32)
@@ -830,7 +861,8 @@ class GBDT:
         Tree::PredictContrib / TreeSHAP, src/io/tree.cpp; native kernel in
         native/treeshap.cpp)."""
         from .shap import tree_shap_accumulate
-        data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        data = np.asarray(data, dtype=np.float64)
+        data = np.ascontiguousarray(self._check_predict_shape(data))
         N, F_data = data.shape
         K = self.num_tree_per_iteration
         idx = self._model_slice(start_iteration, num_iteration)

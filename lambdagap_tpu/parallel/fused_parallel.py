@@ -139,7 +139,8 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
         # n_dev-times smaller, so a wide window is mostly padding (measured
         # 3.2x -> 1.2x vs serial fused on the 8-CPU mesh)
         cap = max(int(self.config.tpu_rows_per_block) * 16, 1 << 12)
-        return min(max(_next_pow2(max(self.n_loc // 16, 1)), 1 << 10), cap)
+        per_leaf = self.n_loc // max(self.config.num_leaves, 8)
+        return min(max(_next_pow2(max(per_leaf, 1)), 1 << 10), cap)
 
     # ------------------------------------------------------------------
     def _shard_vec(self, v: jax.Array) -> jax.Array:

@@ -41,10 +41,22 @@ def init_distributed(coordinator_address: Optional[str] = None,
     the reference's ``machines``/``num_machines`` parameters.
     """
     if config is not None:
-        if coordinator_address is None and config.machines:
-            coordinator_address = config.machines.split(",")[0].strip()
+        machines = config.machines
+        file_count = 0
+        if not machines and config.machine_list_filename:
+            # reference: mlist.txt, one host per line
+            # (src/network/linkers_socket.cpp machine-list file)
+            with open(config.machine_list_filename) as fh:
+                entries = [ln.strip() for ln in fh if ln.strip()]
+            machines = ",".join(entries)
+            file_count = len(entries)
+        if coordinator_address is None and machines:
+            coordinator_address = machines.split(",")[0].strip()
         if num_processes is None and config.num_machines > 1:
+            # num_machines governs; the machine list may list spare hosts
             num_processes = config.num_machines
+        elif num_processes is None and file_count > 1:
+            num_processes = file_count
         if process_id is None and config.machine_rank >= 0:
             process_id = config.machine_rank
     if num_processes is None or num_processes <= 1:

@@ -113,3 +113,84 @@ def test_sequence_subsampled_binning_and_reference():
     assert vals[-1] < vals[0]
     tds, vds = dtrain.construct(), dvalid.construct()
     assert tds.mappers is vds.mappers
+
+
+def test_predict_shape_check():
+    """Fewer predict columns than the model needs must fail loudly, unless
+    predict_disable_shape_check pads with NaN (reference:
+    predict_disable_shape_check)."""
+    import pytest
+    rng = np.random.RandomState(0)
+    X = rng.randn(1000, 6)
+    y = (X[:, 5] > 0).astype(float)     # force use of the last feature
+    b = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 7},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    with pytest.raises(Exception):
+        b.predict(X[:10, :3])
+    b2 = lgb.train({"objective": "binary", "verbose": -1, "num_leaves": 7,
+                    "predict_disable_shape_check": True},
+                   lgb.Dataset(X, label=y), num_boost_round=3)
+    p = b2.predict(X[:10, :3])          # missing columns ride as NaN
+    assert np.all(np.isfinite(p))
+
+
+def test_auc_mu_weights_matrix():
+    """auc_mu_weights reshapes into the KxK cost matrix and changes the
+    pairwise separating directions (reference: config.cpp
+    auc_mu_weights_matrix)."""
+    from sklearn.datasets import make_classification
+    X, y = make_classification(1200, 8, n_informative=5, n_classes=3,
+                               random_state=0)
+    base = {"objective": "multiclass", "num_class": 3, "metric": "auc_mu",
+            "verbose": -1}
+    res1, res2 = {}, {}
+    ds = lgb.Dataset(X, label=y)
+    lgb.train(base, ds, num_boost_round=5, valid_sets=[lgb.Dataset(X, label=y)],
+              callbacks=[lgb.record_evaluation(res1)])
+    w = [0, 1, 5, 1, 0, 1, 5, 1, 0]
+    lgb.train({**base, "auc_mu_weights": w}, lgb.Dataset(X, label=y),
+              num_boost_round=5, valid_sets=[lgb.Dataset(X, label=y)],
+              callbacks=[lgb.record_evaluation(res2)])
+    a1 = res1["valid_0"]["auc_mu"][-1]
+    a2 = res2["valid_0"]["auc_mu"][-1]
+    assert 0.5 < a1 <= 1.0 and 0.5 < a2 <= 1.0
+    assert a1 != a2
+
+
+def test_booster_api_parity():
+    """Reference Booster surface: pickling/deepcopy via the text model,
+    eval() on arbitrary data matching the training-loop metrics,
+    lower/upper_bound, get/set_leaf_output, get_split_value_histogram,
+    model_from_string, shuffle_models (reference: python-package basic.py
+    Booster methods)."""
+    import copy
+    import pickle
+    from sklearn.datasets import make_classification
+    X, y = make_classification(800, 6, random_state=0)
+    res = {}
+    b = lgb.train({"objective": "binary", "metric": "auc", "verbose": -1,
+                   "num_leaves": 7},
+                  lgb.Dataset(X, label=y), num_boost_round=4,
+                  valid_sets=[lgb.Dataset(X, label=y)],
+                  callbacks=[lgb.record_evaluation(res)])
+    p0 = b.predict(X[:20])
+    b2 = pickle.loads(pickle.dumps(b))
+    np.testing.assert_allclose(b2.predict(X[:20]), p0, rtol=1e-6)
+    b3 = copy.deepcopy(b)
+    np.testing.assert_allclose(b3.predict(X[:20]), p0, rtol=1e-6)
+    assert b.lower_bound() < b.upper_bound()
+    ev = b3.eval(lgb.Dataset(X, label=y), "extra")
+    assert ev[0][0] == "extra"
+    assert abs(ev[0][2] - res["valid_0"]["auc"][-1]) < 1e-5
+    hist, edges = b3.get_split_value_histogram(0)
+    assert hist.sum() >= 0 and len(edges) == len(hist) + 1
+    v = b.get_leaf_output(0, 0)
+    b.set_leaf_output(0, 0, v + 1.0)
+    assert abs(b.get_leaf_output(0, 0) - (v + 1.0)) < 1e-12
+    assert not np.allclose(b.predict(X[:20]), p0)
+    # model_from_string replaces the model in place
+    b.model_from_string(b3.model_to_string())
+    np.testing.assert_allclose(b.predict(X[:20]), p0, rtol=1e-6)
+    # shuffled tree order leaves gbdt predictions unchanged (order-free sum)
+    b3.shuffle_models()
+    np.testing.assert_allclose(b3.predict(X[:20]), p0, rtol=1e-6)
