@@ -526,7 +526,7 @@ class Booster:
         return self
 
     def set_train_data_name(self, name: str) -> "Booster":
-        self._train_data_name = name
+        self._train_name = name       # read by engine.train's eval loop
         return self
 
     def shuffle_models(self, start_iteration: int = 0,
@@ -538,7 +538,8 @@ class Booster:
         lo = start_iteration * K
         hi = len(b.models) if end_iteration < 0 else end_iteration * K
         seg = b.models[lo:hi]
-        np.random.shuffle(seg)
+        # seeded like every other source of randomness in the package
+        np.random.RandomState(self.config.data_random_seed).shuffle(seg)
         b.models[lo:hi] = seg
         b._fast_cache = None
         return self

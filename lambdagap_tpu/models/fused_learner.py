@@ -135,8 +135,11 @@ class FusedTreeLearner(SerialTreeLearner):
         self.forced_seq = None
         if self.forced_json is not None:
             self.forced_seq = self._build_forced_seq(config.num_leaves - 1)
-        if self.extra_on:
-            self._ekey = jax.random.PRNGKey(config.extra_seed)
+        self._need_step_keys = (self.extra_on
+                                or config.feature_fraction_bynode < 1.0)
+        if self._need_step_keys:
+            self._ekey = jax.random.PRNGKey(config.extra_seed
+                                            + 31 * config.feature_fraction_seed)
         # when set (FusedDataParallelTreeLearner), _train_tree_impl runs as
         # the per-shard body of a shard_map over this mesh axis: rows are
         # sharded, histograms are psum-ed over ICI after each chunked local
@@ -185,6 +188,22 @@ class FusedTreeLearner(SerialTreeLearner):
         self.hx_rows = jnp.asarray(hx)
         self.x_cols = jnp.asarray(np.ascontiguousarray(hx.T))
 
+    @staticmethod
+    def _chunk_override() -> Optional[int]:
+        """Debug/bench knob: LAMBDAGAP_CHUNK forces the window size (used
+        for the measured W sweeps in the bench notes). Rounded to a power
+        of two; malformed values are ignored loudly."""
+        import os
+        raw = os.environ.get("LAMBDAGAP_CHUNK")
+        if not raw:
+            return None
+        try:
+            return max(_next_pow2(int(raw)), 1 << 10)
+        except ValueError:
+            from ..utils import log
+            log.warning("LAMBDAGAP_CHUNK=%r is not an integer; ignored", raw)
+            return None
+
     def _pick_chunk(self) -> int:
         """Chunk window for the while-loop'd row passes: small enough that a
         deep (small) leaf doesn't pay a huge padded window of gather/scan
@@ -195,8 +214,12 @@ class FusedTreeLearner(SerialTreeLearner):
         waste across one tree is ~num_leaves * W/2 rows against ~N*log2(L)
         total row-touches, so W near the deep-leaf size keeps waste ~10%
         where an N-scaled window pays ~40% at the HIGGS shape (10.5M rows,
-        255 leaves). Inside one compiled program extra while-loop trips
-        cost only loop control, not kernel launches."""
+        255 leaves; measured 5.21 vs 5.65 s/iter on the bench chip).
+        Inside one compiled program extra while-loop trips cost only loop
+        control, not kernel launches."""
+        forced = self._chunk_override()
+        if forced is not None:
+            return forced
         cap = max(int(self.config.tpu_rows_per_block) * 16, 1 << 12)
         per_leaf = self.num_data // max(self.config.num_leaves, 8)
         return min(max(_next_pow2(max(per_leaf, 1)), 1 << 12), cap)
@@ -215,7 +238,7 @@ class FusedTreeLearner(SerialTreeLearner):
         else:
             gq = hq = jnp.zeros(1, jnp.int8)
             gs = hs = jnp.float32(1.0)
-        if self.extra_on:
+        if self._need_step_keys:
             self._ekey, ekey = jax.random.split(self._ekey)
         else:
             ekey = jnp.zeros(2, jnp.uint32)
@@ -398,8 +421,47 @@ class FusedTreeLearner(SerialTreeLearner):
         extra_on = self.extra_on
         contri = self.contri_arr
         nb_m1 = self.nb_minus1_arr
+        # interaction constraints, in-program (reference: col_sampler.hpp
+        # interaction sets): each leaf carries a bitmask of features used on
+        # its path; a feature is allowed iff some group contains path+{f}
+        ic_on = self.ic_groups is not None
+        if ic_on:
+            PW = (F + 31) // 32
+            gb = np.zeros((len(self.ic_groups), PW), np.uint32)
+            gm = np.zeros((len(self.ic_groups), F), bool)
+            for gi, g in enumerate(self.ic_groups):
+                for f in g:
+                    gb[gi, f // 32] |= np.uint32(1) << np.uint32(f % 32)
+                    gm[gi, f] = True
+            group_bits = jnp.asarray(gb)
+            group_member = jnp.asarray(gm)
+        else:
+            PW = 1
+        bynode_frac = float(cfg.feature_fraction_bynode)
+        bynode_on = bynode_frac < 1.0
 
-        def best_of(hist, pg, ph, pc, pout, lo, hi, depth, rkey):
+        def node_fmask(path_bits, rkey):
+            """Per-leaf effective feature mask: interaction-set filtering +
+            by-node sampling (reference: col_sampler.hpp GetByNode)."""
+            m = fmask
+            if ic_on:
+                subset = jnp.all((path_bits[None, :] & ~group_bits) == 0,
+                                 axis=1)                       # [G]
+                # union of the groups containing the path; the empty path is
+                # a subset of every group, so the root gets the union of ALL
+                # groups — features outside every group are never usable
+                # (matches the host learner's _node_fmask)
+                m = m & jnp.any(subset[:, None] & group_member, axis=0)
+            if bynode_on:
+                r = jax.random.uniform(rkey, (F,))
+                r = jnp.where(m, r, -jnp.inf)
+                avail = jnp.sum(m.astype(jnp.int32))
+                k = jnp.maximum(jnp.ceil(bynode_frac * avail), 1.0)
+                rank = jnp.argsort(jnp.argsort(-r))
+                m = m & (rank < k.astype(jnp.int32))
+            return m
+
+        def best_of(hist, pg, ph, pc, pout, lo, hi, depth, rkey, fm):
             """Best split for one leaf, with the max_depth guard.
             Returns (gain, feat, thr, dl, cat, bits, lg, lh, lc, lout, rout)."""
             if bundled:
@@ -412,7 +474,7 @@ class FusedTreeLearner(SerialTreeLearner):
                 rand_t = jax.random.randint(rkey, (F,), 0, 1 << 30) % nb_m1
             gain, thr, dl, lg, lh, lc, bits = per_feature_best(
                 hist, pg, ph, pc, pout, num_bins, default_bins,
-                missing_types, is_cat_arr, fmask, p, has_cat,
+                missing_types, is_cat_arr, fm, p, has_cat,
                 constraints=cons, rand_thresholds=rand_t)
             parent_gain = leaf_gain(pg, ph, p, pc, pout)
             shift = parent_gain + p.min_gain_to_split
@@ -446,7 +508,7 @@ class FusedTreeLearner(SerialTreeLearner):
                     is_cat_arr[f], bits[f], lg[f], lh[f], lc[f], lout, rout)
 
         best_children = jax.vmap(best_of,
-                                 in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0))
+                                 in_axes=(0, 0, 0, 0, 0, 0, 0, None, 0, 0))
 
         # ------------------------------------------------------ state init
         # consolidated per-leaf/per-node state; row L / row NODES is the dump
@@ -467,11 +529,17 @@ class FusedTreeLearner(SerialTreeLearner):
                                          0.0)
         neg_inf = jnp.float32(-jnp.inf)
         pos_inf = jnp.float32(jnp.inf)
-        root_key = jax.random.fold_in(ekey, NODES) if extra_on else ekey
+        need_keys = extra_on or bynode_on
+        root_key = jax.random.fold_in(ekey, NODES) if need_keys else ekey
+        if ic_on or bynode_on:
+            fm0 = node_fmask(jnp.zeros(PW, jnp.uint32),
+                             jax.random.fold_in(root_key, 7))
+        else:
+            fm0 = fmask
         (bg0, bf0, bt0, bdl0, bcat0, bbits0, blg0, blh0, blc0, blout0,
          brout0) = best_of(hist_root, totals[0], totals[1], totals[2],
                            root_out, neg_inf, pos_inf, jnp.int32(0),
-                           root_key)
+                           root_key, fm0)
 
         iota_l1 = jnp.arange(L + 1, dtype=jnp.int32)
         f32 = jnp.float32
@@ -501,6 +569,8 @@ class FusedTreeLearner(SerialTreeLearner):
             hist=jnp.zeros((L + 1, C, Bb, HIST_C), f32).at[0].set(hist_root),
             num_leaves=jnp.int32(1),
         )
+        if ic_on:
+            state["path"] = jnp.zeros((L + 1, PW), jnp.uint32)
 
         forced = self.forced_seq
         if forced is not None:
@@ -689,19 +759,36 @@ class FusedTreeLearner(SerialTreeLearner):
             hist = st["hist"].at[wl].set(hist_left).at[wn].set(hist_right)
 
             # -- both children's best splits in one vmapped scan -------
-            if extra_on:
+            if extra_on or bynode_on:
                 step_key = jax.random.fold_in(ekey, k)
                 child_keys = jnp.stack([jax.random.fold_in(step_key, 0),
                                         jax.random.fold_in(step_key, 1)])
             else:
+                step_key = ekey
                 child_keys = jnp.zeros((2,) + ekey.shape, ekey.dtype)
+            if ic_on:
+                # children inherit the path plus the feature just split on
+                pbit = jnp.where(
+                    jnp.arange(PW, dtype=jnp.uint32)
+                    == (feat // 32).astype(jnp.uint32),
+                    jnp.left_shift(jnp.uint32(1),
+                                   (feat % 32).astype(jnp.uint32)),
+                    jnp.uint32(0))
+                child_path = st["path"][leaf] | pbit
+            if ic_on or bynode_on:
+                cp = child_path if ic_on else jnp.zeros(PW, jnp.uint32)
+                fms = jnp.stack([
+                    node_fmask(cp, jax.random.fold_in(step_key, 2)),
+                    node_fmask(cp, jax.random.fold_in(step_key, 3))])
+            else:
+                fms = jnp.broadcast_to(fmask, (2, F))
             (bg2, bf2, bt2, bdl2, bcat2, bbits2, blg2, blh2, blc2, blout2,
              brout2) = best_children(
                 jnp.stack([hist_left, hist_right]),
                 jnp.stack([lg, rg]), jnp.stack([lh, rh]),
                 jnp.stack([lc, rc]), jnp.stack([lout, rout]),
                 jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]), depth,
-                child_keys)
+                child_keys, fms)
 
             i32 = jnp.int32
             lrow_f = jnp.stack([lg, lh, lc, lout, bg2[0], blg2[0], blh2[0],
@@ -726,6 +813,9 @@ class FusedTreeLearner(SerialTreeLearner):
             )
             if forced is not None:
                 out["forcing"] = forcing_next
+            if ic_on:
+                out["path"] = st["path"].at[wl].set(child_path) \
+                                        .at[wn].set(child_path)
             return out
 
         if L > 1:

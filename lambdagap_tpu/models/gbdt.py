@@ -151,9 +151,6 @@ class GBDT:
             if tl not in ("serial", "data"):
                 log.fatal("pre-partitioned multi-process training supports "
                           "tree_learner=data (got %r)", tl)
-            if cfg.interaction_constraints:
-                log.fatal("interaction_constraints are not supported with "
-                          "pre-partitioned multi-process training")
             if cfg.linear_tree:
                 log.warning("linear_tree is not supported with "
                             "pre-partitioned training; training "
@@ -166,8 +163,6 @@ class GBDT:
                             "'basic'", cfg.monotone_constraints_method)
                 cfg.monotone_constraints_method = "basic"
             not_applied = []
-            if cfg.feature_fraction_bynode < 1.0:
-                not_applied.append("feature_fraction_bynode")
             if cfg.cegb_tradeoff > 0 and (
                     cfg.cegb_penalty_split > 0
                     or cfg.cegb_penalty_feature_coupled
@@ -187,8 +182,6 @@ class GBDT:
             # same shape as the reference's CUDA learner deferring
             # unsupported combos to the CPU path)
             host_only = []
-            if cfg.interaction_constraints:
-                host_only.append("interaction_constraints")
             if (cfg.monotone_constraints
                     and cfg.monotone_constraints_method != "basic"):
                 # intermediate needs cross-leaf constraint propagation +
@@ -196,8 +189,6 @@ class GBDT:
                 # straight-line step has no re-scan slot)
                 host_only.append("monotone_constraints_method="
                                  + cfg.monotone_constraints_method)
-            if cfg.feature_fraction_bynode < 1.0:
-                host_only.append("feature_fraction_bynode")
             if cfg.linear_tree:
                 host_only.append("linear_tree")
             if cfg.cegb_tradeoff > 0 and (
@@ -221,33 +212,42 @@ class GBDT:
             log.warning("linear_tree is not supported with tree_learner=%s; "
                         "training constant-leaf trees", tl)
             self.config.linear_tree = False
-        if self.config.interaction_constraints:
-            # no distributed learner implements per-node interaction
-            # filtering; silently dropping a constraint is worse than failing
-            log.fatal("interaction_constraints are not supported with "
-                      "tree_learner=%s; use the serial learner", tl)
+        if self.config.interaction_constraints and not (
+                tl == "data"
+                and _fused_mode_enabled(self.config.tpu_fused_learner)):
+            # only the fused data-parallel program filters features by the
+            # per-leaf path in-program; the host-loop distributed learners
+            # do not, and silently dropping a constraint is worse than
+            # failing
+            log.fatal("interaction_constraints with tree_learner=%s require "
+                      "the fused learner (tree_learner=data + "
+                      "tpu_fused_learner=1) or tree_learner=serial", tl)
         if tl == "data":
             # the fused whole-tree shard_map program is the production
             # multi-chip path (one psum per split, zero per-split host
             # syncs); the host-loop learner is the explicit opt-out
-            # (tpu_fused_learner=0). Options no distributed learner applies
-            # are warned, not silently swallowed.
+            # (tpu_fused_learner=0). Options the chosen learner does not
+            # apply are warned, not silently swallowed.
             cfg = self.config
             not_applied = []
-            if cfg.feature_fraction_bynode < 1.0:
-                not_applied.append("feature_fraction_bynode")
             if cfg.cegb_tradeoff > 0 and (
                     cfg.cegb_penalty_split > 0
                     or cfg.cegb_penalty_feature_coupled
                     or cfg.cegb_penalty_feature_lazy):
                 not_applied.append("cegb")
-            if not_applied:
-                log.warning("%s are not applied by tree_learner=data",
-                            ", ".join(not_applied))
             if _fused_mode_enabled(cfg.tpu_fused_learner):
+                if not_applied:
+                    log.warning("%s are not applied by tree_learner=data",
+                                ", ".join(not_applied))
                 from ..parallel.fused_parallel import \
                     FusedDataParallelTreeLearner
                 return FusedDataParallelTreeLearner(ds, self.config)
+            # host-loop learner: per-node sampling also unsupported
+            if cfg.feature_fraction_bynode < 1.0:
+                not_applied.append("feature_fraction_bynode")
+            if not_applied:
+                log.warning("%s are not applied by the host-loop "
+                            "tree_learner=data", ", ".join(not_applied))
         from ..parallel import (DataParallelTreeLearner,
                                 FeatureParallelTreeLearner,
                                 VotingParallelTreeLearner)

@@ -220,3 +220,45 @@ def test_forced_bins_zero_bounds(tmp_path):
     assert zero_bin not in set(neg.tolist())
     for b in (-0.5, 0.5):
         assert any(abs(x - b) < 1e-9 for x in m.bin_upper_bound)
+
+
+def test_interaction_constraints_fused():
+    """The fused program enforces interaction sets in-program via per-leaf
+    path bitmasks (no host-learner fallback)."""
+    from lambdagap_tpu.models.fused_learner import FusedTreeLearner
+    X, y = _data()
+    groups = [frozenset([0, 1]), frozenset([2, 3, 4, 5])]
+    b = lgb.train({**BASE, "interaction_constraints": [[0, 1], [2, 3, 4, 5]],
+                   "tpu_fused_learner": "1"},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    assert isinstance(b._booster.learner, FusedTreeLearner)
+    for t in b._booster.host_models:
+        def walk(node, path):
+            if node < 0:
+                if path:
+                    assert any(path <= g for g in groups), path
+                return
+            p2 = path | {t.split_feature[node]}
+            walk(t.left_child[node], p2)
+            walk(t.right_child[node], p2)
+        if t.num_internal:
+            walk(0, frozenset())
+    # features outside every group are never used
+    assert _used_features(b) <= {0, 1, 2, 3, 4, 5}
+
+
+def test_feature_fraction_bynode_fused():
+    from lambdagap_tpu.models.fused_learner import FusedTreeLearner
+    X, y = _data()
+    b = lgb.train({**BASE, "feature_fraction_bynode": 0.5,
+                   "tpu_fused_learner": "1"},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    assert isinstance(b._booster.learner, FusedTreeLearner)
+    resid = y - b.predict(X)
+    assert np.var(resid) < 0.5 * np.var(y)
+    assert len(_used_features(b)) >= 3
+    # seeded: reproducible
+    b2 = lgb.train({**BASE, "feature_fraction_bynode": 0.5,
+                    "tpu_fused_learner": "1"},
+                   lgb.Dataset(X, label=y), num_boost_round=10)
+    assert b2.model_to_string() == b.model_to_string()
