@@ -138,8 +138,11 @@ class FusedTreeLearner(SerialTreeLearner):
         self._need_step_keys = (self.extra_on
                                 or config.feature_fraction_bynode < 1.0)
         if self._need_step_keys:
-            self._ekey = jax.random.PRNGKey(config.extra_seed
-                                            + 31 * config.feature_fraction_seed)
+            # independent streams, like the host learner's separate RNGs:
+            # extra_seed drives random thresholds, feature_fraction_seed
+            # drives by-node sampling — changing one never perturbs the other
+            self._ekey = jax.random.PRNGKey(config.extra_seed)
+            self._bkey = jax.random.PRNGKey(config.feature_fraction_seed + 7)
         # when set (FusedDataParallelTreeLearner), _train_tree_impl runs as
         # the per-shard body of a shard_map over this mesh axis: rows are
         # sharded, histograms are psum-ed over ICI after each chunked local
@@ -239,9 +242,11 @@ class FusedTreeLearner(SerialTreeLearner):
             gq = hq = jnp.zeros(1, jnp.int8)
             gs = hs = jnp.float32(1.0)
         if self._need_step_keys:
-            self._ekey, ekey = jax.random.split(self._ekey)
+            self._ekey, e = jax.random.split(self._ekey)
+            self._bkey, b = jax.random.split(self._bkey)
+            ekey = jnp.stack([e, b])            # [2, 2]: extra / by-node
         else:
-            ekey = jnp.zeros(2, jnp.uint32)
+            ekey = jnp.zeros((2, 2), jnp.uint32)
         rec = self._train_jit(grad, hess, mask, fmask, self.hx_rows,
                               self.x_cols, gq, hq, gs, hs, ekey,
                               has_mask=row_mask is not None)
@@ -529,11 +534,15 @@ class FusedTreeLearner(SerialTreeLearner):
                                          0.0)
         neg_inf = jnp.float32(-jnp.inf)
         pos_inf = jnp.float32(jnp.inf)
+        # ekey carries TWO independent streams: [0] extra_trees random
+        # thresholds, [1] by-node column sampling (separate seeds, like the
+        # host learner's _extra_rng vs _col_rng)
         need_keys = extra_on or bynode_on
-        root_key = jax.random.fold_in(ekey, NODES) if need_keys else ekey
+        xkey, bkey = ekey[0], ekey[1]
+        root_key = jax.random.fold_in(xkey, NODES) if need_keys else xkey
         if ic_on or bynode_on:
             fm0 = node_fmask(jnp.zeros(PW, jnp.uint32),
-                             jax.random.fold_in(root_key, 7))
+                             jax.random.fold_in(bkey, NODES))
         else:
             fm0 = fmask
         (bg0, bf0, bt0, bdl0, bcat0, bbits0, blg0, blh0, blc0, blout0,
@@ -760,12 +769,13 @@ class FusedTreeLearner(SerialTreeLearner):
 
             # -- both children's best splits in one vmapped scan -------
             if extra_on or bynode_on:
-                step_key = jax.random.fold_in(ekey, k)
-                child_keys = jnp.stack([jax.random.fold_in(step_key, 0),
-                                        jax.random.fold_in(step_key, 1)])
+                xstep = jax.random.fold_in(xkey, k)
+                bstep = jax.random.fold_in(bkey, k)
+                child_keys = jnp.stack([jax.random.fold_in(xstep, 0),
+                                        jax.random.fold_in(xstep, 1)])
             else:
-                step_key = ekey
-                child_keys = jnp.zeros((2,) + ekey.shape, ekey.dtype)
+                bstep = bkey
+                child_keys = jnp.zeros((2,) + xkey.shape, xkey.dtype)
             if ic_on:
                 # children inherit the path plus the feature just split on
                 pbit = jnp.where(
@@ -778,8 +788,8 @@ class FusedTreeLearner(SerialTreeLearner):
             if ic_on or bynode_on:
                 cp = child_path if ic_on else jnp.zeros(PW, jnp.uint32)
                 fms = jnp.stack([
-                    node_fmask(cp, jax.random.fold_in(step_key, 2)),
-                    node_fmask(cp, jax.random.fold_in(step_key, 3))])
+                    node_fmask(cp, jax.random.fold_in(bstep, 2)),
+                    node_fmask(cp, jax.random.fold_in(bstep, 3))])
             else:
                 fms = jnp.broadcast_to(fmask, (2, F))
             (bg2, bf2, bt2, bdl2, bcat2, bbits2, blg2, blh2, blc2, blout2,

@@ -101,6 +101,9 @@ class _QueryBuckets:
             self.buckets.append((L, np.asarray(qids, np.int32), idx))
 
 
+_LOOP_CACHE: dict = {}
+
+
 class RankingBase(ObjectiveFunction):
     """Shared query plumbing (reference: rank_objective.hpp:45-147
     RankingObjective): per-query gradient kernels + position-bias Newton
@@ -135,30 +138,86 @@ class RankingBase(ObjectiveFunction):
     def _bucket_gradients(self, scores_b, labels_b, valid_b, aux_b):
         raise NotImplementedError
 
+    def _bucket_gradients_k(self, scores_b, labels_b, valid_b, aux_b, key):
+        """Keyed variant for randomized objectives (xendcg); the default
+        ignores the key."""
+        return self._bucket_gradients(scores_b, labels_b, valid_b, aux_b)
+
     def _bucket_aux(self, qids: np.ndarray) -> tuple:
         return ()
 
+    def _next_key(self):
+        """Per-iteration PRNG key for randomized subclasses."""
+        return jnp.zeros(2, jnp.uint32)
+
+    def _loop_statics(self) -> tuple:
+        """Hashable tuple of EVERY self-dependency the jitted loop body
+        reads (kernel config + label gains): two objectives with equal
+        statics may share one compiled loop."""
+        return ()
+
+    def _make_loop(self):
+        """Compile the WHOLE bucket loop into one program. The eager loop
+        paid ~6 dispatches per bucket per iteration (gathers, the kernel,
+        two scatter-adds) — real latency on a remote device link. Bucket
+        index/aux arrays are passed as pytree ARGUMENTS, not closed over:
+        captured device arrays would inline into the HLO as constants
+        (N-scale payloads break the remote-compile transport)."""
+        num_data = self.num_data
+        has_pos = self.positions is not None
+
+        def loop(s, label, positions, pos_biases, key, idxs, auxs):
+            if has_pos:
+                s = s + pos_biases[positions]
+            grad = jnp.zeros(num_data + 1, jnp.float32)
+            hess = jnp.zeros(num_data + 1, jnp.float32)
+            pad_s = jnp.concatenate([s, jnp.asarray([K_MIN_SCORE], s.dtype)])
+            pad_l = jnp.concatenate([label,
+                                     jnp.asarray([0.0], label.dtype)])
+            eff_sum = jnp.float32(0.0)
+            for idx_d, aux in zip(idxs, auxs):
+                sb = pad_s[idx_d]
+                lb = pad_l[idx_d]
+                vb = idx_d < num_data
+                lam, hes, eff = self._bucket_gradients_k(sb, lb, vb, aux,
+                                                         key)
+                grad = grad.at[idx_d.reshape(-1)].add(lam.reshape(-1),
+                                                      mode="drop")
+                hess = hess.at[idx_d.reshape(-1)].add(hes.reshape(-1),
+                                                      mode="drop")
+                eff_sum = eff_sum + jnp.sum(eff)
+            return grad[:-1], hess[:-1], eff_sum
+
+        idxs = tuple(jnp.asarray(idx) for (_, _, idx)
+                     in self.bucketing.buckets)
+        auxs = tuple(self._bucket_aux(qids) for (_, qids, _)
+                     in self.bucketing.buckets)
+        # share compiled loops across instances (cv folds, repeated
+        # sweeps): the closure captures `self`, so the cache key must list
+        # every self-dependency of the body — num_data, position use, and
+        # the kernel statics. The cached closure pins its first objective
+        # alive; the cache is small and bounded.
+        key = (type(self).__qualname__, num_data, has_pos,
+               self._loop_statics())
+        fn = _LOOP_CACHE.get(key)
+        if fn is None:
+            if len(_LOOP_CACHE) > 16:
+                _LOOP_CACHE.clear()
+            _LOOP_CACHE[key] = fn = jax.jit(loop)
+        return fn, idxs, auxs
+
     def get_gradients(self, scores):
         s = scores[0]
-        if self.positions is not None:
-            s = s + self.pos_biases[self.positions]
-        grad = jnp.zeros(self.num_data + 1, jnp.float32)
-        hess = jnp.zeros(self.num_data + 1, jnp.float32)
-        pad_s = jnp.concatenate([s, jnp.asarray([K_MIN_SCORE], s.dtype)])
-        pad_l = jnp.concatenate([self.label,
-                                 jnp.asarray([0.0], self.label.dtype)])
-        eff_pairs = []
-        for L, qids, idx in self.bucketing.buckets:
-            idx_d = jnp.asarray(idx)
-            sb = pad_s[idx_d]
-            lb = pad_l[idx_d]
-            vb = idx_d < self.num_data
-            aux = self._bucket_aux(qids)
-            lam, hes, eff = self._bucket_gradients(sb, lb, vb, aux)
-            grad = grad.at[idx_d.reshape(-1)].add(lam.reshape(-1), mode="drop")
-            hess = hess.at[idx_d.reshape(-1)].add(hes.reshape(-1), mode="drop")
-            eff_pairs.append(eff)
-        g, h = grad[:-1], hess[:-1]
+        if getattr(self, "_loop_jit", None) is None:
+            self._loop_jit, self._loop_idxs, self._loop_auxs = \
+                self._make_loop()
+        pos = self.positions if self.positions is not None \
+            else jnp.zeros(1, jnp.int32)
+        pb = self.pos_biases if self.positions is not None \
+            else jnp.zeros(1, jnp.float32)
+        g, h, eff_sum = self._loop_jit(s, self.label, pos, pb,
+                                       self._next_key(), self._loop_idxs,
+                                       self._loop_auxs)
         if self.weight is not None:
             g = g * self.weight
             h = h * self.weight
@@ -167,9 +226,8 @@ class RankingBase(ObjectiveFunction):
         # the fork's per-iteration effective-pair-rate line
         # (reference: src/objective/rank_objective.hpp:108-116) — the D2H
         # sync is only paid when debug logging is on
-        if eff_pairs and log.debug_enabled():
-            rate_sum = float(sum(float(jnp.sum(e)) for e in eff_pairs))
-            rate = rate_sum / max(self.num_queries, 1)
+        if log.debug_enabled():
+            rate = float(eff_sum) / max(self.num_queries, 1)
             self.last_effective_pair_rate = rate
             log.debug("iteration %d: effective pair rate %.4f "
                       "(mean over %d queries)",
@@ -228,6 +286,12 @@ class LambdarankNDCG(RankingBase):
         self.inv_max_dcg = inv_dcg
         self.inv_max_bdcg = inv_bdcg
         log.info("Using lambdarank objective with target '%s'", self.target)
+
+    def _loop_statics(self) -> tuple:
+        import numpy as _np
+        return (self.target, self.sigmoid, self.norm,
+                self.truncation_level, self.lambdagap_weight,
+                tuple(_np.asarray(self.label_gain).tolist()))
 
     def _bucket_aux(self, qids):
         return (jnp.asarray(self.inv_max_dcg[qids], jnp.float32),
@@ -406,16 +470,15 @@ class RankXENDCG(RankingBase):
     def _bucket_aux(self, qids):
         return (len(qids),)
 
-    def get_gradients(self, scores):
+    def _next_key(self):
         # fresh per-iteration randomness (reference uses per-query Random
         # streams; a split PRNG key is the JAX analog)
-        self.key, self._iter_key = jax.random.split(self.key)
-        return super().get_gradients(scores)
+        self.key, sub = jax.random.split(self.key)
+        return sub
 
-    def _bucket_gradients(self, scores_b, labels_b, valid_b, aux_b):
-        nq = scores_b.shape[0]
-        key = jax.random.fold_in(self._iter_key, scores_b.shape[1])
-        return _xendcg_bucket(scores_b, labels_b, valid_b, key)
+    def _bucket_gradients_k(self, scores_b, labels_b, valid_b, aux_b, key):
+        return _xendcg_bucket(scores_b, labels_b, valid_b,
+                              jax.random.fold_in(key, scores_b.shape[1]))
 
 
 @jax.jit

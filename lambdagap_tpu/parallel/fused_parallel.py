@@ -175,9 +175,11 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
             gq = hq = jnp.zeros(1, jnp.int8)
             gs = hs = jnp.float32(1.0)
         if self._need_step_keys:
-            self._ekey, ekey = jax.random.split(self._ekey)
+            self._ekey, e = jax.random.split(self._ekey)
+            self._bkey, b = jax.random.split(self._bkey)
+            ekey = jnp.stack([e, b])            # [2, 2]: extra / by-node
         else:
-            ekey = jnp.zeros(2, jnp.uint32)
+            ekey = jnp.zeros((2, 2), jnp.uint32)
         rec = self._train_jit_dp(g, h, m, fmask, self.hx_rows, self.x_cols,
                                  gq, hq, gs, hs, ekey)
         # consumers (score update, leaf renewal) see an unpadded [N] leaf map
