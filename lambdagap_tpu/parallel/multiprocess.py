@@ -62,6 +62,20 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if num_processes is None or num_processes <= 1:
         log.info("init_distributed: single process (no coordinator needed)")
         return
+    # "already joined" must be detected WITHOUT touching the backend:
+    # jax.process_count() initializes XLA, which would make the
+    # jax.distributed.initialize below fail for not-yet-joined callers
+    try:
+        from jax._src import distributed as _dist
+        already = _dist.global_state.client is not None
+    except Exception:   # pragma: no cover - private-API drift
+        already = False
+    if already:
+        # the CLI joins pre-import in __main__, before any
+        # backend-initializing jnp constant
+        log.info("init_distributed: already connected (process %d/%d)",
+                 jax.process_index(), jax.process_count())
+        return
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

@@ -138,3 +138,32 @@ def test_dryrun_multichip():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     mod.dryrun_multichip(min(8, len(jax.devices())))
+
+
+def test_fused_dp_interaction_constraints_and_bynode():
+    """The in-program per-leaf feature masks ride the data-parallel mesh:
+    constraints hold on every shard-count, and by-node sampling stays
+    seeded/reproducible."""
+    X, y = _data()
+    groups = [frozenset([0, 1]), frozenset([2, 3, 4, 5])]
+    b = _train(X, y, "data", min(NEED, len(jax.devices())), rounds=5,
+               extra={"interaction_constraints": [[0, 1], [2, 3, 4, 5]],
+                      "feature_fraction_bynode": 0.7})
+    from lambdagap_tpu.parallel.fused_parallel import \
+        FusedDataParallelTreeLearner
+    assert isinstance(b._booster.learner, FusedDataParallelTreeLearner)
+    for t in b._booster.host_models:
+        def walk(node, path):
+            if node < 0:
+                if path:
+                    assert any(path <= g for g in groups), path
+                return
+            p2 = path | {t.split_feature[node]}
+            walk(t.left_child[node], p2)
+            walk(t.right_child[node], p2)
+        if t.num_internal:
+            walk(0, frozenset())
+    b2 = _train(X, y, "data", min(NEED, len(jax.devices())), rounds=5,
+                extra={"interaction_constraints": [[0, 1], [2, 3, 4, 5]],
+                       "feature_fraction_bynode": 0.7})
+    assert b.model_to_string() == b2.model_to_string()

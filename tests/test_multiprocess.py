@@ -202,3 +202,52 @@ def test_two_process_pre_partitioned_training(tmp_path):
     auc_d = roc_auc_score(yt, p0)
     assert auc_d > 0.9, auc_d
     assert abs(auc_s - auc_d) < 0.03, (auc_s, auc_d)
+
+
+def test_cli_pre_partitioned_training(tmp_path):
+    """The full CLI flow: `python -m lambdagap_tpu pre_partition=true
+    num_machines=2 machine_rank=R machines=...` — the distributed runtime
+    joins BEFORE the package import touches the backend (__main__ early
+    init), mappers sync, both ranks save identical models (reference: the
+    distributed CLI mockup, tests/distributed/_test_distributed.py)."""
+    import socket
+    rng = np.random.RandomState(4)
+    X = rng.randn(1200, 5)
+    y = (X[:, 0] - 0.5 * X[:, 2] > 0).astype(float)
+    full = np.column_stack([y, X])
+    np.savetxt(tmp_path / "part0.tsv", full[:600], delimiter="\t")
+    np.savetxt(tmp_path / "part1.tsv", full[600:], delimiter="\t")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    env = {k: v for k, v in os.environ.items()
+           if "AXON" not in k and k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = os.getcwd()
+    procs = []
+    for r in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "lambdagap_tpu",
+             f"data={tmp_path}/part{r}.tsv", "task=train",
+             "objective=binary", "num_leaves=15", "min_data_in_leaf=5",
+             "num_iterations=4", "verbose=-1", "pre_partition=true",
+             "num_machines=2", f"machine_rank={r}",
+             f"machines=127.0.0.1:{port}", "tree_learner=data",
+             f"output_model={tmp_path}/model{r}.txt"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.getcwd(), env=env))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("CLI pre-partitioned training timed out")
+        outs.append(out)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
+    m0 = (tmp_path / "model0.txt").read_text()
+    m1 = (tmp_path / "model1.txt").read_text()
+    assert m0.split("\nparameters")[0] == m1.split("\nparameters")[0]
