@@ -1,0 +1,15 @@
+#!/bin/bash
+cd /root/repo
+{
+echo "=== G1 $(date)"
+python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_misc_api.py -q 2>&1 | tail -1
+echo "=== G2 $(date)"
+python -m pytest tests/test_train.py tests/test_rank.py tests/test_cli_io.py -q 2>&1 | tail -1
+echo "=== G3 $(date)"
+python -m pytest tests/test_monotone.py tests/test_tree_options.py tests/test_extra_contri.py tests/test_forced_splits.py -q 2>&1 | tail -1
+echo "=== G4 $(date)"
+python -m pytest tests/test_fused.py tests/test_distributed.py tests/test_quantized.py tests/test_continued.py tests/test_model_io.py tests/test_shap_json.py -q 2>&1 | tail -1
+echo "=== G5 $(date)"
+python -m pytest tests/test_consistency.py tests/test_multiprocess.py -q 2>&1 | tail -1
+echo "=== DONE $(date)"
+} > /tmp/full_suite_result.txt 2>&1
