@@ -53,6 +53,31 @@ def _ds_from_file(d, fname, params):
     return lgb.Dataset(os.path.join(EX, d, fname), params=params)
 
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli_env():
+    """CLI subprocess env: strip the axon TPU-tunnel shim so the child runs
+    the same CPU backend as the in-process API (cross-backend float noise
+    flips near-ties)."""
+    env = {k: v for k, v in os.environ.items()
+           if "AXON" not in k and k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _cli_train_binary(model_path, num_trees):
+    d = os.path.join(EX, "binary_classification")
+    return subprocess.run(
+        [sys.executable, "-m", "lambdagap_tpu",
+         "config=" + os.path.join(d, "train.conf"),
+         "data=" + os.path.join(d, "binary.train"),
+         "valid_data=" + os.path.join(d, "binary.test"),
+         f"num_trees={num_trees}", "output_model=" + model_path,
+         "verbose=-1"],
+        capture_output=True, text=True, env=_cli_env(), cwd=_REPO_ROOT)
+
+
 def test_binary_example():
     d = "binary_classification"
     p = _conf(d)
@@ -96,24 +121,13 @@ def test_binary_cli_matches_api(tmp_path):
     d = os.path.join(EX, "binary_classification")
     model = str(tmp_path / "cli_model.txt")
     pred = str(tmp_path / "cli_pred.txt")
-    # strip the axon TPU-tunnel shim so the CLI runs the same CPU backend
-    # as the in-process API (cross-backend float noise flips near-ties)
-    env = {k: v for k, v in os.environ.items()
-           if "AXON" not in k and k != "PYTHONPATH"}
-    env["JAX_PLATFORMS"] = "cpu"
-    r = subprocess.run(
-        [sys.executable, "-m", "lambdagap_tpu",
-         "config=" + os.path.join(d, "train.conf"),
-         "data=" + os.path.join(d, "binary.train"),
-         "valid_data=" + os.path.join(d, "binary.test"),
-         "num_trees=20", "output_model=" + model, "verbose=-1"],
-        capture_output=True, text=True, env=env, cwd="/root/repo")
+    r = _cli_train_binary(model, 20)
     assert r.returncode == 0, r.stderr[-2000:]
     r = subprocess.run(
         [sys.executable, "-m", "lambdagap_tpu", "task=predict",
          "data=" + os.path.join(d, "binary.test"),
          "input_model=" + model, "output_result=" + pred],
-        capture_output=True, text=True, env=env, cwd="/root/repo")
+        capture_output=True, text=True, env=_cli_env(), cwd=_REPO_ROOT)
     assert r.returncode == 0, r.stderr[-2000:]
     cli_pred = np.loadtxt(pred)
 
@@ -200,3 +214,52 @@ def test_parallel_learning_example():
     auc_d = roc_auc_score(yt, dist.predict(Xt))
     assert auc_d > 0.7, auc_d
     assert abs(auc_s - auc_d) < 0.05, (auc_s, auc_d)
+
+
+def test_binary_linear_example():
+    """The reference's shipped linear-tree config (train_linear.conf) on its
+    own data (reference model: test_consistency.py test_binary_linear)."""
+    d = "binary_classification"
+    p = _conf(d, name="train_linear.conf")
+    X, y = _load(d, "binary.train")
+    Xt, yt = _load(d, "binary.test")
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p))
+    from sklearn.metrics import roc_auc_score
+    auc = roc_auc_score(yt, bst.predict(Xt))
+    assert auc > 0.75, auc
+    # the model really carries linear leaves
+    assert "is_linear=1" in bst.model_to_string()
+
+
+def test_regression_forced_bins_example():
+    """The reference's shipped forced_bins.json drives bin boundaries on its
+    own regression data (reference: examples/regression/forced_bins.json)."""
+    d = "regression"
+    p = _conf(d)
+    p["forcedbins_filename"] = os.path.join(EX, d, "forced_bins.json")
+    X, y = _load(d, "regression.train")
+    ds = lgb.Dataset(X, label=y, params=p).construct()
+    for feat, bounds in ((0, (0.3, 0.35, 0.4)), (1, (-0.1, -0.15, -0.2))):
+        ub = ds.mappers[feat].bin_upper_bound
+        for b in bounds:
+            assert any(abs(x - b) < 1e-9 for x in ub), (feat, b, ub[:10])
+
+
+def test_predict_conf_cli(tmp_path):
+    """The reference's predict.conf flow: train via CLI, then task=predict
+    driven by the shipped conf (with path overrides)."""
+    d = os.path.join(EX, "binary_classification")
+    model = str(tmp_path / "m.txt")
+    out = str(tmp_path / "preds.txt")
+    r = _cli_train_binary(model, 5)
+    assert r.returncode == 0, r.stderr[-1500:]
+    r = subprocess.run(
+        [sys.executable, "-m", "lambdagap_tpu",
+         "config=" + os.path.join(d, "predict.conf"),
+         "data=" + os.path.join(d, "binary.test"),
+         "input_model=" + model, "output_result=" + out],
+        capture_output=True, text=True, env=_cli_env(), cwd=_REPO_ROOT)
+    assert r.returncode == 0, r.stderr[-1500:]
+    preds = np.loadtxt(out)
+    assert preds.shape == (500,)
+    assert np.all((preds >= 0) & (preds <= 1))
