@@ -525,6 +525,19 @@ class Booster:
         self._booster = GBDT.from_model_string(model_str, self.config)
         return self
 
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Update training parameters mid-run (reference:
+        Booster.reset_parameter -> LGBM_BoosterResetParameter); the
+        reset_parameter callback routes through here."""
+        self.config.update(params)
+        has_lr = any(Config.canonical_name(k) == "learning_rate"
+                     for k in params)
+        # rf never applies shrinkage (reference: rf.hpp); gbdt/goss pick up
+        # the new rate from the canonicalized config
+        if has_lr and self.config.boosting != "rf":
+            self._booster.shrinkage_rate = float(self.config.learning_rate)
+        return self
+
     def set_train_data_name(self, name: str) -> "Booster":
         self._train_name = name       # read by engine.train's eval loop
         return self

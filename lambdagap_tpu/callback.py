@@ -60,10 +60,7 @@ def reset_parameter(**kwargs) -> Callable:
             else:
                 new_params[key] = value
         if new_params:
-            booster = env.model
-            if "learning_rate" in new_params:
-                booster._booster.shrinkage_rate = float(new_params["learning_rate"])
-            booster.config.update(new_params)
+            env.model.reset_parameter(new_params)
     _callback.before_iteration = True
     _callback.order = 10
     return _callback
