@@ -99,10 +99,24 @@ class Dataset:
             from .data.loader import load_data_file
             if isinstance(self.categorical_feature, (list, tuple)):
                 # constructor argument takes the place of the params key,
-                # same as the matrix path
-                cfg.categorical_feature = ",".join(
-                    str(int(c)) for c in self.categorical_feature
-                    if not isinstance(c, str))
+                # same as the matrix path; never mutate a caller-passed config
+                import copy as _copy
+                cfg = _copy.deepcopy(cfg)
+                names = (list(self.feature_name)
+                         if isinstance(self.feature_name, (list, tuple))
+                         else None)
+                cats = []
+                for c in self.categorical_feature:
+                    if isinstance(c, str):
+                        if names and c in names:
+                            cats.append(str(names.index(c)))
+                        else:
+                            # defer to the loader's name:<col> resolution
+                            # against the file's header row (data/loader.py)
+                            cats.append(f"name:{c}")
+                    else:
+                        cats.append(str(int(c)))
+                cfg.categorical_feature = ",".join(cats)
             ref = (self.reference.construct(config)
                    if self.reference is not None else None)
             self._constructed = load_data_file(str(self.data), cfg,
@@ -356,7 +370,7 @@ class Booster:
             # (reference: Booster.predict accepts a path; c_api
             # LGBM_BoosterPredictForFile)
             from .data.loader import _parse_text_file
-            data, _, _, _ = _parse_text_file(str(data), self._booster.config)
+            data, _, _, _, _ = _parse_text_file(str(data), self._booster.config)
         mat, _, _ = _to_matrix(data)
         if pred_leaf:
             return self._booster.predict_leaf(mat, start_iteration, num_iteration)
@@ -443,7 +457,7 @@ class Booster:
                           "not a registered train/valid set")
         if isinstance(data.data, (str, os.PathLike)):
             from .data.loader import _parse_text_file
-            X, label, weight, group = _parse_text_file(
+            X, label, weight, group, _ = _parse_text_file(
                 str(data.data), self.config)
         else:
             X, _, _ = _to_matrix(data.data)

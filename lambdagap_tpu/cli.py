@@ -122,7 +122,7 @@ def run_predict(cfg: Config) -> None:
 
 def _load_raw_matrix(path: str, cfg: Config) -> np.ndarray:
     from .data.loader import raw_matrix_of
-    X, _, _, _ = raw_matrix_of(path, cfg)
+    X, _, _, _, _ = raw_matrix_of(path, cfg)
     return X
 
 
@@ -133,7 +133,7 @@ def run_refit(cfg: Config) -> None:
         log.fatal("task=refit requires data=<file> and input_model=<model>")
     booster = GBDT.from_model_file(cfg.input_model, cfg)
     from .data.loader import raw_matrix_of
-    X, y, weight, group = raw_matrix_of(cfg.data, cfg)
+    X, y, weight, group, _ = raw_matrix_of(cfg.data, cfg)
     booster.refit(X, y, weight=weight, group=group)
     booster.save_model(cfg.output_model)
     log.info("Refitted model saved to %s", cfg.output_model)

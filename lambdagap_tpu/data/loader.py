@@ -153,11 +153,15 @@ def _rows_to_sizes(per_row: np.ndarray) -> np.ndarray:
 
 def _parse_text_file(path: str, config: Config):
     """Shared column handling for every text-ingest path (train, refit,
-    predict). Returns (X, label, weight_or_None, group_sizes_or_None)."""
+    predict). Returns (X, label, weight_or_None, group_sizes_or_None,
+    feature_names_or_None) — feature names are the header names of the KEPT
+    columns (label/weight/group/ignored dropped), reference:
+    DatasetLoader::SetHeader (src/io/dataset_loader.cpp)."""
     fmt = detect_format(path)
     weight = None
     group = None
     header_names: Optional[List[str]] = None
+    feature_names: Optional[List[str]] = None
     if fmt == "libsvm":
         X, y, qid = _load_libsvm(path)
         if qid is not None:
@@ -189,6 +193,9 @@ def _parse_text_file(path: str, config: Config):
         y = M[:, label_col]
         keep = [j for j in range(M.shape[1]) if j not in set(drop)]
         X = M[:, keep]
+        if header_names:
+            feature_names = [header_names[j] for j in keep
+                             if j < len(header_names)]
 
     # sidecar files (reference: Metadata::LoadWeights/LoadQueryBoundaries)
     if weight is None and os.path.exists(path + ".weight"):
@@ -197,7 +204,7 @@ def _parse_text_file(path: str, config: Config):
                   if os.path.exists(p)), None)
     if qpath is not None:
         group = np.loadtxt(qpath, dtype=np.int64).reshape(-1)
-    return X, y, weight, group
+    return X, y, weight, group, feature_names
 
 
 def load_data_file(path: str, config: Config,
@@ -206,7 +213,7 @@ def load_data_file(path: str, config: Config,
     (reference: DatasetLoader::LoadFromFile)."""
     if path.endswith(".bin") and os.path.exists(path):
         return load_binary(path)
-    X, y, weight, qgroups = _parse_text_file(path, config)
+    X, y, weight, qgroups, fnames = _parse_text_file(path, config)
     init_score = None
     if os.path.exists(path + ".init"):
         init_score = np.loadtxt(path + ".init", dtype=np.float64)
@@ -222,8 +229,8 @@ def load_data_file(path: str, config: Config,
                 continue
             if tok.startswith("name:"):
                 name = tok[5:]
-                if header_names and name in header_names:
-                    categorical.append(header_names.index(name))
+                if fnames and name in fnames:
+                    categorical.append(fnames.index(name))
                 else:
                     log.fatal("categorical_feature name %r not found in "
                               "header", name)
@@ -232,7 +239,8 @@ def load_data_file(path: str, config: Config,
     return BinnedDataset.from_matrix(
         X, config, label=y, weight=weight, group=qgroups,
         init_score=init_score, position=pos,
-        categorical_features=categorical, reference=reference)
+        categorical_features=categorical, feature_names=fnames,
+        reference=reference)
 
 
 def raw_matrix_of(path: str, config: Config):
@@ -240,7 +248,8 @@ def raw_matrix_of(path: str, config: Config):
     column handling and sidecars as :func:`load_data_file` (used by CLI
     refit/predict, reference: application.cpp:254-290).
 
-    Returns (X, label, weight_or_None, group_sizes_or_None)."""
+    Returns (X, label, weight_or_None, group_sizes_or_None,
+    feature_names_or_None)."""
     return _parse_text_file(path, config)
 
 

@@ -917,10 +917,15 @@ class GBDT:
         name = self.objective.name
         if name == "binary":
             return f"binary sigmoid:{self.config.sigmoid:g}"
-        if name in ("multiclass", "multiclassova"):
-            return f"{name} num_class:{self.num_class}"
+        if name == "multiclass":
+            return f"multiclass num_class:{self.num_class}"
+        if name == "multiclassova":
+            return (f"multiclassova num_class:{self.num_class} "
+                    f"sigmoid:{self.config.sigmoid:g}")
         if name == "lambdarank":
             return "lambdarank"
+        if name == "regression" and getattr(self.objective, "sqrt", False):
+            return "regression sqrt"
         return name
 
     def feature_infos(self) -> List[str]:
@@ -965,6 +970,8 @@ class GBDT:
             if ":" in tok:
                 k, v = tok.split(":", 1)
                 params[k] = v
+            elif tok == "sqrt":
+                params["reg_sqrt"] = True
         if "num_class" in header:
             params["num_class"] = int(header["num_class"])
         cfg.update(params)

@@ -14,6 +14,7 @@
 // Supported: numerical/categorical splits, all three missing types, linear
 // trees, binary/multiclass/regression/poisson-family output transforms,
 // random-forest average_output. Predict types: 0 = transformed, 1 = raw.
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -42,7 +43,7 @@ struct CTree {
   std::vector<int32_t> leaf_feat;
   std::vector<double> leaf_coeff;
 
-  double predict_row(const double* row) const {
+  int leaf_index(const double* row) const {
     int leaf = 0;
     if (num_leaves > 1) {
       int node = 0;
@@ -74,6 +75,11 @@ struct CTree {
       }
       leaf = ~node;
     }
+    return leaf;
+  }
+
+  double predict_row(const double* row) const {
+    const int leaf = leaf_index(row);
     if (is_linear) {
       bool ok = true;
       double out = leaf_const[leaf];
@@ -94,17 +100,27 @@ struct CModel {
   bool average_output = false;
   std::string objective = "regression";
   double sigmoid = 1.0;
+  bool sqrt_transform = false;   // "regression sqrt" (reg_sqrt=true)
   std::vector<CTree> trees;
 
-  void predict(const double* row, int predict_type, double* out) const {
+  // Predict trees [start_tree, end_tree) for one row.
+  // predict_type: 0 = transformed, 1 = raw score (C_API_PREDICT_*).
+  void predict(const double* row, int predict_type, double* out,
+               size_t start_tree, size_t end_tree) const {
     for (int k = 0; k < num_class; ++k) out[k] = 0.0;
-    for (size_t t = 0; t < trees.size(); ++t)
+    for (size_t t = start_tree; t < end_tree; ++t)
       out[t % num_class] += trees[t].predict_row(row);
-    if (average_output && !trees.empty()) {
-      const double inv = static_cast<double>(num_class) / trees.size();
+    if (average_output && end_tree > start_tree) {
+      const double inv =
+          static_cast<double>(num_class) / (end_tree - start_tree);
       for (int k = 0; k < num_class; ++k) out[k] *= inv;
     }
     if (predict_type == 1) return;   // raw scores
+    if (sqrt_transform) {
+      for (int k = 0; k < num_class; ++k)
+        out[k] = (out[k] >= 0 ? 1.0 : -1.0) * out[k] * out[k];
+      return;
+    }
     if (objective == "binary" || objective == "cross_entropy" ||
         objective == "multiclassova") {
       for (int k = 0; k < num_class; ++k)
@@ -212,9 +228,12 @@ CModel* parse_model(const std::string& text) {
       std::istringstream ov(v);
       ov >> m->objective;
       std::string tok;
-      while (ov >> tok)
+      while (ov >> tok) {
         if (tok.rfind("sigmoid:", 0) == 0)
           m->sigmoid = std::stod(tok.substr(8));
+        else if (tok == "sqrt")
+          m->sqrt_transform = true;
+      }
     }
   }
   if (!flush_tree()) return nullptr;
@@ -278,40 +297,94 @@ int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out) {
   return 0;
 }
 
-// predict_type: 0 = transformed output, 1 = raw score
-int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle,
-                                       const double* row, int ncol,
-                                       int predict_type, double* out) {
-  const CModel* m = static_cast<const CModel*>(handle);
-  if (ncol <= m->max_feature_idx) {
-    g_last_error = "row has fewer features than the model";
-    return -1;
-  }
-  m->predict(row, predict_type, out);
-  return 0;
-}
+namespace {
 
-int LGBM_BoosterPredictForMat(BoosterHandle handle, const double* data,
-                              int32_t nrow, int32_t ncol, int is_row_major,
-                              int predict_type, double* out_result) {
+// Shared matrix-predict core. Signatures of the public entry points below
+// match the reference include/LightGBM/c_api.h:1289 / :1327 exactly so a C
+// consumer compiling against the real LightGBM header links AND runs
+// correctly (data_type C_API_DTYPE_FLOAT32/64, predict_type
+// C_API_PREDICT_NORMAL/RAW_SCORE/LEAF_INDEX, start/num_iteration honored,
+// *out_len set; `parameter` accepted and ignored).
+int predict_mat_impl(BoosterHandle handle, const void* data, int data_type,
+                     int32_t nrow, int32_t ncol, int is_row_major,
+                     int predict_type, int start_iteration, int num_iteration,
+                     int64_t* out_len, double* out_result) {
   const CModel* m = static_cast<const CModel*>(handle);
   if (ncol <= m->max_feature_idx) {
     g_last_error = "matrix has fewer features than the model";
     return -1;
   }
-  std::vector<double> buf;
+  if (data_type != 0 && data_type != 1) {
+    g_last_error = "data_type must be C_API_DTYPE_FLOAT32 or FLOAT64";
+    return -1;
+  }
+  if (predict_type < 0 || predict_type > 2) {
+    g_last_error =
+        "predict_type must be NORMAL (0), RAW_SCORE (1) or LEAF_INDEX (2); "
+        "SHAP contributions are served from Python (models/shap.py)";
+    return -1;
+  }
+  const size_t total_iters = m->trees.size() / m->num_class;
+  size_t start = start_iteration < 0 ? 0 : (size_t)start_iteration;
+  if (start > total_iters) start = total_iters;
+  size_t end = num_iteration <= 0 ? total_iters
+                                  : std::min(total_iters,
+                                             start + (size_t)num_iteration);
+  const size_t start_tree = start * m->num_class;
+  const size_t end_tree = end * m->num_class;
+  const size_t per_row =
+      predict_type == 2 ? (end_tree - start_tree) : (size_t)m->num_class;
+  const float* f32 = static_cast<const float*>(data);
+  const double* f64 = static_cast<const double*>(data);
+  std::vector<double> buf((size_t)ncol);
   for (int32_t r = 0; r < nrow; ++r) {
     const double* row;
-    if (is_row_major) {
-      row = data + (int64_t)r * ncol;
+    if (data_type == 1 && is_row_major) {
+      row = f64 + (int64_t)r * ncol;
     } else {
-      buf.resize(ncol);
-      for (int32_t c = 0; c < ncol; ++c) buf[c] = data[(int64_t)c * nrow + r];
+      for (int32_t c = 0; c < ncol; ++c) {
+        const int64_t idx = is_row_major ? (int64_t)r * ncol + c
+                                         : (int64_t)c * nrow + r;
+        buf[c] = data_type == 0 ? (double)f32[idx] : f64[idx];
+      }
       row = buf.data();
     }
-    m->predict(row, predict_type, out_result + (int64_t)r * m->num_class);
+    double* out = out_result + (int64_t)r * per_row;
+    if (predict_type == 2) {
+      for (size_t t = start_tree; t < end_tree; ++t)
+        out[t - start_tree] = (double)m->trees[t].leaf_index(row);
+    } else {
+      m->predict(row, predict_type, out, start_tree, end_tree);
+    }
   }
+  if (out_len != nullptr) *out_len = (int64_t)nrow * per_row;
   return 0;
+}
+
+}  // namespace
+
+// Signature-compatible with reference c_api.h:1327.
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle, const void* data,
+                                       int data_type, int ncol,
+                                       int is_row_major, int predict_type,
+                                       int start_iteration, int num_iteration,
+                                       const char* /*parameter*/,
+                                       int64_t* out_len, double* out_result) {
+  return predict_mat_impl(handle, data, data_type, 1, ncol, is_row_major,
+                          predict_type, start_iteration, num_iteration,
+                          out_len, out_result);
+}
+
+// Signature-compatible with reference c_api.h:1289.
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char* /*parameter*/, int64_t* out_len,
+                              double* out_result) {
+  return predict_mat_impl(handle, data, data_type, nrow, ncol, is_row_major,
+                          predict_type, start_iteration, num_iteration,
+                          out_len, out_result);
 }
 
 }  // extern "C"

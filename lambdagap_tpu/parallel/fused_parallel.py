@@ -149,12 +149,33 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
     def _shard_vec(self, v: jax.Array) -> jax.Array:
         if self.proc_sharded:
             # v is this process's LOCAL rows (boosting state is per-rank,
-            # like the reference's per-machine Boosting object)
-            loc = np.asarray(jax.device_get(v))
-            pad = self.proc_pad - loc.shape[0]
+            # like the reference's per-machine Boosting object). Pad and
+            # split on device — no host round-trip on the per-tree hot path.
+            if isinstance(v, jax.Array) and not v.is_fully_addressable:
+                if v.sharding.is_fully_replicated:
+                    # replicated global array (e.g. state that passed
+                    # through a shard_map output): take this process's copy
+                    v = v.addressable_data(0)
+                else:
+                    from ..utils import log
+                    log.fatal(
+                        "pre-partitioned boosting state must be rank-local "
+                        "(or replicated), got a cross-process sharded array "
+                        "%s", v.sharding)
+            v = jnp.asarray(v)
+            pad = self.proc_pad - v.shape[0]
             if pad:
-                loc = np.pad(loc, [(0, pad)] + [(0, 0)] * (loc.ndim - 1))
-            return global_array_from_local(loc, self.mesh, P(DATA_AXIS))
+                v = jnp.pad(v, [(0, pad)] + [(0, 0)] * (v.ndim - 1))
+            gshape = (self.n_pad,) + v.shape[1:]
+            sharding = NamedSharding(self.mesh, P(DATA_AXIS))
+            p0 = jax.process_index() * self.proc_pad
+            blocks = []
+            for d, idx in sharding.addressable_devices_indices_map(
+                    gshape).items():
+                lo = (idx[0].start or 0) - p0
+                blocks.append(jax.device_put(v[lo:lo + self.n_loc], d))
+            return jax.make_array_from_single_device_arrays(
+                gshape, sharding, blocks)
         return shard_rows(self.mesh, v)[0]
 
     def train_device(self, grad: jax.Array, hess: jax.Array,
@@ -185,10 +206,15 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
         # consumers (score update, leaf renewal) see an unpadded [N] leaf map
         if self.proc_sharded:
             # hand back this process's LOCAL rows: the booster's score
-            # update stays rank-local (one D2H per tree, not per split)
+            # update stays rank-local (one D2H per tree, not per split).
+            # leaf_value is localized too (replicated global -> this
+            # process's copy) so downstream boosting state never becomes a
+            # cross-process array.
             from .multiprocess import local_block
-            rec = rec._replace(row_leaf=jnp.asarray(
-                local_block(rec.row_leaf, self.num_data)))
+            rec = rec._replace(
+                row_leaf=jnp.asarray(local_block(rec.row_leaf,
+                                                 self.num_data)),
+                leaf_value=jnp.asarray(rec.leaf_value.addressable_data(0)))
         else:
             rec = rec._replace(row_leaf=rec.row_leaf[:self.num_data])
         self.last_row_leaf = rec.row_leaf

@@ -123,7 +123,7 @@ def load_pre_partitioned(path: str, config: Config):
     from ..data.loader import _parse_text_file
     from jax.experimental import multihost_utils
 
-    X, y, weight, qgroups = _parse_text_file(path, config)
+    X, y, weight, qgroups, fnames = _parse_text_file(path, config)
     n_local = len(X)
     if n_local == 0:
         log.fatal("pre_partition: %s holds no rows for process %d",

@@ -1,7 +1,9 @@
 """Standalone C serving ABI (native/capi.cpp — the reference-c_api-shaped
 model-load + predict surface, reference: src/c_api.cpp). A C consumer loads
 a saved text model and predicts with no Python/JAX in the loop; here the
-ABI is driven through ctypes and checked against Booster.predict."""
+ABI is driven through ctypes with the REFERENCE signatures
+(include/LightGBM/c_api.h:1289/:1327 — data_type, start/num_iteration,
+parameter, out_len) and checked against Booster.predict."""
 import ctypes
 import os
 
@@ -16,6 +18,9 @@ from lambdagap_tpu import native
 pytestmark = pytest.mark.skipif(native.get_lib() is None,
                                 reason="native lib unavailable")
 
+F32, F64 = 0, 1                # C_API_DTYPE_*
+NORMAL, RAW, LEAF = 0, 1, 2    # C_API_PREDICT_*
+
 
 def _capi():
     lib = ctypes.CDLL(native._build_lib())
@@ -25,13 +30,18 @@ def _capi():
     lib.LGBM_BoosterLoadModelFromString.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
         ctypes.POINTER(ctypes.c_void_p)]
+    # reference c_api.h:1289
     lib.LGBM_BoosterPredictForMat.argtypes = [
-        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int32,
-        ctypes.c_int32, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_double)]
+    # reference c_api.h:1327
     lib.LGBM_BoosterPredictForMatSingleRow.argtypes = [
-        ctypes.c_void_p, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
-        ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double)]
     lib.LGBM_GetLastError.restype = ctypes.c_char_p
     return lib
 
@@ -46,15 +56,23 @@ def _load(lib, model_str: str):
     return h, int(it.value)
 
 
-def _predict(lib, h, X, num_class=1, predict_type=0):
-    X = np.ascontiguousarray(X, dtype=np.float64)
-    out = np.zeros((len(X), num_class), dtype=np.float64)
+def _predict(lib, h, X, num_class=1, predict_type=0, dtype=np.float64,
+             start_iteration=0, num_iteration=-1, out_cols=None,
+             row_major=1):
+    X = np.ascontiguousarray(X, dtype=dtype)
+    cols = num_class if out_cols is None else out_cols
+    out = np.zeros((len(X), cols), dtype=np.float64)
+    out_len = ctypes.c_int64()
     rc = lib.LGBM_BoosterPredictForMat(
-        h, X.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-        len(X), X.shape[1], 1, predict_type,
+        h, X.ctypes.data_as(ctypes.c_void_p),
+        F32 if dtype == np.float32 else F64,
+        len(X), X.shape[1], row_major, predict_type,
+        start_iteration, num_iteration, b"",
+        ctypes.byref(out_len),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     assert rc == 0, lib.LGBM_GetLastError()
-    return out[:, 0] if num_class == 1 else out
+    assert out_len.value == out.size
+    return out[:, 0] if cols == 1 else out
 
 
 def test_binary_with_categorical_and_missing(tmp_path):
@@ -76,16 +94,22 @@ def test_binary_with_categorical_and_missing(tmp_path):
     got = _predict(lib, h, Xc[:400])
     np.testing.assert_allclose(got, bst.predict(Xc[:400]), rtol=1e-6,
                                atol=1e-9)
-    raw = _predict(lib, h, Xc[:400], predict_type=1)
+    raw = _predict(lib, h, Xc[:400], predict_type=RAW)
     np.testing.assert_allclose(raw, bst.predict(Xc[:400], raw_score=True),
                                rtol=1e-5, atol=1e-5)
-    # single-row entry
+    # float32 input, same rows
+    got32 = _predict(lib, h, Xc[:400], dtype=np.float32)
+    np.testing.assert_allclose(got32, got, rtol=1e-4, atol=1e-5)
+    # single-row entry (reference signature)
     out = np.zeros(1)
+    out_len = ctypes.c_int64()
     row = np.ascontiguousarray(Xc[5], dtype=np.float64)
     rc = lib.LGBM_BoosterPredictForMatSingleRow(
-        h, row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-        Xc.shape[1], 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        h, row.ctypes.data_as(ctypes.c_void_p), F64,
+        Xc.shape[1], 1, NORMAL, 0, -1, b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     assert rc == 0
+    assert out_len.value == 1
     np.testing.assert_allclose(out[0], got[5], rtol=1e-12)
     lib.LGBM_BoosterFree(h)
 
@@ -102,15 +126,67 @@ def test_multiclass_and_column_major():
     got = _predict(lib, h, X[:300], num_class=3)
     np.testing.assert_allclose(got, bst.predict(X[:300]), rtol=1e-6,
                                atol=1e-9)
-    # column-major input
-    Xc = np.asfortranarray(X[:300].astype(np.float64))
+    # column-major input: the Fortran-order buffer of X[:300]
+    buf = np.ascontiguousarray(X[:300].astype(np.float64).T)
     out = np.zeros((300, 3))
+    out_len = ctypes.c_int64()
     rc = lib.LGBM_BoosterPredictForMat(
-        h, Xc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), 300,
-        X.shape[1], 0, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
-    assert rc == 0
+        h, buf.ctypes.data_as(ctypes.c_void_p), F64, 300, X.shape[1], 0,
+        NORMAL, 0, -1, b"", ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0, lib.LGBM_GetLastError()
+    assert out_len.value == 900
     np.testing.assert_allclose(out, got, rtol=1e-12)
     lib.LGBM_BoosterFree(h)
+
+
+def test_iteration_range_and_leaf_index():
+    X, y = make_regression(1200, 6, noise=0.1, random_state=3)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=10)
+    lib = _capi()
+    h, _ = _load(lib, bst.model_to_string())
+    part = _predict(lib, h, X[:100], start_iteration=2, num_iteration=5)
+    np.testing.assert_allclose(
+        part, bst.predict(X[:100], start_iteration=2, num_iteration=5),
+        rtol=1e-6, atol=1e-8)
+    leaves = _predict(lib, h, X[:50], predict_type=LEAF, out_cols=10)
+    ref_leaves = bst.predict(X[:50], pred_leaf=True)
+    np.testing.assert_array_equal(leaves.astype(int), ref_leaves)
+    lib.LGBM_BoosterFree(h)
+
+
+def test_sqrt_and_ova_transforms():
+    # reg_sqrt: model text records "regression sqrt"; C predict applies
+    # sign(x)*x^2 (reference: RegressionL2loss with sqrt_,
+    # src/objective/regression_objective.hpp:149)
+    rng = np.random.RandomState(4)
+    X = rng.rand(1000, 5)
+    y = (3.0 * X[:, 0] + X[:, 1]) ** 2
+    bst = lgb.train({"objective": "regression", "reg_sqrt": True,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=8)
+    assert next(l for l in bst.model_to_string().split("\n")
+                if l.startswith("objective=")) == "objective=regression sqrt"
+    lib = _capi()
+    h, _ = _load(lib, bst.model_to_string())
+    got = _predict(lib, h, X[:200])
+    np.testing.assert_allclose(got, bst.predict(X[:200]), rtol=1e-5,
+                               atol=1e-6)
+    lib.LGBM_BoosterFree(h)
+    # multiclassova with non-default sigmoid
+    Xc, yc = make_classification(1500, 8, n_informative=5, n_classes=3,
+                                 random_state=5)
+    bst2 = lgb.train({"objective": "multiclassova", "num_class": 3,
+                      "sigmoid": 1.7, "verbose": -1},
+                     lgb.Dataset(Xc, label=yc), num_boost_round=6)
+    assert "sigmoid:1.7" in bst2.model_to_string().split("feature_names")[0]
+    h2, _ = _load(lib, bst2.model_to_string())
+    got2 = _predict(lib, h2, Xc[:200], num_class=3)
+    np.testing.assert_allclose(got2, bst2.predict(Xc[:200]), rtol=1e-5,
+                               atol=1e-7)
+    lib.LGBM_BoosterFree(h2)
 
 
 def test_linear_tree_model():
