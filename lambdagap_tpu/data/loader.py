@@ -194,8 +194,9 @@ def _parse_text_file(path: str, config: Config):
         keep = [j for j in range(M.shape[1]) if j not in set(drop)]
         X = M[:, keep]
         if header_names:
-            feature_names = [header_names[j] for j in keep
-                             if j < len(header_names)]
+            # a short header row still yields one name per kept column
+            feature_names = [header_names[j] if j < len(header_names)
+                             else f"Column_{i}" for i, j in enumerate(keep)]
 
     # sidecar files (reference: Metadata::LoadWeights/LoadQueryBoundaries)
     if weight is None and os.path.exists(path + ".weight"):
@@ -205,6 +206,29 @@ def _parse_text_file(path: str, config: Config):
     if qpath is not None:
         group = np.loadtxt(qpath, dtype=np.int64).reshape(-1)
     return X, y, weight, group, feature_names
+
+
+def resolve_categorical(config: Config,
+                        feature_names: Optional[List[str]]) -> List[int]:
+    """``categorical_feature`` config -> feature indices; ``name:<col>``
+    tokens resolve against the loaded feature names (reference:
+    Config categorical_feature name handling, src/io/config.cpp)."""
+    categorical: List[int] = []
+    if config.categorical_feature:
+        for tok in str(config.categorical_feature).split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if tok.startswith("name:"):
+                name = tok[5:]
+                if feature_names and name in feature_names:
+                    categorical.append(feature_names.index(name))
+                else:
+                    log.fatal("categorical_feature name %r not found in "
+                              "header", name)
+            else:
+                categorical.append(int(tok))
+    return categorical
 
 
 def load_data_file(path: str, config: Config,
@@ -221,21 +245,7 @@ def load_data_file(path: str, config: Config,
     if os.path.exists(path + ".position"):
         pos = np.loadtxt(path + ".position", dtype=np.int64)
 
-    categorical = []
-    if config.categorical_feature:
-        for tok in str(config.categorical_feature).split(","):
-            tok = tok.strip()
-            if not tok:
-                continue
-            if tok.startswith("name:"):
-                name = tok[5:]
-                if fnames and name in fnames:
-                    categorical.append(fnames.index(name))
-                else:
-                    log.fatal("categorical_feature name %r not found in "
-                              "header", name)
-            else:
-                categorical.append(int(tok))
+    categorical = resolve_categorical(config, fnames)
     return BinnedDataset.from_matrix(
         X, config, label=y, weight=weight, group=qgroups,
         init_score=init_score, position=pos,

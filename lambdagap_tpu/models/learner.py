@@ -104,8 +104,8 @@ class SerialTreeLearner:
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
 
         # monotone constraints, mapped original-feature -> used-feature
-        # (reference: monotone_constraints.hpp — 'basic' and 'intermediate'
-        # methods; 'advanced' falls back to intermediate)
+        # (reference: monotone_constraints.hpp — 'basic', 'intermediate'
+        # and 'advanced' methods)
         mono = np.zeros(self.num_features, dtype=np.int32)
         self.mono_method = config.monotone_constraints_method
         if config.monotone_constraints:
@@ -116,13 +116,10 @@ class SerialTreeLearner:
             if (mono != 0)[meta["is_categorical"]].any():
                 log.fatal("monotone_constraints cannot be set on "
                           "categorical features")
-            if self.mono_method == "advanced":
-                log.warning("monotone_constraints_method=advanced is not "
-                            "implemented; using 'intermediate'")
-                self.mono_method = "intermediate"
-            elif self.mono_method not in ("basic", "intermediate"):
+            if self.mono_method not in ("basic", "intermediate", "advanced"):
                 log.fatal("unknown monotone_constraints_method %r",
                           self.mono_method)
+        self._nb_np = meta["num_bins"].astype(np.int32)
         self.mono_np = mono
         self.mono_arr = jnp.asarray(mono)
         self.mono_on = bool((mono != 0).any())
@@ -244,11 +241,16 @@ class SerialTreeLearner:
         return jnp.asarray(m)
 
     def _best(self, hist, pg, ph, pc, parent_output, fmask,
-              bounds=None, path_feats=frozenset(), depth=0) -> _HostSplit:
+              bounds=None, path_feats=frozenset(), depth=0,
+              adv=None) -> _HostSplit:
         cons = None
         if self.mono_on:
-            lo, hi = bounds if bounds is not None else (-np.inf, np.inf)
-            cons = (self.mono_arr, jnp.float32(lo), jnp.float32(hi))
+            if adv is not None:
+                # advanced method: dense per-threshold bound arrays
+                cons = (self.mono_arr,) + tuple(jnp.asarray(a) for a in adv)
+            else:
+                lo, hi = bounds if bounds is not None else (-np.inf, np.inf)
+                cons = (self.mono_arr, jnp.float32(lo), jnp.float32(hi))
         pen = None
         if self.cegb_on:
             pen = (self._cegb_split_pen * pc
@@ -274,6 +276,100 @@ class SerialTreeLearner:
             gain_penalty=pen, rand_thresholds=rand_t,
             gain_contri=contri)
         return _HostSplit(jax.device_get(res))
+
+    # advanced monotone method -------------------------------------------
+    # TPU-first re-design of AdvancedLeafConstraints (reference:
+    # src/treelearner/monotone_constraints.hpp:858-1176). Instead of the
+    # reference's recursive GoUp/GoDownToFindConstrainingLeaves walks
+    # building piecewise (threshold, constraint) lists, every leaf carries
+    # its bin-space bounding box; the constraining-leaf relation is one
+    # vectorized box-adjacency test (m lies across a monotone feature g and
+    # overlaps the leaf in every other feature — exactly the set the
+    # reference's contiguity pruning converges to), and the per-threshold
+    # cumulative extrema (CumulativeFeatureConstraint) become prefix/suffix
+    # cummax/cummin over dense [F, B] arrays consumed by the vectorized
+    # split scan.
+
+    def _adv_constrainers(self, lo_l, hi_l, los, his):
+        """For each monotone feature g: boolean masks over candidate leaves
+        that bound this leaf from above/below in g while overlapping it in
+        every other feature. Returns {g: (above[M], below[M])}."""
+        ov = (los < hi_l[None, :]) & (lo_l[None, :] < his)       # [M, F]
+        n_ov = ov.sum(axis=1)
+        F = lo_l.shape[0]
+        out = {}
+        for g in np.nonzero(self.mono_np)[0]:
+            others_ok = (n_ov - ov[:, g]) == (F - 1)
+            above = (los[:, g] >= hi_l[g]) & others_ok
+            below = (his[:, g] <= lo_l[g]) & others_ok
+            out[int(g)] = (above, below)
+        return out
+
+    def _advanced_bound_arrays(self, leaf, boxes, tree):
+        """Dense per-(feature, bin) monotone bounds for ``leaf`` from the
+        current tree leaves, already cumulated into the four arrays the
+        scan consumes: (min_left, max_left, min_right, max_right), each
+        [F, B] f32 where index t carries the bound applicable to the
+        left/right child of a split at threshold t."""
+        F, B = self.num_features, self.B
+        lo_l, hi_l = boxes[leaf]
+        live = [m for m in range(tree.num_leaves)
+                if m != leaf and m in boxes]
+        min_raw = np.full((F, B), -np.inf, np.float32)
+        max_raw = np.full((F, B), np.inf, np.float32)
+        if live:
+            los = np.stack([boxes[m][0] for m in live])
+            his = np.stack([boxes[m][1] for m in live])
+            outs = np.asarray([tree.leaf_value[m] for m in live], np.float32)
+            bins = np.arange(B, dtype=np.int32)
+            for g, (above, below) in self._adv_constrainers(
+                    lo_l, hi_l, los, his).items():
+                sgn = int(self.mono_np[g])
+                uppers = above if sgn > 0 else below
+                lowers = below if sgn > 0 else above
+                for sel, is_upper in ((uppers, True), (lowers, False)):
+                    if not sel.any():
+                        continue
+                    vs = outs[sel]
+                    # each constrainer applies over ITS f-range for every
+                    # scan feature f != g, and over the full range for
+                    # f == g (all of this leaf lies across the boundary)
+                    mask = ((bins[None, None, :] >= los[sel][:, :, None])
+                            & (bins[None, None, :] < his[sel][:, :, None]))
+                    mask[:, g, :] = True
+                    if is_upper:
+                        v = np.where(mask, vs[:, None, None], np.inf)
+                        max_raw = np.minimum(max_raw, v.min(axis=0))
+                    else:
+                        v = np.where(mask, vs[:, None, None], -np.inf)
+                        min_raw = np.maximum(min_raw, v.max(axis=0))
+        # left child at threshold t covers bins [lo, t] -> inclusive prefix;
+        # right child covers (t, hi) -> suffix shifted one past t
+        min_l = np.maximum.accumulate(min_raw, axis=1)
+        max_l = np.minimum.accumulate(max_raw, axis=1)
+        sfx_min = np.maximum.accumulate(min_raw[:, ::-1], axis=1)[:, ::-1]
+        sfx_max = np.minimum.accumulate(max_raw[:, ::-1], axis=1)[:, ::-1]
+        min_r = np.concatenate([sfx_min[:, 1:], sfx_min[:, -1:]], axis=1)
+        max_r = np.concatenate([sfx_max[:, 1:], sfx_max[:, -1:]], axis=1)
+        return min_l, max_l, min_r, max_r
+
+    def _adv_affected(self, lo_p, hi_p, boxes, leaves):
+        """Leaves whose advanced constraints may change when the leaf that
+        owned box (lo_p, hi_p) re-splits (its children's outputs are new):
+        every leaf the OLD box constrained. The constrainer relation is
+        symmetric in adjacency, so this is the union of the above/below
+        masks from the shared box test (the reference tracks this as
+        leaves_to_update_, monotone_constraints.hpp:560+)."""
+        cand = [m for m in leaves if m in boxes]
+        if not cand:
+            return []
+        los = np.stack([boxes[m][0] for m in cand])
+        his = np.stack([boxes[m][1] for m in cand])
+        hit = np.zeros(len(cand), dtype=bool)
+        for above, below in self._adv_constrainers(lo_p, hi_p,
+                                                   los, his).values():
+            hit |= above | below
+        return [m for m, h in zip(cand, hit) if h]
 
     # histogram hook points (overridden by the distributed learners) --------
     def _root_histogram(self, grad, hess, row_mask):
@@ -369,10 +465,19 @@ class SerialTreeLearner:
         tree.leaf_count[0] = int(float(jax.device_get(totals[2])))
 
         # intermediate monotone method: per-tree node topology + subtree
-        # markers (reference: IntermediateLeafConstraints state)
-        inter_on = self.mono_on and self.mono_method == "intermediate"
+        # markers (reference: IntermediateLeafConstraints state). The
+        # advanced method keeps the intermediate scalar-bound bookkeeping
+        # (AdvancedLeafConstraints : IntermediateLeafConstraints) and adds
+        # per-leaf bin-space boxes feeding _advanced_bound_arrays.
+        adv_on = self.mono_on and self.mono_method == "advanced"
+        inter_on = self.mono_on and self.mono_method in ("intermediate",
+                                                         "advanced")
         node_parent: List[int] = []
         leaf_mono: Dict[int, bool] = {}
+        boxes: Dict[int, tuple] = {}
+        if adv_on:
+            boxes[0] = (np.zeros(self.num_features, np.int32),
+                        self._nb_np.copy())
 
         def apply_split(leaf: int, s: _HostSplit) -> Optional[int]:
             """Partition + record split ``s`` on ``leaf``, then compute both
@@ -479,6 +584,18 @@ class SerialTreeLearner:
                         rhi = min(phi, mid)
             bounds[leaf] = (llo, lhi)
             bounds[right_leaf] = (rlo, rhi)
+            if adv_on:
+                # children inherit the parent's bin-space box narrowed on
+                # the split feature (categorical splits scatter bins to
+                # both sides; keeping the parent box is conservative)
+                lo_p, hi_p = boxes.pop(leaf)
+                llo_b, lhi_b = lo_p.copy(), hi_p.copy()
+                rlo_b, rhi_b = lo_p.copy(), hi_p.copy()
+                if not bool(s.is_categorical):
+                    lhi_b[feat] = int(s.threshold) + 1
+                    rlo_b[feat] = int(s.threshold) + 1
+                boxes[leaf] = (llo_b, lhi_b)
+                boxes[right_leaf] = (rlo_b, rhi_b)
             child_path = paths.pop(leaf, frozenset()) | {feat}
             paths[leaf] = child_path
             paths[right_leaf] = child_path
@@ -505,16 +622,22 @@ class SerialTreeLearner:
             hists[small_leaf] = hist_small
             hists[large_leaf] = hist_large
             child_depth = int(tree.leaf_depth[leaf])
+            adv_s = (self._advanced_bound_arrays(small_leaf, boxes, tree)
+                     if adv_on else None)
+            adv_g = (self._advanced_bound_arrays(large_leaf, boxes, tree)
+                     if adv_on else None)
             best[small_leaf] = self._best(hist_small, *s_sums, fmask,
                                           bounds[small_leaf],
-                                          paths[small_leaf], child_depth)
+                                          paths[small_leaf], child_depth,
+                                          adv=adv_s)
             best[large_leaf] = self._best(hist_large, *g_sums, fmask,
                                           bounds[large_leaf],
-                                          paths[large_leaf], child_depth)
+                                          paths[large_leaf], child_depth,
+                                          adv=adv_g)
             sums[small_leaf] = s_sums
             sums[large_leaf] = g_sums
 
-            if inter_on and leaf_mono.get(leaf, False):
+            if inter_on and not adv_on and leaf_mono.get(leaf, False):
                 # tighten bounds of contiguous leaves in monotone ancestors'
                 # opposite subtrees, then refresh their cached best splits
                 upd = _intermediate_propagate(
@@ -526,6 +649,21 @@ class SerialTreeLearner:
                         best[ul] = self._best(hists[ul], *sums[ul], fmask,
                                               bounds[ul], paths[ul],
                                               int(tree.leaf_depth[ul]))
+            elif adv_on:
+                # the split replaced one output with two new ones: refresh
+                # the cached best split of every leaf the OLD box
+                # constrained (reference: leaves_to_update_ +
+                # RecomputeConstraintsIfNeeded)
+                lo_pre, hi_pre = boxes[leaf][0].copy(), boxes[leaf][1].copy()
+                if not bool(s.is_categorical):
+                    hi_pre[feat] = boxes[right_leaf][1][feat]  # parent range
+                for ul in self._adv_affected(
+                        lo_pre, hi_pre, boxes,
+                        [m for m in hists if m not in (leaf, right_leaf)]):
+                    best[ul] = self._best(
+                        hists[ul], *sums[ul], fmask, bounds[ul], paths[ul],
+                        int(tree.leaf_depth[ul]),
+                        adv=self._advanced_bound_arrays(ul, boxes, tree))
             return right_leaf
 
         # ---- forced-splits phase (reference: serial_tree_learner.cpp:624

@@ -223,15 +223,23 @@ def pack_ghq8(gq: jax.Array, hq: jax.Array, valid: jax.Array) -> jax.Array:
 
 
 def quantize_gradients(grad: jax.Array, hess: jax.Array, key,
-                       num_bins: int, stochastic: bool = True):
+                       num_bins: int, stochastic: bool = True,
+                       gmax=None, hmax=None):
     """Discretize grad/hess to signed int8 levels with stochastic rounding
     (reference: GradientDiscretizer::DiscretizeGradients,
     src/treelearner/gradient_discretizer.cpp). Returns
-    (g_q i8, h_q i8, g_scale, h_scale)."""
+    (g_q i8, h_q i8, g_scale, h_scale).
+
+    ``gmax``/``hmax`` override the locally-measured extrema — the
+    pre-partitioned multi-process path passes GLOBAL maxima so every rank
+    derives identical scales (the distributed analog of the reference
+    syncing gradient scales before histogram reduction)."""
     qb = max(2, min(num_bins, 127))   # int8 hessian levels reach qb
     half = max(qb // 2, 1)
-    gmax = jnp.maximum(jnp.max(jnp.abs(grad)), 1e-12)
-    hmax = jnp.maximum(jnp.max(hess), 1e-12)
+    if gmax is None:
+        gmax = jnp.maximum(jnp.max(jnp.abs(grad)), 1e-12)
+    if hmax is None:
+        hmax = jnp.maximum(jnp.max(hess), 1e-12)
     gs = gmax / half
     hs = hmax / qb
     g = grad / gs

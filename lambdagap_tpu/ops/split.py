@@ -109,6 +109,23 @@ def split_gains(lg, lh, rg, rh, p: SplitParams, l_cnt=None, r_cnt=None,
             + leaf_gain(rg, rh, p, r_cnt, parent_output, l2_extra))
 
 
+def _norm_constraints(constraints):
+    """Normalize monotone constraints to
+    ``(monotone[F], min_l, max_l, min_r, max_r)``.
+
+    ``min_l``/``max_l`` bound the LEFT child at threshold t, ``min_r``/
+    ``max_r`` the RIGHT child; each broadcasts against ``[F, B]`` — scalars
+    for the basic/intermediate methods (one bound per leaf), dense
+    per-threshold arrays for the advanced method (prefix/suffix cumulative
+    extrema of the per-bin constraints — the vectorized form of the
+    reference's CumulativeFeatureConstraint,
+    src/treelearner/monotone_constraints.hpp:146-264)."""
+    if len(constraints) == 3:
+        monotone, min_c, max_c = constraints
+        return monotone, min_c, max_c, min_c, max_c
+    return constraints
+
+
 # ---------------------------------------------------------------------------
 # numerical scan
 # ---------------------------------------------------------------------------
@@ -168,16 +185,18 @@ def _numerical_best(hist, parent_g, parent_h, parent_c, parent_output,
             gain = split_gains(left_g, left_h, right_g, right_h, p,
                                left_c, right_c, parent_output)
             return jnp.where(ok, gain, K_MIN_SCORE)
-        # monotone path (basic method): per-candidate child outputs,
-        # clamped to the leaf's inherited bounds, with a direction veto on
-        # the constrained feature (reference:
+        # monotone path: per-candidate child outputs clamped to the leaf's
+        # bounds — scalar for basic/intermediate, per-threshold [F, B]
+        # arrays for advanced — with a direction veto on the constrained
+        # feature (reference:
         # src/treelearner/monotone_constraints.hpp:329 BasicLeafConstraints
-        # + feature_histogram.hpp monotone-templated scan)
-        monotone, min_c, max_c = constraints
+        # + feature_histogram.hpp monotone-templated scan; per-threshold
+        # bounds: CumulativeFeatureConstraint Get{Left,Right}{Min,Max})
+        monotone, min_l, max_l, min_r, max_r = _norm_constraints(constraints)
         lout = jnp.clip(calculate_leaf_output(left_g, left_h, p, left_c,
-                                              parent_output), min_c, max_c)
+                                              parent_output), min_l, max_l)
         rout = jnp.clip(calculate_leaf_output(right_g, right_h, p, right_c,
-                                              parent_output), min_c, max_c)
+                                              parent_output), min_r, max_r)
         m = monotone[:, None]
         veto = ((m > 0) & (lout > rout)) | ((m < 0) & (lout < rout))
         gain = (leaf_gain_given_output(left_g, left_h, lout, p)
@@ -255,8 +274,12 @@ def _categorical_best(hist, parent_g, parent_h, parent_c, parent_output,
                                l2_extra=p.cat_l2)
             return jnp.where(ok, gain, K_MIN_SCORE)
         # no ordering veto for categorical splits, but child outputs still
-        # clamp to the leaf's inherited monotone bounds
-        _, min_c, max_c = constraints
+        # clamp to the leaf's inherited monotone bounds; under the advanced
+        # method a categorical split scatters bins to both sides, so the
+        # FULL-range bound applies (last prefix-cumulated column)
+        _, min_l, max_l, _, _ = _norm_constraints(constraints)
+        min_c = min_l[:, -1:] if getattr(min_l, "ndim", 0) == 2 else min_l
+        max_c = max_l[:, -1:] if getattr(max_l, "ndim", 0) == 2 else max_l
         lout = jnp.clip(calculate_leaf_output(
             left_g, left_h, p, left_c, parent_output, l2_extra=p.cat_l2),
             min_c, max_c)
@@ -571,9 +594,21 @@ def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
     left_out = calculate_leaf_output(left_g, left_h, p, left_c, parent_output)
     right_out = calculate_leaf_output(right_g, right_h, p, right_c, parent_output)
     if constraints is not None:
-        _, min_c, max_c = constraints
-        left_out = jnp.clip(left_out, min_c, max_c)
-        right_out = jnp.clip(right_out, min_c, max_c)
+        _, min_l, max_l, min_r, max_r = _norm_constraints(constraints)
+        if getattr(min_l, "ndim", 0) == 2:
+            # advanced: bound at the CHOSEN (feature, threshold); a
+            # categorical winner uses the full-range bound (last prefix col)
+            bt = thr[best_f]
+            cat_w = use_cat[best_f]
+            lmin = jnp.where(cat_w, min_l[best_f, -1], min_l[best_f, bt])
+            lmax = jnp.where(cat_w, max_l[best_f, -1], max_l[best_f, bt])
+            rmin = jnp.where(cat_w, min_l[best_f, -1], min_r[best_f, bt])
+            rmax = jnp.where(cat_w, max_l[best_f, -1], max_r[best_f, bt])
+            left_out = jnp.clip(left_out, lmin, lmax)
+            right_out = jnp.clip(right_out, rmin, rmax)
+        else:
+            left_out = jnp.clip(left_out, min_l, max_l)
+            right_out = jnp.clip(right_out, min_r, max_r)
 
     splittable = jnp.isfinite(best_gain_raw) & (split_gain > 0.0)
     return SplitResult(

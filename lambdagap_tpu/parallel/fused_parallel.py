@@ -28,12 +28,13 @@ FusedTreeLearner._train_tree_impl):
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
@@ -42,6 +43,9 @@ from ..models.fused_learner import DeviceTree, FusedTreeLearner
 from ..models.learner import _next_pow2
 from .mesh import DATA_AXIS, make_mesh, shard_rows
 from .multiprocess import global_array_from_local
+
+_DEBUG_CHECKS = os.environ.get("LAMBDAGAP_DEBUG", "0") not in ("0", "",
+                                                               "false")
 
 
 class FusedDataParallelTreeLearner(FusedTreeLearner):
@@ -58,11 +62,6 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
             # global leading axis splits evenly over all devices
             # (reference: per-rank data with synced mappers,
             # src/io/dataset_loader.cpp:1072)
-            if config.use_quantized_grad:
-                from ..utils import log
-                log.fatal("use_quantized_grad is not supported with "
-                          "pre-partitioned multi-process training "
-                          "(gradient scales would differ per rank)")
             self.mesh = mesh if mesh is not None else make_mesh(0)
             self.n_dev = int(self.mesh.devices.size)
             n_proc = jax.process_count()
@@ -163,6 +162,10 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
                         "(or replicated), got a cross-process sharded array "
                         "%s", v.sharding)
             v = jnp.asarray(v)
+            if v.shape[0] == self.n_pad and self.n_pad != self.proc_pad:
+                # GLOBAL-length replicated state: take this rank's block
+                p = jax.process_index() * self.proc_pad
+                v = lax.dynamic_slice_in_dim(v, p, self.proc_pad, axis=0)
             pad = self.proc_pad - v.shape[0]
             if pad:
                 v = jnp.pad(v, [(0, pad)] + [(0, 0)] * (v.ndim - 1))
@@ -178,6 +181,33 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
                 gshape, sharding, blocks)
         return shard_rows(self.mesh, v)[0]
 
+    def _check_shard_agreement(self, rec: DeviceTree) -> None:
+        """LAMBDAGAP_DEBUG cross-shard divergence check. The tree record is
+        nominally replicated — every shard derives it from identically
+        psum-ed histograms — but ``check_vma=False`` on the shard_map means
+        the static checker never proves it: a dropped psum on a new code
+        path would silently corrupt training. Here each device's copy of
+        the per-split decisions is compared bit-for-bit (the runtime analog
+        of the reference's SyncUpGlobalBestSplit all-reduce agreeing on one
+        winner, src/treelearner/parallel_tree_learner.h:209)."""
+        from ..utils import log
+        for name in ("node_feature", "node_threshold", "node_gain",
+                     "leaf_value", "num_leaves"):
+            arr = getattr(rec, name)
+            shards = getattr(arr, "addressable_shards", None)
+            if not shards:
+                continue
+            ref = np.asarray(shards[0].data)
+            for s in shards[1:]:
+                got = np.asarray(s.data)
+                if not np.array_equal(ref, got, equal_nan=True):
+                    bad = np.nonzero(ref != got)[0][:8] if ref.ndim else []
+                    log.fatal(
+                        "cross-shard divergence in %s on device %s "
+                        "(first diverging indices %s): shards disagreed on "
+                        "the split sequence — a collective is missing from "
+                        "the fused program", name, s.device, list(bad))
+
     def train_device(self, grad: jax.Array, hess: jax.Array,
                      row_mask: Optional[jax.Array] = None) -> DeviceTree:
         fmask = self._feature_mask()
@@ -188,9 +218,22 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
         if self.quant:
             from ..ops.hist_pallas import quantize_gradients
             self._qkey, sub = jax.random.split(self._qkey)
+            gmax = hmax = None
+            if self.proc_sharded and jax.process_count() > 1:
+                # every rank holds different rows: agree on GLOBAL |grad| /
+                # hess maxima before deriving quantization scales, else the
+                # psum-ed int32 histograms would mix incompatible units
+                from jax.experimental import multihost_utils
+                lm = np.asarray(
+                    [float(jnp.max(jnp.abs(grad))), float(jnp.max(hess))],
+                    np.float32)
+                gm = np.asarray(multihost_utils.process_allgather(
+                    lm)).reshape(-1, 2).max(axis=0)
+                gmax = jnp.float32(max(float(gm[0]), 1e-12))
+                hmax = jnp.float32(max(float(gm[1]), 1e-12))
             gq, hq, gs, hs = quantize_gradients(
                 grad, hess, sub, self.config.num_grad_quant_bins,
-                self.config.stochastic_rounding)
+                self.config.stochastic_rounding, gmax=gmax, hmax=hmax)
             gq, hq = self._shard_vec(gq), self._shard_vec(hq)
         else:
             gq = hq = jnp.zeros(1, jnp.int8)
@@ -203,6 +246,8 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
             ekey = jnp.zeros((2, 2), jnp.uint32)
         rec = self._train_jit_dp(g, h, m, fmask, self.hx_rows, self.x_cols,
                                  gq, hq, gs, hs, ekey)
+        if _DEBUG_CHECKS:
+            self._check_shard_agreement(rec)
         # consumers (score update, leaf renewal) see an unpadded [N] leaf map
         if self.proc_sharded:
             # hand back this process's LOCAL rows: the booster's score

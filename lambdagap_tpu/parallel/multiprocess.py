@@ -142,12 +142,8 @@ def load_pre_partitioned(path: str, config: Config):
     counts = np.asarray(multihost_utils.process_allgather(
         np.asarray([n_local], np.int64))).reshape(-1)
 
-    categorical = []
-    if config.categorical_feature:
-        for tok in str(config.categorical_feature).split(","):
-            tok = tok.strip()
-            if tok:
-                categorical.append(int(tok))
+    from ..data.loader import resolve_categorical
+    categorical = resolve_categorical(config, fnames)
 
     # identical global sample on every rank -> identical mappers
     mapper_ref = BinnedDataset.from_matrix(

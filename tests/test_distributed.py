@@ -167,3 +167,37 @@ def test_fused_dp_interaction_constraints_and_bynode():
                 extra={"interaction_constraints": [[0, 1], [2, 3, 4, 5]],
                        "feature_fraction_bynode": 0.7})
     assert b.model_to_string() == b2.model_to_string()
+
+
+def test_debug_shard_agreement_check(monkeypatch):
+    """LAMBDAGAP_DEBUG cross-shard divergence detection on the fused
+    data-parallel path: a clean training run passes the bit-for-bit
+    per-device comparison of the split sequence, and a hand-built
+    divergent record is caught (compensates check_vma=False on the
+    shard_map — reference analog: SyncUpGlobalBestSplit agreement,
+    src/treelearner/parallel_tree_learner.h:209)."""
+    from lambdagap_tpu.parallel import fused_parallel
+    monkeypatch.setattr(fused_parallel, "_DEBUG_CHECKS", True)
+    X, y = _data(seed=5)
+    b = _train(X, y, "data", min(NEED, len(jax.devices())), rounds=3)
+    assert roc_auc_score(y, b.predict(X)) > 0.9   # check ran and passed
+
+    # negative: shards that disagree must be caught
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from lambdagap_tpu.parallel.mesh import make_mesh
+    lrn = b._booster.learner
+    mesh = lrn.mesh
+    n_dev = int(mesh.devices.size)
+    divergent = jax.device_put(
+        jnp.arange(n_dev, dtype=jnp.float32),
+        NamedSharding(mesh, P("data")))   # per-device values all differ
+
+    class FakeRec:
+        node_feature = divergent
+        node_threshold = divergent
+        node_gain = divergent
+        leaf_value = divergent
+        num_leaves = divergent
+    with pytest.raises(Exception, match="divergence"):
+        lrn._check_shard_agreement(FakeRec())
