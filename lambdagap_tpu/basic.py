@@ -23,6 +23,55 @@ try:  # pandas optional
 except ImportError:  # pragma: no cover
     _PANDAS = False
 
+try:  # pyarrow optional (reference: include/LightGBM/arrow.h + the Arrow
+    # paths of src/c_api.cpp; here Tables/Arrays convert at the Python
+    # boundary — zero-copy when the chunk layout allows — and flow through
+    # the same binning as numpy)
+    import pyarrow as pa
+    _ARROW = True
+except ImportError:  # pragma: no cover
+    _ARROW = False
+
+
+def _is_arrow_table(data) -> bool:
+    return _ARROW and isinstance(data, pa.Table)
+
+
+def _is_arrow_array(data) -> bool:
+    return _ARROW and isinstance(data, (pa.Array, pa.ChunkedArray))
+
+
+def _arrow_table_to_matrix(table) -> tuple:
+    """pyarrow Table -> (float64 matrix, feature_names, categorical_idx).
+    Dictionary-encoded columns become category codes (the pandas-categorical
+    analog); boolean/integer/float columns cast to float64 with nulls as
+    NaN."""
+    names = [str(c) for c in table.column_names]
+    n = table.num_rows
+    mat = np.empty((n, table.num_columns), dtype=np.float64)
+    categorical = []
+    for i, col in enumerate(table.columns):
+        typ = col.type
+        if pa.types.is_dictionary(typ):
+            combined = col.combine_chunks()
+            if isinstance(combined, pa.ChunkedArray):
+                combined = combined.chunk(0)
+            codes = combined.indices.to_numpy(zero_copy_only=False)
+            mat[:, i] = codes
+            categorical.append(i)
+        else:
+            mat[:, i] = col.to_numpy(zero_copy_only=False)
+    return mat, names, categorical
+
+
+def _arrow_to_vector(arr, dtype=np.float32) -> np.ndarray:
+    """pyarrow Array/ChunkedArray (or a 1/K-column Table of init scores)
+    -> numpy."""
+    if _ARROW and isinstance(arr, pa.Table):
+        cols = [c.to_numpy(zero_copy_only=False) for c in arr.columns]
+        return np.column_stack(cols).astype(dtype)
+    return arr.to_numpy(zero_copy_only=False).astype(dtype)
+
 
 class Sequence:
     """Generic data access interface for streaming Dataset construction
@@ -48,6 +97,8 @@ def _to_matrix(data) -> tuple:
     categorical_from_dtype)."""
     feature_names = None
     categorical = []
+    if _is_arrow_table(data):
+        return _arrow_table_to_matrix(data)
     if _PANDAS and isinstance(data, pd.DataFrame):
         feature_names = [str(c) for c in data.columns]
         mat = np.empty(data.shape, dtype=np.float64)
@@ -92,6 +143,23 @@ class Dataset:
         if self._constructed is not None:
             return self._constructed
         cfg = config or Config.from_params(self.params)
+        # Arrow metadata vectors normalize once at the boundary (reference:
+        # the Arrow field paths of LGBM_DatasetSetField, src/c_api.cpp)
+        if _ARROW:
+            if _is_arrow_array(self.label) or isinstance(self.label, pa.Table):
+                self.label = _arrow_to_vector(self.label, np.float32).reshape(-1)
+            if _is_arrow_array(self.weight):
+                self.weight = _arrow_to_vector(self.weight, np.float32)
+            if _is_arrow_array(self.group):
+                self.group = _arrow_to_vector(self.group, np.int64)
+            if _is_arrow_array(self.position):
+                self.position = _arrow_to_vector(self.position, np.int64)
+            if (_is_arrow_array(self.init_score)
+                    or isinstance(self.init_score, pa.Table)):
+                init = _arrow_to_vector(self.init_score, np.float64)
+                # a K-column table is class-major init scores
+                self.init_score = (init.T.reshape(-1) if init.ndim == 2
+                                   else init)
         if isinstance(self.data, (str, os.PathLike)):
             # data straight from a file, sidecars (.weight/.query/.init)
             # auto-loaded (reference: Dataset accepts a path →

@@ -369,6 +369,7 @@ class Config:
     tpu_hist_impl: str = "auto"               # auto / onehot / pallas
     tpu_num_devices: int = 0                  # 0 = all visible devices
     tpu_fused_learner: str = "auto"           # auto / 1 / 0: whole-tree-on-device
+    tpu_fast_predict_rows: int = 10000        # route predict batches up to this many rows through the threaded native traverser
     # gradient operand precision for the MXU histogram contraction:
     #   split — two-term bf16 (hi + residual) decomposition, ~f32-accurate
     #           at one extra matmul row-block (default; the reference

@@ -149,6 +149,9 @@ class FusedTreeLearner(SerialTreeLearner):
         # accumulation, and everything derived from histograms (gains, split
         # choices, leaf values) is replicated-by-construction
         self.axis: Optional[str] = None
+        # voting mode: keep histograms local, vote top-k features, psum
+        # only voted columns (set by FusedVotingParallelTreeLearner)
+        self.voting: bool = False
         self._train_jit = jax.jit(self._train_tree_impl,
                                   static_argnames=("has_mask",))
         self.last_row_leaf: Optional[jax.Array] = None
@@ -258,11 +261,28 @@ class FusedTreeLearner(SerialTreeLearner):
         return self.materialize(self.train_device(grad, hess, row_mask))
 
     # ------------------------------------------------------------------
+    def materialize_batch(self, recs) -> list:
+        """Fetch MANY DeviceTrees in one transfer: each field is stacked
+        across trees on device, so the D2H cost is one buffer per field
+        instead of one per (tree, field) — on the tunneled chip that is the
+        difference between ~16 and ~16*T round-trips (the round-3 bench's
+        20s+ first-predict wall was exactly this)."""
+        if not recs:
+            return []
+        stacked = {k: jnp.stack([getattr(r, k) for r in recs])
+                   for k in DeviceTree._fields if k != "row_leaf"}
+        h = jax.device_get(stacked)
+        return [self._tree_from_host({k: v[i] for k, v in h.items()})
+                for i in range(len(recs))]
+
     def materialize(self, rec: DeviceTree) -> Tree:
         """Fetch a DeviceTree and build the host Tree model (one transfer;
         row_leaf stays on device — it is O(N))."""
         h = jax.device_get({k: v for k, v in rec._asdict().items()
                             if k != "row_leaf"})
+        return self._tree_from_host(h)
+
+    def _tree_from_host(self, h) -> Tree:
         L = int(h["num_leaves"])
         nodes = max(L - 1, 0)
         tree = Tree(max_leaves=self.config.num_leaves)
@@ -409,14 +429,17 @@ class FusedTreeLearner(SerialTreeLearner):
             _, hist = lax.while_loop(
                 lambda st: st[0] < nch, body,
                 (jnp.int32(0), jnp.zeros((C, Bb, HIST_C), acc_dtype)))
-            if self.axis is not None:
+            if self.axis is not None and not self.voting:
                 # the one collective per split: local chunk loops may run
                 # different trip counts per shard (local leaf sizes differ),
                 # but every shard reaches this psum exactly once per step.
                 # In quant_exact mode the reduction is over raw integer level
                 # sums — order-independent, hence deterministic for any shard
                 # count (reference: the 16/32-bit integer ReduceScatter at
-                # data_parallel_tree_learner.cpp:283-298)
+                # data_parallel_tree_learner.cpp:283-298).
+                # Voting mode keeps histograms LOCAL: the collective moves
+                # into best_of as a top-k vote + psum of only the voted
+                # columns (reference: voting_parallel_tree_learner.cpp).
                 hist = lax.psum(hist, self.axis)
             if qexact:
                 hist = hist.astype(jnp.float32) * jnp.stack(
@@ -466,21 +489,66 @@ class FusedTreeLearner(SerialTreeLearner):
                 m = m & (rank < k.astype(jnp.int32))
             return m
 
+        voting = self.voting
+        vote_k = int(getattr(self, "vote_k", 0)) if voting else 0
+
         def best_of(hist, pg, ph, pc, pout, lo, hi, depth, rkey, fm):
             """Best split for one leaf, with the max_depth guard.
-            Returns (gain, feat, thr, dl, cat, bits, lg, lh, lc, lout, rout)."""
-            if bundled:
-                from ..ops.histogram import unbundle_hist
-                hist = unbundle_hist(hist, self.ub_src, self.ub_kind,
-                                     pg, ph, pc)
+            Returns (gain, feat, thr, dl, cat, bits, lg, lh, lc, lout, rout).
+
+            Voting mode (reference:
+            src/treelearner/voting_parallel_tree_learner.cpp:151-184
+            GlobalVoting + CopyLocalHistogram): ``hist`` is this shard's
+            LOCAL histogram; each shard scans it against its local parent
+            sums, proposes its top-k features, the votes all_gather, and
+            only the voted columns psum — O(D·k·B) bytes on the wire per
+            split instead of O(F·B) — before one global scan whose results
+            scatter back into full-F arrays so the downstream argmax/
+            penalty/monotone code is identical in all modes."""
             cons = (mono_arr, lo, hi) if mono_on else None
             rand_t = None
             if extra_on:
                 rand_t = jax.random.randint(rkey, (F,), 0, 1 << 30) % nb_m1
-            gain, thr, dl, lg, lh, lc, bits = per_feature_best(
-                hist, pg, ph, pc, pout, num_bins, default_bins,
-                missing_types, is_cat_arr, fm, p, has_cat,
-                constraints=cons, rand_thresholds=rand_t)
+            if voting:
+                lt = jnp.sum(hist[0], axis=0)     # local parent sums
+                if bundled:
+                    from ..ops.histogram import unbundle_hist
+                    hist = unbundle_hist(hist, self.ub_src, self.ub_kind,
+                                         lt[0], lt[1], lt[2])
+                lgain, *_ = per_feature_best(
+                    hist, lt[0], lt[1], lt[2], jnp.float32(0.0), num_bins,
+                    default_bins, missing_types, is_cat_arr, fm, p, has_cat)
+                _, local_top = lax.top_k(lgain, vote_k)
+                votes = lax.all_gather(local_top.astype(jnp.int32),
+                                       self.axis, tiled=True)     # [D*k]
+                hist_v = lax.psum(hist[votes], self.axis)
+                cons_v = (mono_arr[votes], lo, hi) if mono_on else None
+                gain_v, thr_v, dl_v, lg_v, lh_v, lc_v, bits_v = \
+                    per_feature_best(
+                        hist_v, pg, ph, pc, pout, num_bins[votes],
+                        default_bins[votes], missing_types[votes],
+                        is_cat_arr[votes], fm[votes], p, has_cat,
+                        constraints=cons_v,
+                        rand_thresholds=(rand_t[votes]
+                                         if rand_t is not None else None))
+                # scatter voted results back to [F] (duplicate votes write
+                # identical values)
+                gain = jnp.full((F,), K_MIN_SCORE).at[votes].set(gain_v)
+                thr = jnp.zeros((F,), jnp.int32).at[votes].set(thr_v)
+                dl = jnp.zeros((F,), bool).at[votes].set(dl_v)
+                lg = jnp.zeros((F,)).at[votes].set(lg_v)
+                lh = jnp.zeros((F,)).at[votes].set(lh_v)
+                lc = jnp.zeros((F,)).at[votes].set(lc_v)
+                bits = jnp.zeros((F, 8), jnp.uint32).at[votes].set(bits_v)
+            else:
+                if bundled:
+                    from ..ops.histogram import unbundle_hist
+                    hist = unbundle_hist(hist, self.ub_src, self.ub_kind,
+                                         pg, ph, pc)
+                gain, thr, dl, lg, lh, lc, bits = per_feature_best(
+                    hist, pg, ph, pc, pout, num_bins, default_bins,
+                    missing_types, is_cat_arr, fm, p, has_cat,
+                    constraints=cons, rand_thresholds=rand_t)
             parent_gain = leaf_gain(pg, ph, p, pc, pout)
             shift = parent_gain + p.min_gain_to_split
             mult = contri
@@ -530,6 +598,9 @@ class FusedTreeLearner(SerialTreeLearner):
                                  jnp.zeros(W, jnp.int32)])
         hist_root = leaf_hist(perm0, jnp.int32(0), jnp.int32(N))
         totals = jnp.sum(hist_root[0], axis=0)
+        if voting:
+            # local root hist: global parent sums need their own (tiny) psum
+            totals = lax.psum(totals, self.axis)
         root_out = calculate_leaf_output(totals[0], totals[1], p, totals[2],
                                          0.0)
         neg_inf = jnp.float32(-jnp.inf)
@@ -850,7 +921,13 @@ class FusedTreeLearner(SerialTreeLearner):
         node_i = state["node_i"]
         leaf_f = state["leaf_f"]
         leaf_i = state["leaf_i"]
-        leaf_value_out = leaf_f[:L, 3]
+        # an unsplittable tree contributes NOTHING — the reference turns
+        # one-leaf trees into constant-0 trees (gbdt.cpp:408-436
+        # AsConstantTree(0); the host learner matches); without this the
+        # fused fast path would add the root's Newton step every round
+        leaf_value_out = jnp.where(state["num_leaves"] > 1,
+                                   leaf_f[:L, 3],
+                                   jnp.zeros_like(leaf_f[:L, 3]))
         if quant and cfg.quant_train_renew_leaf:
             # re-fit leaf outputs with the full-precision gradient sums
             # (reference: GradientDiscretizer::RenewIntGradTreeOutput)
@@ -862,7 +939,9 @@ class FusedTreeLearner(SerialTreeLearner):
             parent_out = node_f[jnp.clip(leaf_i[:L, 3], 0, NODES - 1), 1]
             renewed = calculate_leaf_output(gsum, hsum, p, leaf_f[:L, 2],
                                             parent_out)
-            active = jnp.arange(L, dtype=jnp.int32) < state["num_leaves"]
+            # renew only real trees: a one-leaf tree stays constant-0
+            active = ((jnp.arange(L, dtype=jnp.int32) < state["num_leaves"])
+                      & (state["num_leaves"] > 1))
             leaf_value_out = jnp.where(active, renewed, leaf_value_out)
         return DeviceTree(
             node_feature=node_i[:NODES, 0],

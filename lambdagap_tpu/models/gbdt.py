@@ -56,6 +56,19 @@ def _add_tree_score(score, perm, leaf_begin, leaf_count, leaf_values,
     return score.at[perm].add(vals)
 
 
+def _finalize_tree(tree: "Tree", shrinkage: float, bias: float) -> "Tree":
+    """Shrinkage + boost-from-average bias fold shared by every
+    materialization path (reference: Tree::Shrinkage + Tree::AddBias,
+    gbdt.cpp:415-421)."""
+    tree.apply_shrinkage(shrinkage)
+    if abs(bias) > K_EPSILON:
+        tree.leaf_value[:tree.num_leaves] += bias
+        tree.internal_value = [v + bias for v in tree.internal_value]
+        if getattr(tree, "is_linear", False):
+            tree.leaf_const[:tree.num_leaves] += bias
+    return tree
+
+
 class _LazyTree:
     """A trained tree still resident on device (fused learner); materializes
     to a host :class:`Tree` on first access."""
@@ -69,12 +82,8 @@ class _LazyTree:
         self.bias = bias
 
     def materialize(self) -> "Tree":
-        tree = self.learner.materialize(self.rec)
-        tree.apply_shrinkage(self.shrinkage)
-        if abs(self.bias) > K_EPSILON:
-            tree.leaf_value[:tree.num_leaves] += self.bias
-            tree.internal_value = [v + self.bias for v in tree.internal_value]
-        return tree
+        return _finalize_tree(self.learner.materialize(self.rec),
+                              self.shrinkage, self.bias)
 
 
 class GBDT:
@@ -213,7 +222,7 @@ class GBDT:
                         "training constant-leaf trees", tl)
             self.config.linear_tree = False
         if self.config.interaction_constraints and not (
-                tl == "data"
+                tl in ("data", "voting")
                 and _fused_mode_enabled(self.config.tpu_fused_learner)):
             # only the fused data-parallel program filters features by the
             # per-leaf path in-program; the host-loop distributed learners
@@ -248,6 +257,34 @@ class GBDT:
             if not_applied:
                 log.warning("%s are not applied by the host-loop "
                             "tree_learner=data", ", ".join(not_applied))
+        if tl == "voting" and _fused_mode_enabled(
+                self.config.tpu_fused_learner):
+            # fused voting: whole-tree program with per-split top-k vote +
+            # voted-column psum; combinations it cannot express fall back
+            # to the host-loop voting learner below
+            cfg = self.config
+            host_only = []
+            if self.config.forcedsplits_filename:
+                host_only.append("forcedsplits_filename")
+            if cfg.cegb_tradeoff > 0 and (
+                    cfg.cegb_penalty_split > 0
+                    or cfg.cegb_penalty_feature_coupled
+                    or cfg.cegb_penalty_feature_lazy):
+                host_only.append("cegb")
+            if host_only:
+                if cfg.interaction_constraints:
+                    # the host-loop voting learner does not filter features
+                    # by interaction set; dropping a constraint silently is
+                    # worse than failing
+                    log.fatal("interaction_constraints with "
+                              "tree_learner=voting cannot be combined "
+                              "with %s", ", ".join(host_only))
+                log.info("Using the host-loop voting learner for: %s",
+                         ", ".join(host_only))
+            else:
+                from ..parallel.fused_parallel import \
+                    FusedVotingParallelTreeLearner
+                return FusedVotingParallelTreeLearner(ds, self.config)
         from ..parallel import (DataParallelTreeLearner,
                                 FeatureParallelTreeLearner,
                                 VotingParallelTreeLearner)
@@ -373,6 +410,10 @@ class GBDT:
                 with global_timer.scope("score: update"):
                     lv = rec.leaf_value * self.shrinkage_rate
                     self.scores = self.scores.at[k].add(lv[rec.row_leaf])
+                # drop the O(N) row->leaf map from the kept record: at
+                # 10.5M rows x 500 trees it would pin ~21 GB of HBM that
+                # materialization never reads
+                rec = rec._replace(row_leaf=None)
                 lazy = _LazyTree(self.learner, rec, self.shrinkage_rate,
                                  init_scores[k])
                 self.models.append(lazy)
@@ -482,8 +523,27 @@ class GBDT:
             self.models[i] = m
         return m
 
+    def _materialize_lazy(self, idx=None) -> None:
+        """Materialize every (requested) device-resident tree in ONE batched
+        transfer (fused learner's materialize_batch) instead of per-tree
+        round-trips — the difference between one and hundreds of D2H syncs
+        when predicting from a freshly trained model."""
+        want = range(len(self.models)) if idx is None else idx
+        lazy = [i for i in want if isinstance(self.models[i], _LazyTree)]
+        if len(lazy) <= 1:
+            return
+        learner = self.models[lazy[0]].learner
+        if not hasattr(learner, "materialize_batch"):
+            return
+        same = [i for i in lazy if self.models[i].learner is learner]
+        trees = learner.materialize_batch([self.models[i].rec for i in same])
+        for i, t in zip(same, trees):
+            m = self.models[i]
+            self.models[i] = _finalize_tree(t, m.shrinkage, m.bias)
+
     @property
     def host_models(self) -> List[Tree]:
+        self._materialize_lazy()
         return [self._tree(i) for i in range(len(self.models))]
 
     def _update_train_score(self, tree: Tree, k: int) -> None:
@@ -802,6 +862,7 @@ class GBDT:
         if not idx:
             res = np.zeros((K, N), dtype=np.float32)
             return res[0] if K == 1 else res.T
+        self._materialize_lazy(idx)
         trees = [self._tree(i) for i in idx]
         # margin-based prediction early stop, classification only
         # (reference: src/boosting/prediction_early_stop.cpp)
@@ -813,9 +874,14 @@ class GBDT:
                    and self.objective.name in ("binary", "multiclass",
                                                "multiclassova") else 0)
         has_linear = any(getattr(t, "is_linear", False) for t in trees)
-        if N <= 512 and not has_linear and es_freq == 0:
-            # serving-shaped call: native host traversal, no jit dispatch
-            # (reference: src/c_api.cpp:63 SingleRowPredictorInner)
+        if (N <= max(int(self.config.tpu_fast_predict_rows), 512)
+                and not has_linear and es_freq == 0):
+            # serving-shaped call: threaded native host traversal, no jit
+            # dispatch (reference: src/c_api.cpp:63 SingleRowPredictorInner
+            # + the OpenMP row loop of Predictor). The threshold is a
+            # config knob: on a healthy chip the device forest wins earlier
+            # than on a throttled one (bench measures both sides)
+            # (reference: src/c_api.cpp:63)
             ff = self._fast_forest(idx, trees)
             if ff is not None and data.shape[1] > ff.max_feat:
                 res = ff.predict(data).astype(np.float32).T      # [K, N]
@@ -848,6 +914,7 @@ class GBDT:
         idx = self._model_slice(start_iteration, num_iteration)
         if not idx:
             return np.zeros((data.shape[0], 0), np.int32)
+        self._materialize_lazy(idx)
         trees = [self._tree(i) for i in idx]
         forest, depth = forest_to_arrays(trees, use_inner_feature=False)
         ys = predict_forest_leaf(jnp.asarray(data), forest, depth,
@@ -866,6 +933,7 @@ class GBDT:
         N, F_data = data.shape
         K = self.num_tree_per_iteration
         idx = self._model_slice(start_iteration, num_iteration)
+        self._materialize_lazy(idx)
         trees = [self._tree(i) for i in idx]
         if any(getattr(t, "is_linear", False) for t in trees):
             # TreeSHAP over constant leaf values would break the "rows sum to

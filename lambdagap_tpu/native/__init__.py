@@ -32,7 +32,7 @@ def _build_lib() -> Optional[str]:
         return out
     try:
         subprocess.run(["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                        *srcs, "-o", out],
+                        "-pthread", *srcs, "-o", out],
                        check=True, capture_output=True, timeout=120)
         return out
     except (subprocess.SubprocessError, FileNotFoundError) as e:

@@ -15,8 +15,11 @@
 //     device path compares f32), values are f32 — compares are exact.
 //   categorical: NaN goes right; bit `cat` of the node's raw-category
 //     bitset decides.
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -50,26 +53,46 @@ void lg_fast_predict(
     const uint32_t* cat_bits, const int32_t* left, const int32_t* right,
     const double* leaf_val, const int32_t* tree_class, int64_t n_class,
     const float* X, int64_t n_rows, int64_t n_cols, double* out) {
-  for (int64_t r = 0; r < n_rows; ++r) {
-    const float* row = X + r * n_cols;
-    double* orow = out + r * n_class;
-    for (int64_t t = 0; t < n_trees; ++t) {
-      const int64_t n0 = tree_node_off[t];
-      int64_t leaf = 0;
-      if (tree_node_off[t + 1] > n0) {
-        int32_t node = 0;
-        while (node >= 0) {
-          const int64_t g = n0 + node;
-          bool gl = go_left(row[feat[g]], thr[g], default_left[g],
-                            missing_type[g], is_cat[g], cat_bits + cat_off[g],
-                            cat_len[g]);
-          node = gl ? left[g] : right[g];
+  auto run_rows = [&](int64_t lo, int64_t hi) {
+    for (int64_t r = lo; r < hi; ++r) {
+      const float* row = X + r * n_cols;
+      double* orow = out + r * n_class;
+      for (int64_t t = 0; t < n_trees; ++t) {
+        const int64_t n0 = tree_node_off[t];
+        int64_t leaf = 0;
+        if (tree_node_off[t + 1] > n0) {
+          int32_t node = 0;
+          while (node >= 0) {
+            const int64_t g = n0 + node;
+            bool gl = go_left(row[feat[g]], thr[g], default_left[g],
+                              missing_type[g], is_cat[g],
+                              cat_bits + cat_off[g], cat_len[g]);
+            node = gl ? left[g] : right[g];
+          }
+          leaf = ~node;
         }
-        leaf = ~node;
+        orow[tree_class[t]] += leaf_val[tree_leaf_off[t] + leaf];
       }
-      orow[tree_class[t]] += leaf_val[tree_leaf_off[t] + leaf];
     }
+  };
+  // rows are independent: block-parallel for larger batches (the
+  // reference's predictor parallelizes with OpenMP; std::thread here)
+  const int64_t kMinRowsPerThread = 1024;
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  int n_threads = static_cast<int>(
+      std::min<int64_t>(std::max(hw, 1), n_rows / kMinRowsPerThread));
+  if (n_threads <= 1) {
+    run_rows(0, n_rows);
+    return;
   }
+  std::vector<std::thread> workers;
+  const int64_t step = (n_rows + n_threads - 1) / n_threads;
+  for (int w = 0; w < n_threads; ++w) {
+    const int64_t lo = w * step;
+    const int64_t hi = std::min(n_rows, lo + step);
+    if (lo < hi) workers.emplace_back(run_rows, lo, hi);
+  }
+  for (auto& th : workers) th.join();
 }
 
 }  // extern "C"

@@ -264,3 +264,32 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
             rec = rec._replace(row_leaf=rec.row_leaf[:self.num_data])
         self.last_row_leaf = rec.row_leaf
         return rec
+
+
+class FusedVotingParallelTreeLearner(FusedDataParallelTreeLearner):
+    """Voting-parallel as ONE compiled whole-tree program (reference:
+    src/treelearner/voting_parallel_tree_learner.cpp — GlobalVoting :151-175
+    + CopyLocalHistogram/Allreduce :184): histograms stay shard-local, each
+    split step all_gathers the shards' top-k feature votes and psums only
+    the voted columns — O(D·top_k·B) bytes per split instead of O(F·B) —
+    with zero per-split host syncs (the host-loop variant in
+    voting_parallel.py pays a D2H per split; this one does not)."""
+
+    def __init__(self, dataset: BinnedDataset, config: Config,
+                 mesh: Optional[Mesh] = None) -> None:
+        from ..utils import log
+        if config.use_quantized_grad:
+            log.warning("use_quantized_grad is not applied with the fused "
+                        "voting learner (the exact integer reduction needs "
+                        "full-histogram psum); training in full precision")
+            config.use_quantized_grad = False
+        if config.extra_trees:
+            log.fatal("extra_trees is not supported with "
+                      "tree_learner=voting (use serial or data)")
+        super().__init__(dataset, config, mesh)
+        if self.forced_seq is not None:
+            log.fatal("forced splits are not supported with the fused "
+                      "voting learner (forced gathers need global "
+                      "histograms); use tree_learner=data")
+        self.voting = True
+        self.vote_k = max(1, min(int(config.top_k), self.num_features))

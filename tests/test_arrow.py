@@ -1,0 +1,125 @@
+"""Arrow ingestion (reference: include/LightGBM/arrow.h, the Arrow paths in
+src/c_api.cpp, behavioral spec tests/python_package_test/test_arrow.py):
+pyarrow Tables construct Datasets and predict; Arrays/ChunkedArrays carry
+label/weight/group/init_score; dictionary columns are categorical."""
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+import lambdagap_tpu as lgb
+from sklearn.metrics import roc_auc_score
+
+
+def _chunked_table(X, types=None, n_chunks=3):
+    n, d = X.shape
+    bounds = np.linspace(0, n, n_chunks + 1).astype(int)
+    cols = []
+    for j in range(d):
+        typ = (types or {}).get(j, pa.float64())
+        chunks = [pa.array(X[a:b, j].astype(np.float64), type=typ)
+                  for a, b in zip(bounds[:-1], bounds[1:])]
+        cols.append(pa.chunked_array(chunks))
+    return pa.table(cols, names=[f"f{j}" for j in range(d)])
+
+
+def test_table_construct_matches_numpy():
+    rng = np.random.RandomState(0)
+    X = rng.randn(1200, 6)
+    X[:, 2] = rng.randint(0, 30, 1200)        # integral column
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    table = _chunked_table(X, types={2: pa.int32()})
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5}
+    b_np = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=8)
+    b_pa = lgb.train(params,
+                     lgb.Dataset(table, label=pa.chunked_array([y[:500],
+                                                                y[500:]])),
+                     num_boost_round=8)
+    np.testing.assert_allclose(b_np.predict(X), b_pa.predict(X),
+                               rtol=1e-6, atol=1e-8)
+    # predict straight from the Table too
+    np.testing.assert_allclose(b_pa.predict(table), b_pa.predict(X),
+                               rtol=1e-6, atol=1e-8)
+    # feature names come from the Table schema
+    assert b_pa.feature_name() == [f"f{j}" for j in range(6)]
+
+
+def test_arrow_weights_and_groups():
+    rng = np.random.RandomState(1)
+    X = rng.randn(900, 5)
+    y = np.clip((X[:, 0] + rng.randn(900) * 0.3) > 0, 0, 4).astype(float)
+    w = rng.rand(900) + 0.5
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    b_np = lgb.train(params, lgb.Dataset(X, label=y, weight=w),
+                     num_boost_round=5)
+    b_pa = lgb.train(params, lgb.Dataset(_chunked_table(X),
+                                         label=pa.array(y),
+                                         weight=pa.array(w)),
+                     num_boost_round=5)
+    np.testing.assert_allclose(b_np.predict(X), b_pa.predict(X),
+                               rtol=1e-6, atol=1e-8)
+
+    # lambdarank with an arrow group array
+    groups = np.full(30, 30, np.int64)
+    yr = rng.randint(0, 4, 900).astype(float)
+    pr = {"objective": "lambdarank", "num_leaves": 7, "verbose": -1,
+          "min_data_in_leaf": 5}
+    br_np = lgb.train(pr, lgb.Dataset(X, label=yr, group=groups),
+                      num_boost_round=4)
+    br_pa = lgb.train(pr, lgb.Dataset(_chunked_table(X), label=pa.array(yr),
+                                      group=pa.array(groups)),
+                      num_boost_round=4)
+    np.testing.assert_allclose(br_np.predict(X), br_pa.predict(X),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_arrow_init_score_and_nulls():
+    rng = np.random.RandomState(2)
+    X = rng.randn(800, 4)
+    y = (X[:, 0] > 0).astype(float)
+    init = rng.randn(800) * 0.1
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    b_np = lgb.train(params, lgb.Dataset(X, label=y, init_score=init),
+                     num_boost_round=5)
+    b_pa = lgb.train(params, lgb.Dataset(_chunked_table(X), label=pa.array(y),
+                                         init_score=pa.array(init)),
+                     num_boost_round=5)
+    np.testing.assert_allclose(
+        b_np.predict(X, raw_score=True), b_pa.predict(X, raw_score=True),
+        rtol=1e-6, atol=1e-8)
+
+    # nulls become NaN (missing) — parity with the NaN numpy matrix
+    Xn = X.copy()
+    Xn[::7, 1] = np.nan
+    mask = np.isnan(Xn[:, 1])
+    col = pa.array([None if m else float(v)
+                    for v, m in zip(Xn[:, 1], mask)], type=pa.float64())
+    table = pa.table({"f0": pa.array(Xn[:, 0]), "f1": col,
+                      "f2": pa.array(Xn[:, 2]), "f3": pa.array(Xn[:, 3])})
+    bn = lgb.train(params, lgb.Dataset(Xn, label=y), num_boost_round=5)
+    bp = lgb.train(params, lgb.Dataset(table, label=pa.array(y)),
+                   num_boost_round=5)
+    np.testing.assert_allclose(bn.predict(Xn), bp.predict(Xn),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_arrow_dictionary_categorical():
+    rng = np.random.RandomState(3)
+    n = 1000
+    cats = rng.randint(0, 6, n)
+    X = np.column_stack([rng.randn(n, 3), cats])
+    y = (X[:, 0] + (cats % 3 == 1) * 2.0 + 0.1 * rng.randn(n) > 0.5)
+    y = y.astype(float)
+    dict_col = pa.DictionaryArray.from_arrays(
+        pa.array(cats, type=pa.int32()),
+        pa.array([f"c{k}" for k in range(6)]))
+    table = pa.table({"a": pa.array(X[:, 0]), "b": pa.array(X[:, 1]),
+                      "c": pa.array(X[:, 2]), "cat": dict_col})
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5}
+    b = lgb.train(params, lgb.Dataset(table, label=pa.array(y)),
+                  num_boost_round=10)
+    ds = lgb.Dataset(table, label=pa.array(y)).construct()
+    assert ds.mappers[3].bin_type == "categorical"
+    assert roc_auc_score(y, b.predict(X)) > 0.9

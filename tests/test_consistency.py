@@ -27,6 +27,13 @@ _SKIP_KEYS = {"task", "data", "valid_data", "output_model", "num_machines",
               "tree_learner"}
 
 
+# LAMBDAGAP_CONSISTENCY_FULL=1 runs every example at its conf's full
+# num_trees (the reference confs ship 100) with the full-length metric
+# bars; the default caps at 50 to keep the quick suite quick. The full
+# mode runs in tools/run_full_suite.sh's slow group.
+FULL = os.environ.get("LAMBDAGAP_CONSISTENCY_FULL", "0") not in ("0", "")
+
+
 def _conf(d, name="train.conf", max_trees=50):
     params = {}
     for line in open(os.path.join(EX, d, name)):
@@ -37,9 +44,8 @@ def _conf(d, name="train.conf", max_trees=50):
                 continue
             params[k] = v
     params["verbose"] = -1
-    # keep every conf parameter but cap rounds: this suite anchors accuracy
-    # on real data, full 100-tree runs belong to the bench
-    if max_trees and int(params.get("num_trees", 100)) > max_trees:
+    # keep every conf parameter but (outside FULL mode) cap rounds
+    if not FULL and max_trees and int(params.get("num_trees", 100)) > max_trees:
         params["num_trees"] = max_trees
     return params
 
@@ -93,7 +99,7 @@ def test_binary_example():
     # the reference's own example reaches ~0.98 train / high-0.7s test AUC
     from sklearn.metrics import roc_auc_score
     test_auc = roc_auc_score(yt, bst.predict(Xt))
-    assert test_auc > 0.75, test_auc
+    assert test_auc > (0.77 if FULL else 0.75), test_auc
     # file-loaded prediction path agrees with the array path
     pred_arr = bst.predict(Xt)
     pred_file = bst.predict(os.path.join(EX, d, "binary.test"))
@@ -173,8 +179,9 @@ def test_multiclass_example():
     ml = res["valid_0"]["multi_logloss"]
     assert ml[-1] < ml[0]
     acc = np.mean(np.argmax(bst.predict(Xt), axis=1) == yt)
-    # 5 classes, chance = 0.2; the example's 50-tree accuracy is ~0.43
-    assert acc > 0.38, acc
+    # 5 classes, chance = 0.2; the example reaches ~0.43 at 50 trees and
+    # ~0.46 at the conf's full 100
+    assert acc > (0.42 if FULL else 0.38), acc
 
 
 @pytest.mark.parametrize("d,obj", [("lambdarank", "lambdarank"),

@@ -1,51 +1,96 @@
 """Seeded differential sweep: the host-driven serial learner and the fused
 whole-tree program must agree across random config combinations (the
 cross-backend analog of the reference's CPU-vs-GPU test_dual.py, run here
-as host-loop vs fused on one backend so float noise stays bounded)."""
+as host-loop vs fused on one backend so float noise stays bounded), and the
+fused data-parallel program must agree with itself across mesh sizes
+(1 device vs 8) — the sweep that catches a fused-path regression in any
+major feature (bagging, GOSS, DART, EFB, monotone, forced splits,
+linear trees, quantized gradients)."""
+import json
+import os
+
 import numpy as np
 import pytest
 
 import lambdagap_tpu as lgb
 
 
-def _random_case(rng):
+def _random_case(rng, tmp_path=None, for_dp=False):
     n = int(rng.randint(600, 1500))
     d = int(rng.randint(4, 10))
     X = rng.randn(n, d)
     cat_col = None
-    if rng.rand() < 0.5:                       # a categorical column
+    if rng.rand() < 0.4:                       # a categorical column
         cat_col = int(rng.randint(d))
         X[:, cat_col] = rng.randint(0, int(rng.randint(3, 20)), n)
-    if rng.rand() < 0.5:                       # missing values
-        X[rng.rand(n) < 0.1, int(rng.randint(d))] = np.nan
-    if rng.rand() < 0.3:                       # exact zeros (Zero missing)
-        X[rng.rand(n) < 0.3, int(rng.randint(d))] = 0.0
-    w = np.abs(rng.randn(n)) + 0.1 if rng.rand() < 0.4 else None
+    # labels derive from the PRE-corruption features (NaN labels are
+    # invalid input, not a differential case)
     obj = rng.choice(["binary", "regression"])
     if obj == "binary":
         y = (X[:, 0] + 0.5 * rng.randn(n) > 0).astype(float)
     else:
         y = X[:, 0] * 2 + rng.randn(n)
+    if rng.rand() < 0.5:                       # missing values
+        X[rng.rand(n) < 0.1, int(rng.randint(d))] = np.nan
+    if rng.rand() < 0.3:                       # exact zeros (Zero missing)
+        X[rng.rand(n) < 0.3, int(rng.randint(d))] = 0.0
+    if rng.rand() < 0.3:                       # near-exclusive one-hot block
+        k = min(d - 1, 3)
+        hot = rng.randint(0, k, n)
+        for j in range(k):
+            X[:, d - 1 - j] = (hot == j) * np.abs(rng.randn(n))
+    w = np.abs(rng.randn(n)) + 0.1 if rng.rand() < 0.4 else None
     params = {
         "objective": obj,
         "num_leaves": int(rng.choice([4, 15, 31])),
-        "min_data_in_leaf": int(rng.choice([1, 5, 20])),
+        # 1-row leaves make f32 gain ties ubiquitous and flip near-tie
+        # splits between any two summation orders; 3 is still adversarial
+        "min_data_in_leaf": int(rng.choice([3, 5, 20])),
         "max_bin": int(rng.choice([15, 63, 255])),
         "learning_rate": float(rng.choice([0.05, 0.1, 0.3])),
         "lambda_l1": float(rng.choice([0.0, 0.0, 1.0])),
         "lambda_l2": float(rng.choice([0.0, 1.0])),
         "min_gain_to_split": float(rng.choice([0.0, 0.0, 0.1])),
+        "enable_bundle": bool(rng.rand() < 0.7),
         "verbose": -1,
     }
+    # feature-level draws ------------------------------------------------
+    r = rng.rand()
+    if r < 0.25:
+        params.update(bagging_fraction=float(rng.choice([0.5, 0.8])),
+                      bagging_freq=1)
+    elif r < 0.45:
+        params.update(data_sample_strategy="goss",
+                      top_rate=0.3, other_rate=0.2)
+    if rng.rand() < 0.2:
+        params.update(boosting="dart", drop_rate=0.3)
+    if cat_col is None and rng.rand() < 0.3:
+        mono = [0] * d
+        mono[0] = 1
+        params.update(monotone_constraints=mono,
+                      monotone_constraints_method=str(
+                          rng.choice(["basic", "intermediate", "advanced"])))
+    if cat_col is None and not for_dp and rng.rand() < 0.15:
+        # linear trees route both sides to the host learner — the draw
+        # still covers determinism of that path
+        params.update(linear_tree=True)
+    if tmp_path is not None and rng.rand() < 0.2 and cat_col != 0:
+        forced = {"feature": 0, "threshold": float(np.nanmedian(X[:, 0]))}
+        fp = os.path.join(str(tmp_path), "forced.json")
+        with open(fp, "w") as f:
+            json.dump(forced, f)
+        params["forcedsplits_filename"] = fp
+    if for_dp and rng.rand() < 0.25:
+        params.update(use_quantized_grad=True, stochastic_rounding=False)
     if cat_col is not None:
         params["categorical_feature"] = [cat_col]
     return X, y, w, params
 
 
-@pytest.mark.parametrize("seed", range(8))
-def test_host_vs_fused_random_config(seed):
+@pytest.mark.parametrize("seed", range(20))
+def test_host_vs_fused_random_config(seed, tmp_path):
     rng = np.random.RandomState(1000 + seed)
-    X, y, w, params = _random_case(rng)
+    X, y, w, params = _random_case(rng, tmp_path)
     rounds = 5
     b_host = lgb.train({**params, "tpu_fused_learner": "0"},
                        lgb.Dataset(X, label=y, weight=w),
@@ -61,4 +106,28 @@ def test_host_vs_fused_random_config(seed):
     close = np.isclose(p_host, p_fused, rtol=5e-3, atol=5e-3)
     assert close.mean() > 0.99, (params, float(close.mean()))
     np.testing.assert_allclose(np.mean(p_host), np.mean(p_fused),
+                               rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_dp_1dev_vs_8dev_random_config(seed, tmp_path):
+    """The fused data-parallel shard_map program must produce the same
+    model on a 1-device and an 8-device mesh (per-split psum + replicated
+    argmax — any missing collective shows up as divergence here)."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    rng = np.random.RandomState(7000 + seed)
+    X, y, w, params = _random_case(rng, tmp_path, for_dp=True)
+    params.update(tree_learner="data", tpu_fused_learner="1")
+    rounds = 4
+    b1 = lgb.train({**params, "tpu_num_devices": 1},
+                   lgb.Dataset(X, label=y, weight=w), num_boost_round=rounds)
+    b8 = lgb.train({**params, "tpu_num_devices": 8},
+                   lgb.Dataset(X, label=y, weight=w), num_boost_round=rounds)
+    p1 = b1.predict(X)
+    p8 = b8.predict(X)
+    close = np.isclose(p1, p8, rtol=5e-3, atol=5e-3)
+    assert close.mean() > 0.99, (params, float(close.mean()))
+    np.testing.assert_allclose(np.mean(p1), np.mean(p8),
                                rtol=1e-3, atol=1e-3)

@@ -201,3 +201,41 @@ def test_debug_shard_agreement_check(monkeypatch):
         num_leaves = divergent
     with pytest.raises(Exception, match="divergence"):
         lrn._check_shard_agreement(FakeRec())
+
+
+def test_fused_voting_parallel():
+    """tree_learner=voting defaults to the FUSED whole-tree program (one
+    compiled dispatch per tree; per-split traffic = top-k vote all_gather +
+    psum of only the voted columns, reference:
+    voting_parallel_tree_learner.cpp:151-184). Must match the host-loop
+    voting learner (same algorithm, fused execution) and train well."""
+    from lambdagap_tpu.parallel.fused_parallel import \
+        FusedVotingParallelTreeLearner
+    X, y = _data(seed=11)
+    params = {"top_k": 4, "min_data_in_leaf": 5}
+    b_f = _train(X, y, "voting", min(NEED, len(jax.devices())), extra=params)
+    assert isinstance(b_f._booster.learner, FusedVotingParallelTreeLearner)
+    b_h = _train(X, y, "voting", min(NEED, len(jax.devices())),
+                 extra={**params, "tpu_fused_learner": "0"})
+    p_f, p_h = b_f.predict(X), b_h.predict(X)
+    assert roc_auc_score(y, p_f) > 0.95
+    close = np.isclose(p_f, p_h, rtol=5e-3, atol=5e-3)
+    assert close.mean() > 0.99, float(close.mean())
+
+
+def test_fused_voting_interaction_constraints():
+    """Interaction constraints ride the fused voting program's in-program
+    path bitmasks (same machinery as fused data-parallel)."""
+    X, y = _data(seed=12)
+    b = _train(X, y, "voting", min(NEED, len(jax.devices())),
+               extra={"top_k": 4,
+                      "interaction_constraints": "[0,1,2,3],[4,5,6,7]"})
+    dump = b.dump_model()
+    for ti in dump["tree_info"]:
+        feats = set()
+        def walk(node):
+            if "split_feature" in node:
+                feats.add(node["split_feature"])
+                walk(node["left_child"]); walk(node["right_child"])
+        walk(ti["tree_structure"])
+        assert (feats <= {0, 1, 2, 3}) or (feats <= {4, 5, 6, 7}), feats

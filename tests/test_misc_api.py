@@ -194,3 +194,25 @@ def test_booster_api_parity():
     # shuffled tree order leaves gbdt predictions unchanged (order-free sum)
     b3.shuffle_models()
     np.testing.assert_allclose(b3.predict(X[:20]), p0, rtol=1e-6)
+
+
+def test_parameter_docs_in_sync():
+    """docs/Parameters.md is generated from the Config dataclass (the
+    config_auto pattern, reference: src/io/config_auto.cpp:6); the
+    checked-in artifact must match a fresh generation."""
+    import subprocess, sys, os
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "gen_params_doc.py"),
+         "--check"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+
+
+def test_parameter_docs_cover_all_fields():
+    import dataclasses, re, os
+    from lambdagap_tpu.config import Config
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = open(os.path.join(root, "docs", "Parameters.md")).read()
+    documented = set(re.findall(r"^\| `(\w+)`", doc, re.M))
+    missing = {f.name for f in dataclasses.fields(Config)} - documented
+    assert not missing, missing
