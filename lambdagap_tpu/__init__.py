@@ -12,6 +12,7 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
 from .config import Config
 from .data import BinnedDataset, Metadata
 from .engine import CVBooster, cv, train
+from .parallel.cluster import train_cluster
 from .models import GBDT, Tree
 from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 from .utils.log import register_logger
@@ -19,6 +20,7 @@ from .utils.log import register_logger
 __version__ = "0.1.0"
 
 __all__ = ["Booster", "Dataset", "Sequence", "Config", "BinnedDataset",
+           "train_cluster",
            "Metadata", "GBDT", "Tree", "train", "cv", "CVBooster",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "early_stopping", "EarlyStopException", "log_evaluation",

@@ -376,6 +376,8 @@ class GBDT:
                     md_g = Metadata()
                     md_g.label = ts.global_label
                     md_g.weight = ts.global_weight
+                    if getattr(ts, "global_group", None) is not None:
+                        md_g.set_group(ts.global_group)
                     init_obj = create_objective(cfg)
                     init_obj.init(md_g, len(ts.global_label))
                 for k in range(self.num_tree_per_iteration):

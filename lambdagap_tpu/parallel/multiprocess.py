@@ -178,6 +178,24 @@ def load_pre_partitioned(path: str, config: Config):
                   "but not others")
     ds.global_weight = (_gather_ragged(weight, np.float32)
                         if weight is not None else None)
+    has_g = np.asarray(multihost_utils.process_allgather(
+        np.asarray([0 if qgroups is None else 1], np.int64))).reshape(-1)
+    if has_g.any() and not has_g.all():
+        log.fatal("pre_partition: query/group information present on some "
+                  "ranks but not others")
+    ds.global_group = None
+    if has_g.all():
+        # ragged per-rank group-size vectors -> one global sizes vector
+        # (ranking objectives need GLOBAL query stats for init, like the
+        # global label/weight above)
+        sizes = np.asarray(qgroups, np.int64)
+        ngs = np.asarray(multihost_utils.process_allgather(
+            np.asarray([len(sizes)], np.int64))).reshape(-1)
+        pad = np.zeros(int(ngs.max()), np.int64)
+        pad[:len(sizes)] = sizes
+        g = np.asarray(multihost_utils.process_allgather(pad))
+        ds.global_group = np.concatenate(
+            [g[r, :ngs[r]] for r in range(nproc)])
     log.info("pre_partition: process %d/%d holds %d of %d rows",
              jax.process_index(), nproc, n_local, ds.global_num_data)
     return ds
