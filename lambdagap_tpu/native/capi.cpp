@@ -19,6 +19,7 @@
 #include <cstdint>
 #include <cstring>
 #include <fstream>
+#include <limits>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -101,6 +102,11 @@ struct CModel {
   std::string objective = "regression";
   double sigmoid = 1.0;
   bool sqrt_transform = false;   // "regression sqrt" (reg_sqrt=true)
+  // Verbatim loaded text, retained to support SaveModel. Deliberate
+  // tradeoff: ~1x the text size of extra resident memory per booster
+  // (typically a few MB); consumers that never SaveModel and hold very
+  // large ensembles can keep their own copy instead.
+  std::string model_text;
   std::vector<CTree> trees;
 
   // Predict trees [start_tree, end_tree) for one row.
@@ -238,6 +244,7 @@ CModel* parse_model(const std::string& text) {
   }
   if (!flush_tree()) return nullptr;
   if (m->num_class < 1) return nullptr;
+  m->model_text = text;
   return m.release();
 }
 
@@ -385,6 +392,128 @@ int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
   return predict_mat_impl(handle, data, data_type, nrow, ncol, is_row_major,
                           predict_type, start_iteration, num_iteration,
                           out_len, out_result);
+}
+
+int LGBM_BoosterGetNumModelPerIteration(BoosterHandle handle, int* out) {
+  *out = static_cast<CModel*>(handle)->num_class;
+  return 0;
+}
+
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int /*importance_type*/,
+                          const char* filename) {
+  const CModel* m = static_cast<const CModel*>(handle);
+  const int total_iters = static_cast<int>(m->trees.size() / m->num_class);
+  if (start_iteration > 0 ||
+      (num_iteration > 0 && num_iteration < total_iters)) {
+    // loud failure beats silently writing a different model than asked
+    g_last_error =
+        "this serving-side reader saves the loaded model verbatim; "
+        "iteration-range truncation is not supported (re-save from Python)";
+    return -1;
+  }
+  std::ofstream f(filename);
+  if (!f) {
+    g_last_error = std::string("cannot write ") + filename;
+    return -1;
+  }
+  f << m->model_text;   // the loaded text, verbatim (serving-side reader)
+  f.flush();
+  if (!f.good()) {
+    g_last_error = std::string("write failed for ") + filename;
+    return -1;
+  }
+  return 0;
+}
+
+// Signature-compatible with reference c_api.h LGBM_BoosterPredictForFile.
+// Parses CSV/TSV (auto-delimiter). Label handling: `parameter` may carry
+// "has_label=true" or "has_label=false" to state whether column 0 is a
+// label; without it, a file with EXACTLY one more column than the model's
+// feature count is treated as the training-file layout (label first) —
+// pass has_label=false to override the heuristic.
+int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                               const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int start_iteration, int num_iteration,
+                               const char* parameter,
+                               const char* result_filename) {
+  const CModel* m = static_cast<const CModel*>(handle);
+  std::ifstream in(data_filename);
+  if (!in) {
+    g_last_error = std::string("cannot open ") + data_filename;
+    return -1;
+  }
+  std::ofstream outf(result_filename);
+  if (!outf) {
+    g_last_error = std::string("cannot write ") + result_filename;
+    return -1;
+  }
+  int label_override = -1;             // -1 auto, 0 no label, 1 label
+  if (parameter != nullptr) {
+    const std::string ps(parameter);
+    if (ps.find("has_label=true") != std::string::npos) label_override = 1;
+    if (ps.find("has_label=false") != std::string::npos) label_override = 0;
+  }
+  outf.precision(17);
+  std::string line;
+  if (data_has_header) std::getline(in, line);
+  std::vector<double> row;
+  std::vector<double> out;
+  bool first_data_line = true;
+  int skip_label = 0;
+  while (std::getline(in, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    if (line.empty() || line[0] == '#') continue;
+    const char delim = line.find('\t') != std::string::npos ? '\t' : ',';
+    row.clear();
+    size_t start = 0;
+    while (start <= line.size()) {
+      size_t end = line.find(delim, start);
+      if (end == std::string::npos) end = line.size();
+      try {
+        row.push_back(std::stod(line.substr(start, end - start)));
+      } catch (const std::exception&) {
+        row.push_back(std::numeric_limits<double>::quiet_NaN());
+      }
+      start = end + 1;
+      if (end == line.size()) break;
+    }
+    // a trailing delimiter yields a trailing NaN field, not a column
+    if (!line.empty() && line.back() == delim) row.pop_back();
+    if (first_data_line) {
+      first_data_line = false;
+      if (label_override >= 0) {
+        skip_label = label_override;
+      } else {
+        skip_label =
+            (static_cast<int>(row.size()) == m->max_feature_idx + 2) ? 1 : 0;
+      }
+    }
+    if (static_cast<int>(row.size()) - skip_label <= m->max_feature_idx) {
+      g_last_error = "row has fewer features than the model";
+      return -1;
+    }
+    int64_t out_len = 0;
+    out.assign(predict_type == 2 ? m->trees.size()
+                                 : (size_t)m->num_class, 0.0);
+    int rc = predict_mat_impl(handle, row.data() + skip_label, 1, 1,
+                              static_cast<int32_t>(row.size() - skip_label),
+                              1, predict_type, start_iteration,
+                              num_iteration, &out_len, out.data());
+    if (rc != 0) return rc;
+    for (int64_t k = 0; k < out_len; ++k) {
+      if (k) outf << '\t';
+      outf << out[(size_t)k];
+    }
+    outf << '\n';
+  }
+  outf.flush();
+  if (!outf.good()) {
+    g_last_error = std::string("write failed for ") + result_filename;
+    return -1;
+  }
+  return 0;
 }
 
 }  // extern "C"

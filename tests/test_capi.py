@@ -212,3 +212,57 @@ def test_malformed_model_fails_loudly():
         b"tree\nTree=0\nnum_leaves=5\n", ctypes.byref(it), ctypes.byref(h))
     assert rc != 0
     assert lib.LGBM_GetLastError()
+
+
+def test_predict_for_file_and_save_model(tmp_path):
+    X, y = make_regression(900, 5, noise=0.1, random_state=6)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=6)
+    lib = _capi()
+    lib.LGBM_BoosterPredictForFile.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p]
+    lib.LGBM_BoosterSaveModel.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_char_p]
+    h, _ = _load(lib, bst.model_to_string())
+    # training-file layout: label in column 0 (auto-detected + skipped)
+    data = str(tmp_path / "data.tsv")
+    np.savetxt(data, np.column_stack([y[:100], X[:100]]), delimiter="\t")
+    result = str(tmp_path / "preds.txt")
+    rc = lib.LGBM_BoosterPredictForFile(h, data.encode(), 0, NORMAL, 0, -1,
+                                        b"", result.encode())
+    assert rc == 0, lib.LGBM_GetLastError()
+    got = np.loadtxt(result)
+    np.testing.assert_allclose(got, bst.predict(X[:100]), rtol=1e-6,
+                               atol=1e-8)
+    # feature-only layout (no label column)
+    data2 = str(tmp_path / "feat.csv")
+    np.savetxt(data2, X[:50], delimiter=",")
+    rc = lib.LGBM_BoosterPredictForFile(h, data2.encode(), 0, NORMAL, 0, -1,
+                                        b"", result.encode())
+    assert rc == 0, lib.LGBM_GetLastError()
+    np.testing.assert_allclose(np.loadtxt(result), bst.predict(X[:50]),
+                               rtol=1e-6, atol=1e-8)
+    # explicit has_label=false defeats the label auto-detect heuristic on
+    # a feature file that happens to carry one extra (ignored) column
+    data3 = str(tmp_path / "feat6.csv")
+    np.savetxt(data3, np.column_stack([X[:50], np.zeros(50)]), delimiter=",")
+    rc = lib.LGBM_BoosterPredictForFile(h, data3.encode(), 0, NORMAL, 0, -1,
+                                        b"has_label=false", result.encode())
+    assert rc == 0, lib.LGBM_GetLastError()
+    np.testing.assert_allclose(np.loadtxt(result), bst.predict(X[:50]),
+                               rtol=1e-6, atol=1e-8)
+    # truncated SaveModel must fail loudly, not write a different model
+    rc = lib.LGBM_BoosterSaveModel(h, 0, 3, 0,
+                                   str(tmp_path / "t.txt").encode())
+    assert rc != 0
+    # SaveModel round-trips the loaded text
+    saved = str(tmp_path / "saved.txt")
+    rc = lib.LGBM_BoosterSaveModel(h, 0, -1, 0, saved.encode())
+    assert rc == 0
+    h2, it2 = _load(lib, open(saved).read())
+    assert it2 == 6
+    lib.LGBM_BoosterFree(h)
+    lib.LGBM_BoosterFree(h2)
