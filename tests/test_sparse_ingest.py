@@ -135,3 +135,18 @@ def test_two_round_rank_with_groups(tmp_path):
                   lgb.Dataset(path, params={"two_round": True}),
                   num_boost_round=4)
     assert len(b._booster.models) == 4
+
+
+def test_csc_and_coo_inputs():
+    """CSC/COO inputs ride the same CSR adapter (reference: the CSC path
+    of LGBM_DatasetCreateFromCSC, src/c_api.cpp)."""
+    rng = np.random.RandomState(5)
+    dense = np.where(rng.rand(1500, 8) < 0.2, rng.randn(1500, 8), 0.0)
+    y = (dense[:, 0] + dense[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+    ref = lgb.train(params, lgb.Dataset(dense, label=y),
+                    num_boost_round=5).predict(dense)
+    for maker in (sp.csc_matrix, sp.coo_matrix):
+        b = lgb.train(params, lgb.Dataset(maker(dense), label=y),
+                      num_boost_round=5)
+        np.testing.assert_allclose(b.predict(dense), ref, rtol=1e-6)
