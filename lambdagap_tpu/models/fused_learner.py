@@ -388,7 +388,12 @@ class FusedTreeLearner(SerialTreeLearner):
                 ghb = lax.bitcast_convert_type(gh2, jnp.uint8)    # [N,2,4]
             ghb = ghb.reshape(ghb.shape[0], -1)
             gh_cols = ghb.shape[1]
-            packed_rows = jnp.concatenate([x_rows, ghb], axis=1)
+            parts = [x_rows, ghb]
+            if has_mask:
+                # the bagging/GOSS mask rides the same gather as one more
+                # packed column
+                parts.append(row_mask.astype(x_rows.dtype)[:, None])
+            packed_rows = jnp.concatenate(parts, axis=1)
 
         def perm_slice(perm, start):
             """Contiguous W-row window of the (N+W padded) permutation —
@@ -399,10 +404,12 @@ class FusedTreeLearner(SerialTreeLearner):
             """Histogram of rows perm[begin+cW : begin+(c+1)W]."""
             rows = perm_slice(perm, begin + c * W)
             valid = (c * W + lane) < count
-            if has_mask:
+            if has_mask and quant:
                 valid = valid & row_mask[rows]
-            prow = packed_rows[rows]                    # [W, C(+gh)]
+            prow = packed_rows[rows]                    # [W, C(+gh+mask)]
             bins = prow[:, :C]
+            if has_mask and not quant:
+                valid = valid & (prow[:, C + gh_cols] > 0)
             if quant:
                 qscale = jnp.stack([gs, hs, jnp.float32(1.0)])
                 if self.hist_impl == "pallas":
@@ -423,7 +430,7 @@ class FusedTreeLearner(SerialTreeLearner):
                                    self.hist_precision)
                 return acc + part.reshape(HIST_C, C, Bb).transpose(1, 2, 0)
             ghr = lax.bitcast_convert_type(
-                prow[:, C:].reshape(W, 2, gh_cols // 2),
+                prow[:, C:C + gh_cols].reshape(W, 2, gh_cols // 2),
                 jnp.float32)                            # [W, 2]
             if self.hist_impl == "pallas":
                 from ..ops.hist_pallas import hist_pallas, pack_gh8
