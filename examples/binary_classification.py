@@ -1,0 +1,29 @@
+"""Binary classification end to end: train with a validation set and early
+stopping, save/reload the model, predict (reference:
+examples/binary_classification + examples/python-guide/simple_example.py)."""
+import numpy as np
+
+import lambdagap_tpu as lgb
+
+rng = np.random.RandomState(0)
+X = rng.randn(20_000, 20)
+y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.3 * rng.randn(20_000) > 0)
+y = y.astype(np.float64)
+X_train, X_val = X[:16_000], X[16_000:]
+y_train, y_val = y[:16_000], y[16_000:]
+
+train = lgb.Dataset(X_train, label=y_train)
+valid = lgb.Dataset(X_val, label=y_val, reference=train)
+
+booster = lgb.train(
+    {"objective": "binary", "metric": ["auc", "binary_logloss"],
+     "num_leaves": 63, "learning_rate": 0.1, "verbose": 1},
+    train, num_boost_round=200, valid_sets=[valid],
+    callbacks=[lgb.early_stopping(20), lgb.log_evaluation(25)])
+
+print("best iteration:", booster.best_iteration)
+booster.save_model("model.txt")
+reloaded = lgb.Booster(model_file="model.txt")
+pred = reloaded.predict(X_val)
+print("val AUC pieces: mean pred on pos/neg =",
+      float(pred[y_val > 0.5].mean()), float(pred[y_val < 0.5].mean()))

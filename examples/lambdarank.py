@@ -1,0 +1,29 @@
+"""Learning-to-rank with the fork's extended LambdaGap objective family:
+all 18 lambdarank_target gradients are selectable (reference:
+the LambdaGap fork's config.h:989-1013; examples/lambdarank)."""
+import numpy as np
+
+import lambdagap_tpu as lgb
+
+rng = np.random.RandomState(1)
+n_q, per = 400, 50
+N = n_q * per
+X = rng.randn(N, 30).astype(np.float32)
+w = rng.randn(30) * (rng.rand(30) < 0.3)
+rel = np.clip(np.floor(X @ w * 0.5 + rng.randn(N) * 0.5 + 1.0), 0, 4)
+groups = np.full(n_q, per)
+
+train = lgb.Dataset(X[: N // 2], label=rel[: N // 2],
+                    group=groups[: n_q // 2])
+valid = lgb.Dataset(X[N // 2:], label=rel[N // 2:],
+                    group=groups[n_q // 2:], reference=train)
+
+for target in ("ndcg", "lambdaloss-ndcg-plus-plus", "lambdagap-s-plus"):
+    res = {}
+    lgb.train({"objective": "lambdarank", "lambdarank_target": target,
+               "metric": "ndcg", "eval_at": [10], "num_leaves": 31,
+               "verbose": -1},
+              train, num_boost_round=40, valid_sets=[valid],
+              callbacks=[lgb.record_evaluation(res)])
+    key = next(k for k in res["valid_0"] if "ndcg" in k)
+    print(f"{target:28s} valid {key} = {res['valid_0'][key][-1]:.5f}")
