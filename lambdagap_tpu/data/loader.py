@@ -294,9 +294,15 @@ def _load_two_round(path: str, config: Config,
             f.seek(off[idx_lo])
             end = off[idx_hi] if idx_hi < n else None
             blob = f.read(None if end is None else end - off[idx_lo])
-        lines = [ln for ln in blob.decode().splitlines()
+        # Split on '\n' only: pass 1 iterated the binary file, which splits
+        # on b'\n' — str.splitlines() would additionally split on \f/\v/\x85
+        # etc. and silently misalign rows against the byte offsets.
+        lines = [ln for ln in blob.decode().split("\n")
                  if ln.strip() and not ln.lstrip().startswith("#")]
-        assert len(lines) == cnt, (len(lines), cnt)
+        if len(lines) != cnt:
+            log.fatal("two_round chunk parse mismatch in %s: pass 1 indexed "
+                      "%d rows in [%d, %d) but pass 2 decoded %d",
+                      path, cnt, idx_lo, idx_hi, len(lines))
         if fmt == "libsvm":
             X = np.zeros((cnt, max(n_cols, 1)), np.float64)
             y = np.empty(cnt, np.float64)

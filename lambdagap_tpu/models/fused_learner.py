@@ -469,9 +469,11 @@ class FusedTreeLearner(SerialTreeLearner):
                 # into best_of as a top-k vote + psum of only the voted
                 # columns (reference: voting_parallel_tree_learner.cpp).
                 hist = lax.psum(hist, self.axis)
-            if qexact:
+            if qexact and not self.voting:
                 hist = hist.astype(jnp.float32) * jnp.stack(
                     [gs, hs, jnp.float32(1.0)])
+            # voting + quant_exact: keep RAW level sums — the exact integer
+            # reduction happens per voted column inside best_of, scales after
             return hist
 
         extra_on = self.extra_on
@@ -536,20 +538,37 @@ class FusedTreeLearner(SerialTreeLearner):
             cons = (mono_arr, lo, hi) if mono_on else None
             rand_t = None
             if extra_on:
+                # rkey is replicated, so every shard draws the same
+                # thresholds: votes are scored by the same randomized gain
+                # the final voted scan uses
                 rand_t = jax.random.randint(rkey, (F,), 0, 1 << 30) % nb_m1
             if voting:
-                lt = jnp.sum(hist[0], axis=0)     # local parent sums
+                ltr = jnp.sum(hist[0], axis=0)    # local parent sums (RAW
+                # level sums in quant_exact mode — same units as hist)
                 if bundled:
                     from ..ops.histogram import unbundle_hist
                     hist = unbundle_hist(hist, self.ub_src, self.ub_kind,
-                                         lt[0], lt[1], lt[2])
+                                         ltr[0], ltr[1], ltr[2])
+                if quant and qexact:
+                    qsc = jnp.stack([gs, hs, jnp.float32(1.0)])
+                    hist_s = hist.astype(jnp.float32) * qsc
+                    lt = ltr.astype(jnp.float32) * qsc
+                else:
+                    hist_s, lt = hist, ltr
                 lgain, *_ = per_feature_best(
-                    hist, lt[0], lt[1], lt[2], jnp.float32(0.0), num_bins,
-                    default_bins, missing_types, is_cat_arr, fm, p, has_cat)
+                    hist_s, lt[0], lt[1], lt[2], jnp.float32(0.0), num_bins,
+                    default_bins, missing_types, is_cat_arr, fm, p, has_cat,
+                    rand_thresholds=rand_t)
                 _, local_top = lax.top_k(lgain, vote_k)
                 votes = lax.all_gather(local_top.astype(jnp.int32),
                                        self.axis, tiled=True)     # [D*k]
+                # in quant_exact mode this psum reduces raw integer level
+                # sums (exact, order-independent — the voted-column analog
+                # of the full-histogram integer reduction in leaf_hist);
+                # scales apply after
                 hist_v = lax.psum(hist[votes], self.axis)
+                if quant and qexact:
+                    hist_v = hist_v.astype(jnp.float32) * qsc
                 cons_v = (mono_arr[votes], lo, hi) if mono_on else None
                 gain_v, thr_v, dl_v, lg_v, lh_v, lc_v, bits_v = \
                     per_feature_best(
@@ -629,6 +648,11 @@ class FusedTreeLearner(SerialTreeLearner):
         if voting:
             # local root hist: global parent sums need their own (tiny) psum
             totals = lax.psum(totals, self.axis)
+            if quant and qexact:
+                # raw level sums -> gradient units (voting defers scaling
+                # until after its collectives; see leaf_hist)
+                totals = totals.astype(jnp.float32) * jnp.stack(
+                    [gs, hs, jnp.float32(1.0)])
         root_out = calculate_leaf_output(totals[0], totals[1], p, totals[2],
                                          0.0)
         neg_inf = jnp.float32(-jnp.inf)

@@ -41,6 +41,15 @@ def _fused_mode_enabled(mode) -> bool:
     return mode == "auto" or mode in ("1", "true", "on", "yes", True)
 
 
+def _cegb_requested(cfg) -> bool:
+    """Any CEGB penalty configured — the learner-routing predicate
+    (reference: src/treelearner/cost_effective_gradient_boosting.hpp)."""
+    return cfg.cegb_tradeoff > 0 and (
+        cfg.cegb_penalty_split > 0
+        or cfg.cegb_penalty_feature_coupled
+        or cfg.cegb_penalty_feature_lazy)
+
+
 @functools.partial(jax.jit, static_argnames=("num_leaves",))
 def _add_tree_score(score, perm, leaf_begin, leaf_count, leaf_values,
                     num_leaves: int):
@@ -172,10 +181,7 @@ class GBDT:
                             "'basic'", cfg.monotone_constraints_method)
                 cfg.monotone_constraints_method = "basic"
             not_applied = []
-            if cfg.cegb_tradeoff > 0 and (
-                    cfg.cegb_penalty_split > 0
-                    or cfg.cegb_penalty_feature_coupled
-                    or cfg.cegb_penalty_feature_lazy):
+            if _cegb_requested(cfg):
                 not_applied.append("cegb")
             if not_applied:
                 log.warning("%s are not applied by pre-partitioned training",
@@ -200,10 +206,7 @@ class GBDT:
                                  + cfg.monotone_constraints_method)
             if cfg.linear_tree:
                 host_only.append("linear_tree")
-            if cfg.cegb_tradeoff > 0 and (
-                    cfg.cegb_penalty_split > 0
-                    or cfg.cegb_penalty_feature_coupled
-                    or cfg.cegb_penalty_feature_lazy):
+            if _cegb_requested(cfg):
                 host_only.append("cegb")
             if use_fused and host_only:
                 log.info("Using the host-driven serial learner for: %s",
@@ -239,10 +242,7 @@ class GBDT:
             # apply are warned, not silently swallowed.
             cfg = self.config
             not_applied = []
-            if cfg.cegb_tradeoff > 0 and (
-                    cfg.cegb_penalty_split > 0
-                    or cfg.cegb_penalty_feature_coupled
-                    or cfg.cegb_penalty_feature_lazy):
+            if _cegb_requested(cfg):
                 not_applied.append("cegb")
             if _fused_mode_enabled(cfg.tpu_fused_learner):
                 if not_applied:
@@ -263,13 +263,23 @@ class GBDT:
             # voted-column psum; combinations it cannot express fall back
             # to the host-loop voting learner below
             cfg = self.config
+            if cfg.forcedsplits_filename:
+                # forced gathers need a GLOBAL histogram of the forced leaf,
+                # which voting never materializes — the full-histogram-psum
+                # learner honors the schedule at the cost of voting's
+                # bandwidth cap
+                log.warning("forcedsplits_filename with tree_learner=voting: "
+                            "training with the fused data-parallel learner "
+                            "(full-histogram psum per split) so forced "
+                            "splits apply")
+                if _cegb_requested(cfg):
+                    log.warning("cegb is not applied by the fused "
+                                "data-parallel learner")
+                from ..parallel.fused_parallel import \
+                    FusedDataParallelTreeLearner
+                return FusedDataParallelTreeLearner(ds, self.config)
             host_only = []
-            if self.config.forcedsplits_filename:
-                host_only.append("forcedsplits_filename")
-            if cfg.cegb_tradeoff > 0 and (
-                    cfg.cegb_penalty_split > 0
-                    or cfg.cegb_penalty_feature_coupled
-                    or cfg.cegb_penalty_feature_lazy):
+            if _cegb_requested(cfg):
                 host_only.append("cegb")
             if host_only:
                 if cfg.interaction_constraints:

@@ -240,6 +240,15 @@ class SerialTreeLearner:
             m[keep] = True
         return jnp.asarray(m)
 
+    def _draw_extra_thresholds(self) -> jax.Array:
+        """One uniform-random threshold bin per feature from the host-side
+        extra_trees stream (reference: feature_histogram.hpp:192-205
+        USE_RAND) — shared by every host-loop scan (serial, data-parallel,
+        voting) so the draw semantics cannot diverge between learners."""
+        return jnp.asarray(
+            (self._extra_rng.randint(0, 1 << 30, self.num_features)
+             % self._nb_minus1).astype(np.int32))
+
     def _best(self, hist, pg, ph, pc, parent_output, fmask,
               bounds=None, path_feats=frozenset(), depth=0,
               adv=None) -> _HostSplit:
@@ -257,9 +266,7 @@ class SerialTreeLearner:
                    + self._cegb_coupled * jnp.asarray(~self._cegb_used))
         rand_t = None
         if self.extra_on:
-            rand_t = jnp.asarray(
-                (self._extra_rng.randint(0, 1 << 30, self.num_features)
-                 % self._nb_minus1).astype(np.int32))
+            rand_t = self._draw_extra_thresholds()
         contri = self.contri_arr
         if self.mono_on and self.mono_penalty > 0:
             # depth-dependent gain penalty on monotone features (reference:
@@ -327,22 +334,29 @@ class SerialTreeLearner:
                 sgn = int(self.mono_np[g])
                 uppers = above if sgn > 0 else below
                 lowers = below if sgn > 0 else above
+                pinf = np.float32(np.inf)
                 for sel, is_upper in ((uppers, True), (lowers, False)):
-                    if not sel.any():
-                        continue
-                    vs = outs[sel]
-                    # each constrainer applies over ITS f-range for every
-                    # scan feature f != g, and over the full range for
-                    # f == g (all of this leaf lies across the boundary)
-                    mask = ((bins[None, None, :] >= los[sel][:, :, None])
-                            & (bins[None, None, :] < his[sel][:, :, None]))
-                    mask[:, g, :] = True
-                    if is_upper:
-                        v = np.where(mask, vs[:, None, None], np.inf)
-                        max_raw = np.minimum(max_raw, v.min(axis=0))
-                    else:
-                        v = np.where(mask, vs[:, None, None], -np.inf)
-                        min_raw = np.maximum(min_raw, v.max(axis=0))
+                    idx = np.nonzero(sel)[0]
+                    # chunk the constrainer axis: the [n, F, B] masks are
+                    # transient reductions, so a bounded chunk keeps peak
+                    # memory at CH*F*B regardless of leaf count (many-leaf
+                    # trees otherwise pay O(leaves*F*B) per refreshed leaf)
+                    CH = 64
+                    for c0 in range(0, idx.size, CH):
+                        ii = idx[c0:c0 + CH]
+                        vs = outs[ii]
+                        # each constrainer applies over ITS f-range for every
+                        # scan feature f != g, and over the full range for
+                        # f == g (all of this leaf lies across the boundary)
+                        mask = ((bins[None, None, :] >= los[ii][:, :, None])
+                                & (bins[None, None, :] < his[ii][:, :, None]))
+                        mask[:, g, :] = True
+                        if is_upper:
+                            v = np.where(mask, vs[:, None, None], pinf)
+                            max_raw = np.minimum(max_raw, v.min(axis=0))
+                        else:
+                            v = np.where(mask, vs[:, None, None], -pinf)
+                            min_raw = np.maximum(min_raw, v.max(axis=0))
         # left child at threshold t covers bins [lo, t] -> inclusive prefix;
         # right child covers (t, hi) -> suffix shifted one past t
         min_l = np.maximum.accumulate(min_raw, axis=1)

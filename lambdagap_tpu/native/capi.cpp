@@ -15,6 +15,7 @@
 // trees, binary/multiclass/regression/poisson-family output transforms,
 // random-forest average_output. Predict types: 0 = transformed, 1 = raw.
 #include <algorithm>
+#include <cctype>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -430,8 +431,11 @@ int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
 // Parses CSV/TSV (auto-delimiter). Label handling: `parameter` may carry
 // "has_label=true" or "has_label=false" to state whether column 0 is a
 // label; without it, a file with EXACTLY one more column than the model's
-// feature count is treated as the training-file layout (label first) —
-// pass has_label=false to override the heuristic.
+// feature count is treated as the training-file layout (label first).
+// When data_has_header=1 the header refines the guess: a label-like first
+// column name (label/target/class/y) confirms label-first, a feature-like
+// one (Column_*, feat*, f<digit>*) vetoes it. Pass has_label=... to
+// override both (documented in README alongside the ABI list).
 int LGBM_BoosterPredictForFile(BoosterHandle handle,
                                const char* data_filename,
                                int data_has_header, int predict_type,
@@ -457,7 +461,11 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle,
   }
   outf.precision(17);
   std::string line;
-  if (data_has_header) std::getline(in, line);
+  std::string header;
+  if (data_has_header) {
+    std::getline(in, header);
+    if (!header.empty() && header.back() == '\r') header.pop_back();
+  }
   std::vector<double> row;
   std::vector<double> out;
   bool first_data_line = true;
@@ -486,8 +494,35 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle,
       if (label_override >= 0) {
         skip_label = label_override;
       } else {
+        // count heuristic: exactly one column more than the model's feature
+        // count reads as the training-file layout (label first)
         skip_label =
             (static_cast<int>(row.size()) == m->max_feature_idx + 2) ? 1 : 0;
+        // a header row is more authoritative than the count: a label-like
+        // first column name confirms label-first; a feature-like name in a
+        // features+1-wide file means the extra column is a real feature
+        if (!header.empty()) {
+          size_t hend = header.find(delim);
+          std::string h0 = header.substr(
+              0, hend == std::string::npos ? header.size() : hend);
+          for (auto& c : h0)
+            c = static_cast<char>(
+                std::tolower(static_cast<unsigned char>(c)));
+          // confirm only when the file is actually wider than the model:
+          // an exact-width file whose first FEATURE happens to be named
+          // "y"/"label" must keep all its columns
+          if ((h0 == "label" || h0 == "target" || h0 == "class" ||
+               h0 == "y") &&
+              static_cast<int>(row.size()) > m->max_feature_idx + 1) {
+            skip_label = 1;
+          } else if (skip_label == 1 &&
+                     (h0.rfind("column_", 0) == 0 ||
+                      h0.rfind("feat", 0) == 0 ||
+                      (h0.size() >= 2 && h0[0] == 'f' &&
+                       std::isdigit(static_cast<unsigned char>(h0[1]))))) {
+            skip_label = 0;
+          }
+        }
       }
     }
     if (static_cast<int>(row.size()) - skip_label <= m->max_feature_idx) {

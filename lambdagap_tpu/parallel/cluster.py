@@ -140,6 +140,7 @@ def train_cluster(params: Dict[str, Any], data, label=None, *,
 
     procs = []
     cmds = []
+    log_paths = []
     env = dict(os.environ)
     env.update(worker_env or {})
     for r in range(num_workers):
@@ -150,22 +151,46 @@ def train_cluster(params: Dict[str, Any], data, label=None, *,
                f"machines={machines}", f"output_model={model_path}",
                *_params_to_cli(run_params)]
         cmds.append(" ".join(cmd))
-        procs.append(subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                      stderr=subprocess.STDOUT, text=True,
-                                      cwd=os.getcwd(), env=env))
-    outs = []
+        # per-rank log FILES, not pipes: a verbose worker that fills a 64KB
+        # pipe buffer blocks mid-collective and drags every rank to the
+        # timeout kill; files never backpressure the workers
+        lp = os.path.join(tmp, f"worker{r}.log")
+        log_paths.append(lp)
+        lf = open(lp, "w")
+        try:
+            procs.append(subprocess.Popen(cmd, stdout=lf,
+                                          stderr=subprocess.STDOUT,
+                                          cwd=os.getcwd(), env=env))
+        finally:
+            lf.close()          # the child holds its own descriptor
+    def _tail(path, n=3000):
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(f.tell() - n, 0))
+                return f.read().decode(errors="replace")
+        except OSError:
+            return ""
+
+    import time
+    deadline = time.monotonic() + timeout
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=timeout)
+            p.wait(timeout=max(deadline - time.monotonic(), 0.1))
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            log.fatal("cluster training timed out after %.0fs", timeout)
-        outs.append(out)
-    for r, (p, out) in enumerate(zip(procs, outs)):
+            stalled = [r for r, q in enumerate(procs) if q.returncode is None
+                       or q.returncode < 0]
+            detail = "\n".join(
+                f"--- worker {r} ({log_paths[r]}) ---\n{_tail(log_paths[r])}"
+                for r in stalled)
+            log.fatal("cluster training timed out after %.0fs "
+                      "(stalled ranks: %s)\n%s", timeout, stalled, detail)
+    for r, p in enumerate(procs):
         if p.returncode != 0:
             log.fatal("cluster worker %d failed (rc=%d):\n%s", r,
-                      p.returncode, (out or "")[-3000:])
+                      p.returncode, _tail(log_paths[r]))
 
     with open(os.path.join(tmp, "model0.txt")) as f:
         model_str = f.read()

@@ -278,18 +278,24 @@ class FusedVotingParallelTreeLearner(FusedDataParallelTreeLearner):
     def __init__(self, dataset: BinnedDataset, config: Config,
                  mesh: Optional[Mesh] = None) -> None:
         from ..utils import log
-        if config.use_quantized_grad:
-            log.warning("use_quantized_grad is not applied with the fused "
-                        "voting learner (the exact integer reduction needs "
-                        "full-histogram psum); training in full precision")
-            config.use_quantized_grad = False
-        if config.extra_trees:
-            log.fatal("extra_trees is not supported with "
-                      "tree_learner=voting (use serial or data)")
         super().__init__(dataset, config, mesh)
         if self.forced_seq is not None:
-            log.fatal("forced splits are not supported with the fused "
-                      "voting learner (forced gathers need global "
-                      "histograms); use tree_learner=data")
+            # unreachable via the factory (gbdt._create_learner routes
+            # forced-splits configs to the fused data-parallel learner);
+            # guards direct construction
+            log.fatal("forced splits need global histograms, which voting "
+                      "keeps local; use the fused data-parallel learner")
         self.voting = True
         self.vote_k = max(1, min(int(config.top_k), self.num_features))
+        if self.quant and self.quant_exact:
+            # voting stores RAW integer level sums in the float32 per-leaf
+            # histogram state until the voted-column psum (the full-histogram
+            # paths scale immediately after their psum), so exactness is
+            # bounded by the f32 integer range, not the int32 accumulator
+            qb = max(2, min(config.num_grad_quant_bins, 127))
+            self.quant_exact = dataset.num_data * qb < 2**24
+            if not self.quant_exact:
+                log.warning("quantized voting-parallel level sums may exceed "
+                            "the float32-exact range (%d rows x %d levels); "
+                            "using per-chunk scaled float32 accumulation",
+                            dataset.num_data, qb)

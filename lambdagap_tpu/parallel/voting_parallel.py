@@ -36,10 +36,6 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
 
     def _build_ops(self) -> None:
         super()._build_ops()
-        if self.config.extra_trees:
-            from ..utils import log
-            log.fatal("extra_trees is not supported with "
-                      "tree_learner=voting (use serial or data)")
         mesh = self.mesh
         B = self.B
         rpb = self.rows_per_block
@@ -86,21 +82,29 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
 
         self._root_totals_op = jax.jit(root_totals)
 
+        extra_on = self.extra_on
+        in_specs = (P(DATA_AXIS), P(), P(), P(), P(), P())
+        if extra_on:
+            in_specs = in_specs + (P(),)
+
         @functools.partial(
             shard_map, mesh=mesh,
-            in_specs=(P(DATA_AXIS), P(), P(), P(), P(), P()),
+            in_specs=in_specs,
             out_specs=P(),
             check_vma=False)   # psum/all_gather make outputs replicated
-        def voting_best(hist_l, pg, ph, pc, pout, fmask):
+        def voting_best(hist_l, pg, ph, pc, pout, fmask, *ext):
             """Local top-k vote -> psum of voted columns -> global best."""
             h0 = hist_l            # local [F, B, 3]
             num_bins, default_bins, missing_types, is_cat = meta
+            # extra_trees: rand_t is replicated, so votes are scored by the
+            # same randomized gain the final voted scan uses
+            rand_t = ext[0] if extra_on else None
             # local parent sums for the vote (approximate, like the reference)
             lt = jnp.sum(h0[0], axis=0)
             lgain, *_ = per_feature_best(
                 h0, lt[0], lt[1], lt[2], jnp.float32(0.0),
                 num_bins, default_bins, missing_types, is_cat, fmask,
-                params, has_cat)
+                params, has_cat, rand_thresholds=rand_t)
             _, local_top = jax.lax.top_k(lgain, top_k)
             votes = jax.lax.all_gather(local_top.astype(jnp.int32),
                                        DATA_AXIS, tiled=True)    # [D*k]
@@ -112,6 +116,7 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
                 num_bins[votes], default_bins[votes], missing_types[votes],
                 is_cat[votes], fmask[votes], params,
                 has_categorical=has_cat, constraints=cons,
+                rand_thresholds=rand_t[votes] if extra_on else None,
                 gain_contri=(self.contri_arr[votes]
                              if self.contri_arr is not None else None))
             # remap the winning index back to the true feature id
@@ -132,9 +137,11 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         return self._leaf_hist_ops[padded]
 
     def _best(self, hist, pg, ph, pc, parent_output, fmask) -> _HostSplit:
-        res = self._voting_best_op(hist, jnp.float32(pg), jnp.float32(ph),
-                                   jnp.float32(pc), jnp.float32(parent_output),
-                                   fmask)
+        args = [hist, jnp.float32(pg), jnp.float32(ph), jnp.float32(pc),
+                jnp.float32(parent_output), fmask]
+        if self.extra_on:
+            args.append(self._draw_extra_thresholds())
+        res = self._voting_best_op(*args)
         return _HostSplit(jax.device_get(res))
 
     def _root_totals(self, hist_root):

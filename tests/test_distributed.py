@@ -223,6 +223,80 @@ def test_fused_voting_parallel():
     assert close.mean() > 0.99, float(close.mean())
 
 
+def test_voting_extra_trees():
+    """extra_trees under voting — both variants (the reference's voting
+    learner inherits it from the serial learner,
+    voting_parallel_tree_learner.cpp). With top_k >= num_features every
+    feature is voted, so the fused voting scan sees the fused data-parallel
+    scan's inputs with the SAME PRNG streams — models agree up to
+    reduction-order float noise."""
+    from lambdagap_tpu.parallel.fused_parallel import \
+        FusedVotingParallelTreeLearner
+    X, y = _data(seed=13)
+    nd = min(NEED, len(jax.devices()))
+    ex = {"extra_trees": True, "extra_seed": 17}
+    b_v = _train(X, y, "voting", nd, rounds=6, extra={**ex, "top_k": 12})
+    assert isinstance(b_v._booster.learner, FusedVotingParallelTreeLearner)
+    b_d = _train(X, y, "data", nd, rounds=6, extra=ex)
+    close = np.isclose(b_v.predict(X), b_d.predict(X), rtol=5e-3, atol=5e-3)
+    assert close.mean() > 0.99, float(close.mean())
+    # the bandwidth-capped case trains well
+    b_k = _train(X, y, "voting", nd, extra={**ex, "top_k": 4})
+    assert roc_auc_score(y, b_k.predict(X)) > 0.9
+    # host-loop voting accepts extra_trees too
+    b_h = _train(X, y, "voting", nd,
+                 extra={**ex, "top_k": 4, "tpu_fused_learner": "0"})
+    assert roc_auc_score(y, b_h.predict(X)) > 0.9
+
+
+def test_fused_voting_quantized():
+    """use_quantized_grad under the fused voting learner: raw integer level
+    sums stay shard-local, the voted-column psum reduces them exactly, and
+    the gradient scales apply after the collective (the voting analog of
+    the full-histogram integer reduction). The caller's config must not be
+    mutated (a reused params/Config would silently lose quantization)."""
+    from lambdagap_tpu.parallel.fused_parallel import \
+        FusedVotingParallelTreeLearner
+    X, y = _data(seed=14)
+    nd = min(NEED, len(jax.devices()))
+    params = {"objective": "binary", "tree_learner": "voting",
+              "tpu_num_devices": nd, "verbose": -1, "num_leaves": 15,
+              "min_data_in_leaf": 5, "top_k": 5, "use_quantized_grad": True}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=10)
+    lrn = b._booster.learner
+    assert isinstance(lrn, FusedVotingParallelTreeLearner)
+    assert lrn.quant and lrn.quant_exact
+    assert b._booster.config.use_quantized_grad is True
+    assert roc_auc_score(y, b.predict(X)) > 0.9
+
+
+def test_voting_forced_splits_route_to_data_parallel():
+    """forcedsplits_filename + tree_learner=voting: voting keeps histograms
+    local so forced gathers cannot run — the factory routes (loudly) to the
+    fused data-parallel learner and the forced schedule applies."""
+    import json
+    import os
+    import tempfile
+    from lambdagap_tpu.parallel.fused_parallel import (
+        FusedDataParallelTreeLearner, FusedVotingParallelTreeLearner)
+    X, y = _data(seed=15)
+    forced = {"feature": 3, "threshold": float(np.median(X[:, 3]))}
+    fd, path = tempfile.mkstemp(suffix=".json")
+    with os.fdopen(fd, "w") as f:
+        json.dump(forced, f)
+    try:
+        nd = min(NEED, len(jax.devices()))
+        b = _train(X, y, "voting", nd, rounds=3,
+                   extra={"forcedsplits_filename": path, "top_k": 4})
+        lrn = b._booster.learner
+        assert isinstance(lrn, FusedDataParallelTreeLearner)
+        assert not isinstance(lrn, FusedVotingParallelTreeLearner)
+        root = b.dump_model()["tree_info"][0]["tree_structure"]
+        assert root["split_feature"] == 3
+    finally:
+        os.unlink(path)
+
+
 def test_fused_voting_interaction_constraints():
     """Interaction constraints ride the fused voting program's in-program
     path bitmasks (same machinery as fused data-parallel)."""
