@@ -76,20 +76,21 @@ def max_bdcg_at_k(labels: np.ndarray, k: int) -> float:
 
 
 class _QueryBuckets:
-    """Queries grouped by padded length for shape-stable jitted kernels."""
+    """Queries grouped by padded length for shape-stable jitted kernels.
 
-    def __init__(self, query_boundaries: np.ndarray, num_data: int,
-                 max_bucket: int = 1 << 14) -> None:
+    No length cap: arbitrarily long queries are exact (the reference handles
+    any query length, rank_objective.hpp:253-524) — buckets past the dense
+    lattice limit route to the row-tiled pairwise kernel, whose memory is
+    O(L·T) instead of O(L²)."""
+
+    def __init__(self, query_boundaries: np.ndarray, num_data: int) -> None:
         self.num_data = num_data
         qb = np.asarray(query_boundaries, dtype=np.int64)
         lengths = np.diff(qb)
         self.num_queries = len(lengths)
         buckets: Dict[int, List[int]] = {}
         for qi, ln in enumerate(lengths):
-            L = min(max(_next_pow2(int(ln)), 8), max_bucket)
-            if ln > max_bucket:
-                log.warning("Query %d has %d docs > bucket cap %d; truncating",
-                            qi, ln, max_bucket)
+            L = max(_next_pow2(int(ln)), 8)
             buckets.setdefault(L, []).append(qi)
         self.buckets = []
         for L, qids in sorted(buckets.items()):
@@ -299,44 +300,52 @@ class LambdarankNDCG(RankingBase):
 
     def _bucket_gradients(self, scores_b, labels_b, valid_b, aux_b):
         inv_dcg, inv_bdcg = aux_b
+        L = scores_b.shape[1]
+        tile = None if L <= _DENSE_PAIR_L else max(
+            (_DENSE_PAIR_L * _DENSE_PAIR_L) // L, 64)
         return _lambdarank_bucket(
             scores_b, labels_b, valid_b, inv_dcg, inv_bdcg, self.label_gain,
             target=self.target, sigmoid=self.sigmoid, norm=self.norm,
             truncation_level=self.truncation_level,
-            lambdagap_weight=self.lambdagap_weight)
+            lambdagap_weight=self.lambdagap_weight, tile=tile)
+
+
+# queries up to this padded length use the dense [L, L] lattice; longer ones
+# route to the row-tiled sweep (same math, O(L*tile) memory) — the TPU-shaped
+# answer to the reference's arbitrary-length per-query loops
+# (rank_objective.hpp:253-524)
+_DENSE_PAIR_L = 4096
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("target", "sigmoid", "norm", "truncation_level",
-                     "lambdagap_weight"))
+                     "lambdagap_weight", "tile"))
 def _lambdarank_bucket(scores, labels, valid, inv_dcg, inv_bdcg, label_gain,
                        *, target: str, sigmoid: float, norm: bool,
-                       truncation_level: int, lambdagap_weight: float):
+                       truncation_level: int, lambdagap_weight: float,
+                       tile: Optional[int] = None):
     """Vectorized per-query lambda computation for one padded bucket.
 
     scores/labels/valid: [nq, L]; inv_dcg/inv_bdcg: [nq].
     Returns (lambdas [nq, L], hessians [nq, L], effective_pair_rate [nq]).
-    """
 
-    def one_query(s, l, v, imd, imb):
-        L = s.shape[0]
-        neg = jnp.where(v, s, K_MIN_SCORE)
-        order = jnp.argsort(-neg)              # stable: ranks by score desc
-        ss = neg[order]
-        ls = l[order].astype(jnp.float32)
-        vs = v[order]
-        ranks = jnp.arange(L, dtype=jnp.int32)
+    ``tile=None``: one dense [L, L] pair lattice per query. ``tile=T``:
+    the row axis is swept in blocks of T under the same window masks —
+    peak memory O(L*T), identical arithmetic per pair — so arbitrarily
+    long queries stay exact."""
+    from jax import lax
+    tl = truncation_level
 
-        i = ranks[:, None]                     # pair row: better-ranked index
-        j = ranks[None, :]                     # pair col
-        li = ls[:, None]
-        lj = ls[None, :]
-        si = ss[:, None]
-        sj = ss[None, :]
-        tl = truncation_level
-
-        pair_valid = vs[:, None] & vs[None, :] & (i < j) & (li != lj)
+    def pair_block(i, j, si, sj, li, lj, vij, imd, imb, best, worst):
+        """All pair quantities for one [bi, bj] block of the sorted
+        lattice. i/j are rank indices ([bi,1] / [1,bj]); s/l are the
+        score/label slices at those ranks; vij the validity product.
+        Returns (lam_to_row [bi,bj] signed lambda for the ROW doc,
+        p_hessian [bi,bj], sum_p_lambda scalar, pair_count scalar); the
+        COLUMN doc's lambda is minus the row's (accumulated by the
+        caller), per reference :505-512."""
+        pair_valid = vij & (i < j) & (li != lj)
         if target in _BINARY_TARGETS:
             pair_valid &= ~((li > 0) & (lj > 0))
 
@@ -389,9 +398,11 @@ def _lambdarank_bucket(scores, labels, valid, inv_dcg, inv_bdcg, label_gain,
                         "bin-ranknet", "ranknet"):
             delta = jnp.ones_like(delta_score)
         elif target == "lambdagap-s-plus":
-            delta = ((j - i == tl) * lambdagap_weight + (i < tl)).astype(jnp.float32)
+            delta = ((j - i == tl) * lambdagap_weight
+                     + (i < tl)).astype(jnp.float32)
         elif target == "lambdagap-x-plus":
-            delta = ((j - i >= tl) * lambdagap_weight + (i < tl)).astype(jnp.float32)
+            delta = ((j - i >= tl) * lambdagap_weight
+                     + (i < tl)).astype(jnp.float32)
         elif target == "lambdagap-s-plus-plus":
             delta = ((j - i == tl) * lambdagap_weight + (j + 1 - tl)
                      - (i >= tl) * (i + 1 - tl)).astype(jnp.float32)
@@ -399,7 +410,8 @@ def _lambdarank_bucket(scores, labels, valid, inv_dcg, inv_bdcg, label_gain,
             delta = ((j - i >= tl) * lambdagap_weight + (j + 1 - tl)
                      - (i >= tl) * (i + 1 - tl)).astype(jnp.float32)
         elif target == "arpk":
-            delta = ((j + 1 - tl) - (i >= tl) * (i + 1 - tl)).astype(jnp.float32)
+            delta = ((j + 1 - tl)
+                     - (i >= tl) * (i + 1 - tl)).astype(jnp.float32)
         elif target == "lambdaloss-arp1":
             delta = jnp.where(hi_is_i, li, lj)
         elif target == "lambdaloss-arp2":
@@ -410,9 +422,6 @@ def _lambdarank_bucket(scores, labels, valid, inv_dcg, inv_bdcg, label_gain,
         pair_valid &= delta != 0
 
         # score-distance normalization (reference: :495-498)
-        nv = jnp.sum(vs)
-        best = ss[0]
-        worst = ss[jnp.maximum(nv - 1, 0)]
         if norm:
             delta = jnp.where(best != worst,
                               delta / (0.01 + jnp.abs(delta_score)), delta)
@@ -422,21 +431,81 @@ def _lambdarank_bucket(scores, labels, valid, inv_dcg, inv_bdcg, label_gain,
         p_hessian = sigmoid * sigmoid * delta * p * (1.0 - p)
         p_lambda = jnp.where(pair_valid, p_lambda, 0.0)
         p_hessian = jnp.where(pair_valid, p_hessian, 0.0)
-
-        # accumulate: the high-label doc gets +p_lambda, the low gets
-        # -p_lambda; both get +p_hessian (reference: :505-512). Per pair
-        # (i, j): row doc i receives ±p depending on which side is "high",
-        # col doc j receives the opposite sign.
         lam_to_row = jnp.where(hi_is_i, p_lambda, -p_lambda)
-        lam_sorted = jnp.sum(lam_to_row, axis=1) - jnp.sum(lam_to_row, axis=0)
-        hes_sorted = jnp.sum(p_hessian, axis=1) + jnp.sum(p_hessian, axis=0)
+        # pair count in f32: int32 would wrap past ~2^31 pairs, reachable
+        # now that query length is uncapped (a 66k-doc query alone has 2^31)
+        return (lam_to_row, p_hessian, jnp.sum(p_lambda),
+                jnp.sum(pair_valid, dtype=jnp.float32))
 
-        sum_lambdas = -2.0 * jnp.sum(p_lambda)
-        count_lambdas = jnp.sum(pair_valid)
+    def one_query(s, l, v, imd, imb):
+        L = s.shape[0]
+        neg = jnp.where(v, s, K_MIN_SCORE)
+        order = jnp.argsort(-neg)              # stable: ranks by score desc
+        ss = neg[order]
+        ls = l[order].astype(jnp.float32)
+        vs = v[order]
+        ranks = jnp.arange(L, dtype=jnp.int32)
+        nv = jnp.sum(vs)
+        best = ss[0]
+        worst = ss[jnp.maximum(nv - 1, 0)]
+
+        if tile is None:
+            lam_to_row, p_hessian, sum_pl, count_lambdas = pair_block(
+                ranks[:, None], ranks[None, :], ss[:, None], ss[None, :],
+                ls[:, None], ls[None, :], vs[:, None] & vs[None, :],
+                imd, imb, best, worst)
+            lam_sorted = (jnp.sum(lam_to_row, axis=1)
+                          - jnp.sum(lam_to_row, axis=0))
+            hes_sorted = (jnp.sum(p_hessian, axis=1)
+                          + jnp.sum(p_hessian, axis=0))
+        else:
+            T = tile
+            # truncated-i targets zero every row past the truncation level:
+            # their row sweep stops at ceil(tl / T) blocks (exact — those
+            # rows' pair_valid is identically False)
+            i_limit = min(L, tl) if target in _TRUNCATED_I_TARGETS else L
+            nb = -(-i_limit // T)
+            jr = ranks[None, :]
+            sj = ss[None, :]
+            lj = ls[None, :]
+            vj = vs[None, :]
+
+            def body(b, carry):
+                lam_row, col_lam, hes_row, col_hes, spl, cnt = carry
+                off = b * T
+                ir = (off + jnp.arange(T, dtype=jnp.int32))[:, None]
+                si = lax.dynamic_slice(ss, (off,), (T,))[:, None]
+                li = lax.dynamic_slice(ls, (off,), (T,))[:, None]
+                vi = lax.dynamic_slice(vs, (off,), (T,))[:, None]
+                ltr, ph, s1, c1 = pair_block(ir, jr, si, sj, li, lj,
+                                             vi & vj, imd, imb, best, worst)
+                lam_row = lax.dynamic_update_slice(
+                    lam_row,
+                    lax.dynamic_slice(lam_row, (off,), (T,))
+                    + jnp.sum(ltr, axis=1), (off,))
+                hes_row = lax.dynamic_update_slice(
+                    hes_row,
+                    lax.dynamic_slice(hes_row, (off,), (T,))
+                    + jnp.sum(ph, axis=1), (off,))
+                col_lam = col_lam + jnp.sum(ltr, axis=0)
+                col_hes = col_hes + jnp.sum(ph, axis=0)
+                return (lam_row, col_lam, hes_row, col_hes,
+                        spl + s1, cnt + c1)
+
+            z = jnp.zeros(L, jnp.float32)
+            lam_row, col_lam, hes_row, col_hes, sum_pl, count_lambdas = \
+                lax.fori_loop(0, nb, body,
+                              (z, z, z, z, jnp.float32(0.0),
+                               jnp.float32(0.0)))
+            lam_sorted = lam_row - col_lam
+            hes_sorted = hes_row + col_hes
+
+        sum_lambdas = -2.0 * sum_pl
         if norm:
             norm_factor = jnp.where(
                 sum_lambdas > 0,
-                jnp.log2(1.0 + sum_lambdas) / jnp.maximum(sum_lambdas, K_EPSILON),
+                jnp.log2(1.0 + sum_lambdas)
+                / jnp.maximum(sum_lambdas, K_EPSILON),
                 1.0)
             lam_sorted = lam_sorted * norm_factor
             hes_sorted = hes_sorted * norm_factor
@@ -445,8 +514,9 @@ def _lambdarank_bucket(scores, labels, valid, inv_dcg, inv_bdcg, label_gain,
         inv = jnp.argsort(order)
         lam = lam_sorted[inv]
         hes = hes_sorted[inv]
+        nvf = nv.astype(jnp.float32)           # int32 nv*(nv-1) would wrap
         eff = 2.0 * count_lambdas.astype(jnp.float32) / \
-            jnp.maximum(nv * (nv - 1), 1).astype(jnp.float32)
+            jnp.maximum(nvf * (nvf - 1.0), 1.0)
         return lam, hes, eff
 
     return jax.vmap(one_query)(scores, labels, valid, inv_dcg, inv_bdcg)

@@ -178,10 +178,12 @@ def train_cluster(params: Dict[str, Any], data, label=None, *,
         try:
             p.wait(timeout=max(deadline - time.monotonic(), 0.1))
         except subprocess.TimeoutExpired:
+            # reap already-finished ranks first: kill() does not set
+            # returncode, so without poll() every unwaited-but-exited
+            # worker would be misreported as stalled
+            stalled = [r for r, q in enumerate(procs) if q.poll() is None]
             for q in procs:
                 q.kill()
-            stalled = [r for r, q in enumerate(procs) if q.returncode is None
-                       or q.returncode < 0]
             detail = "\n".join(
                 f"--- worker {r} ({log_paths[r]}) ---\n{_tail(log_paths[r])}"
                 for r in stalled)
