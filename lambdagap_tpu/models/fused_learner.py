@@ -364,6 +364,13 @@ class FusedTreeLearner(SerialTreeLearner):
         has_cat = self.has_categorical
         mono_on = self.mono_on
         mono_arr = self.mono_arr
+        # monotone 'intermediate' runs IN-PROGRAM: sibling-output child
+        # bounds + the cross-leaf constraint propagation as a vectorized
+        # per-split state update over the leaf_f bounds columns, with eager
+        # re-scans of tightened leaves (reference:
+        # monotone_constraints.hpp:560-850 IntermediateLeafConstraints)
+        inter = mono_on and self.mono_method == "intermediate"
+        NPW_N = (NODES + 31) // 32 if inter else 1
         lane = jnp.arange(W, dtype=jnp.int32)
         bin_iota = jnp.arange(Bb, dtype=x_rows.dtype)
         quant = self.quant
@@ -703,6 +710,17 @@ class FusedTreeLearner(SerialTreeLearner):
         )
         if ic_on:
             state["path"] = jnp.zeros((L + 1, PW), jnp.uint32)
+        if inter:
+            # per-leaf bin-space boxes ([lo, hi) per feature, root = full
+            # range), per-leaf ancestor-node bitsets, the stale-scan marks,
+            # and node parent/side pointers for the up-walk
+            state["box_lo"] = jnp.zeros((L + 1, F), jnp.int32)
+            state["box_hi"] = jnp.zeros((L + 1, F),
+                                        jnp.int32).at[0].set(num_bins)
+            state["npath"] = jnp.zeros((L + 1, NPW_N), jnp.uint32)
+            state["stale"] = jnp.zeros(L + 1, bool)
+            state["node_par"] = jnp.full(NODES + 1, -1, jnp.int32)
+            state["node_side"] = jnp.zeros(NODES + 1, jnp.int32)
 
         forced = self.forced_seq
         if forced is not None:
@@ -714,7 +732,59 @@ class FusedTreeLearner(SerialTreeLearner):
 
         # ------------------------------------------------------ split step
         def split_step(k, st):
-            leaf_f, leaf_i = st["leaf_f"], st["leaf_i"]
+            if inter:
+                # eager re-scan of every leaf whose bounds the previous
+                # split's propagation tightened (the host learner re-scans
+                # them inside apply_split; here the re-scan runs at the
+                # start of the next step — before the argmax, so the
+                # choice sees only fresh gains). Loop trips are derived
+                # from replicated state, so every shard runs the same
+                # number of (collective-bearing, under voting) re-scans.
+                def rescan_cond(rst):
+                    return jnp.any(rst[3][:L])
+
+                def rescan_body(rst):
+                    lf_c, li_c, lb_c, stale_c = rst
+                    rl = jnp.argmax(stale_c[:L]).astype(jnp.int32)
+                    lfr = lf_c[rl]
+                    lir = li_c[rl]
+                    if need_keys:
+                        rk = jax.random.fold_in(
+                            jax.random.fold_in(xkey, NODES + 1),
+                            k * (L + 1) + rl)
+                    else:
+                        rk = xkey
+                    if ic_on or bynode_on:
+                        cp = (st["path"][rl] if ic_on
+                              else jnp.zeros(PW, jnp.uint32))
+                        fm_l = node_fmask(cp, jax.random.fold_in(
+                            jax.random.fold_in(bkey, NODES + 1),
+                            k * (L + 1) + rl))
+                    else:
+                        fm_l = fmask
+                    (rg, rf, rt, rdl, rcat, rbits, rlg, rlh, rlc, rlout,
+                     rrout) = best_of(st["hist"][rl], lfr[0], lfr[1],
+                                      lfr[2], lfr[3], lfr[10], lfr[11],
+                                      lir[2], rk, fm_l)
+                    new_lf = jnp.stack([lfr[0], lfr[1], lfr[2], lfr[3],
+                                        rg, rlg, rlh, rlc, rlout, rrout,
+                                        lfr[10], lfr[11]])
+                    new_li = jnp.stack([lir[0], lir[1], lir[2], lir[3],
+                                        lir[4], rf, rt,
+                                        rdl.astype(jnp.int32),
+                                        rcat.astype(jnp.int32)])
+                    return (lf_c.at[rl].set(new_lf),
+                            li_c.at[rl].set(new_li),
+                            lb_c.at[rl].set(rbits),
+                            stale_c.at[rl].set(False))
+
+                leaf_f, leaf_i, leaf_bits, stale = lax.while_loop(
+                    rescan_cond, rescan_body,
+                    (st["leaf_f"], st["leaf_i"], st["leaf_bits"],
+                     st["stale"]))
+            else:
+                leaf_f, leaf_i = st["leaf_f"], st["leaf_i"]
+                leaf_bits = st["leaf_bits"]
             leaf = jnp.argmax(leaf_f[:L, 4]).astype(jnp.int32)
             forcing_next = None
             fon = use_f = None
@@ -756,7 +826,7 @@ class FusedTreeLearner(SerialTreeLearner):
             bgain = lf[4]
             feat = li[5]
             thrv, dlv, catv = li[6], li[7].astype(bool), li[8].astype(bool)
-            bitsv = st["leaf_bits"][leaf]
+            bitsv = leaf_bits[leaf]
             blg, blh, blc = lf[5], lf[6], lf[7]
             blout, brout = lf[8], lf[9]
             if forced is not None:
@@ -857,15 +927,21 @@ class FusedTreeLearner(SerialTreeLearner):
             lout, rout = blout, brout
             depth = li[2] + 1
 
-            # children's monotone bounds (basic method): the mid of the two
-            # constrained outputs caps the subtree on the constrained side
+            # children's monotone bounds. basic: the mid of the two outputs
+            # caps the subtree on the constrained side; intermediate: each
+            # child is capped by its SIBLING's output — looser, recovered
+            # accuracy is the method's point (reference:
+            # UpdateConstraintsWithOutputs, monotone_constraints.hpp:545)
             pmin, pmax = lf[10], lf[11]
             mono_f = mono_arr[feat]
-            mid = (lout + rout) * 0.5
-            lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, mid), pmin)
-            lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, mid), pmax)
-            rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, mid), pmin)
-            rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, mid), pmax)
+            if inter:
+                lcap, rcap = rout, lout
+            else:
+                lcap = rcap = (lout + rout) * 0.5
+            lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, lcap), pmin)
+            lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, lcap), pmax)
+            rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, rcap), pmin)
+            rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, rcap), pmax)
 
             node_f = st["node_f"].at[wk].set(
                 jnp.stack([bgain, lf[3], ph, pc]))
@@ -934,12 +1010,102 @@ class FusedTreeLearner(SerialTreeLearner):
             rrow_i = jnp.stack([begin + left_count, right_count, depth, nidx,
                                 i32(0), bf2[1], bt2[1], bdl2[1].astype(i32),
                                 bcat2[1].astype(i32)])
+
+            if inter:
+                # -- intermediate constraint propagation ---------------
+                # The reference walks up from the new node; at every
+                # monotone numeric ancestor it tightens the bounds of
+                # leaves in the opposite subtree that stay contiguous to
+                # the split leaf, using the new children's outputs
+                # (GoUpToFindLeavesToUpdate / GoDownToFindLeavesToUpdate,
+                # monotone_constraints.hpp:560-850). Here the recursive
+                # down-walk collapses to vectorized [L] box tests: the
+                # contiguity pruning is interval overlap between each
+                # leaf's bin-space box and the split leaf's PRE-split box
+                # on the features crossed so far, and the use-left/right
+                # output choice is overlap with each child's range on the
+                # split feature. Tightened leaves are marked stale and
+                # eagerly re-scanned at the next step's start.
+                plo_vec = st["box_lo"][leaf]           # [F] pre-split box
+                phi_vec = st["box_hi"][leaf]
+                lo_col = st["box_lo"]                  # [L+1, F]
+                hi_col = st["box_hi"]
+                sf_lo = lo_col[:, feat]                # [L+1] on the new
+                sf_hi = hi_col[:, feat]                # split's feature
+                # active leaves only; the host learner tightens every leaf
+                # still carrying a cached scan (its "splittable" guard is
+                # vacuous — K_MIN_SCORE is finite), so no gain condition
+                row_ok = (iota_l1 < L) & ok
+                npath_s = st["npath"]
+                BIGB = jnp.int32(1 << 30)
+
+                def wbody(wst):
+                    a, child_left, crossed, keep, lf_c, stale_c = wst
+                    g = node_i[a, 0]
+                    t_a = node_i[a, 1]
+                    is_num_a = node_i[a, 3] == 0
+                    m_g = mono_arr[g]
+                    opposite_ok = is_num_a & ~crossed[
+                        g, child_left.astype(jnp.int32)]
+                    in_sub = ((npath_s[:, a // 32]
+                               >> (a % 32).astype(jnp.uint32)) & 1) == 1
+                    opp_side = jnp.where(child_left,
+                                         lo_col[:, g] > t_a,
+                                         hi_col[:, g] <= t_a + 1)
+                    opp = in_sub & opp_side
+                    # which child output applies to leaf M: the reference
+                    # flips use_left/use_right only at sf-splits INSIDE the
+                    # opposite subtree — in box terms, M keeps a side unless
+                    # its own sf-range moved past the new threshold relative
+                    # to the subtree ROOT's range (= the subtree extrema)
+                    alo = jnp.min(jnp.where(opp, sf_lo, BIGB))
+                    ahi = jnp.max(jnp.where(opp, sf_hi, -BIGB))
+                    use_l = catv | (sf_lo <= thrv) | (sf_lo == alo)
+                    use_r = catv | (sf_hi > thrv + 1) | (sf_hi == ahi)
+                    both = use_l & use_r
+                    lo_v = jnp.where(both, jnp.minimum(lout, rout),
+                                     jnp.where(use_r, rout, lout))
+                    hi_v = jnp.where(both, jnp.maximum(lout, rout),
+                                     jnp.where(use_r, rout, lout))
+                    cand = (opp & keep & row_ok
+                            & opposite_ok & (m_g != 0))
+                    update_max = jnp.where(m_g > 0, ~child_left, child_left)
+                    cur_lo = lf_c[:, 10]
+                    cur_hi = lf_c[:, 11]
+                    new_hi = jnp.where(cand & update_max,
+                                       jnp.minimum(cur_hi, lo_v), cur_hi)
+                    new_lo = jnp.where(cand & ~update_max,
+                                       jnp.maximum(cur_lo, hi_v), cur_lo)
+                    changed = (new_hi < cur_hi) | (new_lo > cur_lo)
+                    lf_c = lf_c.at[:, 10].set(new_lo).at[:, 11].set(new_hi)
+                    stale_c = stale_c | changed
+                    # record the crossing + the (one-sided) contiguity
+                    # constraint this up-path entry imposes on leaves seen
+                    # from higher ancestors: leaves past the crossed
+                    # threshold in the crossing's direction are pruned
+                    crossed = crossed.at[g, child_left.astype(
+                        jnp.int32)].set(crossed[g, child_left.astype(
+                            jnp.int32)] | opposite_ok)
+                    entry_keep = jnp.where(child_left,
+                                           lo_col[:, g] <= t_a,
+                                           hi_col[:, g] > t_a + 1)
+                    keep = keep & jnp.where(opposite_ok, entry_keep, True)
+                    return (st["node_par"][a], st["node_side"][a] == 1,
+                            crossed, keep, lf_c, stale_c)
+
+                a0 = jnp.where(ok, li[3], -1)
+                (_, _, _, _, leaf_f, stale) = lax.while_loop(
+                    lambda wst: wst[0] >= 0, wbody,
+                    (a0, li[4] == 1,
+                     jnp.zeros((F, 2), bool),
+                     jnp.ones(L + 1, bool), leaf_f, stale))
+
             out = dict(
                 perm=perm, perm_buf=pbuf,
                 leaf_f=leaf_f.at[wl].set(lrow_f).at[wn].set(rrow_f),
                 leaf_i=leaf_i.at[wl].set(lrow_i).at[wn].set(rrow_i),
-                leaf_bits=st["leaf_bits"].at[wl].set(bbits2[0])
-                                         .at[wn].set(bbits2[1]),
+                leaf_bits=leaf_bits.at[wl].set(bbits2[0])
+                                   .at[wn].set(bbits2[1]),
                 node_f=node_f, node_i=node_i, node_bits=node_bits,
                 hist=hist,
                 num_leaves=st["num_leaves"] + ok.astype(jnp.int32),
@@ -949,6 +1115,30 @@ class FusedTreeLearner(SerialTreeLearner):
             if ic_on:
                 out["path"] = st["path"].at[wl].set(child_path) \
                                         .at[wn].set(child_path)
+            if inter:
+                # children inherit the parent's box narrowed on the split
+                # feature (categorical splits scatter bins to both sides;
+                # keeping the parent box is conservative — matches the
+                # host learner's apply_split)
+                l_hi_box = jnp.where(catv, phi_vec,
+                                     phi_vec.at[feat].set(thrv + 1))
+                r_lo_box = jnp.where(catv, plo_vec,
+                                     plo_vec.at[feat].set(thrv + 1))
+                out["box_lo"] = st["box_lo"].at[wl].set(plo_vec) \
+                                            .at[wn].set(r_lo_box)
+                out["box_hi"] = st["box_hi"].at[wl].set(l_hi_box) \
+                                            .at[wn].set(phi_vec)
+                nbit = jnp.where(
+                    jnp.arange(NPW_N, dtype=jnp.int32) == nidx // 32,
+                    jnp.left_shift(jnp.uint32(1),
+                                   (nidx % 32).astype(jnp.uint32)),
+                    jnp.uint32(0))
+                child_npath = st["npath"][leaf] | nbit
+                out["npath"] = st["npath"].at[wl].set(child_npath) \
+                                          .at[wn].set(child_npath)
+                out["stale"] = stale.at[wl].set(False).at[wn].set(False)
+                out["node_par"] = st["node_par"].at[wk].set(li[3])
+                out["node_side"] = st["node_side"].at[wk].set(li[4])
             return out
 
         if L > 1:

@@ -175,11 +175,12 @@ class GBDT:
                             "constant-leaf trees")
                 cfg.linear_tree = False
             if (cfg.monotone_constraints
-                    and cfg.monotone_constraints_method != "basic"):
-                log.warning("monotone_constraints_method=%s is not available "
-                            "on the fused data-parallel learner; using "
-                            "'basic'", cfg.monotone_constraints_method)
-                cfg.monotone_constraints_method = "basic"
+                    and cfg.monotone_constraints_method == "advanced"):
+                log.warning("monotone_constraints_method=advanced is not "
+                            "available on the fused data-parallel learner; "
+                            "using 'intermediate' (basic and intermediate "
+                            "run in-program)")
+                cfg.monotone_constraints_method = "intermediate"
             not_applied = []
             if _cegb_requested(cfg):
                 not_applied.append("cegb")
@@ -198,19 +199,22 @@ class GBDT:
             # unsupported combos to the CPU path)
             host_only = []
             if (cfg.monotone_constraints
-                    and cfg.monotone_constraints_method != "basic"):
-                # intermediate needs cross-leaf constraint propagation +
-                # re-scans — host-orchestrated only (the fused program's
-                # straight-line step has no re-scan slot)
-                host_only.append("monotone_constraints_method="
-                                 + cfg.monotone_constraints_method)
+                    and cfg.monotone_constraints_method == "advanced"):
+                # advanced needs the per-threshold dense bound arrays
+                # rebuilt per affected leaf — host-orchestrated only
+                # (basic AND intermediate run inside the fused program,
+                # incl. intermediate's cross-leaf propagation + re-scans)
+                host_only.append("monotone_constraints_method=advanced")
             if cfg.linear_tree:
                 host_only.append("linear_tree")
             if _cegb_requested(cfg):
                 host_only.append("cegb")
             if use_fused and host_only:
-                log.info("Using the host-driven serial learner for: %s",
-                         ", ".join(host_only))
+                log.warning("Using the host-driven serial learner for: %s "
+                            "— on a high-latency device link this path "
+                            "pays one host sync per split instead of the "
+                            "fused whole-tree program's zero",
+                            ", ".join(host_only))
                 use_fused = False
             if cfg.use_quantized_grad and not use_fused:
                 log.warning("use_quantized_grad is only implemented by the "
@@ -234,6 +238,14 @@ class GBDT:
             log.fatal("interaction_constraints with tree_learner=%s require "
                       "the fused learner (tree_learner=data + "
                       "tpu_fused_learner=1) or tree_learner=serial", tl)
+        if tl in ("data", "voting") and _fused_mode_enabled(
+                self.config.tpu_fused_learner) and (
+                self.config.monotone_constraints
+                and self.config.monotone_constraints_method == "advanced"):
+            log.warning("monotone_constraints_method=advanced is not "
+                        "available on the fused distributed learners; "
+                        "using 'intermediate'")
+            self.config.monotone_constraints_method = "intermediate"
         if tl == "data":
             # the fused whole-tree shard_map program is the production
             # multi-chip path (one psum per split, zero per-split host

@@ -180,3 +180,80 @@ def test_monotone_grid_sweep_all_methods(method, fused):
         base = rng.rand(3)
         assert _is_monotone(b, 0, +1, base), (method, fused)
         assert _is_monotone(b, 1, -1, base), (method, fused)
+
+
+def test_fused_intermediate_matches_host():
+    """monotone_constraints_method=intermediate now runs INSIDE the fused
+    whole-tree program (sibling-output child bounds + the vectorized
+    cross-leaf propagation + eager re-scans of tightened leaves) and must
+    reproduce the host learner's walk exactly (reference:
+    monotone_constraints.hpp:560-850 IntermediateLeafConstraints)."""
+    from lambdagap_tpu.models.fused_learner import FusedTreeLearner
+    rng = np.random.RandomState(0)
+    X = rng.randn(1500, 6)
+    y = (2 * X[:, 0] + np.sin(X[:, 1] * 2) + 0.5 * X[:, 2] * X[:, 0]
+         + 0.2 * rng.randn(1500))
+    base = {"objective": "regression", "num_leaves": 31, "verbose": -1,
+            "monotone_constraints": [1, 0, 0, 0, 0, 0],
+            "monotone_constraints_method": "intermediate",
+            "min_data_in_leaf": 5, "tpu_hist_impl": "onehot"}
+    bh = lgb.train({**base, "tpu_fused_learner": "0"},
+                   lgb.Dataset(X, label=y), num_boost_round=8)
+    bf = lgb.train({**base, "tpu_fused_learner": "1"},
+                   lgb.Dataset(X, label=y), num_boost_round=8)
+    assert isinstance(bf._booster.learner, FusedTreeLearner)
+    assert not isinstance(bh._booster.learner, FusedTreeLearner)
+    ph, pf = bh.predict(X), bf.predict(X)
+    close = np.isclose(ph, pf, rtol=5e-3, atol=5e-3)
+    assert close.mean() > 0.99, float(close.mean())
+
+
+def test_intermediate_distributed_and_voting():
+    """Intermediate monotone rides the fused distributed programs: the
+    data-parallel learner must build the same model on 1 and 8 shards
+    (the propagation state is replicated-by-construction), and the fused
+    voting learner's re-scan loop (collectives inside a while_loop with
+    replicated trip counts) must train a monotone model."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    rng = np.random.RandomState(1)
+    X = rng.randn(1600, 5)
+    y = 1.5 * X[:, 0] - X[:, 1] + 0.4 * rng.randn(1600)
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "monotone_constraints": [1, -1, 0, 0, 0],
+            "monotone_constraints_method": "intermediate",
+            "min_data_in_leaf": 10, "tree_learner": "data"}
+    b1 = lgb.train({**base, "tpu_num_devices": 1},
+                   lgb.Dataset(X, label=y), num_boost_round=5)
+    b8 = lgb.train({**base, "tpu_num_devices": 8},
+                   lgb.Dataset(X, label=y), num_boost_round=5)
+    close = np.isclose(b1.predict(X), b8.predict(X), rtol=5e-3, atol=5e-3)
+    assert close.mean() > 0.99, float(close.mean())
+    bv = lgb.train({**base, "tree_learner": "voting", "top_k": 3,
+                    "tpu_num_devices": 8},
+                   lgb.Dataset(X, label=y), num_boost_round=8)
+    base_row = np.full(5, 0.3)
+    assert _is_monotone(bv, 0, +1, base_row)
+    assert _is_monotone(bv, 1, -1, base_row)
+
+
+def test_advanced_demotions_are_loud_and_routed():
+    """advanced stays host-only on tree_learner=serial (warned demotion to
+    the host-driven learner) and demotes to in-program 'intermediate' on
+    the fused distributed learners (warned)."""
+    from lambdagap_tpu.models.fused_learner import FusedTreeLearner
+    from lambdagap_tpu.parallel.fused_parallel import \
+        FusedDataParallelTreeLearner
+    X, y = _data(n=1200)
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "monotone_constraints": [1, -1, 0],
+            "monotone_constraints_method": "advanced",
+            "min_data_in_leaf": 10}
+    b = lgb.train(dict(base), lgb.Dataset(X, label=y), num_boost_round=3)
+    assert not isinstance(b._booster.learner, FusedTreeLearner)
+    bd = lgb.train({**base, "tree_learner": "data", "tpu_num_devices": 2},
+                   lgb.Dataset(X, label=y), num_boost_round=3)
+    lrn = bd._booster.learner
+    assert isinstance(lrn, FusedDataParallelTreeLearner)
+    assert bd._booster.config.monotone_constraints_method == "intermediate"
