@@ -528,6 +528,73 @@ class FusedTreeLearner(SerialTreeLearner):
 
         voting = self.voting
         vote_k = int(getattr(self, "vote_k", 0)) if voting else 0
+        # feature-parallel mode: rows replicated, COLUMNS sharded over this
+        # axis; histograms need no collective at all — the per-split
+        # traffic is one all_gather of per-shard best-split tuples (the
+        # SyncUpGlobalBestSplit analog) plus a psum broadcast of the
+        # winning feature's column for the partition
+        # (reference: src/treelearner/feature_parallel_tree_learner.cpp)
+        fax = getattr(self, "feat_axis", None)
+
+        def best_of_feat(hist, pg, ph, pc, pout, lo, hi, depth, rkey, fm):
+            """Feature-sharded best split: local scan over this shard's
+            column block, then an all_gather of the D local winners and a
+            replicated argmax. Tie-break matches the serial argmax exactly
+            (first max in global feature order)."""
+            C_loc = hist.shape[0]
+            off = lax.axis_index(fax) * C_loc
+
+            def sl(arr):
+                return lax.dynamic_slice_in_dim(arr, off, C_loc, axis=0)
+
+            mono_l = sl(mono_arr)
+            cons = (mono_l, lo, hi) if mono_on else None
+            rand_t = None
+            if extra_on:
+                # replicated draw over the GLOBAL feature axis, sliced
+                # locally — identical to the serial learner's stream
+                rand_t = sl(jax.random.randint(rkey, (F,), 0, 1 << 30)
+                            % nb_m1)
+            gain, thr, dl, lg, lh, lc, bits = per_feature_best(
+                hist, pg, ph, pc, pout, sl(num_bins), sl(default_bins),
+                sl(missing_types), sl(is_cat_arr), sl(fm), p, has_cat,
+                constraints=cons, rand_thresholds=rand_t)
+            parent_gain = leaf_gain(pg, ph, p, pc, pout)
+            shift = parent_gain + p.min_gain_to_split
+            mult = sl(contri) if contri is not None else None
+            if mono_on and self.mono_penalty > 0:
+                from ..ops.split import monotone_split_penalty
+                mp = jnp.where(mono_l != 0,
+                               monotone_split_penalty(depth,
+                                                      self.mono_penalty),
+                               1.0)
+                mult = mp if mult is None else mult * mp
+            if mult is not None:
+                gain = jnp.where(jnp.isfinite(gain),
+                                 (gain - shift) * mult + shift, gain)
+            fl = jnp.argmax(gain, axis=0).astype(jnp.int32)
+            lout_l = calculate_leaf_output(lg[fl], lh[fl], p, lc[fl], pout)
+            rout_l = calculate_leaf_output(pg - lg[fl], ph - lh[fl], p,
+                                           pc - lc[fl], pout)
+            if mono_on:
+                lout_l = jnp.clip(lout_l, lo, hi)
+                rout_l = jnp.clip(rout_l, lo, hi)
+            fields = (gain[fl], off + fl, thr[fl],
+                      dl[fl].astype(jnp.int32),
+                      sl(is_cat_arr)[fl].astype(jnp.int32), bits[fl],
+                      lg[fl], lh[fl], lc[fl], lout_l, rout_l)
+            gathered = [lax.all_gather(x, fax) for x in fields]   # [D, ...]
+            win = jnp.argmax(gathered[0], axis=0).astype(jnp.int32)
+            gw = gathered[0][win]
+            g = gw - shift
+            ok = jnp.isfinite(gw) & (g > 0.0)
+            if max_depth > 0:
+                ok = ok & (depth < max_depth)
+            return (jnp.where(ok, g, K_MIN_SCORE), gathered[1][win],
+                    gathered[2][win], gathered[3][win].astype(bool),
+                    gathered[4][win].astype(bool), gathered[5][win],
+                    gathered[6][win], gathered[7][win], gathered[8][win],
+                    gathered[9][win], gathered[10][win])
 
         def best_of(hist, pg, ph, pc, pout, lo, hi, depth, rkey, fm):
             """Best split for one leaf, with the max_depth guard.
@@ -542,6 +609,9 @@ class FusedTreeLearner(SerialTreeLearner):
             split instead of O(F·B) — before one global scan whose results
             scatter back into full-F arrays so the downstream argmax/
             penalty/monotone code is identical in all modes."""
+            if fax is not None:
+                return best_of_feat(hist, pg, ph, pc, pout, lo, hi, depth,
+                                    rkey, fm)
             cons = (mono_arr, lo, hi) if mono_on else None
             rand_t = None
             if extra_on:
@@ -845,7 +915,22 @@ class FusedTreeLearner(SerialTreeLearner):
 
             begin = li[0]
             count_eff = jnp.where(ok, li[1], 0)
-            col = x_cols[self.bcol[feat] if bundled else feat]   # [N]
+            if fax is not None:
+                # the winning feature's column lives on ONE shard: psum
+                # broadcasts it for the (row-replicated) partition — the
+                # analog of the reference's best-split partition broadcast
+                # (feature_parallel_tree_learner.cpp SyncUp + split apply)
+                C_loc_p = x_cols.shape[0]
+                f_loc = feat - lax.axis_index(fax) * C_loc_p
+                owned = (f_loc >= 0) & (f_loc < C_loc_p)
+                col_l = x_cols[jnp.clip(f_loc, 0, C_loc_p - 1)]
+                # psum in the native bin dtype: exactly one shard is
+                # nonzero, so no overflow — and the wire moves 1-2 B per
+                # row instead of 4 (pbody casts to i32 as it reads)
+                col = lax.psum(
+                    jnp.where(owned, col_l, jnp.zeros_like(col_l)), fax)
+            else:
+                col = x_cols[self.bcol[feat] if bundled else feat]  # [N]
             nch = (count_eff + W - 1) // W
             perm_in = st["perm"]
 

@@ -41,6 +41,17 @@ def _fused_mode_enabled(mode) -> bool:
     return mode == "auto" or mode in ("1", "true", "on", "yes", True)
 
 
+def _demote_advanced_monotone(cfg, where: str) -> None:
+    """advanced needs per-threshold dense bound arrays rebuilt per affected
+    leaf (host-orchestrated only); basic and intermediate run in-program."""
+    if (cfg.monotone_constraints
+            and cfg.monotone_constraints_method == "advanced"):
+        log.warning("monotone_constraints_method=advanced is not available "
+                    "on %s; using 'intermediate' (basic and intermediate "
+                    "run in-program)", where)
+        cfg.monotone_constraints_method = "intermediate"
+
+
 def _cegb_requested(cfg) -> bool:
     """Any CEGB penalty configured — the learner-routing predicate
     (reference: src/treelearner/cost_effective_gradient_boosting.hpp)."""
@@ -152,6 +163,19 @@ class GBDT:
         if self.config.boosting == "rf":
             self.shrinkage_rate = 1.0
 
+    def _forced_splits_data_parallel(self, ds, tl: str):
+        """forcedsplits need a GLOBAL histogram of the forced leaf; voting
+        keeps histograms shard-local and feature-parallel shards them by
+        column — the full-histogram-psum learner honors the schedule."""
+        log.warning("forcedsplits_filename with tree_learner=%s: training "
+                    "with the fused data-parallel learner (full-histogram "
+                    "psum per split) so forced splits apply", tl)
+        if _cegb_requested(self.config):
+            log.warning("cegb is not applied by the fused data-parallel "
+                        "learner")
+        from ..parallel.fused_parallel import FusedDataParallelTreeLearner
+        return FusedDataParallelTreeLearner(ds, self.config)
+
     def _create_learner(self, ds: BinnedDataset):
         """Learner dispatch (reference: TreeLearner::CreateTreeLearner,
         src/treelearner/tree_learner.cpp — (tree_learner, device) -> class).
@@ -174,13 +198,8 @@ class GBDT:
                             "pre-partitioned training; training "
                             "constant-leaf trees")
                 cfg.linear_tree = False
-            if (cfg.monotone_constraints
-                    and cfg.monotone_constraints_method == "advanced"):
-                log.warning("monotone_constraints_method=advanced is not "
-                            "available on the fused data-parallel learner; "
-                            "using 'intermediate' (basic and intermediate "
-                            "run in-program)")
-                cfg.monotone_constraints_method = "intermediate"
+            _demote_advanced_monotone(
+                cfg, "the fused data-parallel learner")
             not_applied = []
             if _cegb_requested(cfg):
                 not_applied.append("cegb")
@@ -229,23 +248,19 @@ class GBDT:
                         "training constant-leaf trees", tl)
             self.config.linear_tree = False
         if self.config.interaction_constraints and not (
-                tl in ("data", "voting")
+                tl in ("data", "voting", "feature")
                 and _fused_mode_enabled(self.config.tpu_fused_learner)):
             # only the fused data-parallel program filters features by the
             # per-leaf path in-program; the host-loop distributed learners
             # do not, and silently dropping a constraint is worse than
             # failing
             log.fatal("interaction_constraints with tree_learner=%s require "
-                      "the fused learner (tree_learner=data + "
-                      "tpu_fused_learner=1) or tree_learner=serial", tl)
-        if tl in ("data", "voting") and _fused_mode_enabled(
-                self.config.tpu_fused_learner) and (
-                self.config.monotone_constraints
-                and self.config.monotone_constraints_method == "advanced"):
-            log.warning("monotone_constraints_method=advanced is not "
-                        "available on the fused distributed learners; "
-                        "using 'intermediate'")
-            self.config.monotone_constraints_method = "intermediate"
+                      "the fused learner (keep tpu_fused_learner enabled "
+                      "on data/voting/feature) or tree_learner=serial", tl)
+        if tl in ("data", "voting", "feature") and _fused_mode_enabled(
+                self.config.tpu_fused_learner):
+            _demote_advanced_monotone(self.config,
+                                      "the fused distributed learners")
         if tl == "data":
             # the fused whole-tree shard_map program is the production
             # multi-chip path (one psum per split, zero per-split host
@@ -276,20 +291,7 @@ class GBDT:
             # to the host-loop voting learner below
             cfg = self.config
             if cfg.forcedsplits_filename:
-                # forced gathers need a GLOBAL histogram of the forced leaf,
-                # which voting never materializes — the full-histogram-psum
-                # learner honors the schedule at the cost of voting's
-                # bandwidth cap
-                log.warning("forcedsplits_filename with tree_learner=voting: "
-                            "training with the fused data-parallel learner "
-                            "(full-histogram psum per split) so forced "
-                            "splits apply")
-                if _cegb_requested(cfg):
-                    log.warning("cegb is not applied by the fused "
-                                "data-parallel learner")
-                from ..parallel.fused_parallel import \
-                    FusedDataParallelTreeLearner
-                return FusedDataParallelTreeLearner(ds, self.config)
+                return self._forced_splits_data_parallel(ds, tl)
             host_only = []
             if _cegb_requested(cfg):
                 host_only.append("cegb")
@@ -307,6 +309,16 @@ class GBDT:
                 from ..parallel.fused_parallel import \
                     FusedVotingParallelTreeLearner
                 return FusedVotingParallelTreeLearner(ds, self.config)
+        if tl == "feature" and _fused_mode_enabled(
+                self.config.tpu_fused_learner):
+            cfg = self.config
+            if cfg.forcedsplits_filename:
+                return self._forced_splits_data_parallel(ds, tl)
+            if _cegb_requested(cfg):
+                log.warning("cegb is not applied by tree_learner=feature")
+            from ..parallel.fused_parallel import \
+                FusedFeatureParallelTreeLearner
+            return FusedFeatureParallelTreeLearner(ds, self.config)
         from ..parallel import (DataParallelTreeLearner,
                                 FeatureParallelTreeLearner,
                                 VotingParallelTreeLearner)

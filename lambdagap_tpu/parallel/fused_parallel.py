@@ -266,6 +266,103 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
         return rec
 
 
+class FusedFeatureParallelTreeLearner(FusedTreeLearner):
+    """Feature-parallel as ONE compiled whole-tree program (reference:
+    src/treelearner/feature_parallel_tree_learner.cpp — every rank holds
+    all rows, features are partitioned for histogram work, local best
+    splits merge via SyncUpGlobalBestSplit, parallel_tree_learner.h:209):
+    rows stay replicated, the binned matrix is sharded along the COLUMN
+    axis, histograms and scans are shard-local, and the only per-split
+    traffic is one all_gather of the D per-shard best-split tuples plus a
+    psum broadcast of the winning feature's column for the partition —
+    zero per-split host syncs (the host-loop variant in
+    feature_parallel.py pays a D2H per split; this one does not)."""
+
+    def __init__(self, dataset: BinnedDataset, config: Config,
+                 mesh: Optional[Mesh] = None) -> None:
+        from ..utils import log
+        if config.enable_bundle:
+            # EFB bundles are columns; feature ownership under a bundled
+            # shard would decouple from feature ids. Keep ownership trivial
+            # (feat // C_loc) — the config copy avoids mutating the caller
+            import copy
+            config = copy.copy(config)
+            config.enable_bundle = False
+            log.info("EFB bundling is disabled under the fused "
+                     "feature-parallel learner (column ownership must "
+                     "follow feature ids)")
+        self.mesh = mesh if mesh is not None else make_mesh(
+            config.tpu_num_devices)
+        self.n_dev = int(self.mesh.devices.size)
+        super().__init__(dataset, config)
+        if self.forced_seq is not None:
+            # unreachable via the factory (gbdt._create_learner routes
+            # forced-splits configs to the fused data-parallel learner)
+            log.fatal("forced splits are not supported by the fused "
+                      "feature-parallel learner; use tree_learner=data")
+        self.feat_axis = DATA_AXIS
+        # pad the per-feature meta arrays to the sharded width so the
+        # per-shard dynamic slices stay in range; padded features can
+        # never win (fmask False, 2-bin histograms of zeros)
+        Fp = self._Fp
+        pad = Fp - self.num_features
+        if pad:
+            self._real_F = self.num_features
+            self.num_features = Fp
+            z = lambda a, v: jnp.concatenate(
+                [a, jnp.full((pad,), v, a.dtype)])
+            self.num_bins_arr = z(self.num_bins_arr, 2)
+            self.default_bins_arr = z(self.default_bins_arr, 0)
+            self.missing_types_arr = z(self.missing_types_arr, 0)
+            self.is_categorical_arr = z(self.is_categorical_arr, False)
+            self.mono_arr = z(self.mono_arr, 0)
+            self.nb_minus1_arr = z(self.nb_minus1_arr, 1)
+            if self.contri_arr is not None:
+                self.contri_arr = z(self.contri_arr, 1.0)
+        else:
+            self._real_F = self.num_features
+
+        def sharded(grad, hess, mask, fmask, xr, xc, gq, hq, gs, hs, ekey,
+                    *, has_mask):
+            body = functools.partial(self._train_tree_impl,
+                                     has_mask=has_mask)
+            return shard_map(
+                body, mesh=self.mesh,
+                in_specs=(P(), P(), P(), P(), P(None, DATA_AXIS),
+                          P(DATA_AXIS, None), P(), P(), P(), P(), P()),
+                out_specs=DeviceTree(*([P()] * len(DeviceTree._fields))),
+                check_vma=False)(grad, hess, mask, fmask, xr, xc, gq, hq,
+                                 gs, hs, ekey)
+
+        self._train_jit = jax.jit(sharded, static_argnames=("has_mask",))
+
+    def _place_binned(self, hx: np.ndarray) -> None:
+        C = hx.shape[1]
+        pad = (-C) % self.n_dev
+        if pad:
+            hx = np.pad(hx, ((0, 0), (0, pad)))
+        self._Fp = C + pad
+        self.hx_rows = jax.device_put(
+            jnp.asarray(hx), NamedSharding(self.mesh, P(None, DATA_AXIS)))
+        self.x_cols = jax.device_put(
+            jnp.asarray(np.ascontiguousarray(hx.T)),
+            NamedSharding(self.mesh, P(DATA_AXIS, None)))
+
+    def _feature_mask(self) -> jax.Array:
+        # sample over the REAL features only (num_features is the padded
+        # program width), then pad False so pad columns can never win
+        saved = self.num_features
+        self.num_features = self._real_F
+        try:
+            m = super()._feature_mask()
+        finally:
+            self.num_features = saved
+        pad = self.num_features - m.shape[0]
+        if pad > 0:
+            m = jnp.concatenate([m, jnp.zeros(pad, dtype=bool)])
+        return m
+
+
 class FusedVotingParallelTreeLearner(FusedDataParallelTreeLearner):
     """Voting-parallel as ONE compiled whole-tree program (reference:
     src/treelearner/voting_parallel_tree_learner.cpp — GlobalVoting :151-175

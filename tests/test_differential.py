@@ -131,3 +131,149 @@ def test_dp_1dev_vs_8dev_random_config(seed, tmp_path):
     assert close.mean() > 0.99, (params, float(close.mean()))
     np.testing.assert_allclose(np.mean(p1), np.mean(p8),
                                rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_feature_parallel_vs_serial_random_config(seed):
+    """Random-config differential for the fused FEATURE-parallel program:
+    rows are replicated so the column-sharded scan must reproduce the
+    fused serial learner exactly (same arithmetic, same global-feature
+    tie-break) across quantized/monotone/bagging/GOSS/EFB draws."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    rng = np.random.RandomState(3000 + seed)
+    X, y, w, params = _random_case(rng, None, for_dp=True)
+    if params.get("monotone_constraints_method") == "advanced":
+        # advanced demotes to intermediate on distributed learners; pin
+        # both sides to the same method
+        params["monotone_constraints_method"] = "intermediate"
+    rounds = 4
+    b_s = lgb.train({**params, "tpu_fused_learner": "1"},
+                    lgb.Dataset(X, label=y, weight=w),
+                    num_boost_round=rounds)
+    b_f = lgb.train({**params, "tree_learner": "feature",
+                     "tpu_num_devices": 8},
+                    lgb.Dataset(X, label=y, weight=w),
+                    num_boost_round=rounds)
+    from lambdagap_tpu.parallel.fused_parallel import \
+        FusedFeatureParallelTreeLearner
+    assert isinstance(b_f._booster.learner, FusedFeatureParallelTreeLearner)
+    close = np.isclose(b_s.predict(X), b_f.predict(X), rtol=5e-3, atol=5e-3)
+    assert close.mean() > 0.99, (params, float(close.mean()))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_voting_fused_vs_host_loop_random_config(seed):
+    """Random-config differential for the fused VOTING program against the
+    host-loop voting learner — same algorithm (local top-k vote, voted
+    column psum), fused vs per-split-host-sync execution."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    rng = np.random.RandomState(4000 + seed)
+    X, y, w, params = _random_case(rng, None)
+    # host-loop voting applies monotone per-split only, no quantized path,
+    # and linear trees route to host on both sides — keep the comparison
+    # on the shared algorithm space
+    for k in ("monotone_constraints", "monotone_constraints_method",
+              "linear_tree", "use_quantized_grad"):
+        params.pop(k, None)
+    params.update(tree_learner="voting", tpu_num_devices=8,
+                  top_k=int(rng.choice([3, 8])))
+    rounds = 4
+    b_f = lgb.train({**params, "tpu_fused_learner": "1"},
+                    lgb.Dataset(X, label=y, weight=w),
+                    num_boost_round=rounds)
+    b_h = lgb.train({**params, "tpu_fused_learner": "0"},
+                    lgb.Dataset(X, label=y, weight=w),
+                    num_boost_round=rounds)
+    close = np.isclose(b_f.predict(X), b_h.predict(X), rtol=5e-3, atol=5e-3)
+    assert close.mean() > 0.99, (params, float(close.mean()))
+
+
+_CHILD_FUZZ = r"""
+import json, os, sys
+import numpy as np
+sys.path.insert(0, os.getcwd())
+import jax
+
+rank = int(sys.argv[1]); port = sys.argv[2]; workdir = sys.argv[3]
+jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=2, process_id=rank)
+from lambdagap_tpu.config import Config
+from lambdagap_tpu.parallel.multiprocess import load_pre_partitioned
+from lambdagap_tpu.models.dart import create_boosting
+
+params = json.load(open(os.path.join(workdir, "params.json")))
+cfg = Config.from_params({**params, "pre_partition": True,
+                          "num_machines": 2,
+                          "bin_construct_sample_cnt": 4000})
+ds = load_pre_partitioned(os.path.join(workdir, f"part{rank}.tsv"), cfg)
+g = create_boosting(cfg, ds)
+for _ in range(4):
+    g.train_one_iter()
+with open(os.path.join(workdir, f"model{rank}.txt"), "w") as f:
+    f.write(g.save_model_to_string())
+print(f"RANK{rank}_OK")
+"""
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_pre_partitioned_random_config(seed, tmp_path):
+    """Random-config differential for the 2-process pre-partitioned path:
+    both ranks must build byte-identical models under random bagging/GOSS/
+    quantized/num_leaves draws (any rank-divergent reduction shows up as a
+    model mismatch)."""
+    import socket
+    import subprocess
+    import sys as _sys
+    rng = np.random.RandomState(5000 + seed)
+    n = 1600
+    X = rng.randn(n, 6)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "tree_learner": "data",
+              "num_leaves": int(rng.choice([7, 15, 31])),
+              "min_data_in_leaf": int(rng.choice([3, 20])),
+              "verbose": -1}
+    r = rng.rand()
+    if r < 0.33:
+        params.update(bagging_fraction=0.7, bagging_freq=1)
+    elif r < 0.66:
+        params.update(data_sample_strategy="goss", top_rate=0.3,
+                      other_rate=0.2)
+    if rng.rand() < 0.5:
+        params.update(use_quantized_grad=True, stochastic_rounding=False)
+    full = np.column_stack([y, X])
+    np.savetxt(tmp_path / "part0.tsv", full[:800], delimiter="\t")
+    np.savetxt(tmp_path / "part1.tsv", full[800:], delimiter="\t")
+    with open(tmp_path / "params.json", "w") as f:
+        json.dump(params, f)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = str(s.getsockname()[1])
+    script = tmp_path / "child_fuzz.py"
+    script.write_text(_CHILD_FUZZ)
+    env = {k: v for k, v in os.environ.items()
+           if "AXON" not in k and k != "PYTHONPATH"}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    procs = [subprocess.Popen(
+        [_sys.executable, str(script), str(r2), port, str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=os.getcwd(), env=env) for r2 in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("pre-partitioned fuzz timed out")
+        outs.append(out)
+    for r2, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (params, f"rank {r2}:\n{out[-3000:]}")
+        assert f"RANK{r2}_OK" in out
+    m0 = (tmp_path / "model0.txt").read_text()
+    m1 = (tmp_path / "model1.txt").read_text()
+    assert m0 == m1, params

@@ -104,11 +104,83 @@ def test_quantized_distributed_reduction_is_exact():
 
 
 def test_feature_parallel_matches_serial():
+    """tree_learner=feature defaults to the FUSED whole-tree program with
+    the COLUMN axis sharded: histograms/scans are shard-local and the only
+    per-split traffic is the all_gather of per-shard best splits (the
+    SyncUpGlobalBestSplit analog) + the winning column's psum broadcast.
+    Must match the serial learner exactly (same scan, same tie-break)."""
+    from lambdagap_tpu.parallel.fused_parallel import \
+        FusedFeatureParallelTreeLearner
     X, y = _data(seed=1)
     b_serial = _train(X, y, "serial", 1)
-    b_feat = _train(X, y, "feature", min(4, len(jax.devices())))
+    b_feat = _train(X, y, "feature", min(NEED, len(jax.devices())))
+    assert isinstance(b_feat._booster.learner,
+                      FusedFeatureParallelTreeLearner)
     np.testing.assert_allclose(b_serial.predict(X), b_feat.predict(X),
                                rtol=1e-3, atol=1e-4)
+
+
+def test_host_loop_feature_parallel_opt_out():
+    from lambdagap_tpu.parallel import FeatureParallelTreeLearner
+    from lambdagap_tpu.parallel.fused_parallel import \
+        FusedFeatureParallelTreeLearner
+    X, y = _data(seed=1)
+    b = _train(X, y, "feature", min(4, len(jax.devices())),
+               extra={"tpu_fused_learner": "0"})
+    lrn = b._booster.learner
+    assert isinstance(lrn, FeatureParallelTreeLearner)
+    assert not isinstance(lrn, FusedFeatureParallelTreeLearner)
+    b_serial = _train(X, y, "serial", 1)
+    np.testing.assert_allclose(b_serial.predict(X), b.predict(X),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_fused_feature_parallel_option_combos():
+    """Quantized grads, monotone intermediate, extra_trees, bagging and
+    interaction constraints all ride the feature-sharded program and match
+    the fused serial learner (replicated rows -> identical arithmetic; the
+    global-feature-order tie-break is preserved by the winner gather)."""
+    X, y = _data(seed=21)
+    nd = min(NEED, len(jax.devices()))
+    combos = [
+        {"use_quantized_grad": True},
+        {"monotone_constraints": [1] + [0] * 11,
+         "monotone_constraints_method": "intermediate"},
+        {"extra_trees": True},
+        {"bagging_fraction": 0.7, "bagging_freq": 1},
+        {"interaction_constraints": [[0, 1, 2, 3],
+                                     [4, 5, 6, 7, 8, 9, 10, 11]]},
+    ]
+    for extra in combos:
+        b_f = _train(X, y, "feature", nd, rounds=5, extra=extra)
+        b_s = _train(X, y, "serial", 1, rounds=5,
+                     extra={**extra, "tpu_fused_learner": "1"})
+        close = np.isclose(b_f.predict(X), b_s.predict(X),
+                           rtol=5e-3, atol=5e-3)
+        assert close.mean() > 0.99, (extra, float(close.mean()))
+
+
+def test_feature_forced_splits_route_to_data_parallel():
+    import json
+    import os
+    import tempfile
+    from lambdagap_tpu.parallel.fused_parallel import (
+        FusedDataParallelTreeLearner, FusedFeatureParallelTreeLearner)
+    X, y = _data(seed=22)
+    forced = {"feature": 2, "threshold": float(np.median(X[:, 2]))}
+    fd, path = tempfile.mkstemp(suffix=".json")
+    with os.fdopen(fd, "w") as f:
+        json.dump(forced, f)
+    try:
+        b = _train(X, y, "feature", min(NEED, len(jax.devices())), rounds=3,
+                   extra={"forcedsplits_filename": path})
+        lrn = b._booster.learner
+        assert isinstance(lrn, FusedDataParallelTreeLearner)
+        assert not isinstance(lrn, FusedFeatureParallelTreeLearner)
+        root = b.dump_model()["tree_info"][0]["tree_structure"]
+        assert root["split_feature"] == 2
+    finally:
+        os.unlink(path)
 
 
 def test_voting_parallel_learns():
