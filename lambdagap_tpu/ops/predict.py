@@ -19,6 +19,7 @@ iterating inlined trees, reference: src/boosting/gbdt_prediction.cpp).
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, NamedTuple, Optional, Tuple
 
 import jax
@@ -227,11 +228,52 @@ def predict_leaf_index_binned(x_binned: jax.Array, t: TreeArrays,
 @functools.partial(jax.jit,
                    static_argnames=("num_class", "max_depth", "binned",
                                     "early_stop_freq"))
+def _predict_forest_block(x: jax.Array, forest: TreeArrays,
+                          tree_class: jax.Array, carry,
+                          num_class: int, max_depth: int, binned: bool,
+                          early_stop_freq: int = 0,
+                          early_stop_margin: float = 0.0):
+    """One bounded block of trees, threading the (out, stopped, i) carry."""
+    if early_stop_freq <= 0:
+        out, stopped, i = carry
+
+        def step(o, tk):
+            t, k = tk
+            vals = t.leaf_value[_traverse_leaf_id(x, t, max_depth, binned)]
+            return o.at[k].add(vals), None
+
+        out, _ = lax.scan(step, out, (forest, tree_class))
+        return out, stopped, i
+
+    def margin_of(out):
+        if num_class == 1:
+            # reference binary margin is 2*|raw score|
+            # (src/boosting/prediction_early_stop.cpp)
+            return 2.0 * jnp.abs(out[0])
+        top2 = lax.top_k(out.T, 2)[0]          # [N, 2]
+        return top2[:, 0] - top2[:, 1]
+
+    def step(c, tk):
+        out, stopped, i = c
+        t, k = tk
+        vals = t.leaf_value[_traverse_leaf_id(x, t, max_depth, binned)]
+        out = out.at[k].add(jnp.where(stopped, 0.0, vals))
+        i = i + 1
+        check = (i % early_stop_freq) == 0
+        stopped = jnp.where(check, stopped | (margin_of(out)
+                                              > early_stop_margin), stopped)
+        return (out, stopped, i), None
+
+    (out, stopped, i), _ = lax.scan(step, carry, (forest, tree_class))
+    return out, stopped, i
+
+
 def predict_forest(x: jax.Array, forest: TreeArrays, tree_class: jax.Array,
                    num_class: int, max_depth: int, binned: bool,
                    early_stop_freq: int = 0,
-                   early_stop_margin: float = 0.0) -> jax.Array:
-    """Sum a whole forest's leaf values into per-class scores in one dispatch.
+                   early_stop_margin: float = 0.0,
+                   tree_block: Optional[int] = None) -> jax.Array:
+    """Sum a whole forest's leaf values into per-class scores.
 
     x: [N, D] raw floats (binned=False) or [N, F] binned (binned=True).
     forest: TreeArrays stacked along a leading T axis (forest_to_arrays).
@@ -246,52 +288,83 @@ def predict_forest(x: jax.Array, forest: TreeArrays, tree_class: jax.Array,
     A ``lax.scan`` over trees keeps peak memory at O(N) instead of the
     O(T·N) a tree-vmapped traversal would materialize — the device analog
     of GBDT::Predict accumulating over inlined trees
-    (reference: src/boosting/gbdt_prediction.cpp).
-    """
+    (reference: src/boosting/gbdt_prediction.cpp, cuda_tree.cu:459).
+
+    The scan is dispatched in bounded blocks of ``tree_block`` trees
+    (default ``LAMBDAGAP_PREDICT_TREE_BLOCK`` or 64) with the accumulator
+    carried between dispatches: no single kernel grows with the forest, so
+    a 500+ tree forest never exceeds what the device (or a tunneled
+    worker) tolerates, at the cost of T/block dispatches. Forests at most
+    one block long compile to the identical single kernel as before."""
     N = x.shape[0]
-
-    if early_stop_freq <= 0:
-        def step(out, tk):
-            t, k = tk
-            vals = t.leaf_value[_traverse_leaf_id(x, t, max_depth, binned)]
-            return out.at[k].add(vals), None
-
-        out, _ = lax.scan(step, jnp.zeros((num_class, N), jnp.float32),
-                          (forest, tree_class))
-        return out
-
-    def margin_of(out):
-        if num_class == 1:
-            # reference binary margin is 2*|raw score|
-            # (src/boosting/prediction_early_stop.cpp)
-            return 2.0 * jnp.abs(out[0])
-        top2 = lax.top_k(out.T, 2)[0]          # [N, 2]
-        return top2[:, 0] - top2[:, 1]
-
-    def step(carry, tk):
-        out, stopped, i = carry
-        t, k = tk
-        vals = t.leaf_value[_traverse_leaf_id(x, t, max_depth, binned)]
-        out = out.at[k].add(jnp.where(stopped, 0.0, vals))
-        i = i + 1
-        check = (i % early_stop_freq) == 0
-        stopped = jnp.where(check, stopped | (margin_of(out)
-                                              > early_stop_margin), stopped)
-        return (out, stopped, i), None
-
+    T = tree_class.shape[0]
+    if tree_block is None:
+        tree_block = int(os.environ.get("LAMBDAGAP_PREDICT_TREE_BLOCK", 64))
     init = (jnp.zeros((num_class, N), jnp.float32),
             jnp.zeros(N, dtype=bool), jnp.int32(0))
-    (out, _, _), _ = lax.scan(step, init, (forest, tree_class))
-    return out
+    if tree_block <= 0 or T <= tree_block:
+        out, _, _ = _predict_forest_block(
+            x, forest, tree_class, init, num_class, max_depth, binned,
+            early_stop_freq, early_stop_margin)
+        return out
+    carry = init
+    for b in range(0, T, tree_block):
+        blk, tc = _forest_block(forest, tree_class, b, tree_block, T)
+        carry = _predict_forest_block(
+            x, blk, tc, carry, num_class, max_depth, binned,
+            early_stop_freq, early_stop_margin)
+    return carry[0]
+
+
+def _forest_block(forest: TreeArrays, tree_class: jax.Array, b: int,
+                  tree_block: int, T: int):
+    """Trees [b, b+tree_block) of the stacked forest; only the TAIL block
+    pads, with no-op trees (all-zero arrays: the bounded traversal lands on
+    ``leaf_value[-1] == 0``, adding nothing — and pads sit strictly after
+    every real tree, so early-stop margins are unaffected)."""
+    hi = min(b + tree_block, T)
+    pad = tree_block - (hi - b)
+
+    def cut(a):
+        blk = lax.slice_in_dim(a, b, hi)
+        if pad:
+            blk = jnp.concatenate(
+                [blk, jnp.zeros((pad,) + a.shape[1:], a.dtype)])
+        return blk
+
+    return (jax.tree_util.tree_map(cut, forest),
+            cut(tree_class))
 
 
 @functools.partial(jax.jit, static_argnames=("max_depth", "binned"))
-def predict_forest_leaf(x: jax.Array, forest: TreeArrays,
-                        max_depth: int, binned: bool) -> jax.Array:
-    """Leaf index per (tree, row) for a whole forest: [T, N] int32."""
-
+def _predict_forest_leaf_block(x: jax.Array, forest: TreeArrays,
+                               max_depth: int, binned: bool) -> jax.Array:
     def step(_, t):
         return None, _traverse_leaf_id(x, t, max_depth, binned)
 
     _, ys = lax.scan(step, None, forest)
     return ys
+
+
+def predict_forest_leaf(x: jax.Array, forest: TreeArrays,
+                        max_depth: int, binned: bool,
+                        tree_block: Optional[int] = None) -> jax.Array:
+    """Leaf index per (tree, row) for a whole forest: [T, N] int32.
+
+    Dispatched in the same bounded tree blocks as :func:`predict_forest`
+    (refit / linear-tree replay / pred_leaf hit this path with full-size
+    forests, where a single T-long scan kernel can fault a tunneled
+    worker just like the score scan)."""
+    T = forest.leaf_value.shape[0]
+    if tree_block is None:
+        tree_block = int(os.environ.get("LAMBDAGAP_PREDICT_TREE_BLOCK", 64))
+    if tree_block <= 0 or T <= tree_block:
+        return _predict_forest_leaf_block(x, forest, max_depth, binned)
+    outs = []
+    dummy_tc = jnp.zeros(T, jnp.int32)
+    for b in range(0, T, tree_block):
+        blk, _ = _forest_block(forest, dummy_tc, b, tree_block, T)
+        ys = _predict_forest_leaf_block(x, blk, max_depth, binned)
+        hi = min(b + tree_block, T)
+        outs.append(ys[:hi - b])
+    return jnp.concatenate(outs, axis=0)

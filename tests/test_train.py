@@ -219,3 +219,42 @@ def test_cv_runs():
     assert "valid auc-mean" in res
     assert len(res["valid auc-mean"]) == 10
     assert res["valid auc-mean"][-1] > 0.85
+
+
+def test_forest_predict_tree_blocks():
+    """The device forest scan dispatches in bounded tree blocks with the
+    accumulator carried between kernels (no kernel grows with T — the fix
+    for 500-tree forests faulting a tunneled chip worker); results are
+    bit-comparable to the single-dispatch scan for plain, early-stop, and
+    padding (odd block) configurations."""
+    import jax.numpy as jnp
+    from lambdagap_tpu.ops.predict import forest_to_arrays, predict_forest
+    rng = np.random.RandomState(0)
+    X = rng.randn(1200, 8)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(float)
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                   "min_data_in_leaf": 5}, lgb.Dataset(X, label=y),
+                  num_boost_round=150)
+    forest, depth = forest_to_arrays(b._booster.host_models)
+    tc = jnp.zeros(150, jnp.int32)
+    xd = jnp.asarray(X[:256])
+    single = np.asarray(predict_forest(xd, forest, tc, 1, depth, False,
+                                       tree_block=10**9))
+    for kw in ({"tree_block": 64}, {"tree_block": 37},
+               {"tree_block": 64, "early_stop_freq": 10,
+                "early_stop_margin": 3.0}):
+        want = single
+        if "early_stop_freq" in kw:
+            want = np.asarray(predict_forest(
+                xd, forest, tc, 1, depth, False, tree_block=10**9,
+                early_stop_freq=10, early_stop_margin=3.0))
+        got = np.asarray(predict_forest(xd, forest, tc, 1, depth, False,
+                                        **kw))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    # leaf-index prediction blocks the same way (refit/linear replay path)
+    from lambdagap_tpu.ops.predict import predict_forest_leaf
+    leaf_single = np.asarray(predict_forest_leaf(xd, forest, depth, False,
+                                                 tree_block=10**9))
+    leaf_blocked = np.asarray(predict_forest_leaf(xd, forest, depth, False,
+                                                  tree_block=37))
+    np.testing.assert_array_equal(leaf_single, leaf_blocked)
