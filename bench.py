@@ -29,6 +29,13 @@ iterations, default 30), BENCH_MAX_BIN (default 255), BENCH_ATTEMPT_TIMEOUT
 (seconds per attempt, default 2400), BENCH_HOLDOUT (AUC holdout rows,
 default 200k), BENCH_FULL_ROWS (full-500-run size, default 1M; 0 skips),
 BENCH_MICRO=0 skips the microbench.
+
+Real data: BENCH_DATA_HIGGS=<path to HIGGS csv> / BENCH_DATA_MSLR=<path to
+a LETOR qid LibSVM file> train on the real datasets (parsed by the native
+loader) so the accuracy fields compare against the published bars
+(AUC 0.845724, NDCG@10 0.5278). Without them every accuracy field is
+stamped "synthetic": true — synthetic AUC/NDCG are NOT comparable to the
+bars.
 """
 from __future__ import annotations
 
@@ -138,6 +145,45 @@ def _configure_jax_cache() -> None:
         pass
 
 
+def _load_higgs_real(path: str):
+    """BENCH_DATA_HIGGS hook: parse the real HIGGS CSV (label first,
+    28 features, no header; reference setup docs/Experiments.rst:111-124
+    holds out the last 500k rows) through the native parser."""
+    from lambdagap_tpu.config import Config
+    from lambdagap_tpu.data.loader import _parse_text_file
+    X, y, _, _, _ = _parse_text_file(path, Config.from_params(
+        {"header": False, "label_column": 0, "verbose": -1}))
+    holdout = min(500_000, len(X) // 10)
+    n = len(X) - holdout
+    return (np.ascontiguousarray(X[:n], np.float32), y[:n].astype(np.float32),
+            np.ascontiguousarray(X[n:], np.float32), y[n:].astype(np.float32))
+
+
+def _predict_crossover(booster, Xv_np, n_big, t_dev_big, native_per_row):
+    """Two-point linear model of the warm device predict: measure a second
+    (quarter-size) batch, split t = overhead + slope*rows, and solve for
+    where the native line crosses. A single-point t/rate estimate answers
+    the wrong question (it sets the threshold where native equals the
+    FULL-batch device time) and can overstate the crossover ~10x."""
+    import time as _t
+    n_small = max(n_big // 4, 1)
+    t0 = _t.time()
+    booster.predict(Xv_np[:n_small])
+    t_small = _t.time() - t0
+    if n_big == n_small:
+        return {"crossover_rows_est": None}
+    slope = max((t_dev_big - t_small) / (n_big - n_small), 0.0)
+    overhead = max(t_small - slope * n_small, 0.0)
+    if native_per_row <= slope:
+        return {"crossover_rows_est": None,     # native wins at any size
+                "device_overhead_s": round(overhead, 4),
+                "device_slope_us_per_row": round(slope * 1e6, 2)}
+    return {"crossover_rows_est": int(overhead
+                                      / (native_per_row - slope)),
+            "device_overhead_s": round(overhead, 4),
+            "device_slope_us_per_row": round(slope * 1e6, 2)}
+
+
 def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
     """Child-process entry: train + measure, print one JSON line."""
     _configure_jax_cache()
@@ -145,10 +191,17 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
     import lambdagap_tpu as lgb
 
     t_gen0 = time.time()
-    z = np.load(_data_cache_path(rows))
-    X_all, y_all = z["X"], z["y"]          # one read each (npz ignores mmap)
-    X, y = X_all[:rows], y_all[:rows]
-    Xv, yv = X_all[rows:], y_all[rows:]
+    higgs_path = os.environ.get("BENCH_DATA_HIGGS")
+    if higgs_path:
+        X, y, Xv, yv = _load_higgs_real(higgs_path)
+        rows = len(X)
+        synthetic = False
+    else:
+        z = np.load(_data_cache_path(rows))
+        X_all, y_all = z["X"], z["y"]      # one read each (npz ignores mmap)
+        X, y = X_all[:rows], y_all[:rows]
+        Xv, yv = X_all[rows:], y_all[rows:]
+        synthetic = True
     t_gen = time.time() - t_gen0
 
     if max_bin is None:
@@ -187,6 +240,47 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
     auc = auc_score(np.asarray(yv), pred)
     t_pred = time.time() - t3
 
+    # EXACT per-iteration work counts from the trained trees (the round-4
+    # roofline modeled rows*log2(L)*1.35 row-visits; the smaller-child +
+    # subtraction trick makes the real count much lower and tree-shape
+    # dependent, so the model must read it off the trees):
+    #   hist visits  = N (root) + sum over splits of min(child rows)
+    #   part visits  = sum over splits of parent rows
+    # Window padding rounds each pass up to the learner's chunk W.
+    # Fused program only — the serial-fallback attempts run a different
+    # cost model, so modeling them with these counts would mislead.
+    visit_counts = None
+    if fused and hasattr(booster._booster.learner, "chunk"):
+        W = booster._booster.learner.chunk
+        trees = booster._booster.host_models[-min(10, ITERS_MEASURED):]
+        vh = vp = vhp = vpp = 0.0
+        for t in trees:
+            vh_t = float(rows)
+            vhp_t = float(-(-rows // W) * W)
+            vp_t = vpp_t = 0.0
+            for k in range(t.num_internal):
+                lc, rc = t.left_child[k], t.right_child[k]
+                lcnt = (t.internal_count[lc] if lc >= 0
+                        else int(t.leaf_count[~lc]))
+                rcnt = (t.internal_count[rc] if rc >= 0
+                        else int(t.leaf_count[~rc]))
+                small = min(lcnt, rcnt)
+                parent = t.internal_count[k]
+                vh_t += small
+                vp_t += parent
+                vhp_t += -(-small // W) * W
+                vpp_t += -(-parent // W) * W
+            vh += vh_t; vp += vp_t; vhp += vhp_t; vpp += vpp_t
+        nt = max(len(trees), 1)
+        visit_counts = {
+            "hist_rows_per_iter": int(vh / nt),
+            "hist_rows_padded_per_iter": int(vhp / nt),
+            "part_rows_per_iter": int(vp / nt),
+            "part_rows_padded_per_iter": int(vpp / nt),
+            "chunk_window": int(W),
+            "trees_sampled": nt,
+        }
+
     # predict path A/B: the threaded native traverser (fastpred.cpp, the
     # route for batches <= tpu_fast_predict_rows) vs the jitted device
     # forest, measured on the SAME rows — cold (with compile) and warm.
@@ -202,19 +296,16 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
     booster.predict(Xv_np)               # second big call: warm device path
     t_dev_warm = time.time() - tw
     native_per_row = t_native_8k / 8192
-    dev_per_row_warm = t_dev_warm / max(len(yv), 1)
     predict_ab = {
         "native_512rows_s": round(t_native_512, 4),
         "native_8192rows_s": round(t_native_8k, 4),
         "device_%drows_cold_s" % len(yv): round(t_pred, 4),
         "device_%drows_warm_s" % len(yv): round(t_dev_warm, 4),
         "native_us_per_row": round(native_per_row * 1e6, 2),
-        "device_us_per_row_warm": round(dev_per_row_warm * 1e6, 2),
-        # rows where warm device time equals the native rate (device wins
-        # above; None when native wins at every measured size)
-        "crossover_rows_est": (int(t_dev_warm / native_per_row)
-                               if dev_per_row_warm < native_per_row
-                               else None),
+        "device_us_per_row_warm": round(t_dev_warm / max(len(yv), 1) * 1e6,
+                                        2),
+        **_predict_crossover(booster, Xv_np, len(yv), t_dev_warm,
+                             native_per_row),
     }
 
     projected = t_construct + t_warm + per_iter * (ITERS_TOTAL - 2)
@@ -228,9 +319,15 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
         "iters_measured": ITERS_MEASURED,
         "projected_500iter_s": round(projected, 3),
         "holdout_auc": round(float(auc), 5),
+        # a synthetic holdout AUC is NOT comparable to the published HIGGS
+        # bar 0.845724 (docs/Experiments.rst:134) — only a real-data run
+        # (BENCH_DATA_HIGGS) is
+        "synthetic": synthetic,
+        "data": higgs_path or "higgs_like synthetic",
         "holdout_rows": len(yv),
         "predict_s": round(t_pred, 3),
         "predict_ab": predict_ab,
+        "visit_counts": visit_counts,
         "dataload_s": round(t_gen, 3),
     }))
 
@@ -298,6 +395,41 @@ def run_microbench() -> None:
         float(gather(xg, perm))
         best_g = max(best_g, (2 * 68.0 * mg) / (time.time() - t0) / 1e9)
     out["hbm_gather_gbps"] = round(best_g, 3)
+
+    # granule-matched gather profiles: random-row gather RATE (million
+    # rows/s) for each payload the training program actually fetches —
+    # 1 B partition column reads, 4 B u32 lanes, 8 B grad/hess pairs,
+    # 32 B reference rows, and the two row-matrix layouts the histogram
+    # pass can use (40 x u8 unpacked vs 10 x u32 packed). These feed a
+    # floor with NO granule mismatch (the round-4 model read 32 B rows
+    # for everything and conceded optimism).
+    profiles = {
+        "u8x1": (jnp.uint8, 1),
+        "u32x1": (jnp.uint32, 1),
+        "f32x2": (jnp.float32, 2),
+        "f32x8": (jnp.float32, 8),
+        "u8x40": (jnp.uint8, 40),
+        "u32x10": (jnp.uint32, 10),
+    }
+    rates = {}
+    for name, (dt, cols) in profiles.items():
+        shape = (mg,) if cols == 1 else (mg, cols)
+        tab = jnp.ones(shape, dt)
+
+        def gat2(a, p):
+            for _ in range(2):
+                a = lax.optimization_barrier(a[p])
+            return jnp.sum(a.astype(jnp.float32))
+
+        g2 = jax.jit(gat2)
+        float(g2(tab, perm))
+        best = 0.0
+        for _ in range(4):
+            t0 = time.time()
+            float(g2(tab, perm))
+            best = max(best, 2.0 * mg / (time.time() - t0))
+        rates[name] = round(best / 1e6, 2)          # million rows/s
+    out["gather_mrows_per_s"] = rates
 
     # MXU: chained bf16 4096^3 GEMMs (4 per dispatch amortize the tunnel
     # latency); ones * 2^-12 scaling keeps values exactly 1.0 each step
@@ -375,11 +507,7 @@ def run_full_attempt(rows: int, max_bin: int) -> None:
     params = {"objective": "binary", "num_leaves": NUM_LEAVES,
               "learning_rate": 0.1, "max_bin": max_bin,
               "min_data_in_leaf": 100, "verbose": -1,
-              "tpu_fused_learner": "1",
-              # the 500-tree device forest kernel can fault the tunneled
-              # chip worker; the holdout AUC here is a correctness check,
-              # so route it through the threaded native traverser
-              "tpu_fast_predict_rows": HOLDOUT}
+              "tpu_fused_learner": "1"}
     t0 = time.time()
     ds = lgb.Dataset(X, label=y)
     booster = lgb.Booster(params=params, train_set=ds)
@@ -401,8 +529,35 @@ def run_full_attempt(rows: int, max_bin: int) -> None:
     wall = t_construct + t_warm + t_train
     projected = (t_construct + t_warm
                  + (t_slice / split_at) * (ITERS_TOTAL - 2))
-    pred = booster.predict(np.asarray(Xv))
+    # full-forest predict A/B at the REAL forest size: the DEVICE path now
+    # dispatches in bounded 64-tree blocks (ops/predict.py), so the
+    # 500-tree forest that used to fault the tunneled worker runs on
+    # device and gets a measured number — the native/device routing
+    # threshold comes from this, not from an 8k-row extrapolation
+    Xv_np = np.asarray(Xv)
+    tp = time.time()
+    pred = booster.predict(Xv_np)              # device path (cold compile)
+    t_dev_cold = time.time() - tp
     auc = auc_score(np.asarray(yv), pred)
+    tp = time.time()
+    booster.predict(Xv_np)
+    t_dev_warm = time.time() - tp
+    tn = time.time()
+    booster.predict(Xv_np[:8192])              # native route (< threshold)
+    t_native_8k = time.time() - tn
+    native_us = t_native_8k / 8192 * 1e6
+    device_us = t_dev_warm / len(Xv_np) * 1e6
+    predict_full = {
+        "trees": booster.num_trees(),
+        "device_%drows_cold_s" % len(Xv_np): round(t_dev_cold, 3),
+        "device_%drows_warm_s" % len(Xv_np): round(t_dev_warm, 3),
+        "native_8192rows_s": round(t_native_8k, 4),
+        "native_us_per_row": round(native_us, 2),
+        "device_us_per_row_warm": round(device_us, 2),
+        **_predict_crossover(booster, Xv_np, len(Xv_np), t_dev_warm,
+                             native_us / 1e6),
+        "device_faulted": False,
+    }
     print(json.dumps({
         "rows": rows, "max_bin": max_bin, "iters": ITERS_TOTAL,
         "full_500iter_wall_s": round(wall, 3),
@@ -410,25 +565,46 @@ def run_full_attempt(rows: int, max_bin: int) -> None:
         "projected_from_first_%d" % split_at: round(projected, 3),
         "projection_error": round(wall / projected, 4),
         "holdout_auc": round(float(auc), 5),
+        "synthetic": True,     # the projection audit always runs synthetic
+        "predict_full_forest": predict_full,
     }))
 
 
 def run_rank_attempt(n_queries: int, max_bin: int = None) -> None:
     """MSLR-WEB30K-shaped lambdarank benchmark (second north star:
     NDCG@10 ~= 0.527 bar at full size, reference docs/GPU-Performance.rst:156).
-    Child-process entry; prints one JSON line."""
+    Child-process entry; prints one JSON line. BENCH_DATA_MSLR (a LETOR
+    qid LibSVM file) swaps the synthetic queries for real data."""
     _configure_jax_cache()
     import lambdagap_tpu as lgb
 
-    rng = np.random.RandomState(11)
-    F = 136                       # MSLR feature count
-    sizes = rng.randint(40, 201, n_queries)           # ~120 docs/query
-    N = int(sizes.sum())
-    X = rng.randn(N, F).astype(np.float32)
-    w = rng.randn(F).astype(np.float32) * (rng.rand(F) < 0.2)
-    latent = X @ w * 0.6 + rng.randn(N).astype(np.float32)
-    # graded relevance 0..4, MSLR-like skew toward 0
-    y = np.clip(np.floor(latent - latent.mean() + 0.8), 0, 4).astype(np.float32)
+    mslr_path = os.environ.get("BENCH_DATA_MSLR")
+    if mslr_path:
+        from lambdagap_tpu.config import Config
+        from lambdagap_tpu.data.loader import _parse_text_file
+        X, y, _, sizes, _ = _parse_text_file(mslr_path, Config.from_params(
+            {"verbose": -1}))
+        if sizes is None:
+            raise SystemExit("BENCH_DATA_MSLR file carries no qid: groups")
+        X = np.ascontiguousarray(X, np.float32)
+        y = y.astype(np.float32)
+        sizes = np.asarray(sizes, np.int64)
+        n_queries = len(sizes)
+        F = X.shape[1]
+        N = len(X)
+        synthetic = False
+    else:
+        rng = np.random.RandomState(11)
+        F = 136                   # MSLR feature count
+        sizes = rng.randint(40, 201, n_queries)       # ~120 docs/query
+        N = int(sizes.sum())
+        X = rng.randn(N, F).astype(np.float32)
+        w = rng.randn(F).astype(np.float32) * (rng.rand(F) < 0.2)
+        latent = X @ w * 0.6 + rng.randn(N).astype(np.float32)
+        # graded relevance 0..4, MSLR-like skew toward 0
+        y = np.clip(np.floor(latent - latent.mean() + 0.8), 0,
+                    4).astype(np.float32)
+        synthetic = True
 
     n_train_q = int(n_queries * 0.9)
     train_docs = int(sizes[:n_train_q].sum())
@@ -456,14 +632,39 @@ def run_rank_attempt(n_queries: int, max_bin: int = None) -> None:
     np.asarray(booster._booster.scores[0][:1])
     per_iter = (time.time() - t2) / iters
     ndcg = {m: v for (_, m, v, _) in booster.eval_valid()}
+
+    # per-iteration attribution: pairwise-lambda pass vs tree build (the
+    # HIGGS-path rigor the rank section lacked). The gradient call is the
+    # full bucketed pair-lattice program; tree time is the remainder.
+    import jax.numpy as jnp
+    obj = booster._booster.objective
+    scores = booster._booster.scores
+    float(jnp.sum(obj.get_gradients(scores)[0]))      # warm
+    grad_s = float("inf")
+    for _ in range(3):
+        tg = time.time()
+        for _ in range(3):
+            g, _h = obj.get_gradients(scores)
+        float(jnp.sum(g))
+        grad_s = min(grad_s, (time.time() - tg) / 3)
+    # dense pair-lattice work: sum over buckets of nq * L^2 (the tiled
+    # long-query path does identical arithmetic in blocks)
+    pairs = int(sum(len(qids) * (L ** 2)
+                    for (L, qids, _) in obj.bucketing.buckets))
     projected = t_construct + t_warm + per_iter * (ITERS_TOTAL - 2)
     print(json.dumps({
         "queries": n_queries, "docs": N, "features": F,
         "max_bin": params["max_bin"],
         "construct_s": round(t_construct, 3),
         "per_iter_s": round(per_iter, 4),
+        "grad_per_iter_s": round(grad_s, 4),
+        "tree_per_iter_s": round(max(per_iter - grad_s, 0.0), 4),
+        "lattice_pairs_per_iter": pairs,
+        "lattice_gpairs_per_s": round(pairs / grad_s / 1e9, 3),
         "projected_500iter_s": round(projected, 3),
         "valid_ndcg": {k: round(float(v), 5) for k, v in ndcg.items()},
+        "synthetic": synthetic,
+        "data": mslr_path or "mslr-shaped synthetic",
         "iters_trained": iters + 2,
     }))
 
@@ -489,9 +690,15 @@ def main() -> None:
     micro_pre = (None if os.environ.get("BENCH_MICRO", "1") == "0"
                  else _run_child(["--micro"], 900, "microbench (pre)"))
 
-    # attempt ladder: (rows, fused, is_retry)
+    # attempt ladder: (rows, fused, is_retry). With BENCH_DATA_HIGGS the
+    # child trains the full real file regardless of the rows argument, so
+    # row-ramping rungs would just repeat the same job — one rung (with a
+    # retry + the serial fallback), and no synthetic caches get written.
+    real_data = os.environ.get("BENCH_DATA_HIGGS") is not None
     ladder = []
-    for rows in (ROWS, min(ROWS, 4_000_000), min(ROWS, 1_000_000)):
+    row_rungs = ((ROWS,) if real_data
+                 else (ROWS, min(ROWS, 4_000_000), min(ROWS, 1_000_000)))
+    for rows in row_rungs:
         if not ladder or rows != ladder[-1][0]:
             ladder.append((rows, True, False))
             ladder.append((rows, True, True))    # one retry (transport flake)
@@ -505,7 +712,8 @@ def main() -> None:
         if key in seen:
             continue
         seen.add(key)
-        _ensure_data(rows)
+        if not real_data:
+            _ensure_data(rows)
         name = f"{'fused' if fused else 'serial'}@{rows}" + \
                ("(retry)" if is_retry else "")
         print(f"[bench] attempt {name}", file=sys.stderr, flush=True)
@@ -545,9 +753,11 @@ def main() -> None:
     ranking = None
     if os.environ.get("BENCH_RANK", "1") != "0":
         # like the HIGGS attempts: run the CPU-matched 255-bin setting AND
-        # the 63-bin TPU mode (docs/GPU-Performance.rst:43-47), report both,
-        # headline the better one (63-bin measured 21% faster per iter at
-        # equal NDCG on the bench chip)
+        # the 63-bin TPU mode (docs/GPU-Performance.rst:43-47), report
+        # both, headline the better one (round-5 ABAB: 63-bin ~12% faster
+        # per iter at equal NDCG; the round-4 artifact's 7.6x-slower
+        # 63-bin run did NOT reproduce — a corrupted session, hence the
+        # anomaly flag below)
         nq = int(os.environ.get("BENCH_RANK_QUERIES", 2000))
         rank_runs = {}
         for mb in (255, 63):
@@ -572,6 +782,30 @@ def main() -> None:
         ranking = {**best,
                    "max_bin_255": rank_runs.get(255),
                    "max_bin_63": rank_runs.get(63)}
+        if len(ok) == 2:
+            per = [r["per_iter_s"] for r in ok]
+            ratio = max(per) / max(min(per), 1e-9)
+            # an intra-session A/B spread beyond 2x cannot be a real
+            # program property of these two modes (round-5 ABAB measured
+            # ~1.15x) — flag the artifact instead of shipping it silently
+            ranking["anomaly"] = bool(ratio > 2.0)
+            ranking["ab_per_iter_ratio"] = round(ratio, 3)
+        if "grad_per_iter_s" in best and micro_pre \
+                and "hbm_copy_gbps" in (micro_pre or {}):
+            bw = micro_pre["hbm_copy_gbps"] * 1e9
+            ranking["rank_roofline"] = {
+                "grad_per_iter_s": best["grad_per_iter_s"],
+                "tree_per_iter_s": best["tree_per_iter_s"],
+                # ~12 B/pair: the fused lattice reads/writes a few f32
+                # planes per pair — a bytes floor for the pairwise pass;
+                # the pass is VPU/fusion bound well before it is byte
+                # bound, so this floor is loose by design
+                "lattice_bytes_floor_s": round(
+                    best["lattice_pairs_per_iter"] * 12 / bw, 4),
+                "note": "tree build shares the HIGGS-path issue model "
+                        "(visit_counts roofline); the pairwise pass is "
+                        "attributed by direct measurement",
+            }
 
     # 63-bin TPU variant (reference: docs/GPU-Performance.rst:43-47 —
     # the GPU docs' own recommendation; one-hot histogram width drops 4x).
@@ -628,10 +862,17 @@ def main() -> None:
                             str(chosen["max_bin"])], 900,
                            "fixed-cost probe @65536")
 
-    # roofline: the traffic model's floor for one iteration on THIS chip,
-    # from the best same-session bandwidth measurement. roofline_fraction
-    # near 1 = the program runs at the chip's memory roofline (the chip is
-    # the bottleneck); << 1 = the program leaves hardware on the table.
+    # roofline: attainable per-iteration time on THIS chip from the
+    # same-session microbench + EXACT work counts read off the trained
+    # trees (visit_counts). Two attainable estimates bracket the truth:
+    #   bytes_floor — traffic / streaming+gather bandwidth (a true lower
+    #     bound: no access pattern moves fewer bytes);
+    #   issue_est   — row-visit counts / the granule-matched random-row
+    #     gather rates (the program's gathers follow a leaf-ordered
+    #     permutation, i.e. near-random row access at these shapes, so
+    #     this estimates what the chip sustains for THIS pattern; program
+    #     locality can beat it, so it is an estimate, not a bound).
+    # roofline_fraction uses the larger (more honest) of the two.
     roofline = None
     micros = [m for m in (micro_pre, micro_post)
               if m and "hbm_copy_gbps" in m]
@@ -641,31 +882,68 @@ def main() -> None:
         gb, sb = model_bytes_per_iter(chosen["rows"])
         bytes_floor = gb / (bw_g or bw_s) + sb / bw_s
         fixed_s = (probe or {}).get("per_iter_s", 0.0) or 0.0
-        floor_s = bytes_floor + fixed_s
-        model_desc = ("floor = measured per-split fixed cost (65536-row "
-                      "probe, same tree shape, negligible bytes) + modeled "
-                      "bytes / measured gather+stream bandwidths. Known "
-                      "optimistic bias: the gather microbench reads 32 B "
-                      "granules; the program's grad/hess (8 B) and "
-                      "partition-column (1 B) gathers run at lower "
-                      "effective bandwidth, so the true floor is higher "
-                      "and the true fraction above this number"
-                      if fixed_s > 0 else
-                      "bytes-only floor — the fixed-cost probe did not run "
-                      "(disabled or failed), so the floor UNDERSTATES the "
-                      "chip's per-iteration minimum and the fraction reads "
-                      "low")
+
+        def _rate(name):
+            vals = [m.get("gather_mrows_per_s", {}).get(name)
+                    for m in micros]
+            vals = [v for v in vals if v]
+            return max(vals) * 1e6 if vals else None
+
+        issue_est = None
+        vc = chosen.get("visit_counts")
+        pack_on = os.environ.get("LAMBDAGAP_PACK32", "1") != "0"
+        r_hist = _rate("u32x10" if pack_on else "u8x40")
+        r_col = _rate("u8x1")
+        r_i32 = _rate("u32x1")
+        if vc and r_hist and r_col and r_i32:
+            # hist: one packed-row gather per (padded) visit; partition:
+            # one 1 B column gather + one 4 B perm scatter per visit;
+            # perm reads/copy-backs are contiguous window DMAs -> streams
+            t_hist = vc["hist_rows_padded_per_iter"] / r_hist
+            t_part = (vc["part_rows_padded_per_iter"] / r_col
+                      + vc["part_rows_padded_per_iter"] / r_i32)
+            stream_bytes = 4.0 * (vc["hist_rows_padded_per_iter"]
+                                  + 3 * vc["part_rows_padded_per_iter"])
+            t_stream = stream_bytes / bw_s
+            issue_est = {
+                "hist_gather_s": round(t_hist, 4),
+                "part_gather_scatter_s": round(t_part, 4),
+                "window_stream_s": round(t_stream, 4),
+                "total_s": round(t_hist + t_part + t_stream + fixed_s, 4),
+            }
+        bytes_plus_fixed_s = bytes_floor + fixed_s
+        floor_s = max(bytes_plus_fixed_s,
+                      issue_est["total_s"] if issue_est else 0.0)
+        frac = min(floor_s / chosen["per_iter_s"], 1.0)
+        model_desc = (
+            "attainable = max(bytes floor, granule-matched issue "
+            "estimate) + measured per-split fixed cost (65536-row probe). "
+            "Issue estimate = exact tree-derived row-visit counts / "
+            "measured random-row gather rates at the ACTUAL payloads "
+            "(u32-lane packed rows for hist, 1 B column + 4 B scatter for "
+            "partition) — no granule mismatch; counts use smaller-child + "
+            "window-padding accounting read off the trained trees. "
+            "fraction > 1 before capping means the program's gathers beat "
+            "the random-access microbench via partition locality.")
         roofline = {
             "model_gather_bytes_per_iter": int(gb),
             "model_stream_bytes_per_iter": int(sb),
             "hbm_copy_gbps_best": round(bw_s / 1e9, 3),
             "hbm_gather_gbps_best": round(bw_g / 1e9, 3),
+            # pure bytes floor (round-4-comparable key) and the
+            # fixed-cost-inclusive variant, kept separate so readers
+            # never double-count fixed_s
             "bytes_floor_per_iter_s": round(bytes_floor, 4),
+            "bytes_floor_plus_fixed_s": round(bytes_plus_fixed_s, 4),
+            "issue_estimate": issue_est,
             "fixed_cost_per_iter_s": round(fixed_s, 4),
             "fixed_cost_probe": probe,
             "roofline_per_iter_s": round(floor_s, 4),
             "measured_per_iter_s": chosen["per_iter_s"],
-            "roofline_fraction": round(floor_s / chosen["per_iter_s"], 4),
+            "roofline_fraction": round(frac, 4),
+            "roofline_fraction_uncapped": round(
+                floor_s / chosen["per_iter_s"], 4),
+            "visit_counts": vc,
             "model": model_desc,
         }
 

@@ -19,6 +19,7 @@ the histogram-subtraction trick's O(min(|L|,|R|)) economics
 """
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Optional
 
 import jax
@@ -152,6 +153,9 @@ class FusedTreeLearner(SerialTreeLearner):
         # voting mode: keep histograms local, vote top-k features, psum
         # only voted columns (set by FusedVotingParallelTreeLearner)
         self.voting: bool = False
+        # u32-lane packing of the gathered row matrix (A/B knob; see the
+        # pack32 block in _train_tree_impl)
+        self.pack32 = os.environ.get("LAMBDAGAP_PACK32", "1") != "0"
         self._train_jit = jax.jit(self._train_tree_impl,
                                   static_argnames=("has_mask",))
         self.last_row_leaf: Optional[jax.Array] = None
@@ -401,6 +405,26 @@ class FusedTreeLearner(SerialTreeLearner):
                 # packed column
                 parts.append(row_mask.astype(x_rows.dtype)[:, None])
             packed_rows = jnp.concatenate(parts, axis=1)
+        # ... and the packed matrix bitcast into uint32 LANES: TPU gathers
+        # cost per gathered element, not per byte (measured round 4), so
+        # one u32 element carrying 4 binned uint8 columns (2 uint16) cuts
+        # the hot pass's element count ~4x (2x); lanes decode with one
+        # bitcast after the gather (reference analog: cuda_row_data.hpp
+        # :32-117 packs rows by bit width for the same reason)
+        pack32 = self.pack32
+        if pack32:
+            lane_n = 4 if packed_rows.dtype == jnp.uint8 else 2
+            P0 = packed_rows.shape[1]
+            padc = (-P0) % lane_n
+            if padc:
+                packed_rows = jnp.concatenate(
+                    [packed_rows,
+                     jnp.zeros((packed_rows.shape[0], padc),
+                               packed_rows.dtype)], axis=1)
+            P32 = (P0 + padc) // lane_n
+            packed_rows = lax.bitcast_convert_type(
+                packed_rows.reshape(packed_rows.shape[0], P32, lane_n),
+                jnp.uint32)                             # [N, P32]
 
         def perm_slice(perm, start):
             """Contiguous W-row window of the (N+W padded) permutation —
@@ -413,7 +437,10 @@ class FusedTreeLearner(SerialTreeLearner):
             valid = (c * W + lane) < count
             if has_mask and quant:
                 valid = valid & row_mask[rows]
-            prow = packed_rows[rows]                    # [W, C(+gh+mask)]
+            prow = packed_rows[rows]            # [W, P32] u32 lanes, or
+            if pack32:                          # [W, C(+gh+mask)] unpacked
+                prow = lax.bitcast_convert_type(
+                    prow, x_rows.dtype).reshape(W, -1)
             bins = prow[:, :C]
             if has_mask and not quant:
                 valid = valid & (prow[:, C + gh_cols] > 0)

@@ -55,4 +55,3 @@ def test_raw_score_and_refit_invalidation():
     assert not np.allclose(before, after)
     np.testing.assert_allclose(after, b2.predict(X[:600])[:5], rtol=1e-5,
                                atol=1e-5)
-
