@@ -167,11 +167,15 @@ def _predict_crossover(booster, Xv_np, n_big, t_dev_big, native_per_row):
     FULL-batch device time) and can overstate the crossover ~10x."""
     import time as _t
     n_small = max(n_big // 4, 1)
-    t0 = _t.time()
-    booster.predict(Xv_np[:n_small])
-    t_small = _t.time() - t0
-    if n_big == n_small:
+    thresh = getattr(booster._booster.config, "tpu_fast_predict_rows", 10000)
+    if n_big == n_small or n_small <= thresh:
+        # the small point would route native (or equal the big one):
+        # no second device point, no fit
         return {"crossover_rows_est": None}
+    booster.predict(Xv_np[:n_small])     # WARM the new shape: the first
+    t0 = _t.time()                       # call compiles, and compile time
+    booster.predict(Xv_np[:n_small])     # in the fit would swamp the slope
+    t_small = _t.time() - t0
     slope = max((t_dev_big - t_small) / (n_big - n_small), 0.0)
     overhead = max(t_small - slope * n_small, 0.0)
     if native_per_row <= slope:

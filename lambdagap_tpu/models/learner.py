@@ -128,11 +128,9 @@ class SerialTreeLearner:
         # CEGB (reference: src/treelearner/cost_effective_gradient_boosting.hpp)
         c = config
         self.cegb_on = c.cegb_tradeoff > 0 and (
-            c.cegb_penalty_split > 0 or len(c.cegb_penalty_feature_coupled) > 0)
-        if c.cegb_penalty_feature_lazy:
-            log.warning("cegb_penalty_feature_lazy (per-datum on-demand "
-                        "costs) is not supported; the coupled penalty and "
-                        "split penalty are applied")
+            c.cegb_penalty_split > 0
+            or len(c.cegb_penalty_feature_coupled) > 0
+            or len(c.cegb_penalty_feature_lazy) > 0)
         coupled = np.zeros(self.num_features, dtype=np.float32)
         for k, j in enumerate(dataset.used_features):
             if j < len(c.cegb_penalty_feature_coupled):
@@ -140,6 +138,28 @@ class SerialTreeLearner:
         self._cegb_coupled = jnp.asarray(c.cegb_tradeoff * coupled)
         self._cegb_split_pen = float(c.cegb_tradeoff * c.cegb_penalty_split)
         self._cegb_used = np.zeros(self.num_features, dtype=bool)
+        # lazy per-datum on-demand costs (reference: CalculateOndemandCosts
+        # :139-164 + the UpdateLeafBestSplits bitset insert :125-135): a
+        # candidate (leaf, feature) pays lazy[f] per in-bag in-leaf row
+        # that has not yet been routed through an f-split; applying a
+        # split marks the leaf's in-bag rows used for that feature.
+        self._cegb_lazy = None
+        self._cegb_bag_np = None
+        if c.cegb_tradeoff > 0 and c.cegb_penalty_feature_lazy:
+            lazy = np.zeros(self.num_features, dtype=np.float64)
+            for k, j in enumerate(dataset.used_features):
+                if j < len(c.cegb_penalty_feature_lazy):
+                    lazy[k] = c.cegb_penalty_feature_lazy[j]
+            self._cegb_lazy = c.cegb_tradeoff * lazy
+            if self.num_features * self.num_data > (1 << 31):
+                log.warning("cegb_penalty_feature_lazy keeps a "
+                            "[features x rows] used-mask (%.1f GB here)",
+                            self.num_features * self.num_data / 2**30)
+            # host-side bitmask: this learner orchestrates splits from the
+            # host anyway, and an in-place numpy update beats a functional
+            # [F, N] device copy per split
+            self._cegb_lazy_used = np.zeros(
+                (self.num_features, self.num_data), dtype=bool)
 
         # original-feature -> used-feature index map
         self._inner_of = {j: k for k, j in enumerate(dataset.used_features)}
@@ -249,9 +269,39 @@ class SerialTreeLearner:
             (self._extra_rng.randint(0, 1 << 30, self.num_features)
              % self._nb_minus1).astype(np.int32))
 
+    def _cegb_lazy_rows(self, perm, begin: int, count: int):
+        """IN-BAG rows of a leaf spanning perm[begin:begin+count] (the
+        partition routes out-of-bag rows too; the reference's bagged
+        data_partition_ holds in-bag indices only, so lazy charging and
+        marking must filter)."""
+        rows = np.asarray(jax.device_get(perm[begin:begin + count]))
+        if self._cegb_bag_np is not None:
+            rows = rows[self._cegb_bag_np[rows]]
+        return rows
+
+    def _cegb_lazy_pen(self, perm, begin: int, count: int):
+        """Per-feature lazy on-demand penalty for a leaf (reference:
+        CalculateOndemandCosts — lazy[f] * number of in-bag in-leaf rows
+        not yet routed through an f-split)."""
+        if self._cegb_lazy is None or count <= 0:
+            return None
+        rows = self._cegb_lazy_rows(perm, begin, count)
+        used = self._cegb_lazy_used[:, rows].sum(axis=1)
+        return jnp.asarray((self._cegb_lazy
+                            * (len(rows) - used)).astype(np.float32))
+
+    def _cegb_lazy_mark(self, perm, begin: int, count: int,
+                        feat: int) -> None:
+        """Applying a split on ``feat`` marks the leaf's in-bag rows as
+        having paid its lazy cost (reference: UpdateLeafBestSplits bitset
+        insert)."""
+        if self._cegb_lazy is not None and count > 0:
+            self._cegb_lazy_used[
+                feat, self._cegb_lazy_rows(perm, begin, count)] = True
+
     def _best(self, hist, pg, ph, pc, parent_output, fmask,
               bounds=None, path_feats=frozenset(), depth=0,
-              adv=None) -> _HostSplit:
+              adv=None, lazy_pen=None) -> _HostSplit:
         cons = None
         if self.mono_on:
             if adv is not None:
@@ -264,6 +314,8 @@ class SerialTreeLearner:
         if self.cegb_on:
             pen = (self._cegb_split_pen * pc
                    + self._cegb_coupled * jnp.asarray(~self._cegb_used))
+            if lazy_pen is not None:
+                pen = pen + lazy_pen
         rand_t = None
         if self.extra_on:
             rand_t = self._draw_extra_thresholds()
@@ -456,6 +508,9 @@ class SerialTreeLearner:
         max_depth = cfg.max_depth
         tree = Tree(max_leaves=num_leaves)
         fmask = self._feature_mask()
+        if self._cegb_lazy is not None:
+            self._cegb_bag_np = (None if row_mask is None
+                                 else np.asarray(jax.device_get(row_mask)))
 
         perm = self.perm0
         leaf_begin = np.zeros(num_leaves, dtype=np.int64)
@@ -472,7 +527,9 @@ class SerialTreeLearner:
         paths: Dict[int, frozenset] = {0: frozenset()}
         best: Dict[int, _HostSplit] = {
             0: self._best(hist_root, totals[0], totals[1], totals[2], root_out,
-                          fmask, bounds[0], paths[0])}
+                          fmask, bounds[0], paths[0],
+                          lazy_pen=self._cegb_lazy_pen(perm, 0,
+                                                       self.num_data))}
 
         tree.leaf_value[0] = float(jax.device_get(root_out))
         tree.leaf_weight[0] = float(jax.device_get(totals[1]))
@@ -615,6 +672,12 @@ class SerialTreeLearner:
             paths[right_leaf] = child_path
             if self.cegb_on:
                 self._cegb_used[feat] = True
+                # lazy CEGB: the applied split routes the parent's rows
+                # through `feat` even when it is the tree's LAST split —
+                # the mark must precede the early return or later trees
+                # re-charge first-use costs already paid (reference:
+                # UpdateLeafBestSplits runs on every applied split)
+                self._cegb_lazy_mark(perm, begin, count, feat)
 
             if tree.num_leaves >= num_leaves:
                 return right_leaf  # no more splits: skip children histograms
@@ -643,11 +706,19 @@ class SerialTreeLearner:
             best[small_leaf] = self._best(hist_small, *s_sums, fmask,
                                           bounds[small_leaf],
                                           paths[small_leaf], child_depth,
-                                          adv=adv_s)
+                                          adv=adv_s,
+                                          lazy_pen=self._cegb_lazy_pen(
+                                              perm,
+                                              int(leaf_begin[small_leaf]),
+                                              int(leaf_count[small_leaf])))
             best[large_leaf] = self._best(hist_large, *g_sums, fmask,
                                           bounds[large_leaf],
                                           paths[large_leaf], child_depth,
-                                          adv=adv_g)
+                                          adv=adv_g,
+                                          lazy_pen=self._cegb_lazy_pen(
+                                              perm,
+                                              int(leaf_begin[large_leaf]),
+                                              int(leaf_count[large_leaf])))
             sums[small_leaf] = s_sums
             sums[large_leaf] = g_sums
 
@@ -660,9 +731,12 @@ class SerialTreeLearner:
                     lambda lf_: lf_ in best and np.isfinite(best[lf_].gain_f))
                 for ul in set(upd):
                     if ul in hists:
-                        best[ul] = self._best(hists[ul], *sums[ul], fmask,
-                                              bounds[ul], paths[ul],
-                                              int(tree.leaf_depth[ul]))
+                        best[ul] = self._best(
+                            hists[ul], *sums[ul], fmask, bounds[ul],
+                            paths[ul], int(tree.leaf_depth[ul]),
+                            lazy_pen=self._cegb_lazy_pen(
+                                perm, int(leaf_begin[ul]),
+                                int(leaf_count[ul])))
             elif adv_on:
                 # the split replaced one output with two new ones: refresh
                 # the cached best split of every leaf the OLD box
@@ -677,7 +751,10 @@ class SerialTreeLearner:
                     best[ul] = self._best(
                         hists[ul], *sums[ul], fmask, bounds[ul], paths[ul],
                         int(tree.leaf_depth[ul]),
-                        adv=self._advanced_bound_arrays(ul, boxes, tree))
+                        adv=self._advanced_bound_arrays(ul, boxes, tree),
+                        lazy_pen=self._cegb_lazy_pen(
+                            perm, int(leaf_begin[ul]),
+                            int(leaf_count[ul])))
             return right_leaf
 
         # ---- forced-splits phase (reference: serial_tree_learner.cpp:624

@@ -51,6 +51,38 @@ def test_cegb_split_penalty_reduces_splits():
     assert n_pen < n_plain
 
 
+def test_cegb_lazy_penalty_limits_features():
+    """cegb_penalty_feature_lazy: per-datum on-demand cost — a candidate
+    (leaf, feature) pays lazy[f] per in-leaf row not yet routed through an
+    f-split, and applying a split marks the leaf's rows (reference:
+    CalculateOndemandCosts + the UpdateLeafBestSplits bitset,
+    cost_effective_gradient_boosting.hpp:125-164)."""
+    X, y = _data()
+    # prohibitive lazy cost on all but features 0/1: first touches are
+    # priced per row, so the model should never afford them
+    pen = [0.0, 0.0] + [1e6] * 4
+    b = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                   "cegb_penalty_feature_lazy": pen},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    assert _used_features(b) <= {0, 1}
+    # a small lazy penalty reduces feature spread vs no penalty but keeps
+    # the model functional (the marked rows stop paying on reuse, so a
+    # feature that earned its first use stays usable)
+    small = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                       "cegb_penalty_feature_lazy": [0.001] * 6},
+                      lgb.Dataset(X, label=y), num_boost_round=10)
+    plain = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=10)
+    mse_pen = float(np.mean((small.predict(X) - y) ** 2))
+    mse_plain = float(np.mean((plain.predict(X) - y) ** 2))
+    assert mse_pen < 2.0 * mse_plain + 0.1, (mse_pen, mse_plain)
+    # reuse is cheaper than first use: with a uniform moderate penalty the
+    # tree re-splits on already-paid features more than spreading out
+    mod = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                     "cegb_penalty_feature_lazy": [0.05] * 6},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    assert len(_used_features(mod)) <= len(_used_features(plain))
+
+
 def test_interaction_constraints_respected():
     X, y = _data()
     b = lgb.train({**BASE, "interaction_constraints": [[0, 1], [2, 3, 4, 5]]},
