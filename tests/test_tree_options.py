@@ -83,6 +83,22 @@ def test_cegb_lazy_penalty_limits_features():
     assert len(_used_features(mod)) <= len(_used_features(plain))
 
 
+def test_cegb_lazy_with_bagging_in_bag_only():
+    """Lazy CEGB under bagging charges and marks IN-BAG rows only (the
+    reference's bagged data_partition_ holds in-bag indices; our partition
+    routes out-of-bag rows too, so the lazy path must filter)."""
+    X, y = _data()
+    pen = [0.0, 0.0] + [1e6] * 4
+    b = lgb.train({**BASE, "cegb_tradeoff": 1.0,
+                   "cegb_penalty_feature_lazy": pen,
+                   "bagging_fraction": 0.6, "bagging_freq": 1},
+                  lgb.Dataset(X, label=y), num_boost_round=8)
+    assert _used_features(b) <= {0, 1}
+    # training still works and beats a constant predictor
+    mse = float(np.mean((b.predict(X) - y) ** 2))
+    assert mse < float(np.var(y))
+
+
 def test_interaction_constraints_respected():
     X, y = _data()
     b = lgb.train({**BASE, "interaction_constraints": [[0, 1], [2, 3, 4, 5]]},
