@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Serving benchmark: compiled-forest micro-batched server vs naive
+per-request ``Booster.predict`` on batch-size-1 request streams.
+
+The naive side calls ``Booster.predict`` once per single-row request — the
+only serving story the framework had before ``lambdagap_tpu.serve`` — so it
+pays per-call Python/conversion overhead and (above the native-path
+threshold) a full forest re-upload per call. The served side runs the same
+request stream through ``ForestServer``: the forest is device-resident and
+compiled once per padding bucket, and concurrent requests coalesce into
+padded device batches. Clients keep a bounded window of in-flight async
+requests (a streaming RPC client), which is what lets the batcher form
+deep batches.
+
+Usage::
+
+    python bench_serve.py [out.json] [--trees 500] [--feats 32]
+        [--requests 4000] [--clients 8] [--window 64] [--naive-requests 400]
+
+Output JSON: naive + served throughput, speedup, serve p50/p99 latency and
+cache hit stats (the ``ServeStats`` schema of docs/serving.md).
+"""
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def build_booster(n_trees: int, rows: int, feats: int, leaves: int):
+    """A ``n_trees``-tree booster, cheaply: train a base model and tile its
+    trees (structure-realistic forest; serving cost only depends on tree
+    count/shape, not on the training history)."""
+    import lambdagap_tpu as lgb
+    rng = np.random.RandomState(0)
+    X = rng.randn(rows, feats).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] + np.sin(X[:, 2])
+         + 0.1 * rng.randn(rows)).astype(np.float32)
+    base = min(n_trees, 50)
+    b = lgb.train({"objective": "regression", "num_leaves": leaves,
+                   "verbose": -1}, lgb.Dataset(X, label=y),
+                  num_boost_round=base)
+    gb = b._booster
+    host = gb.host_models
+    reps = -(-n_trees // len(host))
+    gb.models = (host * reps)[:n_trees]
+    gb.iter_ = len(gb.models)
+    gb.invalidate_predict_cache()
+    return b, X
+
+
+def bench_naive(booster, X, n_requests: int) -> dict:
+    booster.predict(X[:1])                       # warm every lazy path
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        booster.predict(X[i % len(X)][None, :])
+    dt = time.perf_counter() - t0
+    return {"requests": n_requests, "elapsed_s": dt,
+            "throughput_rps": n_requests / dt,
+            "mean_latency_ms": 1e3 * dt / n_requests}
+
+
+def bench_naive_device(booster, X, n_requests: int) -> dict:
+    """Naive per-request predict with the native single-row traverser
+    suppressed: every request is its own device dispatch — what any
+    deployment without a C++ toolchain gets, and the pre-serve pathology
+    the ISSUE names (a forest conversion + dispatch per call)."""
+    from lambdagap_tpu import native
+    old = native.get_lib
+    native.get_lib = lambda: None
+    try:
+        booster.predict(X[:1])                   # warm the 1-row executable
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            booster.predict(X[i % len(X)][None, :])
+        dt = time.perf_counter() - t0
+    finally:
+        native.get_lib = old
+    return {"requests": n_requests, "elapsed_s": dt,
+            "throughput_rps": n_requests / dt,
+            "mean_latency_ms": 1e3 * dt / n_requests}
+
+
+def bench_served(booster, X, n_requests: int, clients: int,
+                 window: int, max_delay_ms: float) -> dict:
+    server = booster.as_server(max_delay_ms=max_delay_ms)
+    per = n_requests // clients
+    errs = []
+
+    def client(cid: int) -> None:
+        try:
+            inflight = []
+            for i in range(per):
+                inflight.append(server.submit(X[(cid * per + i) % len(X)]))
+                if len(inflight) >= window:
+                    inflight.pop(0).result(timeout=120)
+            for f in inflight:
+                f.result(timeout=120)
+        except Exception as e:  # pragma: no cover
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    snap = server.stats_snapshot()
+    server.close()
+    return {"requests": per * clients, "clients": clients, "window": window,
+            "elapsed_s": dt, "throughput_rps": per * clients / dt,
+            "errors": errs, "stats": snap}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("out", nargs="?", default="")
+    ap.add_argument("--trees", type=int, default=500)
+    ap.add_argument("--rows", type=int, default=8000)
+    ap.add_argument("--feats", type=int, default=32)
+    ap.add_argument("--leaves", type=int, default=31)
+    ap.add_argument("--requests", type=int, default=4000)
+    ap.add_argument("--naive-requests", type=int, default=400)
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--window", type=int, default=64)
+    ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    import jax
+    print(f"building {args.trees}-tree forest "
+          f"({args.feats} features, backend={jax.default_backend()})...",
+          file=sys.stderr)
+    booster, X = build_booster(args.trees, args.rows, args.feats,
+                               args.leaves)
+
+    # correctness gate before timing anything: the served path must agree
+    # bit-for-bit with the one-shot DEVICE predict (naive timing below still
+    # uses the default config, where small batches may take the native f64
+    # traverser — the fastest baseline available)
+    gb = booster._booster
+    fast_rows = gb.config.tpu_fast_predict_rows
+    gb.config.tpu_fast_predict_rows = 0
+    ref = booster.predict(X[:600])               # 600 > 512 -> device path
+    gb.config.tpu_fast_predict_rows = fast_rows
+    server = booster.as_server()
+    got = np.concatenate([server.predict(X[i:i + 37])
+                          for i in range(0, 592, 37)])
+    server.close()
+    exact = bool(np.array_equal(got, ref[:592]))
+    if not exact:
+        print("FATAL: served outputs diverge from the device "
+              "Booster.predict path", file=sys.stderr)
+        return 1
+
+    print(f"naive per-request predict x{args.naive_requests}...",
+          file=sys.stderr)
+    naive = bench_naive(booster, X, args.naive_requests)
+    print(f"  {naive['throughput_rps']:.0f} req/s", file=sys.stderr)
+
+    nd = max(20, args.naive_requests // 8)
+    print(f"naive per-request DEVICE predict x{nd}...", file=sys.stderr)
+    naive_dev = bench_naive_device(booster, X, nd)
+    print(f"  {naive_dev['throughput_rps']:.0f} req/s", file=sys.stderr)
+
+    print(f"served stream x{args.requests} "
+          f"({args.clients} clients, window {args.window})...",
+          file=sys.stderr)
+    served = bench_served(booster, X, args.requests, args.clients,
+                          args.window, args.max_delay_ms)
+    print(f"  {served['throughput_rps']:.0f} req/s", file=sys.stderr)
+
+    speedup = served["throughput_rps"] / max(naive["throughput_rps"], 1e-9)
+    speedup_dev = (served["throughput_rps"]
+                   / max(naive_dev["throughput_rps"], 1e-9))
+    report = {
+        "bench": "serve",
+        "trees": args.trees,
+        "feats": args.feats,
+        "backend": jax.default_backend(),
+        "bit_identical_to_device_predict": exact,
+        "naive": naive,
+        "naive_device": naive_dev,
+        "serve": served,
+        "speedup": speedup,
+        "speedup_vs_device_naive": speedup_dev,
+        "serve_p50_ms": served["stats"]["latency_ms"]["p50"],
+        "serve_p99_ms": served["stats"]["latency_ms"]["p99"],
+        "cache_hit_rate": served["stats"]["cache"]["hit_rate"],
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    print(f"speedup: {speedup:.1f}x vs naive (native single-row path), "
+          f"{speedup_dev:.1f}x vs naive device dispatch per request "
+          f"(target >= 5x; p50={report['serve_p50_ms']:.2f}ms "
+          f"p99={report['serve_p99_ms']:.2f}ms)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,6 +27,18 @@ __all__ = ["Booster", "Dataset", "Sequence", "Config", "BinnedDataset",
            "record_evaluation", "reset_parameter", "register_logger",
            "__version__"]
 
+__all__ += ["ForestServer", "ServeResult"]
+
+
+def __getattr__(name):
+    # serve imports lazily: training-only sessions never pay for the
+    # serving layer (Booster.as_server routes through the same module)
+    if name in ("ForestServer", "ServeResult"):
+        from . import serve
+        return getattr(serve, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 try:  # matplotlib/graphviz are optional
     from .plotting import (create_tree_digraph, plot_importance, plot_metric,
                            plot_split_value_histogram, plot_tree)

@@ -535,6 +535,15 @@ class Booster:
     def num_model_per_iteration(self) -> int:
         return self._booster.num_tree_per_iteration
 
+    def as_server(self, **kwargs) -> "ForestServer":
+        """Wrap this booster in a batched, hot-swappable inference server
+        (``lambdagap_tpu.serve.ForestServer``): the forest is converted to
+        device-resident arrays once, predict executables are pre-compiled
+        per padding bucket, and concurrent ``predict``/``submit`` calls are
+        coalesced into padded device batches. See docs/serving.md."""
+        from .serve import ForestServer
+        return ForestServer(self, **kwargs)
+
     # -- reference Booster API parity ----------------------------------
     def eval(self, data: "Dataset", name: str, feval=None):
         """Evaluate the configured metrics on an arbitrary dataset
@@ -609,7 +618,7 @@ class Booster:
         """(reference: LGBM_BoosterSetLeafValue)"""
         tree = self._booster._tree(tree_id)
         tree.leaf_value[leaf_id] = float(value)
-        self._booster._fast_cache = None
+        self._booster.invalidate_predict_cache()
         return self
 
     def lower_bound(self) -> float:
@@ -680,7 +689,7 @@ class Booster:
         # seeded like every other source of randomness in the package
         np.random.RandomState(self.config.data_random_seed).shuffle(seg)
         b.models[lo:hi] = seg
-        b._fast_cache = None
+        b.invalidate_predict_cache()
         return self
 
     def free_dataset(self) -> "Booster":

@@ -2,12 +2,19 @@
 
 (reference: src/main.cpp:13 + src/application/application.cpp — ``key=value``
 arguments plus ``config=`` file, tasks train / predict / convert_model /
-refit / save_binary :172-290.)
+refit / save_binary :172-290; ``task=serve`` is framework-native, with no
+reference analog.)
 
 Usage::
 
     python -m lambdagap_tpu task=train data=train.csv objective=binary \
         num_iterations=100 output_model=model.txt
+
+    # batched serving loop: one feature row per line (TSV/CSV) from
+    # data= or stdin; 'swap=<model.txt>' lines hot-swap the model
+    # mid-stream with zero dropped requests (docs/serving.md)
+    python -m lambdagap_tpu task=serve input_model=model.txt \
+        data=requests.tsv output_result=preds.tsv serve_stats_file=stats.json
 """
 from __future__ import annotations
 
@@ -126,6 +133,51 @@ def _load_raw_matrix(path: str, cfg: Config) -> np.ndarray:
     return X
 
 
+def run_serve(cfg: Config) -> None:
+    """task=serve: micro-batched inference loop over a request stream.
+
+    Requests come from ``data=<file>`` or stdin, one feature row per line
+    (TSV or CSV; all columns are features). Lines of the form
+    ``swap=<model>`` atomically hot-swap the served model. Predictions go
+    to ``output_result`` (default LightGBM_predict_result.txt); serving
+    metrics JSON goes to ``serve_stats_file`` when set."""
+    if not cfg.input_model:
+        log.fatal("task=serve requires input_model=<model>")
+    from .serve import ForestServer, serve_loop
+    booster = GBDT.from_model_file(cfg.input_model, cfg)
+    server = ForestServer(booster, raw_score=cfg.predict_raw_score,
+                          start_iteration=cfg.start_iteration_predict,
+                          num_iteration=cfg.num_iteration_predict)
+    if cfg.data:
+        src = open(cfg.data)
+    else:
+        src = sys.stdin
+        log.info("task=serve reading requests from stdin "
+                 "(one feature row per line; 'swap=<model>' hot-swaps)")
+    out_path = cfg.extra.get("output_result",
+                             "LightGBM_predict_result.txt")
+    try:
+        with open(out_path, "w") as out:
+            n = serve_loop(server, src, out,
+                           on_swap=lambda tgt, gen: log.info(
+                               "Hot-swapped to %s (generation %d)",
+                               tgt, gen))
+    finally:
+        if src is not sys.stdin:
+            src.close()
+        server.close()
+    snap = server.stats_snapshot()
+    if cfg.serve_stats_file:
+        import json
+        with open(cfg.serve_stats_file, "w") as f:
+            json.dump(snap, f, indent=2)
+    log.info("Served %d requests (gen %d): %.0f req/s, p50=%.3fms "
+             "p99=%.3fms, cache hit rate %.0f%%; predictions in %s", n,
+             snap["generation"], snap["throughput_rps"],
+             snap["latency_ms"]["p50"], snap["latency_ms"]["p99"],
+             100.0 * snap["cache"]["hit_rate"], out_path)
+
+
 def run_refit(cfg: Config) -> None:
     """Refit an existing model's leaf values on new data
     (reference: application.cpp:254-290 ConvertModel-adjacent refit task)."""
@@ -170,6 +222,8 @@ def main(argv=None) -> int:
         run_train(cfg)
     elif task in ("predict", "prediction", "test"):
         run_predict(cfg)
+    elif task == "serve":
+        run_serve(cfg)
     elif task == "save_binary":
         run_save_binary(cfg)
     elif task == "convert_model":

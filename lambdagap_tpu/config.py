@@ -141,6 +141,8 @@ _alias("machine_list_filename", "machine_list_file", "machine_list", "mlist")
 _alias("machines", "workers", "nodes")
 _alias("gpu_device_id", "device_id")
 _alias("num_gpu", "num_gpus")
+_alias("serve_buckets", "serve_padding_buckets")
+_alias("serve_max_delay_ms", "serve_max_latency_ms")
 
 # Fork delta aliases (none published; canonical names only)
 
@@ -319,6 +321,17 @@ class Config:
     pred_early_stop_freq: int = 10
     pred_early_stop_margin: float = 10.0
 
+    # -- serve (task=serve / Booster.as_server; docs/serving.md) ----------
+    # padded request-batch sizes with pre-compiled predict executables;
+    # arbitrary request sizes round up to the nearest bucket
+    serve_buckets: List[int] = field(
+        default_factory=lambda: [1, 8, 64, 512, 4096])
+    serve_max_batch: int = 4096          # micro-batcher row cap per dispatch
+    serve_max_delay_ms: float = 2.0      # coalescing window per batch
+    serve_workers: int = 0               # parallel batch dispatchers; 0=auto
+    serve_warmup: bool = True            # pre-compile buckets before serving
+    serve_stats_file: str = ""           # task=serve: dump metrics JSON here
+
     # -- convert ----------------------------------------------------------
     convert_model_language: str = ""
     convert_model: str = "gbdt_prediction.cpp"
@@ -419,7 +432,8 @@ class Config:
                     setattr(self, key, float(val))
                 elif f.type in ("bool", bool):
                     setattr(self, key, _parse_bool(val))
-                elif key in ("eval_at", "max_bin_by_feature"):
+                elif key in ("eval_at", "max_bin_by_feature",
+                             "serve_buckets"):
                     setattr(self, key, _parse_list(val, int))
                 elif key == "monotone_constraints":
                     setattr(self, key, _parse_list(val, int))
@@ -479,6 +493,10 @@ class Config:
              f"unknown data_sample_strategy {self.data_sample_strategy!r}"),
             (self.monotone_constraints_method in ("basic", "intermediate", "advanced"),
              "unknown monotone_constraints_method"),
+            (self.serve_max_batch >= 1, "serve_max_batch must be >= 1"),
+            (self.serve_max_delay_ms >= 0, "serve_max_delay_ms must be >= 0"),
+            (all(b > 0 for b in self.serve_buckets),
+             "serve_buckets must be positive"),
         ]
         for ok, msg in checks:
             if not ok:

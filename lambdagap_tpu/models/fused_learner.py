@@ -1148,10 +1148,16 @@ class FusedTreeLearner(SerialTreeLearner):
                 hi_col = st["box_hi"]
                 sf_lo = lo_col[:, feat]                # [L+1] on the new
                 sf_hi = hi_col[:, feat]                # split's feature
-                # active leaves only; the host learner tightens every leaf
-                # still carrying a cached scan (its "splittable" guard is
-                # vacuous — K_MIN_SCORE is finite), so no gain condition
-                row_ok = (iota_l1 < L) & ok
+                # active leaves whose cached best split is still viable:
+                # the reference skips leaves with best gain == kMinScore
+                # (e.g. at max_depth) — tightening a dead leaf's bounds
+                # only buys pointless re-scan loop trips (each bearing
+                # collectives under voting), and bounds can never turn an
+                # unsplittable leaf splittable (they only shrink gain)
+                splittable = leaf_f[:, 4] > K_MIN_SCORE
+                if max_depth > 0:
+                    splittable &= leaf_i[:, 2] < max_depth
+                row_ok = (iota_l1 < L) & ok & splittable
                 npath_s = st["npath"]
                 BIGB = jnp.int32(1 << 30)
 

@@ -23,7 +23,8 @@ from ..config import Config
 from ..data.dataset import BinnedDataset
 from ..metrics.base import Metric, create_metrics
 from ..objectives.base import ObjectiveFunction, create_objective
-from ..ops.predict import (_round_depth, forest_to_arrays, predict_forest,
+from ..ops.predict import (_round_depth, build_forest_blocks,
+                           forest_to_arrays, predict_forest,
                            predict_forest_leaf, predict_tree_binned,
                            tree_to_arrays)
 from ..utils import log
@@ -118,6 +119,13 @@ class GBDT:
         self.models: List[Tree] = []           # flat: iter-major, class-minor
         self.best_iteration = -1
         self.shrinkage_rate = config.learning_rate
+        # predict caches + model generation id. The generation bumps on any
+        # in-place mutation of the served forest (refit, set_leaf_output,
+        # shuffle); serve's CompiledForestCache and the device-forest cache
+        # below key on it so stale compiled forests can never be served.
+        self.generation = 0
+        self._fast_cache = None
+        self._forest_cache = None
 
         self.objective: Optional[ObjectiveFunction] = create_objective(config)
         self.num_class = self.objective.num_class if self.objective else config.num_class
@@ -743,7 +751,7 @@ class GBDT:
         (feature_histogram.hpp:198 CalculateSplittedLeafOutput), blended by
         ``refit_decay_rate``."""
         from ..data.dataset import Metadata
-        self._fast_cache = None     # leaf values change in place
+        self.invalidate_predict_cache()     # leaf values change in place
         cfg = self.config
         decay = cfg.refit_decay_rate if decay_rate is None else float(decay_rate)
         X = np.ascontiguousarray(np.asarray(data, dtype=np.float32))
@@ -869,6 +877,32 @@ class GBDT:
                       dtype=data.dtype)
         return np.concatenate([data, pad], axis=1)
 
+    def invalidate_predict_cache(self) -> None:
+        """Drop every cached predict-side view of the forest and bump the
+        model generation. Must be called by anything that mutates tree
+        payloads in place (refit, set_leaf_output, shuffle_models);
+        structural changes (train/rollback/resume) are covered by the
+        model-count component of the cache keys."""
+        self._fast_cache = None
+        self._forest_cache = None
+        self.generation += 1
+
+    def _device_forest(self, idx, trees):
+        """Device-resident stacked forest (+ pre-sliced tree blocks) for the
+        raw-feature predict paths, cached on the booster: the forest is
+        immutable between calls, so re-slicing and re-uploading it per
+        predict call (ADVICE round 5, predict.py:313) was pure waste.
+        Returns (forest, depth, tree_class, blocks)."""
+        key = (self.generation, len(self.models), idx[0], idx[-1], len(idx))
+        cache = getattr(self, "_forest_cache", None)
+        if cache is None or cache[0] != key:
+            K = self.num_tree_per_iteration
+            forest, depth = forest_to_arrays(trees, use_inner_feature=False)
+            tree_class = jnp.asarray([i % K for i in idx], jnp.int32)
+            blocks = build_forest_blocks(forest, tree_class)
+            self._forest_cache = (key, (forest, depth, tree_class, blocks))
+        return self._forest_cache[1]
+
     def _fast_forest(self, idx, trees):
         """Cached flat forest for the native low-latency predictor; None
         when the native lib is unavailable."""
@@ -924,8 +958,7 @@ class GBDT:
                 if self.average_output:
                     res = res / max(1, len(idx) // max(K, 1))
                 return res[0] if K == 1 else res.T
-        forest, depth = forest_to_arrays(trees, use_inner_feature=False)
-        tree_class = jnp.asarray([i % K for i in idx], jnp.int32)
+        forest, depth, tree_class, blocks = self._device_forest(idx, trees)
         if has_linear:
             res = self._linear_forest_outputs(
                 trees, forest, depth, jnp.asarray(data), data,
@@ -935,7 +968,8 @@ class GBDT:
                                  depth, binned=False,
                                  early_stop_freq=es_freq,
                                  early_stop_margin=float(
-                                     self.config.pred_early_stop_margin))
+                                     self.config.pred_early_stop_margin),
+                                 blocks=blocks)
             res = np.asarray(jax.device_get(out))
         if self.average_output:
             n_iters = max(1, len(idx) // max(K, 1))
@@ -952,9 +986,9 @@ class GBDT:
             return np.zeros((data.shape[0], 0), np.int32)
         self._materialize_lazy(idx)
         trees = [self._tree(i) for i in idx]
-        forest, depth = forest_to_arrays(trees, use_inner_feature=False)
+        forest, depth, _, blocks = self._device_forest(idx, trees)
         ys = predict_forest_leaf(jnp.asarray(data), forest, depth,
-                                 binned=False)
+                                 binned=False, blocks=blocks)
         return np.asarray(jax.device_get(ys)).astype(np.int32).T
 
     def predict_contrib(self, data: np.ndarray, start_iteration: int = 0,

@@ -151,15 +151,18 @@ class SerialTreeLearner:
                 if j < len(c.cegb_penalty_feature_lazy):
                     lazy[k] = c.cegb_penalty_feature_lazy[j]
             self._cegb_lazy = c.cegb_tradeoff * lazy
-            if self.num_features * self.num_data > (1 << 31):
+            # host-side bit-packed mask, 1 bit per (feature, row) — the
+            # same footprint as the reference's feature_used_in_data
+            # bitset (cost_effective_gradient_boosting.hpp); this learner
+            # orchestrates splits from the host anyway, and an in-place
+            # numpy update beats a functional [F, N] device copy per split
+            mask_bytes = (self.num_data + 7) // 8
+            if self.num_features * mask_bytes > (1 << 25):   # > 32 MiB
                 log.warning("cegb_penalty_feature_lazy keeps a "
-                            "[features x rows] used-mask (%.1f GB here)",
-                            self.num_features * self.num_data / 2**30)
-            # host-side bitmask: this learner orchestrates splits from the
-            # host anyway, and an in-place numpy update beats a functional
-            # [F, N] device copy per split
+                            "[features x rows] used-bitset (%.0f MB here)",
+                            self.num_features * mask_bytes / 2**20)
             self._cegb_lazy_used = np.zeros(
-                (self.num_features, self.num_data), dtype=bool)
+                (self.num_features, mask_bytes), dtype=np.uint8)
 
         # original-feature -> used-feature index map
         self._inner_of = {j: k for k, j in enumerate(dataset.used_features)}
@@ -286,7 +289,8 @@ class SerialTreeLearner:
         if self._cegb_lazy is None or count <= 0:
             return None
         rows = self._cegb_lazy_rows(perm, begin, count)
-        used = self._cegb_lazy_used[:, rows].sum(axis=1)
+        used = ((self._cegb_lazy_used[:, rows >> 3]
+                 >> (rows & 7)) & 1).sum(axis=1)
         return jnp.asarray((self._cegb_lazy
                             * (len(rows) - used)).astype(np.float32))
 
@@ -296,8 +300,9 @@ class SerialTreeLearner:
         having paid its lazy cost (reference: UpdateLeafBestSplits bitset
         insert)."""
         if self._cegb_lazy is not None and count > 0:
-            self._cegb_lazy_used[
-                feat, self._cegb_lazy_rows(perm, begin, count)] = True
+            rows = self._cegb_lazy_rows(perm, begin, count)
+            np.bitwise_or.at(self._cegb_lazy_used[feat], rows >> 3,
+                             (1 << (rows & 7)).astype(np.uint8))
 
     def _best(self, hist, pg, ph, pc, parent_output, fmask,
               bounds=None, path_feats=frozenset(), depth=0,

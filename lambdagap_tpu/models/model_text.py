@@ -290,6 +290,21 @@ def tree_from_string(block: str) -> Tree:
     return tree
 
 
+def read_model_source(source) -> str:
+    """Model text from a filesystem path OR an already-in-memory model
+    string (the serve hot-swap path accepts either). A multi-line string is
+    always treated as model text; a single-line string must name a readable
+    file."""
+    import os
+    s = str(source)
+    if "\n" in s:
+        return s
+    if os.path.exists(s):
+        with open(s) as f:
+            return f.read()
+    log.fatal("model source %r is neither a readable file nor model text", s)
+
+
 def load_model_from_string(text: str):
     """Parse a saved model into (header dict, [Tree])."""
     if "end of trees" not in text:

@@ -18,6 +18,7 @@
 #include <cctype>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <fstream>
 #include <limits>
@@ -498,6 +499,7 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle,
         // count reads as the training-file layout (label first)
         skip_label =
             (static_cast<int>(row.size()) == m->max_feature_idx + 2) ? 1 : 0;
+        const char* rule = "width-match";
         // a header row is more authoritative than the count: a label-like
         // first column name confirms label-first; a feature-like name in a
         // features+1-wide file means the extra column is a real feature
@@ -515,14 +517,25 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle,
                h0 == "y") &&
               static_cast<int>(row.size()) > m->max_feature_idx + 1) {
             skip_label = 1;
+            rule = "header-label-name";
           } else if (skip_label == 1 &&
                      (h0.rfind("column_", 0) == 0 ||
                       h0.rfind("feat", 0) == 0 ||
                       (h0.size() >= 2 && h0[0] == 'f' &&
                        std::isdigit(static_cast<unsigned char>(h0[1]))))) {
             skip_label = 0;
+            rule = "header-feature-name";
           }
         }
+        // heuristics silently changing column handling across files is
+        // undiagnosable otherwise; has_label= overrides both rules
+        std::fprintf(stderr,
+                     "[lambdagap] PredictForFile: column-0 rule '%s' -> %s "
+                     "(%d columns, model needs %d)\n",
+                     rule,
+                     skip_label ? "dropping column 0 as the label"
+                                : "keeping every column as a feature",
+                     static_cast<int>(row.size()), m->max_feature_idx + 1);
       }
     }
     if (static_cast<int>(row.size()) - skip_label <= m->max_feature_idx) {

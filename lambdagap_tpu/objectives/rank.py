@@ -336,6 +336,13 @@ def _lambdarank_bucket(scores, labels, valid, inv_dcg, inv_bdcg, label_gain,
     long queries stay exact."""
     from jax import lax
     tl = truncation_level
+    if tile is not None and scores.shape[1] % tile != 0:
+        # lax.dynamic_slice clamps out-of-range starts, so a non-divisor
+        # tile would silently misalign rank indices against the sliced
+        # score/label rows and produce wrong lambdas
+        raise ValueError(
+            f"tile={tile} must divide the padded bucket length "
+            f"{scores.shape[1]}")
 
     def pair_block(i, j, si, sj, li, lj, vij, imd, imb, best, worst):
         """All pair quantities for one [bi, bj] block of the sorted

@@ -268,11 +268,38 @@ def _predict_forest_block(x: jax.Array, forest: TreeArrays,
     return out, stopped, i
 
 
+def build_forest_blocks(forest: TreeArrays, tree_class: jax.Array,
+                        tree_block: Optional[int] = None):
+    """Pre-slice a stacked forest into bounded, padded tree blocks ONCE.
+
+    The blocked predict paths used to re-slice and zero-pad-concatenate the
+    stacked forest per block on EVERY call, adding device copies of the
+    whole forest each invocation (ADVICE round 5, predict.py:313). The
+    forest is immutable between calls, so callers (the booster's predict
+    cache, serve's CompiledForestCache) build the blocks once and pass them
+    to :func:`predict_forest` / :func:`predict_forest_leaf`.
+
+    Returns a tuple of ``(block TreeArrays, block tree_class, n_real)``
+    entries, or ``None`` when the forest fits a single dispatch (callers
+    pass the unsliced forest through unchanged in that case)."""
+    T = int(tree_class.shape[0])
+    if tree_block is None:
+        tree_block = int(os.environ.get("LAMBDAGAP_PREDICT_TREE_BLOCK", 64))
+    if tree_block <= 0 or T <= tree_block:
+        return None
+    out = []
+    for b in range(0, T, tree_block):
+        blk, tc = _forest_block(forest, tree_class, b, tree_block, T)
+        out.append((blk, tc, min(b + tree_block, T) - b))
+    return tuple(out)
+
+
 def predict_forest(x: jax.Array, forest: TreeArrays, tree_class: jax.Array,
                    num_class: int, max_depth: int, binned: bool,
                    early_stop_freq: int = 0,
                    early_stop_margin: float = 0.0,
-                   tree_block: Optional[int] = None) -> jax.Array:
+                   tree_block: Optional[int] = None,
+                   blocks=None) -> jax.Array:
     """Sum a whole forest's leaf values into per-class scores.
 
     x: [N, D] raw floats (binned=False) or [N, F] binned (binned=True).
@@ -295,21 +322,25 @@ def predict_forest(x: jax.Array, forest: TreeArrays, tree_class: jax.Array,
     carried between dispatches: no single kernel grows with the forest, so
     a 500+ tree forest never exceeds what the device (or a tunneled
     worker) tolerates, at the cost of T/block dispatches. Forests at most
-    one block long compile to the identical single kernel as before."""
+    one block long compile to the identical single kernel as before.
+
+    ``blocks``: pre-sliced device blocks from :func:`build_forest_blocks`;
+    passing them skips the per-call forest re-slice entirely."""
     N = x.shape[0]
     T = tree_class.shape[0]
     if tree_block is None:
         tree_block = int(os.environ.get("LAMBDAGAP_PREDICT_TREE_BLOCK", 64))
     init = (jnp.zeros((num_class, N), jnp.float32),
             jnp.zeros(N, dtype=bool), jnp.int32(0))
-    if tree_block <= 0 or T <= tree_block:
-        out, _, _ = _predict_forest_block(
-            x, forest, tree_class, init, num_class, max_depth, binned,
-            early_stop_freq, early_stop_margin)
-        return out
+    if blocks is None:
+        if tree_block <= 0 or T <= tree_block:
+            out, _, _ = _predict_forest_block(
+                x, forest, tree_class, init, num_class, max_depth, binned,
+                early_stop_freq, early_stop_margin)
+            return out
+        blocks = build_forest_blocks(forest, tree_class, tree_block)
     carry = init
-    for b in range(0, T, tree_block):
-        blk, tc = _forest_block(forest, tree_class, b, tree_block, T)
+    for blk, tc, _ in blocks:
         carry = _predict_forest_block(
             x, blk, tc, carry, num_class, max_depth, binned,
             early_stop_freq, early_stop_margin)
@@ -348,23 +379,25 @@ def _predict_forest_leaf_block(x: jax.Array, forest: TreeArrays,
 
 def predict_forest_leaf(x: jax.Array, forest: TreeArrays,
                         max_depth: int, binned: bool,
-                        tree_block: Optional[int] = None) -> jax.Array:
+                        tree_block: Optional[int] = None,
+                        blocks=None) -> jax.Array:
     """Leaf index per (tree, row) for a whole forest: [T, N] int32.
 
     Dispatched in the same bounded tree blocks as :func:`predict_forest`
     (refit / linear-tree replay / pred_leaf hit this path with full-size
     forests, where a single T-long scan kernel can fault a tunneled
-    worker just like the score scan)."""
+    worker just like the score scan). ``blocks`` from
+    :func:`build_forest_blocks` skips the per-call forest re-slice."""
     T = forest.leaf_value.shape[0]
     if tree_block is None:
         tree_block = int(os.environ.get("LAMBDAGAP_PREDICT_TREE_BLOCK", 64))
-    if tree_block <= 0 or T <= tree_block:
-        return _predict_forest_leaf_block(x, forest, max_depth, binned)
+    if blocks is None:
+        if tree_block <= 0 or T <= tree_block:
+            return _predict_forest_leaf_block(x, forest, max_depth, binned)
+        blocks = build_forest_blocks(
+            forest, jnp.zeros(T, jnp.int32), tree_block)
     outs = []
-    dummy_tc = jnp.zeros(T, jnp.int32)
-    for b in range(0, T, tree_block):
-        blk, _ = _forest_block(forest, dummy_tc, b, tree_block, T)
+    for blk, _, n_real in blocks:
         ys = _predict_forest_leaf_block(x, blk, max_depth, binned)
-        hi = min(b + tree_block, T)
-        outs.append(ys[:hi - b])
+        outs.append(ys[:n_real])
     return jnp.concatenate(outs, axis=0)

@@ -1,0 +1,24 @@
+"""lambdagap_tpu.serve — batched, hot-swappable TPU inference.
+
+A serving layer above the one-shot predict ops: a device-resident
+compiled-forest cache with padding-bucket executables (cache.py), a
+micro-batching request queue (batcher.py), atomic generation-pointer model
+hot-swap (swap.py) and a serving metrics layer (stats.py), fronted by
+:class:`ForestServer` (server.py). Entry points::
+
+    server = booster.as_server()                  # Python API
+    python -m lambdagap_tpu task=serve \
+        input_model=model.txt data=requests.tsv   # CLI request loop
+
+See docs/serving.md for bucket policy, swap semantics and the metrics
+schema.
+"""
+from .batcher import MicroBatcher, Request
+from .cache import DEFAULT_BUCKETS, CompiledForestCache
+from .server import ForestServer, ServeResult, serve_loop
+from .stats import ServeStats
+from .swap import SwapController, load_booster
+
+__all__ = ["ForestServer", "ServeResult", "serve_loop", "MicroBatcher",
+           "Request", "CompiledForestCache", "DEFAULT_BUCKETS",
+           "ServeStats", "SwapController", "load_booster"]

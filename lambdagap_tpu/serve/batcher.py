@@ -1,0 +1,141 @@
+"""Micro-batching request queue: coalesce concurrent predicts into one
+padded device dispatch.
+
+A single worker thread drains a thread-safe queue under a
+max-batch/max-latency policy (the classic dynamic-batching scheduler of
+TF-Serving/Triton): the first request of a batch opens a window of
+``max_delay_ms``; everything arriving inside the window joins, up to
+``max_batch`` rows, then the whole batch runs as ONE compiled-forest
+dispatch. Batch-size-1 request streams therefore pay one device dispatch
+per ~``max_batch`` requests instead of one each — the coalescing half of
+serve's throughput win (the compile-once half lives in cache.py).
+
+All device work happens on the worker thread; ``submit`` only enqueues, so
+any number of client threads can call it concurrently.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List
+
+import numpy as np
+
+
+class Request:
+    """One queued predict: rows + the future its caller waits on."""
+
+    __slots__ = ("x", "future", "t_submit")
+
+    def __init__(self, x: np.ndarray) -> None:
+        self.x = x
+        self.future: Future = Future()
+        self.t_submit = time.perf_counter()
+
+
+_SENTINEL = object()
+
+
+class MicroBatcher:
+    """Coalesce submitted rows into batches for ``run_batch``.
+
+    run_batch: callable(List[Request]) — must resolve every request's
+    future (result or exception). Exceptions escaping it are fanned out to
+    the batch's unresolved futures so no caller ever hangs.
+    """
+
+    def __init__(self, run_batch: Callable[[List[Request]], None],
+                 max_batch: int = 4096, max_delay_ms: float = 2.0,
+                 workers: int = 1, stats=None,
+                 name: str = "lambdagap-serve-batcher") -> None:
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        self._run = run_batch
+        self.max_batch = int(max_batch)
+        self.max_delay = max(float(max_delay_ms), 0.0) / 1e3
+        self.stats = stats
+        self._q: "queue.Queue" = queue.Queue()
+        self._closed = False
+        # >1 workers overlap independent batch dispatches (jitted calls
+        # release the GIL while executing); correctness is per-batch, so
+        # workers share nothing but the queue and the stats lock
+        self._threads = [threading.Thread(target=self._loop, daemon=True,
+                                          name=f"{name}-{i}")
+                         for i in range(max(int(workers), 1))]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, x: np.ndarray) -> Future:
+        """Enqueue [n, D] float32 rows; returns the Future the worker will
+        resolve. Thread-safe."""
+        if self._closed:
+            raise RuntimeError("MicroBatcher is closed")
+        req = Request(x)
+        self._q.put(req)
+        return req.future
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop accepting work, flush everything already queued, join the
+        workers. Queued requests are never dropped: FIFO ordering puts the
+        sentinels after every prior submit, and a worker that misses its
+        sentinel still exits once the queue drains (closed + empty)."""
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self._threads:
+            self._q.put(_SENTINEL)
+        for t in self._threads:
+            t.join(timeout)
+
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        drain = False
+        while True:
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                if drain or self._closed:
+                    break
+                continue
+            if first is _SENTINEL:
+                break
+            batch = [first]
+            rows = first.x.shape[0]
+            deadline = first.t_submit + self.max_delay
+            while rows < self.max_batch:
+                wait = deadline - time.perf_counter()
+                if wait <= 0:
+                    # opportunistic non-blocking drain past the deadline:
+                    # anything already queued still joins this dispatch
+                    try:
+                        nxt = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                else:
+                    try:
+                        nxt = self._q.get(timeout=wait)
+                    except queue.Empty:
+                        break
+                if nxt is _SENTINEL:
+                    drain = True
+                    break
+                batch.append(nxt)
+                rows += nxt.x.shape[0]
+            self._dispatch(batch, rows)
+            if drain:
+                break
+
+    def _dispatch(self, batch: List[Request], rows: int) -> None:
+        if self.stats is not None:
+            self.stats.record_batch(len(batch), rows)
+        try:
+            self._run(batch)
+        except BaseException as e:  # noqa: BLE001 — worker must survive
+            for r in batch:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            if self.stats is not None:
+                self.stats.record_error()

@@ -1,0 +1,215 @@
+"""Device-resident compiled forest with padding-bucket executables.
+
+The one-shot predict path converts the forest to device arrays on every
+call; serving amortizes that to zero: ``CompiledForestCache`` stacks the
+booster's trees into :class:`~lambdagap_tpu.ops.predict.TreeArrays` blocks
+ONCE (they stay resident in HBM), and routes every request batch through a
+small set of fixed padding buckets (default 1/8/64/512/4096 rows) so
+arbitrary request sizes always hit an already-compiled XLA executable —
+the serving analog of the reference's ``SingleRowPredictorInner`` keeping
+one predictor object warm per booster (reference: src/c_api.cpp:63), but
+for whole padded device batches.
+
+Caches are keyed by ``(model_generation, start_iteration, num_iteration)``;
+any in-place mutation of the booster bumps its generation
+(``GBDT.invalidate_predict_cache``), so a stale compiled forest can never
+be served.
+
+Numerics: a bucket dispatch runs the exact device ops of the one-shot
+``GBDT.predict_raw`` device branch (same stacked blocks, same scan, same
+elementwise transform), and rows are independent under ``vmap``, so padded
+batches return bit-identical outputs to a direct ``Booster.predict`` that
+takes the device path.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.predict import build_forest_blocks, forest_to_arrays, predict_forest
+from ..utils import log
+
+# powers chosen so the jump between buckets wastes at most ~8x padding on
+# pathological sizes while keeping the compiled-executable set tiny
+DEFAULT_BUCKETS = (1, 8, 64, 512, 4096)
+
+
+class CompiledForestCache:
+    """One booster generation, compiled for serving.
+
+    Parameters
+    ----------
+    gbdt: models.gbdt.GBDT — the loaded booster.
+    buckets: padded batch sizes to pre-compile (sorted, deduped).
+    start_iteration / num_iteration: forest slice, as in ``predict``.
+    generation: serving generation id stamped on every response.
+    stats: optional ``ServeStats`` for cache accounting.
+    """
+
+    def __init__(self, gbdt, buckets: Optional[Sequence[int]] = None,
+                 start_iteration: int = 0, num_iteration: int = -1,
+                 generation: int = 0, stats=None,
+                 tree_block: Optional[int] = None) -> None:
+        self.gbdt = gbdt
+        self.generation = int(generation)
+        self.start_iteration = int(start_iteration)
+        self.num_iteration = int(num_iteration)
+        self.stats = stats
+        bl = tuple(sorted({int(b) for b in (buckets or DEFAULT_BUCKETS)
+                           if int(b) > 0}))
+        if not bl:
+            raise ValueError("serve needs at least one positive bucket size")
+        self.buckets = bl
+        self.key = (getattr(gbdt, "generation", 0),
+                    self.start_iteration, self.num_iteration)
+
+        idx = gbdt._model_slice(start_iteration, num_iteration)
+        gbdt._materialize_lazy(idx)
+        trees = [gbdt._tree(i) for i in idx]
+        if any(getattr(t, "is_linear", False) for t in trees):
+            raise ValueError(
+                "serve does not support linear_tree models: linear leaf "
+                "payloads are evaluated host-side (use Booster.predict)")
+        self.idx = idx
+        self.num_class = gbdt.num_tree_per_iteration
+        # matrix width the compiled executables expect: 1 + max split
+        # feature. Wider request rows are truncated (trailing columns can
+        # never be gathered by any node), narrower ones are padded by the
+        # server under predict_disable_shape_check.
+        self.width = max(1, 1 + max(
+            (max(t.split_feature[:t.num_internal], default=0)
+             for t in trees), default=0)) if trees else 1
+        if tree_block is None:
+            tree_block = int(os.environ.get("LAMBDAGAP_PREDICT_TREE_BLOCK",
+                                            64))
+        self._tree_block = tree_block
+        if idx:
+            forest, depth = forest_to_arrays(trees, use_inner_feature=False)
+            tree_class = jnp.asarray([i % self.num_class for i in idx],
+                                     jnp.int32)
+            self._forest = jax.device_put(forest)
+            self._depth = depth
+            self._tree_class = tree_class
+            self._blocks = build_forest_blocks(self._forest, tree_class,
+                                               tree_block)
+        else:
+            self._forest = None
+            self._depth = 8
+            self._tree_class = jnp.zeros(0, jnp.int32)
+            self._blocks = None
+        cfg = gbdt.config
+        obj = gbdt.objective
+        # margin-based prediction early stop, same gating as predict_raw
+        self._es_freq = (cfg.pred_early_stop_freq * self.num_class
+                         if cfg.pred_early_stop and obj is not None
+                         and obj.name in ("binary", "multiclass",
+                                          "multiclassova") else 0)
+        self._es_margin = float(cfg.pred_early_stop_margin)
+        self._n_iters = max(1, len(idx) // max(self.num_class, 1))
+        self._warm: set = set()
+        self._warm_lock = threading.Lock()
+        self.build_time_s = 0.0
+        if stats is not None:
+            stats.record_forest_build()
+
+    # ------------------------------------------------------------------
+    def bucket_of(self, n: int) -> int:
+        """Smallest pre-compiled bucket holding ``n`` rows (requests larger
+        than the top bucket are chunked by the caller)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def plan(self, n: int):
+        """Greedy decomposition of ``n`` rows into (rows, bucket) dispatches.
+
+        Full buckets dispatch unpadded; a padded dispatch is only taken
+        when its bucket is at most 2x the remaining rows (or nothing
+        smaller fits), so padding waste per batch stays under 2x instead
+        of the up-to-8x a naive round-up to the next bucket can cost
+        between sparse bucket sizes."""
+        out = []
+        rem = n
+        while rem > 0:
+            b_pad = next((b for b in self.buckets if b >= rem), None)
+            b_full = next((b for b in reversed(self.buckets) if b <= rem),
+                          None)
+            if b_pad is not None and (b_full is None or b_pad <= 2 * rem):
+                out.append((rem, b_pad))
+                rem = 0
+            else:
+                out.append((b_full, b_full))
+                rem -= b_full
+        return out
+
+    def _dispatch(self, xb: np.ndarray, raw_score: bool) -> jax.Array:
+        """One padded bucket through the compiled forest: [num_class, B]."""
+        out = predict_forest(jnp.asarray(xb), self._forest, self._tree_class,
+                             self.num_class, self._depth, binned=False,
+                             early_stop_freq=self._es_freq,
+                             early_stop_margin=self._es_margin,
+                             tree_block=self._tree_block,
+                             blocks=self._blocks)
+        if self.gbdt.average_output:
+            out = out / self._n_iters
+        obj = self.gbdt.objective
+        if not raw_score and obj is not None:
+            out = obj.convert_output(out)
+        return out
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                record: bool = True) -> np.ndarray:
+        """Predict [N, width] float32 rows; returns [N] (one class) or
+        [N, K], matching ``Booster.predict`` semantics bit-for-bit on the
+        device path. N is chunked by the largest bucket, each chunk padded
+        up to its bucket with zero rows that are sliced off after."""
+        X = np.ascontiguousarray(np.asarray(X, dtype=np.float32))
+        if X.ndim != 2:
+            raise ValueError(f"serve predict expects 2-D rows, got {X.shape}")
+        N = X.shape[0]
+        K = self.num_class
+        if self._forest is None or N == 0:
+            res = np.zeros((K, N), dtype=np.float32)
+            return res[0] if K == 1 else res.T
+        parts = []
+        lo = 0
+        for n, b in self.plan(N):
+            chunk = X[lo:lo + n]
+            lo += n
+            if n < b:
+                chunk = np.concatenate(
+                    [chunk, np.zeros((b - n, X.shape[1]), np.float32)])
+            with self._warm_lock:        # parallel batch workers share this
+                hit = b in self._warm
+                if not hit:
+                    self._warm.add(b)
+            if record and self.stats is not None:
+                self.stats.record_cache(hit, bucket=b)
+            if not hit and self.stats is not None:
+                self.stats.record_bucket_compile(b)
+            out = self._dispatch(chunk, raw_score)
+            parts.append(np.asarray(jax.device_get(out))[:, :n])
+        res = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
+        return res[0] if K == 1 else res.T
+
+    def warm(self) -> float:
+        """Compile + execute every bucket once on zero rows so the first
+        real request of any size hits a warm executable. Returns the time
+        spent (also kept as ``build_time_s``); warm dispatches do not count
+        toward hit/miss stats."""
+        t0 = time.perf_counter()
+        for b in self.buckets:
+            self.predict(np.zeros((b, self.width), np.float32), record=False)
+        self.build_time_s = time.perf_counter() - t0
+        log.info("serve: warmed %d padding buckets %s in %.2fs "
+                 "(generation %d, %d trees)", len(self.buckets),
+                 list(self.buckets), self.build_time_s, self.generation,
+                 len(self.idx))
+        return self.build_time_s

@@ -1,0 +1,226 @@
+"""ForestServer: the serving front door.
+
+Composes the three serving pieces — :class:`CompiledForestCache`
+(compile-once device forest + padding buckets), :class:`MicroBatcher`
+(request coalescing) and :class:`SwapController` (atomic hot-swap) — behind
+a two-call API::
+
+    server = booster.as_server()          # or ForestServer(booster)
+    y = server.predict(x_row)             # blocking, batched under the hood
+    fut = server.submit(rows)             # async: Future[ServeResult]
+    server.swap("model_v2.txt")           # zero-downtime model replace
+    print(server.stats_json())
+    server.close()
+
+Every response is a :class:`ServeResult` carrying the generation that
+produced it, which is what makes hot-swap correctness testable: under a
+concurrent stream, each result matches exactly one generation's forest.
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from typing import List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+from .batcher import MicroBatcher, Request
+from .cache import DEFAULT_BUCKETS, CompiledForestCache
+from .stats import ServeStats
+from .swap import SwapController
+
+
+class ServeResult(NamedTuple):
+    """One request's predictions + the model generation that served it."""
+    values: np.ndarray
+    generation: int
+
+
+class ForestServer:
+    """Batched, hot-swappable TPU inference server for one booster.
+
+    Accepts a ``basic.Booster`` or a ``models.gbdt.GBDT``. Defaults for the
+    batching/bucket knobs come from the booster's config (``serve_*``
+    parameters); keyword arguments override.
+    """
+
+    def __init__(self, model, buckets: Optional[Sequence[int]] = None,
+                 max_batch: Optional[int] = None,
+                 max_delay_ms: Optional[float] = None,
+                 workers: Optional[int] = None,
+                 warmup: Optional[bool] = None,
+                 raw_score: bool = False,
+                 start_iteration: int = 0, num_iteration: int = -1,
+                 stats: Optional[ServeStats] = None) -> None:
+        gbdt = model._booster if hasattr(model, "_booster") else model
+        cfg = gbdt.config
+        self.raw_score = bool(raw_score)
+        self._buckets = tuple(buckets if buckets is not None
+                              else (cfg.serve_buckets or DEFAULT_BUCKETS))
+        self._warmup = bool(cfg.serve_warmup if warmup is None else warmup)
+        self._si = int(start_iteration)
+        self._ni = int(num_iteration)
+        self.stats = stats if stats is not None else ServeStats()
+        self._closed = False
+        self._swap = SwapController(self._build_cache, stats=self.stats)
+        self._swap.install(gbdt)
+        nw = int(cfg.serve_workers if workers is None else workers)
+        if nw <= 0:                      # auto: overlap dispatches, bounded
+            import os
+            nw = max(1, min(4, (os.cpu_count() or 1) // 2))
+        self._batcher = MicroBatcher(
+            self._run_batch,
+            max_batch=int(cfg.serve_max_batch if max_batch is None
+                          else max_batch),
+            max_delay_ms=float(cfg.serve_max_delay_ms if max_delay_ms is None
+                               else max_delay_ms),
+            workers=nw,
+            stats=self.stats)
+
+    # ------------------------------------------------------------------
+    def _build_cache(self, gbdt, generation: int) -> CompiledForestCache:
+        cache = CompiledForestCache(
+            gbdt, buckets=self._buckets, start_iteration=self._si,
+            num_iteration=self._ni, generation=generation, stats=self.stats)
+        if self._warmup:
+            cache.warm()
+        return cache
+
+    @property
+    def generation(self) -> int:
+        return self._swap.active.generation
+
+    @property
+    def num_features(self) -> int:
+        """Width the active compiled forest consumes (1 + max split
+        feature); narrower requests error unless
+        predict_disable_shape_check pads them with NaN."""
+        return self._swap.active.width
+
+    # -- request path ---------------------------------------------------
+    def submit(self, x) -> "Future[ServeResult]":
+        """Async predict: enqueue rows, return a Future of
+        :class:`ServeResult`. ``x`` is one row [D] or a matrix [n, D]."""
+        if self._closed:
+            raise RuntimeError("ForestServer is closed")
+        x = np.asarray(x, dtype=np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        if x.ndim != 2:
+            raise ValueError(f"serve requests are rows [n, D], got {x.shape}")
+        return self._batcher.submit(x)
+
+    def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking predict with ``Booster.predict`` output semantics:
+        [n] for single-class models, [n, K] for multiclass."""
+        return self.submit(x).result(timeout).values
+
+    # -- hot swap -------------------------------------------------------
+    def swap(self, source, params=None, background: bool = False):
+        """Atomically replace the served model (path, model text, Booster
+        or GBDT). The new forest is compiled and pre-warmed BEFORE the
+        generation pointer flips; in-flight requests finish on the old
+        forest. Returns the new generation (or the worker thread when
+        ``background=True``)."""
+        return self._swap.swap(source, params=params, background=background)
+
+    # -- metrics / lifecycle -------------------------------------------
+    def stats_snapshot(self) -> dict:
+        snap = self.stats.snapshot()
+        snap["generation"] = self.generation
+        snap["buckets"] = list(self._swap.active.buckets)
+        return snap
+
+    def stats_json(self, **kwargs) -> str:
+        import json
+        kwargs.setdefault("indent", 2)
+        return json.dumps(self.stats_snapshot(), **kwargs)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Flush queued requests and stop the batcher thread."""
+        if not self._closed:
+            self._closed = True
+            self._batcher.close(timeout)
+
+    def __enter__(self) -> "ForestServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    def _run_batch(self, batch: List[Request]) -> None:
+        """Worker-thread batch execution: snapshot the active generation
+        once, validate widths against it, run ONE padded dispatch, scatter
+        results back to futures."""
+        slot = self._swap.active         # one generation per batch
+        t0 = time.perf_counter()
+        W = slot.width
+        disable_check = slot.gbdt.config.predict_disable_shape_check
+        rows: List[np.ndarray] = []
+        good: List[Request] = []
+        for r in batch:
+            x = r.x
+            if x.shape[1] < W:
+                if not disable_check:
+                    r.future.set_exception(ValueError(
+                        f"request has {x.shape[1]} features but the model "
+                        f"needs {W}; set predict_disable_shape_check=true "
+                        "to pad missing features with NaN"))
+                    self.stats.record_error()
+                    continue
+                x = np.concatenate(
+                    [x, np.full((x.shape[0], W - x.shape[1]), np.nan,
+                                np.float32)], axis=1)
+            rows.append(np.ascontiguousarray(x[:, :W]))
+            good.append(r)
+        if not good:
+            return
+        X = rows[0] if len(rows) == 1 else np.concatenate(rows, axis=0)
+        out = slot.predict(X, raw_score=self.raw_score)
+        t1 = time.perf_counter()
+        lo = 0
+        for r, x in zip(good, rows):
+            n = x.shape[0]
+            r.future.set_result(ServeResult(out[lo:lo + n],
+                                            slot.generation))
+            lo += n
+            self.stats.record_request(queue_wait=t0 - r.t_submit,
+                                      device=t1 - t0,
+                                      total=time.perf_counter() - r.t_submit,
+                                      rows=n)
+
+
+def serve_loop(server: ForestServer, lines, out_stream,
+               on_swap=None) -> int:
+    """Drive a server from an iterable of text request lines (the CLI's
+    ``task=serve`` loop; factored here so tests can drive it without a
+    process). One feature row per line (TSV or CSV); ``swap=<model>``
+    lines hot-swap mid-stream. Returns the number of served requests."""
+    futures = []
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("swap="):
+            target = line.split("=", 1)[1].strip()
+            gen = server.swap(target)
+            if on_swap is not None:
+                on_swap(target, gen)
+            continue
+        delim = "\t" if "\t" in line else ","
+        row = np.array([_parse_cell(tok) for tok in line.split(delim)],
+                       dtype=np.float32)
+        futures.append(server.submit(row))
+    for f in futures:
+        vals = np.atleast_1d(np.asarray(f.result().values)).reshape(-1)
+        out_stream.write("\t".join(f"{v:.10g}" for v in vals) + "\n")
+    return len(futures)
+
+
+def _parse_cell(tok: str) -> float:
+    try:
+        return float(tok)
+    except ValueError:
+        return float("nan")

@@ -1,0 +1,164 @@
+"""Serving metrics: latency distributions, throughput, cache accounting.
+
+The serving analog of the training loop's ``utils.timer`` — every number a
+production operator needs to size a fleet (the reference ships none of this;
+the schema follows what TF-Serving/Triton-style batchers expose: per-request
+queue wait, device time, end-to-end percentiles, batch occupancy, cache
+hit rates, swap counts). All methods are thread-safe; ``snapshot`` is cheap
+enough to poll.
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class _Reservoir:
+    """Bounded latency sample with uniform reservoir replacement, so
+    million-request streams keep O(cap) memory but exact-ish percentiles."""
+
+    __slots__ = ("cap", "seen", "vals", "_rng")
+
+    def __init__(self, cap: int = 100_000, seed: int = 0) -> None:
+        self.cap = cap
+        self.seen = 0
+        self.vals: List[float] = []
+        self._rng = random.Random(seed)
+
+    def add(self, v: float) -> None:
+        self.seen += 1
+        if len(self.vals) < self.cap:
+            self.vals.append(v)
+        else:
+            j = self._rng.randrange(self.seen)
+            if j < self.cap:
+                self.vals[j] = v
+
+    def percentiles(self, qs=(0.5, 0.95, 0.99)) -> Dict[str, float]:
+        if not self.vals:
+            return {f"p{int(q * 100)}": 0.0 for q in qs} | {
+                "mean": 0.0, "max": 0.0}
+        s = sorted(self.vals)
+        out = {}
+        for q in qs:
+            k = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+            out[f"p{int(q * 100)}"] = s[k]
+        out["mean"] = sum(s) / len(s)
+        out["max"] = s[-1]
+        return out
+
+
+class ServeStats:
+    """Thread-safe serving counters + latency reservoirs.
+
+    Times are recorded in seconds and reported in milliseconds. Schema of
+    :meth:`snapshot` is documented in docs/serving.md and is the JSON the
+    ``task=serve`` CLI and ``bench_serve.py`` emit.
+    """
+
+    def __init__(self, max_samples: int = 100_000) -> None:
+        self._lock = threading.Lock()
+        self.t_start = time.perf_counter()
+        self.n_requests = 0
+        self.n_rows = 0
+        self.n_batches = 0
+        self.n_batch_rows = 0
+        self.n_errors = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.per_bucket: Dict[int, Dict[str, int]] = {}
+        self.forest_builds = 0
+        self.bucket_compiles = 0
+        self.swaps = 0
+        self._lat = _Reservoir(max_samples, seed=1)
+        self._queue_wait = _Reservoir(max_samples, seed=2)
+        self._device = _Reservoir(max_samples, seed=3)
+
+    # -- recording ------------------------------------------------------
+    def record_request(self, queue_wait: float, device: float, total: float,
+                       rows: int = 1) -> None:
+        with self._lock:
+            self.n_requests += 1
+            self.n_rows += rows
+            self._lat.add(total)
+            self._queue_wait.add(queue_wait)
+            self._device.add(device)
+
+    def record_batch(self, n_requests: int, rows: int) -> None:
+        with self._lock:
+            self.n_batches += 1
+            self.n_batch_rows += rows
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.n_errors += 1
+
+    def record_cache(self, hit: bool, bucket: Optional[int] = None) -> None:
+        with self._lock:
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.cache_misses += 1
+            if bucket is not None:
+                b = self.per_bucket.setdefault(int(bucket),
+                                               {"hits": 0, "misses": 0})
+                b["hits" if hit else "misses"] += 1
+
+    def record_forest_build(self) -> None:
+        with self._lock:
+            self.forest_builds += 1
+
+    def record_bucket_compile(self, bucket: int) -> None:
+        with self._lock:
+            self.bucket_compiles += 1
+
+    def record_swap(self) -> None:
+        with self._lock:
+            self.swaps += 1
+
+    # -- reporting ------------------------------------------------------
+    @staticmethod
+    def _ms(d: Dict[str, float]) -> Dict[str, float]:
+        return {k: v * 1e3 for k, v in d.items()}
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            elapsed = max(time.perf_counter() - self.t_start, 1e-9)
+            total = self.cache_hits + self.cache_misses
+            return {
+                "requests": self.n_requests,
+                "rows": self.n_rows,
+                "errors": self.n_errors,
+                "elapsed_s": elapsed,
+                "throughput_rps": self.n_requests / elapsed,
+                "throughput_rows_per_s": self.n_rows / elapsed,
+                "latency_ms": self._ms(self._lat.percentiles()),
+                "queue_wait_ms": self._ms(self._queue_wait.percentiles()),
+                "device_ms": self._ms(self._device.percentiles()),
+                "batches": {
+                    "count": self.n_batches,
+                    "mean_rows": (self.n_batch_rows / self.n_batches
+                                  if self.n_batches else 0.0),
+                },
+                "cache": {
+                    "hits": self.cache_hits,
+                    "misses": self.cache_misses,
+                    "hit_rate": (self.cache_hits / total) if total else 0.0,
+                    "forest_builds": self.forest_builds,
+                    "bucket_compiles": self.bucket_compiles,
+                    "per_bucket": {str(k): dict(v)
+                                   for k, v in self.per_bucket.items()},
+                },
+                "swaps": self.swaps,
+            }
+
+    def to_json(self, **kwargs) -> str:
+        kwargs.setdefault("indent", 2)
+        return json.dumps(self.snapshot(), **kwargs)
+
+    def dump(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json() + "\n")

@@ -1,0 +1,244 @@
+"""lambdagap_tpu.serve — batched, hot-swappable inference.
+
+Covers the ISSUE-1 acceptance surface: padding-bucket outputs bit-identical
+to the device ``Booster.predict`` path (incl. ragged chunks), micro-batcher
+coalescing, cache hit accounting (compile-once forest), atomic hot-swap
+under concurrent load (no dropped/torn responses), and the booster-side
+device-forest cache reuse (ADVICE predict.py:313).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification, make_regression
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.serve import ForestServer
+
+
+# tpu_fast_predict_rows=0 drops the native small-batch shortcut to its
+# 512-row floor, so a >512-row Booster.predict takes the device path the
+# serve cache must match bit-for-bit
+DEVICE_PARAMS = {"verbose": -1, "tpu_fast_predict_rows": 0}
+
+
+def _train_binary(rows=1500, feats=12, rounds=12, seed=0, **extra):
+    X, y = make_classification(rows, feats, n_informative=6,
+                               random_state=seed)
+    X = X.astype(np.float32)
+    X[::17, 3] = np.nan
+    params = {"objective": "binary", "num_leaves": 15, **DEVICE_PARAMS,
+              **extra}
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds), X
+
+
+def test_bucket_outputs_bit_identical_to_device_predict():
+    b, X = _train_binary()
+    ref = b.predict(X[:600])        # 600 > 512 rows -> device path
+    with b.as_server(buckets=(1, 8, 64), warmup=True) as s:
+        # every bucket + ragged sizes + chunking past the largest bucket
+        sizes = [1, 3, 8, 11, 64, 100, 129]
+        outs, lo = [], 0
+        for n in sizes:
+            outs.append(s.predict(X[lo:lo + n]))
+            lo += n
+        got = np.concatenate(outs)
+    assert lo <= 600
+    assert np.array_equal(got, ref[:lo]), "serve outputs must be bit-identical"
+
+
+def test_multiclass_and_raw_score_match():
+    X, y = make_classification(1200, 10, n_informative=6, n_classes=3,
+                               random_state=3)
+    X = X.astype(np.float32)
+    b = lgb.train({"objective": "multiclass", "num_class": 3,
+                   **DEVICE_PARAMS}, lgb.Dataset(X, label=y),
+                  num_boost_round=6)
+    ref = b.predict(X[:600])
+    ref_raw = b.predict(X[:600], raw_score=True)
+    with b.as_server(buckets=(8, 64)) as s:
+        got = np.vstack([s.predict(X[i:i + 50]) for i in range(0, 600, 50)])
+    with b.as_server(buckets=(64,), raw_score=True) as s:
+        got_raw = np.vstack([s.predict(X[i:i + 60])
+                             for i in range(0, 600, 60)])
+    assert np.array_equal(got, ref)
+    assert np.array_equal(got_raw, ref_raw)
+
+
+def test_batcher_coalesces_concurrent_submits():
+    b, X = _train_binary()
+    ref = b.predict(X[:600])
+    s = b.as_server(buckets=(1, 8, 64, 512), max_delay_ms=60.0,
+                    max_batch=512)
+    try:
+        futs = [s.submit(X[i]) for i in range(128)]
+        res = [f.result(timeout=30) for f in futs]
+    finally:
+        s.close()
+    for i, r in enumerate(res):
+        assert np.array_equal(r.values, ref[i:i + 1])
+        assert r.generation == 0
+    snap = s.stats_snapshot()
+    assert snap["requests"] == 128
+    # coalescing must have packed many batch-1 submits per dispatch
+    assert snap["batches"]["count"] < 64
+    assert snap["batches"]["mean_rows"] > 2.0
+
+
+def test_cache_hit_accounting_and_warm_buckets():
+    b, X = _train_binary()
+    with b.as_server(buckets=(8, 64), warmup=True) as s:
+        for _ in range(5):
+            s.predict(X[:8])
+        snap = s.stats_snapshot()
+    cache = snap["cache"]
+    assert cache["forest_builds"] == 1
+    assert cache["bucket_compiles"] == 2      # one per bucket, at warmup
+    assert cache["misses"] == 0               # warmup pre-compiled both
+    assert cache["hits"] == 5
+    assert cache["per_bucket"]["8"]["hits"] == 5
+
+
+def test_booster_predict_reuses_cached_device_forest():
+    """ADVICE predict.py:313: two consecutive predict calls must reuse the
+    cached device forest instead of re-slicing/re-uploading it."""
+    b, X = _train_binary()
+    gb = b._booster
+    first = b.predict(X[:600])
+    cache1 = gb._forest_cache
+    assert cache1 is not None
+    second = b.predict(X[:600])
+    assert gb._forest_cache is cache1         # no rebuild
+    assert gb._forest_cache[1][0] is cache1[1][0]   # same TreeArrays object
+    assert np.array_equal(first, second)
+    # in-place leaf mutation must invalidate (generation bump)
+    gen = gb.generation
+    b.set_leaf_output(0, 0, 123.0)
+    assert gb._forest_cache is None
+    assert gb.generation == gen + 1
+    changed = b.predict(X[:600])
+    assert not np.array_equal(first, changed)
+
+
+def test_hot_swap_atomic_under_concurrent_load(tmp_path):
+    b_old, X = _train_binary(seed=0)
+    b_new, _ = _train_binary(seed=7, rounds=9)
+    new_path = str(tmp_path / "new_model.txt")
+    b_new.save_model(new_path)
+
+    ref_old = b_old.predict(X[:600])
+    ref_new = b_new.predict(X[:600])
+    assert not np.allclose(ref_old, ref_new)
+
+    s = b_old.as_server(buckets=(1, 8, 64), max_delay_ms=1.0)
+    results = {}
+    errors = []
+    stop = threading.Event()
+
+    def client(cid):
+        try:
+            i = cid
+            while not stop.is_set():
+                r = s.submit(X[i % 600]).result(timeout=30)
+                results.setdefault(i % 600, []).append(
+                    (r.generation, r.values.copy()))
+                i += 7
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.25)
+    new_gen = s.swap(new_path)
+    assert new_gen == 1
+    # post-swap requests must be served by the new generation
+    post = s.submit(X[0]).result(timeout=30)
+    assert post.generation == 1
+    time.sleep(0.25)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    s.close()
+    assert not errors
+    gens = set()
+    n_responses = 0
+    for row, obs in results.items():
+        for gen, vals in obs:
+            n_responses += 1
+            gens.add(gen)
+            expect = ref_old if gen == 0 else ref_new
+            assert np.array_equal(vals, expect[row:row + 1]), \
+                "response must match exactly one generation's forest"
+    assert n_responses > 0
+    assert gens == {0, 1}, "stream must span the swap"
+    assert s.stats_snapshot()["swaps"] == 1
+    # zero dropped: every recorded response resolved with a value
+    assert s.stats_snapshot()["errors"] == 0
+
+
+def test_swap_from_in_memory_booster_and_num_iteration():
+    b, X = _train_binary()
+    ref_5 = b.predict(X[:600], num_iteration=5)
+    with ForestServer(b, buckets=(64,), num_iteration=5) as s:
+        got = np.concatenate([s.predict(X[i:i + 64])
+                              for i in range(0, 576, 64)])
+    assert np.array_equal(got, ref_5[:576])
+
+
+def test_serve_rejects_narrow_rows_and_linear_trees():
+    b, X = _train_binary()
+    with b.as_server(buckets=(8,)) as s:
+        fut = s.submit(X[0, :2])
+        with pytest.raises(ValueError, match="features"):
+            fut.result(timeout=30)
+    Xr, yr = make_regression(600, 6, noise=1.0, random_state=1)
+    br = lgb.train({"objective": "regression", "linear_tree": True,
+                    "verbose": -1}, lgb.Dataset(Xr, label=yr),
+                   num_boost_round=3)
+    with pytest.raises(ValueError, match="linear_tree"):
+        br.as_server()
+
+
+def test_cli_task_serve_roundtrip(tmp_path):
+    from lambdagap_tpu.cli import main as cli_main
+    b, X = _train_binary()
+    model = str(tmp_path / "model.txt")
+    b.save_model(model)
+    req = tmp_path / "requests.tsv"
+    with open(req, "w") as f:
+        for i in range(40):
+            f.write("\t".join(f"{v:.8g}" for v in X[i]) + "\n")
+    out = str(tmp_path / "preds.tsv")
+    stats = str(tmp_path / "stats.json")
+    rc = cli_main([f"task=serve", f"input_model={model}", f"data={req}",
+                   f"output_result={out}", f"serve_stats_file={stats}",
+                   "verbose=-1"])
+    assert rc == 0
+    got = np.loadtxt(out)
+    ref = b.predict(X[:600])[:40]
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-9)
+    import json
+    snap = json.load(open(stats))
+    assert snap["requests"] == 40
+    assert "p99" in snap["latency_ms"]
+
+
+def test_lambdarank_tile_must_divide_bucket_length():
+    """Satellite (ADVICE rank.py:478): a non-divisor tile fails loudly
+    instead of silently misaligning rank indices."""
+    import jax.numpy as jnp
+    from lambdagap_tpu.objectives.rank import _lambdarank_bucket
+    nq, L = 2, 96
+    scores = jnp.zeros((nq, L), jnp.float32)
+    labels = jnp.zeros((nq, L), jnp.int32)
+    valid = jnp.ones((nq, L), bool)
+    inv = jnp.ones(nq, jnp.float32)
+    gains = jnp.asarray([0.0, 1.0, 3.0], jnp.float32)
+    with pytest.raises(ValueError, match="divide"):
+        _lambdarank_bucket(scores, labels, valid, inv, inv, gains,
+                           target="ndcg", sigmoid=1.0, norm=True,
+                           truncation_level=30, lambdagap_weight=1.0,
+                           tile=40)
