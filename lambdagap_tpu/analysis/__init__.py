@@ -1,0 +1,39 @@
+"""graftlint — AST-level hazard analysis for the lambdagap_tpu codebase.
+
+Usage::
+
+    python -m lambdagap_tpu.analysis lambdagap_tpu/        # scan, exit 1 on findings
+    python tools/graftlint.py lambdagap_tpu/               # same, via wrapper
+    python -m lambdagap_tpu.analysis --list-rules
+    python -m lambdagap_tpu.analysis --write-baseline lambdagap_tpu/
+
+Programmatic::
+
+    from lambdagap_tpu.analysis import scan
+    findings = scan(["lambdagap_tpu"])
+
+Rules (see docs/static-analysis.md for the full rationale):
+
+- R1 host-device sync in hot paths
+- R2 jit recompile hazards
+- R3 clamped dynamic_slice starts without a guarding invariant
+- R4 dtype drift (array creation without an explicit dtype)
+- R5 serve-layer lock discipline
+- R6 collective axis-name consistency
+
+Intentionally import-light: no jax import happens here, so the linter runs
+in milliseconds and can scan trees that do not import.
+"""
+from __future__ import annotations
+
+from .core import (Finding, ModuleContext, PackageIndex, Rule,  # noqa: F401
+                   all_rules, apply_baseline, load_baseline, register_rule,
+                   scan, write_baseline)
+from . import rules  # noqa: F401  (registers R1..R6)
+from .cli import main  # noqa: F401
+
+__all__ = [
+    "Finding", "ModuleContext", "PackageIndex", "Rule", "all_rules",
+    "apply_baseline", "load_baseline", "register_rule", "scan",
+    "write_baseline", "main",
+]

@@ -1,0 +1,109 @@
+"""graftlint CLI: ``python -m lambdagap_tpu.analysis [paths...]``.
+
+Exit codes: 0 — clean (every finding baselined or none); 1 — new findings;
+2 — usage error. ``--write-baseline`` regenerates the baseline file from
+the current findings (preserving per-entry ``why`` justifications whose
+keys still match) and exits 0.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import rules  # noqa: F401  (registers R1..R6)
+from .core import (all_rules, apply_baseline, load_baseline, scan,
+                   write_baseline)
+
+DEFAULT_BASELINE = os.path.join("tools", "graftlint_baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-level TPU hazard analysis for lambdagap_tpu")
+    p.add_argument("paths", nargs="*", default=[],
+                   help="files or directories to scan "
+                        "(default: lambdagap_tpu/ under the cwd)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON (default: {DEFAULT_BASELINE} when "
+                        f"it exists)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="regenerate the baseline from current findings")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--disable", default=None,
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            scope = ",".join(r.path_filter) if r.path_filter else "all files"
+            print(f"{r.id}  [{r.severity}]  ({scope})  {r.description}")
+        return 0
+
+    paths = args.paths or ["lambdagap_tpu"]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"graftlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    select = args.select.split(",") if args.select else None
+    disable = args.disable.split(",") if args.disable else None
+    findings = scan(paths, select=select, disable=disable)
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+
+    if args.write_baseline:
+        out = baseline_path or DEFAULT_BASELINE
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        write_baseline(findings, out)
+        print(f"graftlint: wrote {len(findings)} finding(s) to {out}")
+        return 0
+
+    entries = []
+    if baseline_path and not args.no_baseline:
+        try:
+            entries = load_baseline(baseline_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"graftlint: cannot read baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    new, stale = apply_baseline(findings, entries)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.__dict__ for f in new],
+            "baselined": len(findings) - len(new),
+            "stale_baseline_entries": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        for e in stale:
+            print(f"graftlint: stale baseline entry (code changed or "
+                  f"fixed — regenerate with --write-baseline): "
+                  f"{e['rule']} {e['path']}: {e['snippet'][:60]}",
+                  file=sys.stderr)
+        n_base = len(findings) - len(new)
+        tail = f" ({n_base} baselined)" if n_base else ""
+        print(f"graftlint: {len(new)} finding(s){tail} in "
+              f"{len(set(f.path for f in findings)) if findings else 0} "
+              f"file(s)")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

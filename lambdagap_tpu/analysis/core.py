@@ -1,0 +1,393 @@
+"""graftlint core: findings, rule registry, suppressions, baseline, engine.
+
+An AST-level hazard analyzer for the bug classes that have actually bitten
+this codebase (see docs/static-analysis.md): silent host-device syncs in hot
+paths, jit recompile hazards, clamped ``lax.dynamic_slice`` starts, dtype
+drift, serve-layer lock discipline, and collective axis-name mismatches.
+
+Design notes:
+
+- Rules are pure functions of a :class:`ModuleContext` (one parsed file)
+  plus a :class:`PackageIndex` (cross-file facts such as declared mesh axis
+  names), so the whole scan is two passes and needs no imports of the
+  scanned code — it runs in milliseconds and can lint broken trees.
+- Findings are suppressible inline (``# graftlint: disable=R1,R5``, on the
+  offending line or alone on the line above) and grandfatherable in a
+  checked-in JSON baseline keyed by (rule, path, normalized source line) —
+  line-number drift does not invalidate baseline entries, editing the
+  offending line does.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+SUPPRESS_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One hazard at one source location."""
+    rule: str            # "R1".."R6"
+    path: str            # path relative to the scan root (posix separators)
+    line: int            # 1-based
+    col: int             # 0-based
+    message: str
+    severity: str = "error"
+    snippet: str = ""    # stripped source line, for baseline fingerprints
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift, line content rarely does."""
+        return (self.rule, self.path, self.snippet)
+
+    def fingerprint(self) -> str:
+        h = hashlib.sha1(
+            "\0".join(self.key()).encode("utf-8", "replace")).hexdigest()
+        return h[:16]
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+
+class ModuleContext:
+    """One parsed source file with parent links and suppression tables."""
+
+    def __init__(self, path: str, relpath: str, source: str) -> None:
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self._suppress: Dict[int, set] = {}
+        self._suppress_file: set = set()
+        self._scan_suppressions()
+
+    # -- suppressions ---------------------------------------------------
+    def _scan_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, 1):
+            m = SUPPRESS_FILE_RE.search(line)
+            if m:
+                self._suppress_file |= _rule_list(m.group(1))
+                continue
+            m = SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = _rule_list(m.group(1))
+            self._suppress.setdefault(i, set()).update(rules)
+            # a comment alone on its line suppresses the next code line
+            # (walking past any continuation comment lines of the
+            # justification)
+            if line.lstrip().startswith("#"):
+                j = i + 1
+                while (j <= len(self.lines)
+                       and self.lines[j - 1].lstrip().startswith("#")):
+                    j += 1
+                self._suppress.setdefault(j, set()).update(rules)
+        if not self._suppress:
+            return
+        # a suppressed line covers the whole statement that starts there
+        # (multi-line calls anchor findings on inner lines)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt):
+                continue
+            rules = self._suppress.get(getattr(node, "lineno", -1))
+            if not rules:
+                continue
+            end = getattr(node, "end_lineno", node.lineno) or node.lineno
+            for ln in range(node.lineno + 1, end + 1):
+                self._suppress.setdefault(ln, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._suppress_file or "ALL" in self._suppress_file:
+            return True
+        rules = self._suppress.get(line, ())
+        return rule in rules or "ALL" in rules
+
+    # -- AST helpers ----------------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Function defs containing ``node``, innermost first."""
+        return [a for a in self.ancestors(node)
+                if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for a in self.ancestors(node):
+            if isinstance(a, ast.ClassDef):
+                return a
+        return None
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """Lexically inside a for/while body (stopping at function
+        boundaries: a nested def resets loop context)."""
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return False
+            if isinstance(a, (ast.For, ast.While, ast.AsyncFor)):
+                return True
+        return False
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=rule.id, path=self.relpath, line=line,
+                       col=getattr(node, "col_offset", 0), message=message,
+                       severity=rule.severity, snippet=self.line_at(line))
+
+
+def _rule_list(text: str) -> set:
+    return {t.strip().upper() for t in text.replace(" ", ",").split(",")
+            if t.strip()}
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ("jax.lax.psum", "jnp.zeros",
+    "self._build"); "" when it is not a plain name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted_name(call.func)
+
+
+class PackageIndex:
+    """Cross-file facts collected in the first pass.
+
+    - ``str_constants``: module relpath -> {NAME: "value"} for module-level
+      string assignments (axis-name constants like ``DATA_AXIS = "data"``).
+    - ``axis_names``: every axis name declared anywhere in the scanned set:
+      strings in ``Mesh(..., (names,))`` axis tuples, strings passed to
+      ``PartitionSpec``/``P(...)``, and the values of ``*_AXIS`` constants.
+    - ``imports``: module relpath -> {local name: source module tail} for
+      ``from X import NAME`` statements, so axis constants resolve across
+      files without executing anything.
+    """
+
+    def __init__(self) -> None:
+        self.str_constants: Dict[str, Dict[str, str]] = {}
+        self.axis_names: set = set()
+        self.imports: Dict[str, Dict[str, str]] = {}
+
+    def collect(self, ctx: ModuleContext) -> None:
+        consts: Dict[str, str] = {}
+        imports: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                    ctx.parent(node), ast.Module):
+                if (len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    name = node.targets[0].id
+                    consts[name] = node.value.value
+                    if name.endswith("_AXIS") or name.endswith("AXIS"):
+                        self.axis_names.add(node.value.value)
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    imports[alias.asname or alias.name] = \
+                        (node.module or "").rsplit(".", 1)[-1]
+            elif isinstance(node, ast.Call):
+                name = call_name(node)
+                tail = name.rsplit(".", 1)[-1]
+                if tail == "Mesh" and len(node.args) >= 2:
+                    self._add_strings(node.args[1])
+                elif tail in ("P", "PartitionSpec"):
+                    for a in node.args:
+                        self._add_strings(a)
+        self.str_constants[ctx.relpath] = consts
+        self.imports[ctx.relpath] = imports
+
+    def _add_strings(self, node: ast.AST) -> None:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                self.axis_names.add(n.value)
+
+    def resolve_string(self, ctx: ModuleContext, node: ast.AST
+                       ) -> Optional[str]:
+        """Resolve an expression to a string: literal, module-level constant,
+        or a constant imported from another scanned module. None when the
+        value is not statically known (e.g. ``self.axis``)."""
+        if isinstance(node, ast.Constant):
+            return node.value if isinstance(node.value, str) else None
+        if isinstance(node, ast.Name):
+            consts = self.str_constants.get(ctx.relpath, {})
+            if node.id in consts:
+                return consts[node.id]
+            src_mod = self.imports.get(ctx.relpath, {}).get(node.id)
+            if src_mod:
+                for rel, cmap in self.str_constants.items():
+                    if rel.rsplit("/", 1)[-1] == src_mod + ".py" \
+                            and node.id in cmap:
+                        return cmap[node.id]
+        return None
+
+
+class Rule:
+    """Base class; subclasses set id/severity/description and implement
+    ``check``. ``path_filter`` (a tuple of substrings) restricts a rule to
+    files whose relpath contains any of them; None means every file."""
+
+    id = "R0"
+    severity = "error"
+    description = ""
+    path_filter: Optional[Tuple[str, ...]] = None
+
+    def applies_to(self, relpath: str) -> bool:
+        if not self.path_filter:
+            return True
+        rel = "/" + relpath
+        return any(pat in rel for pat in self.path_filter)
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# -- registry -----------------------------------------------------------
+_RULES: Dict[str, Rule] = {}
+
+
+def register_rule(cls):
+    _RULES[cls.id] = cls()
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+# -- engine -------------------------------------------------------------
+def iter_py_files(paths: Sequence[str]) -> Iterator[Tuple[str, str]]:
+    """Yield (abs path, relpath-from-its-scan-root) for every .py target."""
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            yield p, os.path.basename(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    fp = os.path.join(dirpath, fn)
+                    yield fp, os.path.relpath(fp, p)
+
+
+def scan(paths: Sequence[str], select: Optional[Iterable[str]] = None,
+         disable: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the rule set over ``paths`` (files or directory roots)."""
+    sel = {r.upper() for r in select} if select else None
+    dis = {r.upper() for r in disable} if disable else set()
+    rules = [r for r in all_rules()
+             if (sel is None or r.id in sel) and r.id not in dis]
+    contexts: List[ModuleContext] = []
+    index = PackageIndex()
+    findings: List[Finding] = []
+    for fp, rel in iter_py_files(paths):
+        try:
+            with open(fp, "r", encoding="utf-8") as f:
+                ctx = ModuleContext(fp, rel, f.read())
+        except (SyntaxError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                rule="R0", path=rel.replace(os.sep, "/"),
+                line=getattr(e, "lineno", 1) or 1, col=0,
+                message=f"file does not parse: {e.msg if hasattr(e, 'msg') else e}",
+                snippet=""))
+            continue
+        index.collect(ctx)
+        contexts.append(ctx)
+    for ctx in contexts:
+        for rule in rules:
+            if not rule.applies_to(ctx.relpath):
+                continue
+            for f in rule.check(ctx, index):
+                if not ctx.suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# -- baseline -----------------------------------------------------------
+BASELINE_VERSION = 1
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> None:
+    """Group current findings by identity key and persist counts. A ``why``
+    field per entry is preserved across regenerations when the key matches;
+    new entries get an empty why for a human to fill in."""
+    old_whys = {}
+    if os.path.exists(path):
+        try:
+            for e in load_baseline(path):
+                old_whys[(e["rule"], e["path"], e["snippet"])] = \
+                    e.get("why", "")
+        except Exception:
+            pass
+    grouped: Dict[Tuple[str, str, str], int] = {}
+    for f in findings:
+        grouped[f.key()] = grouped.get(f.key(), 0) + 1
+    entries = [{"rule": r, "path": p, "snippet": s, "count": c,
+                "why": old_whys.get((r, p, s), "")}
+               for (r, p, s), c in sorted(grouped.items())]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": BASELINE_VERSION, "findings": entries}, f,
+                  indent=2)
+        f.write("\n")
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {path}")
+    return list(data.get("findings", ()))
+
+
+def apply_baseline(findings: Sequence[Finding], entries: Sequence[dict]
+                   ) -> Tuple[List[Finding], List[dict]]:
+    """Split findings into (new, stale-baseline-entries). Each baseline
+    entry absorbs up to ``count`` findings with the same identity key."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in entries:
+        k = (e["rule"], e["path"], e["snippet"])
+        budget[k] = budget.get(k, 0) + int(e.get("count", 1))
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            new.append(f)
+    stale = [e for e in entries
+             if budget.get((e["rule"], e["path"], e["snippet"]), 0) > 0]
+    return new, stale

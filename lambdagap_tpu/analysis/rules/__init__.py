@@ -1,0 +1,13 @@
+"""graftlint rule set: importing this package registers every rule.
+
+Each rule module documents its hazard class, its TPU rationale, and the
+exact heuristic it applies; ``docs/static-analysis.md`` is the user-facing
+summary. Add a new rule by dropping an ``rN_*.py`` module here that calls
+``@register_rule`` and importing it below.
+"""
+from __future__ import annotations
+
+from . import (r1_host_sync, r2_recompile, r3_clamped_slice,  # noqa: F401
+               r4_dtype_drift, r5_lock_discipline, r6_collective_axis)
+
+from ..core import all_rules  # noqa: F401  (re-export for convenience)

@@ -1,0 +1,95 @@
+"""R1: host-device synchronization in hot paths.
+
+The TPU failure mode: a ``jax.device_get`` / ``.item()`` / ``float(...)`` /
+``np.asarray(...)`` on a device array forces the host to block on the device
+stream. One sync per *training iteration* or per *serve dispatch* serializes
+the pipeline — XGBoost's GPU work (arXiv:1806.11248) attributes large
+regressions to exactly this family of silent host round-trips, and this
+repo's host-loop distributed learners pay a documented D2H per split.
+
+Heuristic hot contexts:
+
+- any function whose name is in :data:`HOT_FUNCTIONS` (the boosting loop,
+  gradient computation, score update, and serve dispatch surfaces), at any
+  nesting depth;
+- any for/while loop body inside ``serve/`` (the request path).
+
+Sync calls flagged: ``jax.device_get``, ``.item()``, ``.block_until_ready()``,
+``float(...)``/``int(...)`` wrapping a jax/jnp call, and
+``np.asarray``/``np.array`` wrapping a jax/jnp call. ``float(name)`` over an
+already-host value is NOT flagged — only conversions whose argument is
+itself a device computation.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
+                    register_rule)
+
+# the per-iteration / per-dispatch surfaces of this codebase
+HOT_FUNCTIONS = frozenset({
+    "train", "train_device", "train_one_iter", "boost_one_iter",
+    "get_gradients", "get_gradients_fast", "update_scores",
+    "_run_batch", "_dispatch", "_loop",
+})
+
+_JAXISH = ("jax.", "jnp.", "lax.")
+
+
+def _is_jaxish_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and (call_name(node).startswith(_JAXISH)
+                 or call_name(node) in ("device_get",)))
+
+
+def _sync_kind(call: ast.Call) -> str:
+    """Classify a call as a host-sync; '' when it is not one."""
+    name = call_name(call)
+    tail = name.rsplit(".", 1)[-1]
+    if tail == "device_get":
+        return "jax.device_get"
+    if tail in ("item", "block_until_ready") and not call.args:
+        return f".{tail}()"
+    if name in ("float", "int") and len(call.args) == 1:
+        arg = call.args[0]
+        if _is_jaxish_call(arg) and _sync_kind(arg) == "":
+            return f"{name}() over a device value"
+    if tail in ("asarray", "array") and name.startswith("np.") and call.args:
+        arg = call.args[0]
+        if _is_jaxish_call(arg) and _sync_kind(arg) == "":
+            return f"{name}() over a device value"
+    return ""
+
+
+@register_rule
+class HostSyncRule(Rule):
+    id = "R1"
+    severity = "error"
+    description = ("host-device sync (device_get/.item()/float/np.asarray "
+                   "of a device value) inside a training-loop or "
+                   "serve-dispatch function")
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        in_serve = "/serve/" in ("/" + ctx.relpath)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _sync_kind(node)
+            if not kind:
+                continue
+            funcs = ctx.enclosing_functions(node)
+            hot = any(f.name in HOT_FUNCTIONS for f in funcs)
+            if not hot and in_serve and funcs:
+                hot = ctx.in_loop(node)
+            if not hot:
+                continue
+            where = funcs[0].name if funcs else "<module>"
+            yield ctx.finding(
+                self, node,
+                f"{kind} blocks the host on the device stream inside hot "
+                f"function '{where}'; hoist it out of the per-iteration "
+                f"path, keep the value on device, or suppress with a "
+                f"justification if the sync is inherent")

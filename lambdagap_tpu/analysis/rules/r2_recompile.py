@@ -1,0 +1,132 @@
+"""R2: jit recompile hazards.
+
+XLA recompiles whenever a jitted callable's identity or static closure
+changes. Two statically detectable shapes of that bug:
+
+- **R2a — ``jax.jit`` created inside a loop**: every iteration builds a new
+  callable with an empty compile cache, so the program recompiles (or at
+  least re-traces) per iteration. The fix is to hoist the ``jit`` to module
+  scope, ``__init__``, or an explicit cache keyed by the static
+  configuration (see ``objectives/rank.py:_LOOP_CACHE``).
+- **R2b — jitted closure over mutable ``self`` state**: a nested function
+  passed to ``jax.jit`` that reads ``self.<attr>`` where the same attribute
+  is assigned outside ``__init__``/``init`` bakes the *traced value* of the
+  attribute into the executable. Later mutations are silently ignored (or
+  force a retrace if the attribute participates in shapes). Thread mutable
+  state as an explicit argument instead.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
+                    register_rule)
+
+_INIT_METHODS = frozenset({"__init__", "init", "setup"})
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    # functools.partial(jax.jit, static_argnames=...) counts as creating one
+    if name.rsplit(".", 1)[-1] == "partial" and node.args:
+        first = node.args[0]
+        return isinstance(first, (ast.Name, ast.Attribute)) and \
+            call_name(ast.Call(func=first, args=[], keywords=[])) in (
+                "jax.jit", "jit")
+    return False
+
+
+def _mutable_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes assigned via ``self.X = ...`` outside __init__/init."""
+    out: Set[str] = set()
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if item.name in _INIT_METHODS:
+            continue
+        for node in ast.walk(item):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t in targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    out.add(t.attr)
+    return out
+
+
+def _self_reads(fn: ast.AST) -> Set[str]:
+    """``self.<attr>`` loads inside a function body (not call targets —
+    ``self.method(...)`` is dispatch, not captured state)."""
+    reads: Set[str] = set()
+    calls = {id(n.func) for n in ast.walk(fn) if isinstance(n, ast.Call)}
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and id(node) not in calls):
+            reads.add(node.attr)
+    return reads
+
+
+def _resolve_local_def(ctx: ModuleContext, jit_call: ast.Call
+                       ) -> Optional[ast.AST]:
+    """The function object being jitted, when it is a lambda or a nested
+    def in the same enclosing function."""
+    if not jit_call.args:
+        return None
+    arg = jit_call.args[0]
+    if isinstance(arg, ast.Lambda):
+        return arg
+    if not isinstance(arg, ast.Name):
+        return None
+    for fn in ctx.enclosing_functions(jit_call):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == arg.id:
+                return node
+    return None
+
+
+@register_rule
+class RecompileRule(Rule):
+    id = "R2"
+    severity = "error"
+    description = ("jit recompile hazard: jax.jit created inside a loop, or "
+                   "a jitted closure capturing mutable self state")
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+                continue
+            if ctx.in_loop(node):
+                yield ctx.finding(
+                    self, node,
+                    "jax.jit created inside a loop: each iteration builds a "
+                    "fresh callable with an empty compile cache, forcing a "
+                    "re-trace per iteration; hoist the jit (or cache it "
+                    "keyed by its static config)")
+                continue
+            cls = ctx.enclosing_class(node)
+            if cls is None:
+                continue
+            target = _resolve_local_def(ctx, node)
+            if target is None:
+                continue
+            captured = _self_reads(target) & _mutable_attrs(cls)
+            if captured:
+                attrs = ", ".join(sorted(captured))
+                yield ctx.finding(
+                    self, node,
+                    f"jitted closure reads mutable self state ({attrs}): "
+                    f"the traced value is baked into the executable and "
+                    f"later mutations are silently ignored; pass it as an "
+                    f"argument instead")

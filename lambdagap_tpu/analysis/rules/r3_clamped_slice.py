@@ -1,0 +1,87 @@
+"""R3: clamped ``lax.dynamic_slice`` starts without a guarding invariant.
+
+``lax.dynamic_slice`` / ``dynamic_update_slice`` CLAMP out-of-range start
+indices instead of raising. That is exactly the bug class of
+``objectives/rank.py``'s ``_lambdarank_bucket``: a non-divisor tile made the
+last window's start clamp backwards, silently misaligning rank indices
+against the sliced score rows and producing wrong lambdas — no error, just
+wrong gradients (fixed by a divisibility check; see CHANGES.md PR 1).
+
+The rule flags any dynamic-slice family call whose enclosing function chain
+carries no visible invariant:
+
+- an ``assert`` statement anywhere in an enclosing function (shape/
+  divisibility asserts run at trace time, so they are free on device), or
+- a ``raise`` under an ``if`` whose condition involves ``%``
+  (the rank.py divisibility-guard shape), or
+- a start expression derived through ``clip``/``minimum``/``maximum``
+  (clamp-by-construction).
+
+The goal is not to prove in-boundedness — it is to force every dynamic
+slice to state its bounds story where a reviewer can see it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
+                    register_rule)
+
+_SLICE_FNS = frozenset({
+    "dynamic_slice", "dynamic_update_slice",
+    "dynamic_slice_in_dim", "dynamic_update_slice_in_dim",
+})
+
+_CLAMP_FNS = frozenset({"clip", "minimum", "maximum", "min", "max"})
+
+
+def _has_guard(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assert):
+            return True
+        if isinstance(node, ast.If):
+            has_mod = any(isinstance(n, ast.Mod) for n in ast.walk(node.test))
+            has_raise = any(isinstance(n, ast.Raise)
+                            for n in ast.walk(node))
+            if has_mod and has_raise:
+                return True
+    return False
+
+
+def _clamped_args(call: ast.Call) -> bool:
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for n in ast.walk(arg):
+            if isinstance(n, ast.Call) and \
+                    call_name(n).rsplit(".", 1)[-1] in _CLAMP_FNS:
+                return True
+    return False
+
+
+@register_rule
+class ClampedSliceRule(Rule):
+    id = "R3"
+    severity = "error"
+    description = ("lax.dynamic_slice/dynamic_update_slice without a "
+                   "divisibility/bounds assert in scope (silent clamping "
+                   "misaligns data, the rank.py bug class)")
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = call_name(node).rsplit(".", 1)[-1]
+            if tail not in _SLICE_FNS:
+                continue
+            if _clamped_args(node):
+                continue
+            funcs = ctx.enclosing_functions(node)
+            if any(_has_guard(f) for f in funcs):
+                continue
+            yield ctx.finding(
+                self, node,
+                f"lax.{tail} clamps out-of-range starts instead of raising; "
+                f"add a trace-time assert (divisibility or bounds) in the "
+                f"enclosing function, or derive the start through "
+                f"clip/minimum so the invariant is visible")

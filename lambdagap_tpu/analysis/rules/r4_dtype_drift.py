@@ -1,0 +1,60 @@
+"""R4: dtype drift — array creation without an explicit dtype.
+
+``jnp.zeros(n)`` / ``jnp.full(shape, v)`` / ``jnp.arange(n)`` pick the
+*default* dtype, which is float32/int32 on TPU but float64/int64 the moment
+``jax_enable_x64`` is on (CPU test runs, notebooks, downstream users).
+Arrays created without an explicit dtype therefore:
+
+- silently double histogram/gradient memory traffic under x64 (the
+  out-of-core GBDT literature, arXiv:2005.09148, attributes large
+  regressions to exactly this kind of unplanned memory traffic), and
+- make CPU test runs diverge bitwise from TPU runs, so parity tests chase
+  phantom diffs.
+
+``*_like`` variants and ``asarray`` inherit their input's dtype and are not
+flagged. Positional dtypes count (``jnp.zeros(n, jnp.int32)``).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
+                    register_rule)
+
+# creator -> minimum positional argc that includes a dtype
+_CREATORS = {
+    "zeros": 2, "ones": 2, "empty": 2, "eye": 99, "identity": 99,
+    "full": 3, "arange": 4, "linspace": 99,
+}
+_PREFIXES = ("jnp.", "jax.numpy.")
+
+
+@register_rule
+class DtypeDriftRule(Rule):
+    id = "R4"
+    severity = "error"
+    description = ("jnp array creation without an explicit dtype "
+                   "(weak-promotes to float64/int64 under x64, diverging "
+                   "CPU test runs from TPU)")
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not name.startswith(_PREFIXES):
+                continue
+            tail = name.rsplit(".", 1)[-1]
+            if tail not in _CREATORS:
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            if len(node.args) >= _CREATORS[tail]:
+                continue
+            yield ctx.finding(
+                self, node,
+                f"{name}(...) without an explicit dtype: the result "
+                f"follows the default-dtype config and becomes "
+                f"float64/int64 under x64; pass dtype= explicitly")

@@ -1,0 +1,146 @@
+"""R5: serve-layer lock discipline.
+
+The serving path (``serve/batcher.py``, ``serve/swap.py``,
+``serve/server.py``) mixes client threads, batcher workers, and swap
+controllers. Two statically detectable hazards:
+
+- **R5a — blocking call under a lock**: a ``threading.Lock`` held across a
+  blocking operation (``Future.result``, ``thread.join``, ``queue``
+  get/put, ``time.sleep``, device transfers, forest compilation) turns
+  every other thread contending on that lock into a convoy — p99 latency
+  inherits the blocked call's duration. Hold locks only around pointer
+  flips and small mutations; do blocking work outside.
+- **R5b — mixed locking of shared attributes**: an attribute written both
+  inside a ``with <lock>:`` block and outside any lock (excluding
+  ``__init__``) has no consistent happens-before story; readers can
+  observe torn update sequences. Either all writes take the lock or the
+  attribute is documented single-writer.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
+                    dotted_name, register_rule)
+
+# method names that block the calling thread
+_BLOCKING_METHODS = frozenset({
+    "result", "join", "wait", "sleep", "block_until_ready",
+    "device_get", "device_put", "warm", "_build", "recv", "send",
+    "acquire",
+})
+# .get()/.put() only block on queue-ish receivers
+_QUEUEISH = ("q", "queue", "_q", "_queue")
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    name = dotted_name(node).lower()
+    return "lock" in name
+
+
+def _blocking_kind(call: ast.Call) -> str:
+    name = call_name(call)
+    tail = name.rsplit(".", 1)[-1]
+    if tail in _BLOCKING_METHODS:
+        return name
+    if tail in ("get", "put"):
+        recv = name.rsplit(".", 2)
+        if len(recv) >= 2 and any(recv[-2].lower().endswith(q)
+                                  for q in _QUEUEISH):
+            return name
+    return ""
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes initialized to threading.Lock()/RLock() in this class."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            tail = call_name(node.value).rsplit(".", 1)[-1]
+            if tail in ("Lock", "RLock", "Condition", "Semaphore"):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        out.add(t.attr)
+    return out
+
+
+def _self_attr_writes(scope: ast.AST) -> List[Tuple[str, ast.AST]]:
+    out = []
+    for node in ast.walk(scope):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                out.append((t.attr, node))
+    return out
+
+
+@register_rule
+class LockDisciplineRule(Rule):
+    id = "R5"
+    severity = "error"
+    description = ("serve-layer lock discipline: blocking call while "
+                   "holding a lock, or shared attribute written both "
+                   "with and without the lock")
+    path_filter = ("/serve/",)
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        # R5a: blocking calls lexically inside `with <lock>:` bodies
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(_is_lock_expr(item.context_expr)
+                       for item in node.items):
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                kind = _blocking_kind(call)
+                if kind:
+                    yield ctx.finding(
+                        self, call,
+                        f"blocking call {kind}(...) while holding a lock: "
+                        f"every thread contending on the lock convoys "
+                        f"behind it; move the blocking work outside the "
+                        f"critical section (lock only the pointer flip)")
+        # R5b: mixed locked/unlocked writes of the same attribute
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if not _lock_attrs(node):
+                continue
+            locked: Set[str] = set()
+            unlocked: Dict[str, List[ast.AST]] = {}
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                with_lock_nodes: Set[int] = set()
+                for w in ast.walk(item):
+                    if isinstance(w, ast.With) and any(
+                            _is_lock_expr(i.context_expr)
+                            for i in w.items):
+                        for sub in ast.walk(w):
+                            with_lock_nodes.add(id(sub))
+                for attr, stmt in _self_attr_writes(item):
+                    if id(stmt) in with_lock_nodes:
+                        locked.add(attr)
+                    elif item.name not in ("__init__", "init"):
+                        unlocked.setdefault(attr, []).append(stmt)
+            for attr in sorted(locked):
+                for stmt in unlocked.get(attr, ()):
+                    yield ctx.finding(
+                        self, stmt,
+                        f"attribute 'self.{attr}' is written under a lock "
+                        f"elsewhere but written here without it: readers "
+                        f"can observe torn update sequences; take the lock "
+                        f"for every write (or document single-writer)")

@@ -433,6 +433,9 @@ class FusedTreeLearner(SerialTreeLearner):
         def perm_slice(perm, start):
             """Contiguous W-row window of the (N+W padded) permutation —
             a dynamic-slice DMA, not a gather."""
+            # every start is <= N and the buffer carries one full window of
+            # padding, so the dynamic_slice clamp can never fire
+            assert perm.shape[0] == N + W
             return lax.dynamic_slice(perm, (start,), (W,))
 
         def chunk_hist(perm, begin, count, acc, c):
@@ -576,6 +579,9 @@ class FusedTreeLearner(SerialTreeLearner):
             off = lax.axis_index(fax) * C_loc
 
             def sl(arr):
+                # shards tile the padded feature axis exactly, so the
+                # per-shard slice start can never clamp
+                assert arr.shape[0] % C_loc == 0
                 return lax.dynamic_slice_in_dim(arr, off, C_loc, axis=0)
 
             mono_l = sl(mono_arr)
@@ -688,12 +694,13 @@ class FusedTreeLearner(SerialTreeLearner):
                                          if rand_t is not None else None))
                 # scatter voted results back to [F] (duplicate votes write
                 # identical values)
-                gain = jnp.full((F,), K_MIN_SCORE).at[votes].set(gain_v)
+                gain = jnp.full((F,), K_MIN_SCORE,
+                                jnp.float32).at[votes].set(gain_v)
                 thr = jnp.zeros((F,), jnp.int32).at[votes].set(thr_v)
                 dl = jnp.zeros((F,), bool).at[votes].set(dl_v)
-                lg = jnp.zeros((F,)).at[votes].set(lg_v)
-                lh = jnp.zeros((F,)).at[votes].set(lh_v)
-                lc = jnp.zeros((F,)).at[votes].set(lc_v)
+                lg = jnp.zeros((F,), jnp.float32).at[votes].set(lg_v)
+                lh = jnp.zeros((F,), jnp.float32).at[votes].set(lh_v)
+                lc = jnp.zeros((F,), jnp.float32).at[votes].set(lc_v)
                 bits = jnp.zeros((F, 8), jnp.uint32).at[votes].set(bits_v)
             else:
                 if bundled:
@@ -1007,6 +1014,9 @@ class FusedTreeLearner(SerialTreeLearner):
             # of the last window re-written from perm itself
             def cbody(s):
                 c, pm = s
+                # same window-pad invariant as perm_slice: starts stay
+                # <= N, the W-row tail pad absorbs the last window
+                assert pbuf.shape[0] == N + W
                 start = begin + c * W
                 valid = (c * W + lane) < count_eff
                 vals = jnp.where(valid, perm_slice(pbuf, start),

@@ -77,8 +77,9 @@ class BaggingStrategy(SampleStrategy):
                 nq = len(self.query_boundaries) - 1
                 qmask = jax.random.uniform(sub, (nq,)) < c.bagging_fraction
                 qb = jnp.asarray(self.query_boundaries)
-                qid = jnp.searchsorted(qb, jnp.arange(self.num_data),
-                                       side="right") - 1
+                qid = jnp.searchsorted(
+                    qb, jnp.arange(self.num_data, dtype=jnp.int32),
+                    side="right") - 1
                 self.cur_mask = qmask[qid]
             elif self.balanced:
                 u = jax.random.uniform(sub, (self.num_data,))

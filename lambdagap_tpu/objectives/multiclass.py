@@ -50,7 +50,8 @@ class MulticlassSoftmax(ObjectiveFunction):
         """scores [K, N] -> softmax over K
         (reference: multiclass_objective.hpp:85-130)."""
         p = _softmax0(scores)
-        onehot = (jnp.arange(self._num_class)[:, None] == self.label_int[None, :])
+        onehot = (jnp.arange(self._num_class, dtype=jnp.int32)[:, None]
+                  == self.label_int[None, :])
         grad = p - onehot.astype(p.dtype)
         hess = self.factor * p * (1.0 - p)
         if self.weight is not None:

@@ -434,9 +434,9 @@ def per_feature_best(hist: jax.Array, parent_g, parent_h, parent_c,
             num_bins, feature_mask & is_categorical, p, constraints,
             rand_thresholds)
     else:
-        cat_gain = jnp.full((F,), K_MIN_SCORE)
+        cat_gain = jnp.full((F,), K_MIN_SCORE, jnp.float32)
         cat_t = jnp.zeros((F,), jnp.int32)
-        cat_lg = cat_lh = cat_lc = jnp.zeros((F,))
+        cat_lg = cat_lh = cat_lc = jnp.zeros((F,), jnp.float32)
         cat_bits = jnp.zeros((F, 8), jnp.uint32)
 
     use_cat = is_categorical

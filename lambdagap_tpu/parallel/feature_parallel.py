@@ -62,6 +62,9 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         prec = self.config.tpu_hist_precision
         f_loc = self.f_loc
         F = self.num_features
+        # shards tile the padded column axis exactly, so the per-shard
+        # dynamic-slice start d*f_loc can never clamp
+        assert f_loc * self.n_dev == self.f_pad
 
         def hist_blocked(x, perm, g, h, begin, count, row_mask):
             d = jax.lax.axis_index(DATA_AXIS)

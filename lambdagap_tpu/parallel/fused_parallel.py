@@ -173,6 +173,9 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
             v = jnp.asarray(v)
             if v.shape[0] == self.n_pad and self.n_pad != self.proc_pad:
                 # GLOBAL-length replicated state: take this rank's block
+                # (rank blocks tile the global axis exactly, so the
+                # dynamic-slice start can never clamp)
+                assert self.n_pad % self.proc_pad == 0
                 p = jax.process_index() * self.proc_pad
                 v = lax.dynamic_slice_in_dim(v, p, self.proc_pad, axis=0)
             pad = self.proc_pad - v.shape[0]
@@ -233,6 +236,8 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
                 # hess maxima before deriving quantization scales, else the
                 # psum-ed int32 histograms would mix incompatible units
                 from jax.experimental import multihost_utils
+                # graftlint: disable=R1 — one cross-host max sync per TREE
+                # (not per split); quantization scales must agree globally
                 lm = np.asarray(
                     [float(jnp.max(jnp.abs(grad))), float(jnp.max(hess))],
                     np.float32)

@@ -195,6 +195,8 @@ class CompiledForestCache:
             if not hit and self.stats is not None:
                 self.stats.record_bucket_compile(b)
             out = self._dispatch(chunk, raw_score)
+            # graftlint: disable=R1 — the terminal D2H of the response is
+            # inherent to serving: results must reach the client as numpy
             parts.append(np.asarray(jax.device_get(out))[:, :n])
         res = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
         return res[0] if K == 1 else res.T

@@ -57,6 +57,9 @@ class SwapController:
         booster object."""
         with self._swap_lock:
             gen = 0 if self.active is None else self.active.generation + 1
+            # graftlint: disable=R5 — deliberate: _swap_lock serializes
+            # WRITERS only (concurrent swaps apply in call order); readers
+            # snapshot `active` lock-free, so the build convoys no request
             cache = self._build(gbdt, gen)
             self.active = cache          # atomic flip
             if gen > 0 and self._stats is not None:
@@ -75,6 +78,8 @@ class SwapController:
             gbdt = load_booster(source, params)
             with self._swap_lock:
                 gen = self.active.generation + 1
+                # graftlint: disable=R5 — deliberate, same as install():
+                # writer-only lock; the serving path never contends on it
                 cache = self._build(gbdt, gen)
                 self.active = cache      # atomic flip
             if self._stats is not None:

@@ -1,0 +1,6 @@
+"""A hazard-free module: the scan must report nothing here."""
+import jax.numpy as jnp
+
+
+def scale(x, factor):
+    return x * jnp.float32(factor)

@@ -1,0 +1,11 @@
+# graftlint: disable-file=R4
+"""File-level suppression fixture: R4 is off for this whole file."""
+import jax.numpy as jnp
+
+
+def make(n):
+    return jnp.zeros(n)
+
+
+def make2(n):
+    return jnp.arange(n)

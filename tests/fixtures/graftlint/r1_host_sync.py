@@ -1,0 +1,32 @@
+"""R1 fixture: host-device syncs inside hot functions are flagged;
+identical syncs in cold helpers are not."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def train(xs, dev_val):
+    total = 0.0
+    for x in xs:
+        total += float(jax.device_get(x))  # BAD:R1
+    v = dev_val.item()  # BAD:R1
+    arr = np.asarray(jnp.sum(dev_val))  # BAD:R1
+    f = float(jnp.max(dev_val))  # BAD:R1
+    return total, v, arr, f
+
+
+def get_gradients(scores, label):
+    g = scores - label
+    jax.device_get(g)  # BAD:R1
+    return g
+
+
+def helper(dev_val):
+    # cold function: the same syncs are fine here
+    host = float(jax.device_get(dev_val))
+    return np.asarray(jnp.sum(dev_val)) + host
+
+
+def also_fine(rows):
+    # float()/np.asarray of host values never flag, even in hot names
+    return [float(r) for r in rows]

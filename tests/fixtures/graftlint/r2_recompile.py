@@ -1,0 +1,36 @@
+"""R2 fixture: jit-in-loop and jitted closures over mutable self state."""
+import jax
+
+
+def rebuild_per_step(xs):
+    outs = []
+    for x in xs:
+        f = jax.jit(lambda v: v * 2)  # BAD:R2
+        outs.append(f(x))
+    return outs
+
+
+def build_once(xs):
+    f = jax.jit(lambda v: v * 2)
+    return [f(x) for x in xs]
+
+
+class Model:
+    def __init__(self, scale):
+        self.scale = scale
+        self.bias = 0.0
+
+    def update(self, b):
+        self.bias = b
+
+    def compiled(self):
+        def kernel(x):
+            return x * self.scale + self.bias
+        return jax.jit(kernel)  # BAD:R2
+
+    def compiled_ok(self):
+        # immutable self.scale (only assigned in __init__) is fine to close
+        # over; mutable state rides as an argument
+        def kernel(x, bias):
+            return x * self.scale + bias
+        return jax.jit(kernel)

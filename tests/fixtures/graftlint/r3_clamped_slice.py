@@ -1,0 +1,37 @@
+"""R3 fixture: dynamic slices without a visible bounds invariant."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def sliced_unguarded(xs, off, t):
+    return lax.dynamic_slice(xs, (off,), (t,))  # BAD:R3
+
+
+def update_unguarded(xs, vals, off):
+    return lax.dynamic_update_slice(xs, vals, (off,))  # BAD:R3
+
+
+def sliced_assert_guard(xs, off, t):
+    assert xs.shape[0] % t == 0
+    return lax.dynamic_slice(xs, (off,), (t,))
+
+
+def sliced_raise_guard(xs, off, t):
+    if xs.shape[0] % t != 0:
+        raise ValueError("tile must divide the padded length")
+    return lax.dynamic_slice(xs, (off,), (t,))
+
+
+def sliced_clamped_start(xs, off, t):
+    return lax.dynamic_slice(
+        xs, (jnp.minimum(off, xs.shape[0] - t),), (t,))
+
+
+def outer_guard_covers_nested(xs, t):
+    if xs.shape[0] % t != 0:
+        raise ValueError("tile must divide the padded length")
+
+    def body(off):
+        return lax.dynamic_slice(xs, (off,), (t,))
+
+    return body

@@ -1,0 +1,20 @@
+"""R4 fixture: array creation without an explicit dtype."""
+import jax.numpy as jnp
+
+
+def bad_creations(n):
+    a = jnp.zeros(n)  # BAD:R4
+    b = jnp.ones((n, 2))  # BAD:R4
+    c = jnp.full((n,), 1e30)  # BAD:R4
+    d = jnp.arange(n)  # BAD:R4
+    return a, b, c, d
+
+
+def good_creations(n):
+    a = jnp.zeros(n, jnp.float32)
+    b = jnp.ones((n, 2), dtype=jnp.float32)
+    c = jnp.full((n,), 1e30, jnp.float32)
+    d = jnp.arange(n, dtype=jnp.int32)
+    e = jnp.zeros_like(a)          # _like inherits: never flagged
+    f = jnp.asarray([1.0, 2.0])    # asarray inherits: never flagged
+    return a, b, c, d, e, f

@@ -1,0 +1,31 @@
+"""R6 fixture: collective axis names vs declared mesh axes."""
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+
+def make_mesh(devs):
+    return Mesh(np.asarray(devs), (DATA_AXIS,))
+
+
+def good_psum(local):
+    return lax.psum(local, DATA_AXIS)
+
+
+def good_literal(local):
+    return lax.all_gather(local, "data", tiled=True)
+
+
+def bad_psum(local):
+    return lax.psum(local, "batch")  # BAD:R6
+
+
+def bad_axis_index():
+    return lax.axis_index("model")  # BAD:R6
+
+
+def dynamic_axis_skipped(local, axis):
+    # unresolvable axis expressions are never guessed at
+    return lax.psum(local, axis)

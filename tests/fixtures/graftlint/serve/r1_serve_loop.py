@@ -1,0 +1,14 @@
+"""R1 fixture (serve path): any sync inside a loop in serve/ is hot."""
+import jax
+
+
+def flush(batch):
+    out = []
+    for item in batch:
+        out.append(jax.device_get(item))  # BAD:R1
+    return out
+
+
+def single(item):
+    # not in a loop and not a hot function name: fine
+    return jax.device_get(item)

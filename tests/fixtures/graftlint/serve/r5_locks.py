@@ -1,0 +1,35 @@
+"""R5 fixture: blocking under a lock; mixed locked/unlocked writes."""
+import threading
+import time
+
+
+class Controller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.generation = 0
+        self.active = None
+
+    def bad_blocking_result(self, fut):
+        with self._lock:
+            return fut.result()  # BAD:R5
+
+    def bad_sleep_under_lock(self):
+        with self._lock:
+            time.sleep(0.1)  # BAD:R5
+
+    def flip(self, model):
+        with self._lock:
+            self.active = model
+            self.generation += 1
+
+    def bad_unlocked_write(self, model):
+        self.active = model  # BAD:R5
+
+    def ok_lock_free_read(self):
+        return self.active
+
+    def ok_blocking_outside(self, fut):
+        res = fut.result()
+        with self._lock:
+            self.active = res
+        return res

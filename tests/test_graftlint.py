@@ -1,0 +1,175 @@
+"""graftlint (lambdagap_tpu.analysis): rule fixtures, suppressions,
+baseline mechanics, CLI exit codes, and the full-package gate.
+
+Fixture snippets under tests/fixtures/graftlint/ mark every expected
+finding with a ``# BAD:Rn`` comment on the offending line, so the tests
+assert exact rule IDs AND line numbers without hardcoding them.
+
+The full-package test is the ISSUE-2 acceptance gate: the merged tree must
+scan clean (zero non-baselined findings, every baseline entry justified),
+and the scan must actually have teeth (nonzero findings on the known-bad
+fixtures).
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+from lambdagap_tpu.analysis import (all_rules, apply_baseline, load_baseline,
+                                    scan, write_baseline)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+PKG = os.path.join(REPO, "lambdagap_tpu")
+FIXTURES = os.path.join(HERE, "fixtures", "graftlint")
+BASELINE = os.path.join(REPO, "tools", "graftlint_baseline.json")
+
+_MARK = re.compile(r"#\s*BAD:(R\d)")
+
+
+def expected_markers(relpath):
+    """(rule, line) pairs from # BAD:Rn markers in a fixture."""
+    out = set()
+    with open(os.path.join(FIXTURES, relpath)) as f:
+        for i, line in enumerate(f, 1):
+            m = _MARK.search(line)
+            if m:
+                out.add((m.group(1), i))
+    assert out, f"fixture {relpath} declares no expected findings"
+    return out
+
+
+@pytest.fixture(scope="module")
+def fixture_findings():
+    """One scan of the whole fixture tree, grouped by file."""
+    findings = scan([FIXTURES])
+    by_file = {}
+    for f in findings:
+        by_file.setdefault(f.path, set()).add((f.rule, f.line))
+    return by_file
+
+
+@pytest.mark.parametrize("relpath", [
+    "r1_host_sync.py",
+    "serve/r1_serve_loop.py",
+    "r2_recompile.py",
+    "r3_clamped_slice.py",
+    "r4_dtype_drift.py",
+    "serve/r5_locks.py",
+    "r6_collective_axis.py",
+])
+def test_rule_fixture_exact_findings(fixture_findings, relpath):
+    got = fixture_findings.get(relpath, set())
+    assert got == expected_markers(relpath), (
+        f"{relpath}: findings {sorted(got)} != markers "
+        f"{sorted(expected_markers(relpath))}")
+
+
+@pytest.mark.parametrize("relpath", [
+    "suppressed.py", "file_suppressed.py", "clean.py",
+])
+def test_suppressions_and_clean_files(fixture_findings, relpath):
+    assert fixture_findings.get(relpath, set()) == set()
+
+
+def test_every_rule_has_fixture_coverage(fixture_findings):
+    covered = {rule for pairs in fixture_findings.values()
+               for rule, _ in pairs}
+    assert covered == {r.id for r in all_rules()}
+
+
+def test_select_and_disable_filters():
+    target = os.path.join(FIXTURES, "r4_dtype_drift.py")
+    assert all(f.rule == "R4" for f in scan([target], select=["R4"]))
+    assert scan([target], disable=["R4"]) == []
+
+
+# -- baseline mechanics -------------------------------------------------
+def test_baseline_roundtrip_absorbs_known_findings(tmp_path):
+    target = os.path.join(FIXTURES, "r4_dtype_drift.py")
+    findings = scan([target])
+    assert findings
+    bl = tmp_path / "baseline.json"
+    write_baseline(findings, str(bl))
+    new, stale = apply_baseline(findings, load_baseline(str(bl)))
+    assert new == [] and stale == []
+
+
+def test_baseline_reports_new_and_stale(tmp_path):
+    target = os.path.join(FIXTURES, "r4_dtype_drift.py")
+    findings = scan([target])
+    bl = tmp_path / "baseline.json"
+    write_baseline(findings[:-1], str(bl))  # one finding not grandfathered
+    entries = load_baseline(str(bl))
+    new, stale = apply_baseline(findings, entries)
+    assert len(new) == 1 and stale == []
+    # a fixed finding leaves its entry stale
+    new2, stale2 = apply_baseline(findings[1:], entries)
+    assert len(stale2) == 1 or len(new2) == 0
+
+
+def test_baseline_why_preserved_on_regeneration(tmp_path):
+    target = os.path.join(FIXTURES, "r4_dtype_drift.py")
+    findings = scan([target])
+    bl = tmp_path / "baseline.json"
+    write_baseline(findings, str(bl))
+    data = json.loads(bl.read_text())
+    data["findings"][0]["why"] = "fixture justification"
+    bl.write_text(json.dumps(data))
+    write_baseline(findings, str(bl))
+    regenerated = load_baseline(str(bl))
+    assert any(e["why"] == "fixture justification" for e in regenerated)
+
+
+# -- CLI ----------------------------------------------------------------
+def _run_cli(*args, cwd=REPO):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+         *args], capture_output=True, text=True, cwd=cwd, env=env)
+
+
+def test_cli_exits_nonzero_on_bad_fixture():
+    r = _run_cli(os.path.join(FIXTURES, "r4_dtype_drift.py"),
+                 "--no-baseline")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "R4" in r.stdout
+
+
+def test_cli_exits_zero_on_clean_file():
+    r = _run_cli(os.path.join(FIXTURES, "clean.py"), "--no-baseline")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_cli_list_rules():
+    r = _run_cli("--list-rules")
+    assert r.returncode == 0
+    for rule in all_rules():
+        assert rule.id in r.stdout
+
+
+def test_cli_json_format():
+    r = _run_cli(os.path.join(FIXTURES, "r6_collective_axis.py"),
+                 "--no-baseline", "--format", "json")
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert {f["rule"] for f in payload["findings"]} == {"R6"}
+
+
+# -- the acceptance gate ------------------------------------------------
+def test_full_package_scan_clean_modulo_baseline():
+    """`python -m lambdagap_tpu.analysis lambdagap_tpu/` must exit 0 on
+    the merged tree: no new findings, no stale baseline entries, and every
+    grandfathered finding carries a written justification."""
+    findings = scan([PKG])
+    entries = load_baseline(BASELINE)
+    new, stale = apply_baseline(findings, entries)
+    assert new == [], "new graftlint findings:\n" + "\n".join(
+        f.format() for f in new)
+    assert stale == [], f"stale baseline entries: {stale}"
+    for e in entries:
+        assert e.get("why", "").strip(), (
+            f"baseline entry without justification: {e}")

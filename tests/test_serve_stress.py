@@ -1,0 +1,104 @@
+"""Concurrency hammer for the serve layer (ISSUE-2 satellite, marked slow).
+
+This is the dynamic counterpart of graftlint's R5 lock-discipline rule: a
+seeded multi-thread submit/swap storm over ``MicroBatcher`` + the
+``SwapController`` generation pointer. The invariant under attack is the
+one R5 exists to protect statically — every response must be produced by
+exactly ONE generation's forest (no torn reads of the ``active`` pointer
+mid-dispatch, no result scattered across a swap). Each ``ServeResult``
+carries its generation, so a torn read shows up as a bitwise mismatch
+against that generation's reference predictions.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification
+
+import lambdagap_tpu as lgb
+
+# device path (no native small-batch shortcut), as in test_serve.py
+DEVICE_PARAMS = {"verbose": -1, "tpu_fast_predict_rows": 0}
+
+
+def _train(seed, rounds, rows=900, feats=10):
+    X, y = make_classification(rows, feats, n_informative=6,
+                               random_state=seed)
+    X = X.astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 15, **DEVICE_PARAMS}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return b, X
+
+
+@pytest.mark.slow
+def test_submit_swap_hammer_no_torn_generations():
+    # two distinguishable models over one feature space; swaps alternate
+    # between them, so generation parity identifies the serving forest
+    b0, X = _train(seed=0, rounds=8)
+    b1, _ = _train(seed=1, rounds=11)
+    models = [b0, b1]
+    expected = [np.asarray(m.predict(X)) for m in models]
+    assert not np.array_equal(expected[0], expected[1])
+
+    # warmup off: swap atomicity (not compile amortization) is under test,
+    # and cold buckets make the swap cadence fast enough to overlap traffic
+    server = b0.as_server(buckets=(1, 8, 64), max_delay_ms=1.0, workers=2,
+                          warmup=False)
+    swaps_done = threading.Event()
+    failures = []
+    n_clients, n_swaps, min_submits, max_submits = 4, 8, 40, 2000
+    served = [0] * n_clients
+
+    def swapper():
+        try:
+            for g in range(1, n_swaps + 1):
+                new_gen = server.swap(models[g % 2])
+                assert new_gen == g        # swaps serialize in call order
+                time.sleep(0.01)           # let traffic land on each gen
+        finally:
+            swaps_done.set()
+
+    def client(tid):
+        rs = np.random.RandomState(1000 + tid)   # seeded: reproducible storm
+        while served[tid] < max_submits and (
+                served[tid] < min_submits or not swaps_done.is_set()):
+            n = int(rs.choice([1, 3, 16]))
+            i = int(rs.randint(0, X.shape[0] - n))
+            res = server.submit(X[i:i + n]).result(timeout=120)
+            served[tid] += 1
+            exp = expected[res.generation % 2][i:i + n]
+            got = np.atleast_1d(np.asarray(res.values))
+            if not np.array_equal(got, exp):
+                failures.append((tid, i, n, res.generation))
+
+    sw = threading.Thread(target=swapper, daemon=True)
+    clients = [threading.Thread(target=client, args=(t,))
+               for t in range(n_clients)]
+    for c in clients:
+        c.start()
+    sw.start()
+    try:
+        for c in clients:
+            c.join(timeout=300)
+            assert not c.is_alive(), "client thread hung (dropped future?)"
+        sw.join(timeout=120)
+        assert not sw.is_alive(), "swapper hung"
+    finally:
+        swaps_done.set()
+        server.close()
+    assert not failures, (
+        f"{len(failures)} response(s) mixed generations (torn swap): "
+        f"{failures[:5]}")
+    assert server.generation == n_swaps
+    assert server.stats_snapshot()["requests"] == sum(served)
+
+
+@pytest.mark.slow
+def test_close_under_load_never_drops_futures():
+    b0, X = _train(seed=2, rounds=6)
+    server = b0.as_server(buckets=(1, 8), max_delay_ms=0.5, workers=2)
+    futs = [server.submit(X[i % 100:i % 100 + 1]) for i in range(200)]
+    server.close()
+    for f in futs:
+        f.result(timeout=60)   # every queued request resolves, none hang

@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""graftlint wrapper: ``python tools/graftlint.py [paths...]``.
+
+Thin shim over ``python -m lambdagap_tpu.analysis`` so the linter is
+runnable from the tools/ directory without an installed package. See
+docs/static-analysis.md for the rule catalog, suppression syntax, and
+baseline workflow.
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lambdagap_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,8 +1,21 @@
 #!/bin/bash
 cd /root/repo
 {
+echo "=== G0 pre-test gates: graftlint + docs drift $(date)"
+# fail-fast: a hazard finding or stale generated doc aborts before any
+# test group burns wall-clock (graftlint exits nonzero on non-baselined
+# findings; see docs/static-analysis.md)
+if ! python -m lambdagap_tpu.analysis lambdagap_tpu; then
+    echo "FAIL-FAST: graftlint found non-baselined hazards (fix them, "
+    echo "suppress with a justification, or regenerate the baseline)"
+    exit 1
+fi
+if ! python tools/gen_params_doc.py --check; then
+    echo "FAIL-FAST: docs/Parameters.md is stale; run python tools/gen_params_doc.py"
+    exit 1
+fi
 echo "=== G1 $(date)"
-python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_misc_api.py -q 2>&1 | tail -1
+python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_misc_api.py tests/test_graftlint.py -q 2>&1 | tail -1
 echo "=== G2 $(date)"
 python -m pytest tests/test_train.py tests/test_rank.py tests/test_cli_io.py -q 2>&1 | tail -1
 echo "=== G3 $(date)"
@@ -10,7 +23,7 @@ python -m pytest tests/test_monotone.py tests/test_tree_options.py tests/test_ex
 echo "=== G4 $(date)"
 python -m pytest tests/test_fused.py tests/test_distributed.py tests/test_quantized.py tests/test_continued.py tests/test_model_io.py tests/test_shap_json.py -q 2>&1 | tail -1
 echo "=== G5 $(date)"
-python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py -q 2>&1 | tail -1
+python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py tests/test_serve.py tests/test_serve_stress.py -q 2>&1 | tail -1
 echo "=== G6 full-length consistency $(date)"
 LAMBDAGAP_CONSISTENCY_FULL=1 python -m pytest tests/test_consistency.py -q 2>&1 | tail -1
 echo "=== DONE $(date)"
