@@ -164,14 +164,21 @@ def _predict_crossover(booster, Xv_np, n_big, t_dev_big, native_per_row):
     (quarter-size) batch, split t = overhead + slope*rows, and solve for
     where the native line crosses. A single-point t/rate estimate answers
     the wrong question (it sets the threshold where native equals the
-    FULL-batch device time) and can overstate the crossover ~10x."""
+    FULL-batch device time) and can overstate the crossover ~10x.
+
+    ``crossover_rows_est`` is ALWAYS diagnosable (ISSUE 3 satellite): a
+    finite row count when the lines cross, the sentinel string
+    ``"never_at_measured_slopes"`` when the native per-row cost is below
+    the device slope (native wins at any size on this chip), or
+    ``"unmeasurable_single_point"`` when the shape leaves no second
+    device point to fit — never a silent null."""
     import time as _t
     n_small = max(n_big // 4, 1)
     thresh = getattr(booster._booster.config, "tpu_fast_predict_rows", 10000)
     if n_big == n_small or n_small <= thresh:
         # the small point would route native (or equal the big one):
         # no second device point, no fit
-        return {"crossover_rows_est": None}
+        return {"crossover_rows_est": "unmeasurable_single_point"}
     booster.predict(Xv_np[:n_small])     # WARM the new shape: the first
     t0 = _t.time()                       # call compiles, and compile time
     booster.predict(Xv_np[:n_small])     # in the fit would swamp the slope
@@ -179,13 +186,114 @@ def _predict_crossover(booster, Xv_np, n_big, t_dev_big, native_per_row):
     slope = max((t_dev_big - t_small) / (n_big - n_small), 0.0)
     overhead = max(t_small - slope * n_small, 0.0)
     if native_per_row <= slope:
-        return {"crossover_rows_est": None,     # native wins at any size
+        return {"crossover_rows_est": "never_at_measured_slopes",
                 "device_overhead_s": round(overhead, 4),
                 "device_slope_us_per_row": round(slope * 1e6, 2)}
     return {"crossover_rows_est": int(overhead
                                       / (native_per_row - slope)),
             "device_overhead_s": round(overhead, 4),
             "device_slope_us_per_row": round(slope * 1e6, 2)}
+
+
+def _predict_engine_ab(booster, X, hbm_gbps: float = None) -> dict:
+    """Same-session A/B of the two device traversal engines on identical
+    rows (ISSUE 3 acceptance): warm us/row for the tensorized
+    [rows x trees] engine vs the sequential per-tree scan, plus a predict
+    roofline from the node-table traffic model — an upper bound assuming
+    every per-level node gather misses (26 B node record + 4 B feature
+    value per row/tree/level) and a lower bound assuming the node tables
+    stay resident (stream the tables once + the row matrix). Measured
+    us/row between the two bounds is traversal-issue cost; above the
+    gather bound means dispatch overhead dominates."""
+    import time as _t
+    gb = booster._booster
+    fast = gb.config.tpu_fast_predict_rows
+    engine0 = gb.config.predict_engine
+    gb.config.tpu_fast_predict_rows = 0       # force the device path
+    res = {"rows": len(X)}
+    try:
+        for eng in ("tensor", "scan"):
+            gb.config.predict_engine = eng
+            gb.invalidate_predict_cache()
+            booster.predict(X)                # compile + warm this shape
+            t0 = _t.time()
+            booster.predict(X)
+            res[f"{eng}_us_per_row_warm"] = round(
+                (_t.time() - t0) / max(len(X), 1) * 1e6, 2)
+    finally:
+        gb.config.predict_engine = engine0
+        gb.config.tpu_fast_predict_rows = fast
+        gb.invalidate_predict_cache()
+    res["tensor_speedup_vs_scan"] = round(
+        res["scan_us_per_row_warm"]
+        / max(res["tensor_us_per_row_warm"], 1e-9), 3)
+
+    # node-table traffic model (forest dims off the host trees, padded the
+    # way forest_to_arrays pads them)
+    from lambdagap_tpu.ops.predict import _round_depth
+
+    def _round32(v):
+        return max(32, ((v + 31) // 32) * 32)
+
+    trees = gb.host_models
+    T = len(trees)
+    M = _round32(max(max(t.num_internal, 1) for t in trees))
+    L = _round32(max(max(t.num_leaves, 1) for t in trees))
+    depth = _round_depth(max(t.max_depth for t in trees) + 1)
+    node_rec_b = 26                  # feat+thr+children+missing meta
+    gather_bytes_row = depth * T * (node_rec_b + 4) + T * 4
+    table_bytes = T * M * (9 * 4 + 2 + 8 * 4 + 8 * 4) + T * L * 4
+    stream_bytes = table_bytes + len(X) * X.shape[1] * 4
+    roofline = {
+        "trees": T, "padded_nodes": M, "padded_depth": depth,
+        "node_gather_bytes_per_row": int(gather_bytes_row),
+        "node_table_bytes": int(table_bytes),
+        "resident_stream_bytes_per_row": round(
+            stream_bytes / max(len(X), 1), 1),
+    }
+    if hbm_gbps:
+        bw = hbm_gbps * 1e9
+        roofline["gather_bound_us_per_row"] = round(
+            gather_bytes_row / bw * 1e6, 3)
+        roofline["resident_bound_us_per_row"] = round(
+            stream_bytes / max(len(X), 1) / bw * 1e6, 4)
+        roofline["measured_vs_gather_bound"] = round(
+            res["tensor_us_per_row_warm"]
+            / max(gather_bytes_row / bw * 1e6, 1e-9), 3)
+    res["roofline"] = roofline
+    return res
+
+
+def run_predict_ab(n_trees: int, rows: int) -> None:
+    """Child-process entry (ISSUE 3 acceptance shape): a ``n_trees``-tree
+    forest (trained base tiled out, structure-realistic — predict cost
+    depends on tree count/shape, not training history) predicted over
+    ``rows`` rows by both device engines + the native baseline. Prints one
+    JSON line."""
+    _configure_jax_cache()
+    import lambdagap_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    Xt = rng.randn(8000, FEATURES).astype(np.float32)
+    yt = (Xt[:, 0] - 0.5 * Xt[:, 1] + np.sin(Xt[:, 2])
+          + 0.1 * rng.randn(8000)).astype(np.float32)
+    base = min(n_trees, 50)
+    booster = lgb.train({"objective": "regression",
+                         "num_leaves": NUM_LEAVES, "verbose": -1},
+                        lgb.Dataset(Xt, label=yt), num_boost_round=base)
+    gb = booster._booster
+    host = gb.host_models
+    gb.models = (host * (-(-n_trees // len(host))))[:n_trees]
+    gb.iter_ = len(gb.models)
+    gb.invalidate_predict_cache()
+    X = rng.randn(rows, FEATURES).astype(np.float32)
+
+    out = _predict_engine_ab(booster, X)
+    tn = time.time()
+    booster.predict(X[:8192])                # native route (< threshold)
+    out["native_us_per_row"] = round((time.time() - tn) / 8192 * 1e6, 2)
+    out["trees"] = n_trees
+    print(json.dumps(out))
 
 
 def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
@@ -310,6 +418,9 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
                                         2),
         **_predict_crossover(booster, Xv_np, len(yv), t_dev_warm,
                              native_per_row),
+        # tensorized vs sequential engine on identical rows (capped at 50k
+        # so a throttled chip doesn't eat the session budget)
+        "engine_ab": _predict_engine_ab(booster, Xv_np[:50_000]),
     }
 
     projected = t_construct + t_warm + per_iter * (ITERS_TOTAL - 2)
@@ -564,6 +675,9 @@ def run_full_attempt(rows: int, max_bin: int) -> None:
         **_predict_crossover(booster, Xv_np, len(Xv_np), t_dev_warm,
                              native_us / 1e6),
         "device_faulted": False,
+        # the ISSUE 3 acceptance A/B: tensorized vs sequential engine at
+        # the REAL 500-tree/50k-row shape, same session, warm both sides
+        "engine_ab": _predict_engine_ab(booster, Xv_np),
     }
     print(json.dumps({
         "rows": rows, "max_bin": max_bin, "iters": ITERS_TOTAL,
@@ -858,6 +972,15 @@ def main() -> None:
                 break
             time.sleep(30)     # let the tunnel worker recover post-crash
 
+    # dedicated predict A/B at the acceptance shape (500 trees x 50k rows):
+    # tensorized vs sequential device engine vs native, + node-table
+    # traffic roofline. Cheap (tiled forest, no 500-iteration training).
+    predict_ab = None
+    if os.environ.get("BENCH_PREDICT_AB", "1") != "0":
+        predict_ab = _run_child(
+            ["--predict-ab", "500", "50000"], 1800,
+            "predict engine A/B (500 trees x 50k rows)")
+
     # chip ceiling AFTER the attempts
     micro_post = (None if os.environ.get("BENCH_MICRO", "1") == "0"
                   else _run_child(["--micro"], 900, "microbench (post)"))
@@ -977,6 +1100,7 @@ def main() -> None:
             "microbench_post": micro_post,
             "roofline": roofline,
             "full_run": full_run,
+            "predict_tensor_ab": predict_ab,
             "ranking_mslr_shaped": ranking,
         },
     }))
@@ -991,6 +1115,8 @@ if __name__ == "__main__":
                          int(sys.argv[3]) if len(sys.argv) > 3 else None)
     elif sys.argv[1:2] == ["--micro"]:
         run_microbench()
+    elif len(sys.argv) >= 4 and sys.argv[1] == "--predict-ab":
+        run_predict_ab(int(sys.argv[2]), int(sys.argv[3]))
     elif len(sys.argv) >= 4 and sys.argv[1] == "--fixed-probe":
         run_fixed_probe(int(sys.argv[2]), int(sys.argv[3]))
     elif len(sys.argv) >= 4 and sys.argv[1] == "--full-attempt":

@@ -83,6 +83,38 @@ def bench_naive_device(booster, X, n_requests: int) -> dict:
             "mean_latency_ms": 1e3 * dt / n_requests}
 
 
+def bench_engines(booster, X) -> dict:
+    """Warm big-batch device us/row for the tensorized engine next to the
+    sequential scan and the native per-row baseline, same rows — so the
+    serve JSON tracks the traversal-engine win alongside the batching win
+    (ISSUE 3 satellite)."""
+    gb = booster._booster
+    fast = gb.config.tpu_fast_predict_rows
+    engine0 = gb.config.predict_engine
+    gb.config.tpu_fast_predict_rows = 0
+    out = {"rows": len(X)}
+    try:
+        for eng in ("tensor", "scan"):
+            gb.config.predict_engine = eng
+            gb.invalidate_predict_cache()
+            booster.predict(X)               # compile + warm
+            t0 = time.perf_counter()
+            booster.predict(X)
+            out[f"{eng}_us_per_row_warm"] = \
+                1e6 * (time.perf_counter() - t0) / len(X)
+    finally:
+        gb.config.predict_engine = engine0
+        gb.config.tpu_fast_predict_rows = fast
+        gb.invalidate_predict_cache()
+    out["tensor_speedup_vs_scan"] = (out["scan_us_per_row_warm"]
+                                     / max(out["tensor_us_per_row_warm"],
+                                           1e-9))
+    t0 = time.perf_counter()
+    booster.predict(X[:4096])                # native single-row traverser
+    out["native_us_per_row"] = 1e6 * (time.perf_counter() - t0) / 4096
+    return out
+
+
 def bench_served(booster, X, n_requests: int, clients: int,
                  window: int, max_delay_ms: float) -> dict:
     server = booster.as_server(max_delay_ms=max_delay_ms)
@@ -156,6 +188,12 @@ def main(argv=None) -> int:
               "Booster.predict path", file=sys.stderr)
         return 1
 
+    print("device engine A/B (tensor vs scan vs native)...", file=sys.stderr)
+    engines = bench_engines(booster, X)
+    print(f"  tensor {engines['tensor_us_per_row_warm']:.1f} us/row, "
+          f"scan {engines['scan_us_per_row_warm']:.1f}, "
+          f"native {engines['native_us_per_row']:.1f}", file=sys.stderr)
+
     print(f"naive per-request predict x{args.naive_requests}...",
           file=sys.stderr)
     naive = bench_naive(booster, X, args.naive_requests)
@@ -182,11 +220,14 @@ def main(argv=None) -> int:
         "feats": args.feats,
         "backend": jax.default_backend(),
         "bit_identical_to_device_predict": exact,
+        "engine_ab": engines,
         "naive": naive,
         "naive_device": naive_dev,
         "serve": served,
         "speedup": speedup,
         "speedup_vs_device_naive": speedup_dev,
+        "serve_engine": served["stats"].get("engine"),
+        "serve_device_us_per_row": served["stats"].get("device_us_per_row"),
         "serve_p50_ms": served["stats"]["latency_ms"]["p50"],
         "serve_p99_ms": served["stats"]["latency_ms"]["p99"],
         "cache_hit_rate": served["stats"]["cache"]["hit_rate"],

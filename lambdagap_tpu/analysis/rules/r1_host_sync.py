@@ -10,9 +10,12 @@ repo's host-loop distributed learners pay a documented D2H per split.
 Heuristic hot contexts:
 
 - any function whose name is in :data:`HOT_FUNCTIONS` (the boosting loop,
-  gradient computation, score update, and serve dispatch surfaces), at any
-  nesting depth;
-- any for/while loop body inside ``serve/`` (the request path).
+  gradient computation, score update, serve dispatch, and tensorized
+  predict surfaces), at any nesting depth;
+- any for/while loop body inside a :data:`HOT_PATHS` file — ``serve/``
+  (the request path) and ``ops/predict_tensor.py`` (the inference hot
+  path: its tile loop runs once per ``predict_tree_tile`` trees per
+  predict call, so one D2H inside it serializes every tile dispatch).
 
 Sync calls flagged: ``jax.device_get``, ``.item()``, ``.block_until_ready()``,
 ``float(...)``/``int(...)`` wrapping a jax/jnp call, and
@@ -33,7 +36,14 @@ HOT_FUNCTIONS = frozenset({
     "train", "train_device", "train_one_iter", "boost_one_iter",
     "get_gradients", "get_gradients_fast", "update_scores",
     "_run_batch", "_dispatch", "_loop",
+    # tensorized traversal engine (ops/predict_tensor.py): these run once
+    # per predict dispatch; a sync here stalls every serve bucket
+    "predict_forest_tensor", "predict_forest_leaf_tensor",
+    "_predict_tensor_tile", "_traverse_tile",
 })
+
+# files whose loop bodies are hot regardless of function name
+HOT_PATHS = ("/serve/", "/ops/predict_tensor")
 
 _JAXISH = ("jax.", "jnp.", "lax.")
 
@@ -73,7 +83,7 @@ class HostSyncRule(Rule):
 
     def check(self, ctx: ModuleContext, index: PackageIndex
               ) -> Iterator[Finding]:
-        in_serve = "/serve/" in ("/" + ctx.relpath)
+        in_hot_path = any(p in ("/" + ctx.relpath) for p in HOT_PATHS)
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -82,7 +92,7 @@ class HostSyncRule(Rule):
                 continue
             funcs = ctx.enclosing_functions(node)
             hot = any(f.name in HOT_FUNCTIONS for f in funcs)
-            if not hot and in_serve and funcs:
+            if not hot and in_hot_path and funcs:
                 hot = ctx.in_loop(node)
             if not hot:
                 continue

@@ -320,6 +320,11 @@ class Config:
     pred_early_stop: bool = False
     pred_early_stop_freq: int = 10
     pred_early_stop_margin: float = 10.0
+    # device predict traversal engine (docs/serving.md "Forest layout &
+    # traversal"): tensor = batched [rows x trees] node-table traversal;
+    # scan = sequential per-tree reference oracle (bit-identical outputs)
+    predict_engine: str = "tensor"       # tensor (batched rows x trees) / scan (per-tree oracle)
+    predict_tree_tile: int = 64          # trees per tensorized tile dispatch
 
     # -- serve (task=serve / Booster.as_server; docs/serving.md) ----------
     # padded request-batch sizes with pre-compiled predict executables;
@@ -493,6 +498,9 @@ class Config:
              f"unknown data_sample_strategy {self.data_sample_strategy!r}"),
             (self.monotone_constraints_method in ("basic", "intermediate", "advanced"),
              "unknown monotone_constraints_method"),
+            (self.predict_engine in ("tensor", "scan"),
+             f"unknown predict_engine {self.predict_engine!r}"),
+            (self.predict_tree_tile >= 1, "predict_tree_tile must be >= 1"),
             (self.serve_max_batch >= 1, "serve_max_batch must be >= 1"),
             (self.serve_max_delay_ms >= 0, "serve_max_delay_ms must be >= 0"),
             (all(b > 0 for b in self.serve_buckets),

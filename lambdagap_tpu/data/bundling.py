@@ -56,22 +56,38 @@ def find_groups(nz: np.ndarray, feature_bins: np.ndarray,
     """
     S, F = nz.shape
     budget = max_conflict_rate * S
-    order = np.argsort(-nz.sum(axis=0))        # most non-defaults first
+    nz_cnt = nz.sum(axis=0)
+    order = np.argsort(-nz_cnt)                # most non-defaults first
     bundle_members: List[List[int]] = []
     bundle_masks: List[np.ndarray] = []
+    bundle_cnts: List[int] = []                # popcount of each mask
     bundle_conflicts: List[float] = []
     bundle_bins: List[int] = []
     for f in order:
         placed = False
+        cnt_f = int(nz_cnt[f])
         # cap the candidate scan like the reference's random-subset probe
         for bi in range(min(len(bundle_members), max_scan)):
             extra_bins = int(feature_bins[f]) - 1
             if bundle_bins[bi] + extra_bins > MAX_BUNDLE_BINS:
                 continue
+            # pigeonhole lower bound on the conflict count: two sets of
+            # cnt_f and cnt_b rows among S overlap on at least
+            # cnt_f + cnt_b - S rows, so a candidate that already fails on
+            # the bound fails on the true count — skip the O(S) mask AND.
+            # Dense matrices (every feature ~always non-default) used to
+            # pay F x max_scan full-sample ANDs here just to bundle
+            # nothing, which made max_bin=63 dataset construction ~2x
+            # SLOWER than max_bin=255 (whose wide bins never pass the
+            # bin-budget check above); see BENCH_NOTES.md.
+            if bundle_conflicts[bi] + max(0, cnt_f + bundle_cnts[bi] - S) \
+                    > budget:
+                continue
             c = int((bundle_masks[bi] & nz[:, f]).sum())
             if bundle_conflicts[bi] + c <= budget:
                 bundle_members[bi].append(int(f))
                 bundle_masks[bi] |= nz[:, f]
+                bundle_cnts[bi] = int(bundle_masks[bi].sum())
                 bundle_conflicts[bi] += c
                 bundle_bins[bi] += extra_bins
                 placed = True
@@ -79,6 +95,7 @@ def find_groups(nz: np.ndarray, feature_bins: np.ndarray,
         if not placed:
             bundle_members.append([int(f)])
             bundle_masks.append(nz[:, f].copy())
+            bundle_cnts.append(cnt_f)
             bundle_conflicts.append(0.0)
             bundle_bins.append(1 + int(feature_bins[f]) - 1)
     return bundle_members

@@ -27,6 +27,8 @@ from ..ops.predict import (_round_depth, build_forest_blocks,
                            forest_to_arrays, predict_forest,
                            predict_forest_leaf, predict_tree_binned,
                            tree_to_arrays)
+from ..ops.predict_tensor import (build_tree_tiles, predict_forest_leaf_tensor,
+                                  predict_forest_tensor)
 from ..utils import log
 from ..utils.timer import global_timer
 from .learner import SerialTreeLearner
@@ -75,6 +77,38 @@ def _add_tree_score(score, perm, leaf_begin, leaf_count, leaf_values,
     pos_leaf = order[which]
     vals = leaf_values[pos_leaf]
     return score.at[perm].add(vals)
+
+
+def dispatch_forest_predict(cfg, x, forest, tree_class, num_class: int,
+                            max_depth: int, binned: bool,
+                            early_stop_freq: int = 0,
+                            early_stop_margin: float = 0.0,
+                            blocks=None):
+    """Route a whole-forest score dispatch through the configured traversal
+    engine (``predict_engine``): the tensorized [rows x trees] engine
+    (ops.predict_tensor) or the sequential per-tree reference scan
+    (ops.predict). Both return bit-identical [num_class, N] float32;
+    ``blocks`` are pre-sliced tree tiles/blocks from the booster or serve
+    caches (either engine consumes the same layout)."""
+    if cfg.predict_engine == "tensor":
+        return predict_forest_tensor(
+            x, forest, tree_class, num_class, max_depth, binned,
+            early_stop_freq, early_stop_margin,
+            tree_tile=cfg.predict_tree_tile, tiles=blocks)
+    return predict_forest(x, forest, tree_class, num_class, max_depth,
+                          binned, early_stop_freq, early_stop_margin,
+                          blocks=blocks)
+
+
+def dispatch_forest_leaf(cfg, x, forest, max_depth: int, binned: bool,
+                         blocks=None):
+    """Engine-routed leaf-index dispatch ([T, N] int32), same contract as
+    :func:`dispatch_forest_predict`."""
+    if cfg.predict_engine == "tensor":
+        return predict_forest_leaf_tensor(
+            x, forest, max_depth, binned,
+            tree_tile=cfg.predict_tree_tile, tiles=blocks)
+    return predict_forest_leaf(x, forest, max_depth, binned, blocks=blocks)
 
 
 def _finalize_tree(tree: "Tree", shrinkage: float, bias: float) -> "Tree":
@@ -362,9 +396,10 @@ class GBDT:
                 return
             tree_class = jnp.asarray(
                 [i % K for i in range(len(trees))], jnp.int32)
-            self.valid_scores[vi] = self.valid_scores[vi] + predict_forest(
-                self.valid_binned[vi], forest, tree_class, K, depth,
-                binned=True)
+            self.valid_scores[vi] = self.valid_scores[vi] + \
+                dispatch_forest_predict(self.config, self.valid_binned[vi],
+                                        forest, tree_class, K, depth,
+                                        binned=True)
 
     def _linear_forest_outputs(self, trees, forest, depth, x, raw,
                                binned: bool) -> np.ndarray:
@@ -373,8 +408,8 @@ class GBDT:
         loop — resume/valid replay and predict() must agree exactly."""
         from .tree import linear_leaf_outputs
         K = self.num_tree_per_iteration
-        leaf_T = np.asarray(jax.device_get(predict_forest_leaf(
-            x, forest, depth, binned=binned)))
+        leaf_T = np.asarray(jax.device_get(dispatch_forest_leaf(
+            self.config, x, forest, depth, binned=binned)))
         add = np.zeros((K, raw.shape[0]), dtype=np.float64)
         for i, t in enumerate(trees):
             add[i % K] += linear_leaf_outputs(t, raw, leaf_T[i])
@@ -733,13 +768,14 @@ class GBDT:
                     trees, forest, depth, self.valid_binned[vi], vds.raw,
                     self.valid_scores[vi])
             return
-        self.scores = self.scores + predict_forest(
-            jnp.asarray(self.train_set.binned), forest, tree_class, K, depth,
-            binned=True)
+        self.scores = self.scores + dispatch_forest_predict(
+            self.config, jnp.asarray(self.train_set.binned), forest,
+            tree_class, K, depth, binned=True)
         for vi in range(len(self.valid_sets)):
-            self.valid_scores[vi] = self.valid_scores[vi] + predict_forest(
-                self.valid_binned[vi], forest, tree_class, K, depth,
-                binned=True)
+            self.valid_scores[vi] = self.valid_scores[vi] + \
+                dispatch_forest_predict(self.config, self.valid_binned[vi],
+                                        forest, tree_class, K, depth,
+                                        binned=True)
 
     def refit(self, data: np.ndarray, label: np.ndarray, weight=None,
               group=None, decay_rate: Optional[float] = None) -> None:
@@ -780,8 +816,9 @@ class GBDT:
         obj.init(md, N)
 
         forest, depth = forest_to_arrays(trees, use_inner_feature=False)
-        leaf_of = np.asarray(jax.device_get(predict_forest_leaf(
-            jnp.asarray(X), forest, depth, binned=False)))   # [T, N]
+        leaf_of = np.asarray(jax.device_get(dispatch_forest_leaf(
+            self.config, jnp.asarray(X), forest, depth,
+            binned=False)))   # [T, N]
 
         l1, l2 = cfg.lambda_l1, cfg.lambda_l2
         mds = cfg.max_delta_step
@@ -893,13 +930,19 @@ class GBDT:
         immutable between calls, so re-slicing and re-uploading it per
         predict call (ADVICE round 5, predict.py:313) was pure waste.
         Returns (forest, depth, tree_class, blocks)."""
-        key = (self.generation, len(self.models), idx[0], idx[-1], len(idx))
+        cfg = self.config
+        key = (self.generation, len(self.models), idx[0], idx[-1], len(idx),
+               cfg.predict_engine, cfg.predict_tree_tile)
         cache = getattr(self, "_forest_cache", None)
         if cache is None or cache[0] != key:
             K = self.num_tree_per_iteration
             forest, depth = forest_to_arrays(trees, use_inner_feature=False)
             tree_class = jnp.asarray([i % K for i in idx], jnp.int32)
-            blocks = build_forest_blocks(forest, tree_class)
+            if cfg.predict_engine == "tensor":
+                blocks = build_tree_tiles(forest, tree_class,
+                                          cfg.predict_tree_tile)
+            else:
+                blocks = build_forest_blocks(forest, tree_class)
             self._forest_cache = (key, (forest, depth, tree_class, blocks))
         return self._forest_cache[1]
 
@@ -964,12 +1007,11 @@ class GBDT:
                 trees, forest, depth, jnp.asarray(data), data,
                 binned=False).astype(np.float32)
         else:
-            out = predict_forest(jnp.asarray(data), forest, tree_class, K,
-                                 depth, binned=False,
-                                 early_stop_freq=es_freq,
-                                 early_stop_margin=float(
-                                     self.config.pred_early_stop_margin),
-                                 blocks=blocks)
+            out = dispatch_forest_predict(
+                self.config, jnp.asarray(data), forest, tree_class, K,
+                depth, binned=False, early_stop_freq=es_freq,
+                early_stop_margin=float(self.config.pred_early_stop_margin),
+                blocks=blocks)
             res = np.asarray(jax.device_get(out))
         if self.average_output:
             n_iters = max(1, len(idx) // max(K, 1))
@@ -987,8 +1029,8 @@ class GBDT:
         self._materialize_lazy(idx)
         trees = [self._tree(i) for i in idx]
         forest, depth, _, blocks = self._device_forest(idx, trees)
-        ys = predict_forest_leaf(jnp.asarray(data), forest, depth,
-                                 binned=False, blocks=blocks)
+        ys = dispatch_forest_leaf(self.config, jnp.asarray(data), forest,
+                                  depth, binned=False, blocks=blocks)
         return np.asarray(jax.device_get(ys)).astype(np.int32).T
 
     def predict_contrib(self, data: np.ndarray, start_iteration: int = 0,

@@ -5,6 +5,8 @@ from .predict import (TreeArrays, forest_to_arrays, predict_forest,
                       predict_forest_leaf, predict_tree_raw,
                       predict_tree_binned, predict_leaf_index_binned,
                       tree_to_arrays)
+from .predict_tensor import (build_tree_tiles, predict_forest_tensor,
+                             predict_forest_leaf_tensor)
 from .split import SplitParams, SplitResult, find_best_split
 
 __all__ = [
@@ -13,5 +15,7 @@ __all__ = [
     "TreeArrays", "forest_to_arrays", "predict_forest",
     "predict_forest_leaf", "predict_tree_raw", "predict_tree_binned",
     "predict_leaf_index_binned", "tree_to_arrays",
+    "build_tree_tiles", "predict_forest_tensor",
+    "predict_forest_leaf_tensor",
     "SplitParams", "SplitResult", "find_best_split",
 ]

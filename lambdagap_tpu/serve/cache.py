@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.predict import build_forest_blocks, forest_to_arrays, predict_forest
+from ..ops.predict_tensor import build_tree_tiles, predict_forest_tensor
 from ..utils import log
 
 # powers chosen so the jump between buckets wastes at most ~8x padding on
@@ -89,6 +90,13 @@ class CompiledForestCache:
             tree_block = int(os.environ.get("LAMBDAGAP_PREDICT_TREE_BLOCK",
                                             64))
         self._tree_block = tree_block
+        # traversal engine: the tensorized [rows x trees] engine is the
+        # serving default (predict_engine=tensor); the sequential scan
+        # stays selectable for differential testing. Both are bit-identical
+        # (ops/predict_tensor.py contract), so the serve-vs-predict parity
+        # guarantee above holds under either engine.
+        self.engine = gbdt.config.predict_engine
+        self._tree_tile = int(gbdt.config.predict_tree_tile)
         if idx:
             forest, depth = forest_to_arrays(trees, use_inner_feature=False)
             tree_class = jnp.asarray([i % self.num_class for i in idx],
@@ -96,8 +104,12 @@ class CompiledForestCache:
             self._forest = jax.device_put(forest)
             self._depth = depth
             self._tree_class = tree_class
-            self._blocks = build_forest_blocks(self._forest, tree_class,
-                                               tree_block)
+            if self.engine == "tensor":
+                self._blocks = build_tree_tiles(self._forest, tree_class,
+                                                self._tree_tile)
+            else:
+                self._blocks = build_forest_blocks(self._forest, tree_class,
+                                                   tree_block)
         else:
             self._forest = None
             self._depth = 8
@@ -151,12 +163,20 @@ class CompiledForestCache:
 
     def _dispatch(self, xb: np.ndarray, raw_score: bool) -> jax.Array:
         """One padded bucket through the compiled forest: [num_class, B]."""
-        out = predict_forest(jnp.asarray(xb), self._forest, self._tree_class,
-                             self.num_class, self._depth, binned=False,
-                             early_stop_freq=self._es_freq,
-                             early_stop_margin=self._es_margin,
-                             tree_block=self._tree_block,
-                             blocks=self._blocks)
+        if self.engine == "tensor":
+            out = predict_forest_tensor(
+                jnp.asarray(xb), self._forest, self._tree_class,
+                self.num_class, self._depth, binned=False,
+                early_stop_freq=self._es_freq,
+                early_stop_margin=self._es_margin,
+                tree_tile=self._tree_tile, tiles=self._blocks)
+        else:
+            out = predict_forest(
+                jnp.asarray(xb), self._forest, self._tree_class,
+                self.num_class, self._depth, binned=False,
+                early_stop_freq=self._es_freq,
+                early_stop_margin=self._es_margin,
+                tree_block=self._tree_block, blocks=self._blocks)
         if self.gbdt.average_output:
             out = out / self._n_iters
         obj = self.gbdt.objective
@@ -211,7 +231,7 @@ class CompiledForestCache:
             self.predict(np.zeros((b, self.width), np.float32), record=False)
         self.build_time_s = time.perf_counter() - t0
         log.info("serve: warmed %d padding buckets %s in %.2fs "
-                 "(generation %d, %d trees)", len(self.buckets),
+                 "(generation %d, %d trees, %s engine)", len(self.buckets),
                  list(self.buckets), self.build_time_s, self.generation,
-                 len(self.idx))
+                 len(self.idx), self.engine)
         return self.build_time_s

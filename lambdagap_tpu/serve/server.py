@@ -130,6 +130,7 @@ class ForestServer:
         snap = self.stats.snapshot()
         snap["generation"] = self.generation
         snap["buckets"] = list(self._swap.active.buckets)
+        snap["engine"] = getattr(self._swap.active, "engine", "scan")
         return snap
 
     def stats_json(self, **kwargs) -> str:
@@ -180,6 +181,7 @@ class ForestServer:
         X = rows[0] if len(rows) == 1 else np.concatenate(rows, axis=0)
         out = slot.predict(X, raw_score=self.raw_score)
         t1 = time.perf_counter()
+        self.stats.record_dispatch(rows=X.shape[0], device_s=t1 - t0)
         lo = 0
         for r, x in zip(good, rows):
             n = x.shape[0]

@@ -66,6 +66,8 @@ class ServeStats:
         self.n_rows = 0
         self.n_batches = 0
         self.n_batch_rows = 0
+        self.n_dispatch_rows = 0
+        self.dispatch_device_s = 0.0
         self.n_errors = 0
         self.cache_hits = 0
         self.cache_misses = 0
@@ -91,6 +93,17 @@ class ServeStats:
         with self._lock:
             self.n_batches += 1
             self.n_batch_rows += rows
+
+    def record_dispatch(self, rows: int, device_s: float) -> None:
+        """One device dispatch: ``rows`` real rows in ``device_s`` seconds
+        of wall-clock. Unlike the per-request reservoirs (whose rows share
+        the batch's device time), this sums exactly once per dispatch, so
+        ``device_us_per_row`` in the snapshot is the true per-row cost of
+        the active traversal engine — the number the predict-roofline
+        benches compare against the naive and native baselines."""
+        with self._lock:
+            self.n_dispatch_rows += rows
+            self.dispatch_device_s += device_s
 
     def record_error(self) -> None:
         with self._lock:
@@ -143,6 +156,9 @@ class ServeStats:
                     "mean_rows": (self.n_batch_rows / self.n_batches
                                   if self.n_batches else 0.0),
                 },
+                "device_us_per_row": (
+                    1e6 * self.dispatch_device_s / self.n_dispatch_rows
+                    if self.n_dispatch_rows else 0.0),
                 "cache": {
                     "hits": self.cache_hits,
                     "misses": self.cache_misses,

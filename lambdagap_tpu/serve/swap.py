@@ -84,6 +84,10 @@ class SwapController:
                 self.active = cache      # atomic flip
             if self._stats is not None:
                 self._stats.record_swap()
+            from ..utils import log
+            log.info("serve: swapped to generation %d (%s engine, "
+                     "pre-warmed before the flip)", gen,
+                     getattr(cache, "engine", "?"))
             return gen
 
         if background:

@@ -1,0 +1,24 @@
+"""R1 fixture (tensorized predict path): a D2H sync inside the tile
+traversal loop of ops/predict_tensor.py serializes every tile dispatch —
+flagged even though the enclosing function name is arbitrary."""
+import jax
+import jax.numpy as jnp
+
+
+def tiled_predict(x, tiles):
+    carry = jnp.zeros((1, x.shape[0]), jnp.float32)
+    for blk, tc, _ in tiles:
+        carry = carry + blk
+        _ = float(jnp.sum(carry))  # BAD:R1
+    return carry
+
+
+def predict_forest_tensor(x, forest):
+    # hot by function name, no loop needed
+    out = jnp.sum(forest)
+    return jax.device_get(out)  # BAD:R1
+
+
+def build_tiles_host(forest):
+    # not a hot name, not in a loop: fine (one-time layout build)
+    return jax.device_get(forest)

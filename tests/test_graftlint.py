@@ -55,6 +55,7 @@ def fixture_findings():
 @pytest.mark.parametrize("relpath", [
     "r1_host_sync.py",
     "serve/r1_serve_loop.py",
+    "ops/predict_tensor.py",
     "r2_recompile.py",
     "r3_clamped_slice.py",
     "r4_dtype_drift.py",

@@ -1,0 +1,224 @@
+"""Tensorized traversal engine parity (ISSUE 3 acceptance gate).
+
+The tensorized [rows x trees] engine (ops/predict_tensor.py) must be
+BIT-IDENTICAL to the sequential per-tree oracle (ops/predict.py) — not
+close, equal: the engine contract is the same f32 accumulation order, so
+every assertion here is ``array_equal``. Coverage: ragged tree tiles (tree
+counts that don't divide the tile), NaN/default-left routing, zero-missing,
+categorical bitset splits (single- and multi-word), multiclass tree->class
+routing, early-stop margins, and both binned and raw-float inputs.
+"""
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+
+jnp = pytest.importorskip("jax.numpy")
+
+from lambdagap_tpu.ops.predict import (forest_to_arrays, predict_forest,
+                                       predict_forest_leaf)
+from lambdagap_tpu.ops.predict_tensor import (predict_forest_leaf_tensor,
+                                              predict_forest_tensor)
+
+
+def _forest_of(booster, binned=False):
+    gb = booster._booster
+    trees = gb.host_models
+    K = gb.num_tree_per_iteration
+    tc = jnp.asarray([i % K for i in range(len(trees))], jnp.int32)
+    if binned:
+        forest, depth = forest_to_arrays(trees, feature_meta=gb._meta,
+                                         use_inner_feature=True)
+        x = jnp.asarray(gb.train_set.binned)
+    else:
+        forest, depth = forest_to_arrays(trees, use_inner_feature=False)
+        x = None
+    return gb, forest, depth, tc, K, x
+
+
+def _assert_engine_parity(booster, X, tiles=(5, 64), es=(0, 0.0)):
+    """predict_forest_tensor == predict_forest bit-for-bit, raw AND binned,
+    across ragged tile sizes."""
+    es_freq, es_margin = es
+    gb, forest, depth, tc, K, _ = _forest_of(booster)
+    xr = jnp.asarray(np.asarray(X, np.float32))
+    ref = np.asarray(predict_forest(xr, forest, tc, K, depth, binned=False,
+                                    early_stop_freq=es_freq,
+                                    early_stop_margin=es_margin))
+    for tile in tiles:
+        got = np.asarray(predict_forest_tensor(
+            xr, forest, tc, K, depth, binned=False, early_stop_freq=es_freq,
+            early_stop_margin=es_margin, tree_tile=tile))
+        assert np.array_equal(ref, got), \
+            f"raw parity broke at tree_tile={tile}"
+    gb, forest_b, depth_b, tc, K, xb = _forest_of(booster, binned=True)
+    ref_b = np.asarray(predict_forest(xb, forest_b, tc, K, depth_b,
+                                      binned=True, early_stop_freq=es_freq,
+                                      early_stop_margin=es_margin))
+    for tile in tiles:
+        got_b = np.asarray(predict_forest_tensor(
+            xb, forest_b, tc, K, depth_b, binned=True,
+            early_stop_freq=es_freq, early_stop_margin=es_margin,
+            tree_tile=tile))
+        assert np.array_equal(ref_b, got_b), \
+            f"binned parity broke at tree_tile={tile}"
+
+
+def test_binary_nan_default_left_parity():
+    rng = np.random.RandomState(0)
+    X = rng.randn(2500, 10).astype(np.float32)
+    X[::7, 2] = np.nan                       # NaN-missing routing
+    X[::5, 4] = 0.0                          # zero bin
+    y = (X[:, 0] - 0.4 * X[:, 1] > 0).astype(np.float32)
+    b = lgb.train({"objective": "binary", "num_leaves": 31, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=13)   # 13 % tile
+    _assert_engine_parity(b, X[:600])
+
+
+def test_zero_as_missing_parity():
+    rng = np.random.RandomState(1)
+    X = rng.randn(2000, 8).astype(np.float32)
+    X[rng.rand(2000, 8) < 0.3] = 0.0
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                   "zero_as_missing": True},
+                  lgb.Dataset(X, label=y), num_boost_round=9)
+    _assert_engine_parity(b, X[:500])
+
+
+def test_categorical_bitset_parity():
+    rng = np.random.RandomState(2)
+    X = rng.randn(3000, 6).astype(np.float32)
+    # single-word (values < 256) and multi-word (values up to ~900,
+    # exercising the W > 8 raw-category bitsets) categorical columns
+    X[:, 4] = rng.randint(0, 40, 3000)
+    X[:, 5] = rng.choice([3, 17, 256, 511, 899], 3000)
+    y = (X[:, 0] + (X[:, 4] % 3 == 0) + (X[:, 5] > 300)).astype(np.float32)
+    b = lgb.train({"objective": "regression", "num_leaves": 31,
+                   "verbose": -1, "categorical_feature": [4, 5],
+                   "max_cat_to_onehot": 2},
+                  lgb.Dataset(X, label=y), num_boost_round=11)
+    Xq = X[:600].copy()
+    Xq[::9, 4] = np.nan                      # NaN category -> dummy bin
+    Xq[::13, 5] = 1234.0                     # unseen category
+    _assert_engine_parity(b, Xq)
+
+
+def test_multiclass_routing_parity():
+    rng = np.random.RandomState(3)
+    X = rng.randn(2400, 9).astype(np.float32)
+    y = (np.abs(X[:, 0]) + X[:, 1] > 1).astype(int) + \
+        (X[:, 2] > 0.5).astype(int)
+    b = lgb.train({"objective": "multiclass", "num_class": 3,
+                   "num_leaves": 15, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=7)   # 21 trees
+    _assert_engine_parity(b, X[:500], tiles=(5, 64))
+
+
+def test_early_stop_margin_parity():
+    rng = np.random.RandomState(4)
+    X = rng.randn(2600, 8).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=24)
+    # freq=5 does not divide the tile sizes: the accumulation scan must
+    # reproduce the oracle's exact check points
+    _assert_engine_parity(b, X[:400], tiles=(4, 64), es=(5, 0.6))
+
+
+def test_leaf_index_parity():
+    rng = np.random.RandomState(5)
+    X = rng.randn(2000, 10).astype(np.float32)
+    X[::6, 1] = np.nan
+    y = rng.randn(2000).astype(np.float32)
+    b = lgb.train({"objective": "regression", "num_leaves": 31,
+                   "verbose": -1}, lgb.Dataset(X, label=y),
+                  num_boost_round=10)
+    gb, forest, depth, tc, K, _ = _forest_of(b)
+    xr = jnp.asarray(X[:300])
+    ref = np.asarray(predict_forest_leaf(xr, forest, depth, binned=False))
+    for tile in (3, 64):
+        got = np.asarray(predict_forest_leaf_tensor(
+            xr, forest, depth, binned=False, tree_tile=tile))
+        assert np.array_equal(ref, got)
+    gb, forest_b, depth_b, tc, K, xb = _forest_of(b, binned=True)
+    ref_b = np.asarray(predict_forest_leaf(xb[:300], forest_b, depth_b,
+                                           binned=True))
+    got_b = np.asarray(predict_forest_leaf_tensor(
+        xb[:300], forest_b, depth_b, binned=True, tree_tile=4))
+    assert np.array_equal(ref_b, got_b)
+
+
+def test_booster_engine_switch_bit_identical():
+    """End-to-end: predict_engine=tensor and =scan agree bit-for-bit on
+    the device path (native small-batch route disabled), including
+    pred_early_stop and multiclass output layout."""
+    rng = np.random.RandomState(6)
+    X = rng.randn(2200, 12).astype(np.float32)
+    X[::8, 3] = np.nan
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(int) + (X[:, 2] > 1)
+    for params, es in (
+            ({"objective": "binary", "num_leaves": 31}, False),
+            ({"objective": "binary", "num_leaves": 31,
+              "pred_early_stop": True, "pred_early_stop_freq": 3,
+              "pred_early_stop_margin": 0.5}, True),
+            ({"objective": "multiclass", "num_class": 3,
+              "num_leaves": 15}, False)):
+        b = lgb.train({**params, "verbose": -1},
+                      lgb.Dataset(X, label=(y > 0) if params[
+                          "objective"] == "binary" else y),
+                      num_boost_round=10)
+        gb = b._booster
+        gb.config.tpu_fast_predict_rows = 0     # force the device path
+        outs = {}
+        for eng in ("scan", "tensor"):
+            gb.config.predict_engine = eng
+            gb.invalidate_predict_cache()
+            outs[eng] = b.predict(X[:700])
+        assert np.array_equal(outs["scan"], outs["tensor"]), \
+            f"engine mismatch for {params} (early_stop={es})"
+
+
+def test_serve_tensor_engine_bit_identical_and_reported():
+    """The serving path under the tensor engine matches the one-shot
+    device predict bit-for-bit, and the stats snapshot reports which
+    engine served plus its measured device us/row."""
+    rng = np.random.RandomState(7)
+    X = rng.randn(2000, 8).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+    b = lgb.train({"objective": "binary", "num_leaves": 31, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=10)
+    gb = b._booster
+    assert gb.config.predict_engine == "tensor"     # the serving default
+    fast = gb.config.tpu_fast_predict_rows
+    gb.config.tpu_fast_predict_rows = 0
+    ref = b.predict(X[:600])
+    gb.config.tpu_fast_predict_rows = fast
+    server = b.as_server()
+    try:
+        got = np.concatenate([server.predict(X[i:i + 37])
+                              for i in range(0, 592, 37)])
+        assert np.array_equal(got, ref[:592])
+        snap = server.stats_snapshot()
+        assert snap["engine"] == "tensor"
+        assert snap["device_us_per_row"] > 0.0
+    finally:
+        server.close()
+
+
+def test_binned_replay_paths_use_engine():
+    """resume_from / add_valid_set replay through the configured engine;
+    a resumed booster's scores must match continued training under the
+    scan engine exactly."""
+    rng = np.random.RandomState(8)
+    X = rng.randn(1500, 6).astype(np.float32)
+    y = rng.randn(1500).astype(np.float32)
+    scores = {}
+    for eng in ("scan", "tensor"):
+        params = {"objective": "regression", "num_leaves": 15,
+                  "verbose": -1, "predict_engine": eng}
+        b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6)
+        b2 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4,
+                       init_model=b)
+        scores[eng] = b2.predict(X[:400])
+    assert np.array_equal(scores["scan"], scores["tensor"])
