@@ -296,6 +296,35 @@ def run_predict_ab(n_trees: int, rows: int) -> None:
     print(json.dumps(out))
 
 
+def _telemetry_section(booster, last_n: int) -> dict:
+    """BENCH JSON ``telemetry`` section (ISSUE 4): the per-phase breakdown
+    from the booster's TrainTelemetry — aggregate summary plus steady-state
+    per-iteration phase means over the last ``last_n`` recorded iterations
+    (the measured window), and the recompile-watchdog verdict. This is the
+    evidence channel every perf attempt now carries: a regression shows up
+    as WHICH phase grew, not just a bigger total."""
+    tel = booster._booster.telemetry
+    if not tel.enabled:
+        return {"enabled": False}
+    recs = list(tel.records)[-last_n:]
+    steady = {}
+    for rec in recs:
+        for k, v in rec["phases"].items():
+            steady[k] = steady.get(k, 0.0) + v
+    n = max(len(recs), 1)
+    return {
+        "enabled": True,
+        "iterations": tel.iterations,
+        "steady_phase_s_per_iter": {k: round(v / n, 5)
+                                    for k, v in sorted(steady.items())},
+        "steady_window_iters": len(recs),
+        "steady_compiles": sum(r["compiles"]["steady"] for r in recs),
+        "compiles_total": tel.watchdog.totals()["compiles"],
+        "transfers_total": tel.watchdog.totals()["transfers"],
+        "iter_wall_s": tel.wall_res.percentiles(),
+    }
+
+
 def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
     """Child-process entry: train + measure, print one JSON line."""
     _configure_jax_cache()
@@ -326,6 +355,9 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
         "min_data_in_leaf": 100,
         "verbose": -1,
         "tpu_fused_learner": "1" if fused else "0",
+        # phase-span telemetry rides every attempt (measured overhead < 2%,
+        # BENCH_NOTES.md) so the JSON carries its own attribution
+        "telemetry": True,
     }
 
     t0 = time.time()
@@ -337,6 +369,7 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
     t1 = time.time()
     booster.update()
     booster.update()
+    np.asarray(booster._booster.scores[0][:1])   # device-complete warmup
     t_warm = time.time() - t1
 
     t2 = time.time()
@@ -443,6 +476,7 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
         "predict_s": round(t_pred, 3),
         "predict_ab": predict_ab,
         "visit_counts": visit_counts,
+        "telemetry": _telemetry_section(booster, ITERS_MEASURED),
         "dataload_s": round(t_gen, 3),
     }))
 
@@ -536,6 +570,8 @@ def run_microbench() -> None:
                 a = lax.optimization_barrier(a[p])
             return jnp.sum(a.astype(jnp.float32))
 
+        # graftlint: disable=R2 — one jit per payload profile (6 total),
+        # each compiled+run to completion before the next; not a hot loop
         g2 = jax.jit(gat2)
         float(g2(tab, perm))
         best = 0.0
@@ -622,7 +658,7 @@ def run_full_attempt(rows: int, max_bin: int) -> None:
     params = {"objective": "binary", "num_leaves": NUM_LEAVES,
               "learning_rate": 0.1, "max_bin": max_bin,
               "min_data_in_leaf": 100, "verbose": -1,
-              "tpu_fused_learner": "1"}
+              "tpu_fused_learner": "1", "telemetry": True}
     t0 = time.time()
     ds = lgb.Dataset(X, label=y)
     booster = lgb.Booster(params=params, train_set=ds)
@@ -630,6 +666,7 @@ def run_full_attempt(rows: int, max_bin: int) -> None:
     t1 = time.time()
     booster.update()
     booster.update()
+    np.asarray(booster._booster.scores[0][:1])   # device-complete warmup
     t_warm = time.time() - t1
     t2 = time.time()
     split_at = min(ITERS_MEASURED, 30)
@@ -688,6 +725,7 @@ def run_full_attempt(rows: int, max_bin: int) -> None:
         "holdout_auc": round(float(auc), 5),
         "synthetic": True,     # the projection audit always runs synthetic
         "predict_full_forest": predict_full,
+        "telemetry": _telemetry_section(booster, ITERS_TOTAL - 2),
     }))
 
 
@@ -733,7 +771,7 @@ def run_rank_attempt(n_queries: int, max_bin: int = None) -> None:
               "eval_at": [10], "num_leaves": 255, "learning_rate": 0.1,
               "max_bin": (max_bin if max_bin is not None else
                           int(os.environ.get("BENCH_RANK_MAX_BIN", 255))),
-              "min_data_in_leaf": 50, "verbose": -1}
+              "min_data_in_leaf": 50, "verbose": -1, "telemetry": True}
     t0 = time.time()
     dtrain = lgb.Dataset(X[:train_docs], label=y[:train_docs],
                          group=sizes[:n_train_q])
@@ -745,6 +783,7 @@ def run_rank_attempt(n_queries: int, max_bin: int = None) -> None:
     t1 = time.time()
     booster.update()
     booster.update()
+    np.asarray(booster._booster.scores[0][:1])   # device-complete warmup
     t_warm = time.time() - t1
     iters = max(ITERS_MEASURED // 2, 5)
     t2 = time.time()
@@ -787,6 +826,7 @@ def run_rank_attempt(n_queries: int, max_bin: int = None) -> None:
         "synthetic": synthetic,
         "data": mslr_path or "mslr-shaped synthetic",
         "iters_trained": iters + 2,
+        "telemetry": _telemetry_section(booster, iters),
     }))
 
 

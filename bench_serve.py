@@ -142,10 +142,15 @@ def bench_served(booster, X, n_requests: int, clients: int,
         t.join()
     dt = time.perf_counter() - t0
     snap = server.stats_snapshot()
+    # exercise the obs.prom export path at bench time: the same exposition
+    # the task=serve `stats` line prints (docs/observability.md)
+    prom_samples = sum(1 for ln in server.prometheus().splitlines()
+                       if ln and not ln.startswith("#"))
     server.close()
     return {"requests": per * clients, "clients": clients, "window": window,
             "elapsed_s": dt, "throughput_rps": per * clients / dt,
-            "errors": errs, "stats": snap}
+            "errors": errs, "stats": snap,
+            "prometheus_samples": prom_samples}
 
 
 def main(argv=None) -> int:
@@ -228,6 +233,7 @@ def main(argv=None) -> int:
         "speedup_vs_device_naive": speedup_dev,
         "serve_engine": served["stats"].get("engine"),
         "serve_device_us_per_row": served["stats"].get("device_us_per_row"),
+        "prometheus_samples": served.get("prometheus_samples"),
         "serve_p50_ms": served["stats"]["latency_ms"]["p50"],
         "serve_p99_ms": served["stats"]["latency_ms"]["p99"],
         "cache_hit_rate": served["stats"]["cache"]["hit_rate"],

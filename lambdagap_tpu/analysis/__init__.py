@@ -20,6 +20,7 @@ Rules (see docs/static-analysis.md for the full rationale):
 - R4 dtype drift (array creation without an explicit dtype)
 - R5 serve-layer lock discipline
 - R6 collective axis-name consistency
+- R7 unsynced timing (perf_counter deltas over async device dispatch)
 
 Intentionally import-light: no jax import happens here, so the linter runs
 in milliseconds and can scan trees that do not import.

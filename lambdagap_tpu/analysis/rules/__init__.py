@@ -8,6 +8,7 @@ summary. Add a new rule by dropping an ``rN_*.py`` module here that calls
 from __future__ import annotations
 
 from . import (r1_host_sync, r2_recompile, r3_clamped_slice,  # noqa: F401
-               r4_dtype_drift, r5_lock_discipline, r6_collective_axis)
+               r4_dtype_drift, r5_lock_discipline, r6_collective_axis,
+               r7_unsynced_timing)
 
 from ..core import all_rules  # noqa: F401  (re-export for convenience)

@@ -1,0 +1,155 @@
+"""R7: unsynced timing — a perf_counter delta bracketing async device work.
+
+The async-dispatch mis-measurement class: ``jax`` returns control to the
+host as soon as a computation is *enqueued*, so::
+
+    t0 = time.perf_counter()
+    booster.update()                 # returns before the device finishes
+    per_iter = time.perf_counter() - t0    # measures dispatch, not work
+
+silently reports dispatch latency as compute time — the bench number looks
+10-100x better than reality and every roofline built on it is fiction.
+The fix is a device-completion sync inside the bracket (``block_until_ready``,
+``jax.device_get``, ``np.asarray(device_value)``, ``float(...)`` over a
+device scalar) — exactly what ``obs.telemetry`` does once per iteration
+boundary.
+
+Heuristic: within one function (or the module body), track variables
+assigned from ``time.perf_counter()`` / ``time.time()`` /
+``time.monotonic()``. When a later ``<clock>() - t0`` delta closes the
+bracket, flag it iff the bracketed lines contain at least one
+async-device-dispatch call (a ``jax.``/``jnp.``/``lax.`` call or a
+``.update()`` / ``.train_device()`` / ``.get_gradients()`` boosting-loop
+method) and no sync call. Calls that already return host values
+(``.predict()``, which syncs internally) are not treated as async.
+
+Scoped to the surfaces that time device work for a living: ``obs/``,
+``bench*.py`` and ``tools/bench_*`` (graftlint is pointed at those paths by
+tools/run_full_suite.sh's telemetry gate).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional
+
+from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
+                    register_rule)
+
+# clock sources whose deltas mean "wall-clock of the bracketed work"
+_CLOCKS = frozenset({"time.perf_counter", "time.time", "time.monotonic",
+                     "perf_counter", "monotonic"})
+
+_JAXISH = ("jax.", "jnp.", "lax.")
+
+# methods that enqueue device work and return device values (the repo's
+# boosting-loop surface); predict()-style calls sync internally and are
+# excluded on purpose
+_ASYNC_TAILS = frozenset({"update", "train_device", "train_one_iter",
+                          "get_gradients", "get_gradients_fast", "boosting"})
+
+# a call with any of these names anywhere in the bracket forces device
+# completion (or converts to host data) before the delta is read
+_SYNC_TAILS = frozenset({"block_until_ready", "device_get", "asarray",
+                         "array", "item", "result"})
+_SYNC_NAMES = frozenset({"float", "int"})
+
+
+def _clock_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and call_name(node) in _CLOCKS
+
+
+def _target_key(node: ast.AST) -> Optional[str]:
+    """Trackable assignment target: a plain name or a self attribute."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return f"self.{node.attr}"
+    return None
+
+
+def _is_async_device_call(call: ast.Call) -> bool:
+    name = call_name(call)
+    if any(name.startswith(p) for p in _JAXISH):
+        # jnp.asarray / jax.device_get etc. are syncs, not dispatches
+        return name.rsplit(".", 1)[-1] not in _SYNC_TAILS
+    return name.rsplit(".", 1)[-1] in _ASYNC_TAILS
+
+
+def _is_sync_call(call: ast.Call) -> bool:
+    name = call_name(call)
+    return (name in _SYNC_NAMES
+            or name.rsplit(".", 1)[-1] in _SYNC_TAILS)
+
+
+@register_rule
+class UnsyncedTimingRule(Rule):
+    id = "R7"
+    severity = "error"
+    description = ("perf_counter/time delta brackets an async device "
+                   "dispatch with no completion sync (block_until_ready/"
+                   "device_get/np.asarray/float) — measures dispatch "
+                   "latency, not device work")
+    path_filter = ("/obs/", "/bench", "/tools/bench_")
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        # group nodes by enclosing function (module body = None) so a
+        # timestamp taken in one scope never pairs with a delta in another
+        for scope, nodes in self._scopes(ctx).items():
+            yield from self._check_scope(ctx, nodes)
+
+    def _scopes(self, ctx: ModuleContext) -> Dict:
+        scopes: Dict = {}
+        for node in ast.walk(ctx.tree):
+            funcs = ctx.enclosing_functions(node)
+            key = funcs[0] if funcs else None
+            scopes.setdefault(key, []).append(node)
+        return scopes
+
+    def _check_scope(self, ctx: ModuleContext, nodes: List[ast.AST]
+                     ) -> Iterator[Finding]:
+        # timestamp var -> line of its most recent clock assignment
+        stamps: Dict[str, int] = {}
+        events = []          # (line, kind, payload) in source order
+        for node in nodes:
+            line = getattr(node, "lineno", None)
+            if line is None:
+                continue
+            if isinstance(node, ast.Assign) and _clock_call(node.value) \
+                    and len(node.targets) == 1:
+                key = _target_key(node.targets[0])
+                if key:
+                    events.append((line, "stamp", key))
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub) \
+                    and _clock_call(node.left):
+                key = _target_key(node.right)
+                if key:
+                    events.append((line, "delta", (key, node)))
+            elif isinstance(node, ast.Call):
+                if _is_sync_call(node):
+                    events.append((line, "sync", None))
+                elif _is_async_device_call(node):
+                    events.append((line, "async", call_name(node)))
+        events.sort(key=lambda e: e[0])
+        for line, kind, payload in events:
+            if kind == "stamp":
+                stamps[payload] = line
+            elif kind == "delta":
+                key, node = payload
+                t0_line = stamps.get(key)
+                if t0_line is None:
+                    continue
+                asyncs = [p for (ln, k, p) in events
+                          if k == "async" and t0_line <= ln <= line]
+                synced = any(k == "sync" and t0_line <= ln <= line
+                             for (ln, k, _) in events)
+                if asyncs and not synced:
+                    yield ctx.finding(
+                        self, node,
+                        f"timing bracket over '{key}' (opened line "
+                        f"{t0_line}) encloses async device dispatch "
+                        f"{asyncs[0]}() with no completion sync — add "
+                        f"block_until_ready/device_get/np.asarray/float "
+                        f"on the result before reading the clock, or "
+                        f"suppress with a justification")

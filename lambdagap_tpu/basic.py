@@ -452,6 +452,14 @@ class Booster:
     def current_iteration(self) -> int:
         return self._booster.iter_
 
+    @property
+    def telemetry(self):
+        """The booster's TrainTelemetry (lambdagap_tpu.obs): per-iteration
+        phase records, compile counters, Prometheus rendering. Inert
+        (NULL_TELEMETRY) unless ``telemetry``/``telemetry_out``/profiler
+        knobs are set."""
+        return self._booster.telemetry
+
     def num_trees(self) -> int:
         return len(self._booster.models)
 

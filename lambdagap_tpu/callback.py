@@ -19,6 +19,9 @@ class CallbackEnv:
     begin_iteration: int
     end_iteration: int
     evaluation_result_list: List[Tuple[str, str, float, bool]]
+    # the booster's TrainTelemetry (lambdagap_tpu.obs) — phase spans,
+    # per-iteration records, compile counters; NULL_TELEMETRY when off
+    telemetry: Any = None
 
 
 class EarlyStopException(Exception):

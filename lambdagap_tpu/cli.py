@@ -92,16 +92,23 @@ def run_train(cfg: Config) -> None:
         stop = booster.train_one_iter()
         if cfg.metric_freq > 0 and (it + 1) % cfg.metric_freq == 0:
             msgs = []
-            if cfg.is_provide_training_metric:
-                msgs += [f"training {m}: {v:g}"
-                         for (_, m, v, _) in booster.eval_train()]
-            msgs += [f"{d} {m}: {v:g}" for (d, m, v, _) in booster.eval_valid()]
+            with booster.telemetry.phase("eval"):
+                if cfg.is_provide_training_metric:
+                    msgs += [f"training {m}: {v:g}"
+                             for (_, m, v, _) in booster.eval_train()]
+                msgs += [f"{d} {m}: {v:g}"
+                         for (d, m, v, _) in booster.eval_valid()]
             if msgs:
                 log.info("[%d] %s", it + 1, "  ".join(msgs))
         if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
             booster.save_model(f"{cfg.output_model}.snapshot_iter_{it + 1}")
         if stop:
             break
+    if booster.telemetry.enabled:
+        log.info("%s", booster.telemetry.report())
+    booster.telemetry.close()
+    if cfg.telemetry_out:
+        log.info("Telemetry run log written to %s", cfg.telemetry_out)
     booster.save_model(cfg.output_model)
     log.info("Finished training; model saved to %s", cfg.output_model)
 
@@ -138,8 +145,10 @@ def run_serve(cfg: Config) -> None:
 
     Requests come from ``data=<file>`` or stdin, one feature row per line
     (TSV or CSV; all columns are features). Lines of the form
-    ``swap=<model>`` atomically hot-swap the served model. Predictions go
-    to ``output_result`` (default LightGBM_predict_result.txt); serving
+    ``swap=<model>`` atomically hot-swap the served model; ``stats``
+    prints the live Prometheus exposition (``stats json`` the snapshot
+    JSON) to stderr — the scrape hook for a sidecar exporter. Predictions
+    go to ``output_result`` (default LightGBM_predict_result.txt); serving
     metrics JSON goes to ``serve_stats_file`` when set."""
     if not cfg.input_model:
         log.fatal("task=serve requires input_model=<model>")
@@ -161,7 +170,8 @@ def run_serve(cfg: Config) -> None:
             n = serve_loop(server, src, out,
                            on_swap=lambda tgt, gen: log.info(
                                "Hot-swapped to %s (generation %d)",
-                               tgt, gen))
+                               tgt, gen),
+                           stats_stream=sys.stderr)
     finally:
         if src is not sys.stdin:
             src.close()

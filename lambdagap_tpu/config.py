@@ -143,6 +143,8 @@ _alias("gpu_device_id", "device_id")
 _alias("num_gpu", "num_gpus")
 _alias("serve_buckets", "serve_padding_buckets")
 _alias("serve_max_delay_ms", "serve_max_latency_ms")
+_alias("telemetry", "timetag", "enable_telemetry")
+_alias("telemetry_out", "telemetry_file", "run_log")
 
 # Fork delta aliases (none published; canonical names only)
 
@@ -337,6 +339,15 @@ class Config:
     serve_warmup: bool = True            # pre-compile buckets before serving
     serve_stats_file: str = ""           # task=serve: dump metrics JSON here
 
+    # -- observability (lambdagap_tpu.obs; docs/observability.md) ---------
+    telemetry: bool = False              # per-iteration phase spans + recompile watchdog
+    telemetry_out: str = ""              # JSONL run-log path (implies telemetry=true)
+    telemetry_ring: int = 256            # per-iteration records kept in memory
+    telemetry_warmup: int = 2            # iterations before a recompile counts as steady-state
+    profile_start_iter: int = -1         # jax.profiler window start iteration (-1 = off)
+    profile_n_iters: int = 1             # profiler window length in iterations
+    profile_dir: str = ""                # profiler trace output directory
+
     # -- convert ----------------------------------------------------------
     convert_model_language: str = ""
     convert_model: str = "gbdt_prediction.cpp"
@@ -505,6 +516,9 @@ class Config:
             (self.serve_max_delay_ms >= 0, "serve_max_delay_ms must be >= 0"),
             (all(b > 0 for b in self.serve_buckets),
              "serve_buckets must be positive"),
+            (self.telemetry_ring >= 1, "telemetry_ring must be >= 1"),
+            (self.telemetry_warmup >= 0, "telemetry_warmup must be >= 0"),
+            (self.profile_n_iters >= 1, "profile_n_iters must be >= 1"),
         ]
         for ok, msg in checks:
             if not ok:

@@ -72,10 +72,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
     for group in (cbs_before, cbs_after):
         group.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    telemetry = booster._booster.telemetry
     for i in range(num_boost_round):
         env0 = CallbackEnv(model=booster, params=params, iteration=i,
                            begin_iteration=0, end_iteration=num_boost_round,
-                           evaluation_result_list=[])
+                           evaluation_result_list=[], telemetry=telemetry)
         for cb in cbs_before:
             cb(env0)
         stop = booster.update()
@@ -85,17 +86,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 f"{cfg.output_model}.snapshot_iter_{booster.current_iteration}")
 
         evals: List[Tuple[str, str, float, bool]] = []
-        if valid_contains_train:
-            name = getattr(booster, "_train_name", "training")
-            evals.extend((name, m, v, g)
-                         for (_, m, v, g) in booster._booster.eval_train())
-        evals.extend(booster._booster.eval_valid())
-        if feval is not None:
-            evals.extend(_run_feval(feval, booster, train_set, valid_sets,
-                                    valid_names, valid_contains_train))
+        with telemetry.phase("eval"):
+            if valid_contains_train:
+                name = getattr(booster, "_train_name", "training")
+                evals.extend((name, m, v, g)
+                             for (_, m, v, g) in booster._booster.eval_train())
+            evals.extend(booster._booster.eval_valid())
+            if feval is not None:
+                evals.extend(_run_feval(feval, booster, train_set,
+                                        valid_sets, valid_names,
+                                        valid_contains_train))
         env = CallbackEnv(model=booster, params=params, iteration=i,
                           begin_iteration=0, end_iteration=num_boost_round,
-                          evaluation_result_list=evals)
+                          evaluation_result_list=evals, telemetry=telemetry)
         try:
             for cb in cbs_after:
                 cb(env)
@@ -109,10 +112,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if booster.best_iteration < 0:
         for d, m, v, _ in evals if num_boost_round > 0 else []:
             booster.best_score.setdefault(d, {})[m] = v
-    from .utils.timer import _ENABLED as _timing, global_timer
-    if _timing:
+    # flush the run log + unhook jax.monitoring; records stay readable on
+    # booster._booster.telemetry (and keep accumulating if the caller keeps
+    # training the booster by hand)
+    telemetry.close()
+    from .utils.timer import global_timer, timer_enabled
+    if timer_enabled():
         # the reference prints its USE_TIMETAG table at exit
-        # (include/LightGBM/utils/common.h:1017)
+        # (include/LightGBM/utils/common.h:1017); the table is now the
+        # deprecation shim over TrainTelemetry spans (utils/timer.py)
         log.info("%s", global_timer.report())
     return booster
 

@@ -487,6 +487,9 @@ class FusedTreeLearner(SerialTreeLearner):
             return acc + part.reshape(HIST_C, C, Bb).transpose(1, 2, 0)
 
         def leaf_hist(perm, begin, count):
+            # jax.named_scope labels below tag the traced ops so profiler
+            # windows (obs/profile.py) show the same histogram/partition/
+            # split phase structure the host-side telemetry reports
             nch = (count + W - 1) // W
 
             def body(st):
@@ -495,9 +498,10 @@ class FusedTreeLearner(SerialTreeLearner):
 
             acc_dtype = (jnp.int32 if qexact and self.hist_impl == "pallas"
                          else jnp.float32)
-            _, hist = lax.while_loop(
-                lambda st: st[0] < nch, body,
-                (jnp.int32(0), jnp.zeros((C, Bb, HIST_C), acc_dtype)))
+            with jax.named_scope("histogram"):
+                _, hist = lax.while_loop(
+                    lambda st: st[0] < nch, body,
+                    (jnp.int32(0), jnp.zeros((C, Bb, HIST_C), acc_dtype)))
             if self.axis is not None and not self.voting:
                 # the one collective per split: local chunk loops may run
                 # different trip counts per shard (local leaf sizes differ),
@@ -1003,9 +1007,11 @@ class FusedTreeLearner(SerialTreeLearner):
                 pbuf = pbuf.at[pos].set(rows, mode="drop")
                 return c + 1, lcur + nl, rcur - (live - nl), pbuf
 
-            _, lend, _, pbuf = lax.while_loop(
-                lambda s: s[0] < nch, pbody,
-                (jnp.int32(0), begin, begin + count_eff, st["perm_buf"]))
+            with jax.named_scope("partition"):
+                _, lend, _, pbuf = lax.while_loop(
+                    lambda s: s[0] < nch, pbody,
+                    (jnp.int32(0), begin, begin + count_eff,
+                     st["perm_buf"]))
             left_count = lend - begin
             right_count = count_eff - left_count
 
@@ -1024,8 +1030,9 @@ class FusedTreeLearner(SerialTreeLearner):
                 pm = lax.dynamic_update_slice(pm, vals, (start,))
                 return c + 1, pm
 
-            _, perm = lax.while_loop(lambda s: s[0] < nch, cbody,
-                                     (jnp.int32(0), perm_in))
+            with jax.named_scope("partition_copyback"):
+                _, perm = lax.while_loop(lambda s: s[0] < nch, cbody,
+                                         (jnp.int32(0), perm_in))
 
             # -- masked write indices (dump rows swallow no-op steps) --
             # nodes are indexed by the number of REALIZED splits, not the
@@ -1117,13 +1124,14 @@ class FusedTreeLearner(SerialTreeLearner):
                     node_fmask(cp, jax.random.fold_in(bstep, 3))])
             else:
                 fms = jnp.broadcast_to(fmask, (2, F))
-            (bg2, bf2, bt2, bdl2, bcat2, bbits2, blg2, blh2, blc2, blout2,
-             brout2) = best_children(
-                jnp.stack([hist_left, hist_right]),
-                jnp.stack([lg, rg]), jnp.stack([lh, rh]),
-                jnp.stack([lc, rc]), jnp.stack([lout, rout]),
-                jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]), depth,
-                child_keys, fms)
+            with jax.named_scope("split_scan"):
+                (bg2, bf2, bt2, bdl2, bcat2, bbits2, blg2, blh2, blc2,
+                 blout2, brout2) = best_children(
+                    jnp.stack([hist_left, hist_right]),
+                    jnp.stack([lg, rg]), jnp.stack([lh, rh]),
+                    jnp.stack([lc, rc]), jnp.stack([lout, rout]),
+                    jnp.stack([lmin, rmin]), jnp.stack([lmax, rmax]), depth,
+                    child_keys, fms)
 
             i32 = jnp.int32
             lrow_f = jnp.stack([lg, lh, lc, lout, bg2[0], blg2[0], blh2[0],

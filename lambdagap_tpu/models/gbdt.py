@@ -29,8 +29,8 @@ from ..ops.predict import (_round_depth, build_forest_blocks,
                            tree_to_arrays)
 from ..ops.predict_tensor import (build_tree_tiles, predict_forest_leaf_tensor,
                                   predict_forest_tensor)
+from ..obs.telemetry import NULL_TELEMETRY, TrainTelemetry
 from ..utils import log
-from ..utils.timer import global_timer
 from .learner import SerialTreeLearner
 from .sample_strategy import create_sample_strategy
 from .tree import Tree
@@ -170,6 +170,7 @@ class GBDT:
         self.valid_binned: List[jax.Array] = []
         self.valid_metrics: List[List[Metric]] = []
         self.valid_scores: List[jax.Array] = []
+        self.telemetry: TrainTelemetry = NULL_TELEMETRY
 
         if train_set is not None:
             self._setup_training(train_set)
@@ -184,7 +185,13 @@ class GBDT:
                 log.fatal("Cannot use the %s objective with linear_tree",
                           self.objective.name)
             self.objective.init(ds.metadata, ds.num_data)
+        self.telemetry = TrainTelemetry.from_config(self.config)
         self.learner = self._create_learner(ds)
+        # learners that host-orchestrate (SerialTreeLearner) record their
+        # histogram/split/partition sub-phases through this handle; the
+        # fused whole-tree program shows the same structure in profiler
+        # windows via jax.named_scope instead
+        self.learner.telemetry = self.telemetry
         self.sample_strategy = create_sample_strategy(
             self.config, ds.num_data,
             label=None if ds.metadata.label is None else np.asarray(ds.metadata.label),
@@ -434,6 +441,8 @@ class GBDT:
         """One boosting iteration. Returns True when training should stop
         (no splittable leaves), mirroring gbdt.cpp:346-454."""
         cfg = self.config
+        tel = self.telemetry
+        tel.begin_iteration(self.iter_)
         init_scores = [0.0] * self.num_tree_per_iteration
         if grad is None or hess is None:
             if self.objective is None:
@@ -465,10 +474,10 @@ class GBDT:
                         for vi in range(len(self.valid_scores)):
                             self.valid_scores[vi] = self.valid_scores[vi].at[k].add(init)
                         log.info("Start training from score %f", init)
-            with global_timer.scope("boosting: gradients"):
+            with tel.phase("gradients"):
                 grad, hess = self.boosting()
 
-        with global_timer.scope("boosting: sampling"):
+        with tel.phase("sampling"):
             grad, hess, mask = self.sample_strategy.sample(self.iter_, grad,
                                                            hess)
 
@@ -483,10 +492,10 @@ class GBDT:
             # leaves" stop check is skipped to avoid a per-iteration D2H —
             # converged training just appends constant trees.
             for k in range(self.num_tree_per_iteration):
-                with global_timer.scope("tree: fused train"):
+                with tel.phase("tree", legacy="tree: fused train"):
                     rec = self.learner.train_device(grad[k], hess[k],
                                                     row_mask=mask)
-                with global_timer.scope("score: update"):
+                with tel.phase("score_update"):
                     lv = rec.leaf_value * self.shrinkage_rate
                     self.scores = self.scores.at[k].add(lv[rec.row_leaf])
                 # drop the O(N) row->leaf map from the kept record: at
@@ -498,14 +507,16 @@ class GBDT:
                 self.models.append(lazy)
                 if self.valid_sets:
                     tree = self._tree(len(self.models) - 1)
-                    for vi in range(len(self.valid_sets)):
-                        self._add_valid_tree_score(vi, tree, k)
+                    with tel.phase("eval"):
+                        for vi in range(len(self.valid_sets)):
+                            self._add_valid_tree_score(vi, tree, k)
             self.iter_ += 1
+            tel.end_iteration(sync=self.scores)
             return False
 
         should_continue = False
         for k in range(self.num_tree_per_iteration):
-            with global_timer.scope("tree: train"):
+            with tel.phase("tree", legacy="tree: train"):
                 tree = self.learner.train(grad[k], hess[k], row_mask=mask)
             if tree.num_leaves > 1:
                 should_continue = True
@@ -515,9 +526,11 @@ class GBDT:
                 if self.objective is not None and self.objective.is_renew_tree_output:
                     self._renew_tree_output(tree, k, mask)
                 tree.apply_shrinkage(self.shrinkage_rate)
-                self._update_train_score(tree, k)
-                for vi in range(len(self.valid_sets)):
-                    self._add_valid_tree_score(vi, tree, k)
+                with tel.phase("score_update"):
+                    self._update_train_score(tree, k)
+                with tel.phase("eval"):
+                    for vi in range(len(self.valid_sets)):
+                        self._add_valid_tree_score(vi, tree, k)
                 if abs(init_scores[k]) > K_EPSILON:
                     self._tree_add_bias(tree, init_scores[k], k)
             else:
@@ -537,8 +550,10 @@ class GBDT:
                         "that meet the split requirements")
             if len(self.models) > self.num_tree_per_iteration:
                 del self.models[-self.num_tree_per_iteration:]
+            tel.end_iteration(sync=self.scores)
             return True
         self.iter_ += 1
+        tel.end_iteration(sync=self.scores)
         return False
 
     def _host_leaf_index(self, tree: Tree) -> np.ndarray:

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..config import Config
 from ..data.dataset import BinnedDataset
+from ..obs.telemetry import NULL_TELEMETRY
 from ..ops.histogram import full_histogram, leaf_histogram
 from ..ops.partition import split_partition
 from ..ops.split import (SplitParams, find_best_split, gather_threshold_split,
@@ -64,6 +65,10 @@ class _HostSplit:
 
 
 class SerialTreeLearner:
+    # phase-span handle; GBDT._setup_training rebinds it to the booster's
+    # TrainTelemetry so histogram/split/partition sub-phases attribute
+    # inside the enclosing "tree" span (docs/observability.md)
+    telemetry = NULL_TELEMETRY
     """Single-device leaf-wise learner over a BinnedDataset."""
 
     def __init__(self, dataset: BinnedDataset, config: Config) -> None:
@@ -331,15 +336,16 @@ class SerialTreeLearner:
             mp = monotone_split_penalty(int(depth), self.mono_penalty)
             mono_pen = jnp.where(self.mono_arr != 0, mp, 1.0)
             contri = mono_pen if contri is None else contri * mono_pen
-        res = find_best_split(
-            hist, pg, ph, pc, parent_output,
-            self.num_bins_arr, self.default_bins_arr, self.missing_types_arr,
-            self.is_categorical_arr,
-            self._node_fmask(fmask, path_feats), self.params,
-            has_categorical=self.has_categorical, constraints=cons,
-            gain_penalty=pen, rand_thresholds=rand_t,
-            gain_contri=contri)
-        return _HostSplit(jax.device_get(res))
+        with self.telemetry.phase("split"):
+            res = find_best_split(
+                hist, pg, ph, pc, parent_output,
+                self.num_bins_arr, self.default_bins_arr,
+                self.missing_types_arr, self.is_categorical_arr,
+                self._node_fmask(fmask, path_feats), self.params,
+                has_categorical=self.has_categorical, constraints=cons,
+                gain_penalty=pen, rand_thresholds=rand_t,
+                gain_contri=contri)
+            return _HostSplit(jax.device_get(res))
 
     # advanced monotone method -------------------------------------------
     # TPU-first re-design of AdvancedLeafConstraints (reference:
@@ -523,7 +529,8 @@ class SerialTreeLearner:
         leaf_count[0] = self.num_data
 
         # root histogram + totals (BeforeTrain analog)
-        hist_root = self._root_histogram(grad, hess, row_mask)
+        with self.telemetry.phase("histogram"):
+            hist_root = self._root_histogram(grad, hess, row_mask)
         totals = jnp.sum(hist_root[0], axis=0)   # (g, h, c) — every row hits f0
         root_out = _leaf_output_scalar(totals[0], totals[1], totals[2], self.params)
         hists: Dict[int, jax.Array] = {0: hist_root}
@@ -565,15 +572,18 @@ class SerialTreeLearner:
             begin, count = int(leaf_begin[leaf]), int(leaf_count[leaf])
             P = self._pad_size(count)
             feat = int(s.feature)
-            perm, left_cnt_dev = split_partition(
-                self.x_binned, perm,
-                jnp.int32(begin), jnp.int32(count),
-                jnp.int32(feat), jnp.int32(s.threshold),
-                jnp.asarray(bool(s.default_left)),
-                self.default_bins_arr[feat], self.missing_types_arr[feat],
-                self.num_bins_arr[feat], jnp.asarray(bool(s.is_categorical)),
-                jnp.asarray(s.cat_bitset), P)
-            left_cnt = int(jax.device_get(left_cnt_dev))
+            with self.telemetry.phase("partition"):
+                perm, left_cnt_dev = split_partition(
+                    self.x_binned, perm,
+                    jnp.int32(begin), jnp.int32(count),
+                    jnp.int32(feat), jnp.int32(s.threshold),
+                    jnp.asarray(bool(s.default_left)),
+                    self.default_bins_arr[feat],
+                    self.missing_types_arr[feat],
+                    self.num_bins_arr[feat],
+                    jnp.asarray(bool(s.is_categorical)),
+                    jnp.asarray(s.cat_bitset), P)
+                left_cnt = int(jax.device_get(left_cnt_dev))
             right_cnt = count - left_cnt
             if _DEBUG_CHECKS and row_mask is None:
                 # re-check the partition against the histogram's split
@@ -692,9 +702,10 @@ class SerialTreeLearner:
             small_is_left = left_cnt <= right_cnt
             sb, sc = (begin, left_cnt) if small_is_left else (begin + left_cnt, right_cnt)
             Ph = self._pad_size(sc)
-            hist_small = self._leaf_histogram(perm, grad, hess, sb, sc, Ph,
-                                              row_mask)
-            hist_large = parent_hist - hist_small
+            with self.telemetry.phase("histogram"):
+                hist_small = self._leaf_histogram(perm, grad, hess, sb, sc,
+                                                  Ph, row_mask)
+                hist_large = parent_hist - hist_small
 
             small_leaf = leaf if small_is_left else right_leaf
             large_leaf = right_leaf if small_is_left else leaf

@@ -1,0 +1,32 @@
+"""lambdagap_tpu.obs — unified training/serving observability (graftscope).
+
+The telemetry subsystem the perf work reports through (docs/observability.md):
+
+- :mod:`.telemetry` — :class:`TrainTelemetry`: named per-iteration phase
+  spans (gradients, sampling, histogram, split, partition, tree,
+  score_update, eval) with exclusive-time accounting, a bounded ring buffer
+  of per-iteration records, and aggregate reservoirs. Device-complete
+  timing is taken ONCE per iteration boundary (a single
+  ``block_until_ready``), so no host sync lands inside hot paths.
+- :mod:`.events` — JSONL structured run log (run header, one record per
+  iteration, compile/swap/error events): the artifact BENCH runs diff.
+- :mod:`.xla_watch` — recompile & transfer watchdog over ``jax.monitoring``
+  events; warns when a steady-state iteration triggers a fresh compile
+  (the graftlint-R2 hazard class, caught at runtime).
+- :mod:`.profile` — ``jax.profiler`` capture windows driven by the
+  ``profile_start_iter`` / ``profile_n_iters`` / ``profile_dir`` knobs.
+- :mod:`.prom` — Prometheus text exposition for both ``TrainTelemetry``
+  and the serve layer's ``ServeStats``.
+- :mod:`.reservoir` — the bounded uniform sample shared by training and
+  serving percentiles.
+
+Everything is inert unless enabled (``telemetry=true`` / ``telemetry_out=``
+/ ``LAMBDAGAP_TIMETAG``): the off path records nothing and registers no
+``jax.monitoring`` hooks.
+"""
+from __future__ import annotations
+
+from .reservoir import Reservoir  # noqa: F401
+from .telemetry import NULL_TELEMETRY, TrainTelemetry  # noqa: F401
+
+__all__ = ["Reservoir", "TrainTelemetry", "NULL_TELEMETRY"]

@@ -1,0 +1,160 @@
+"""Structured JSONL run log: the machine-readable training artifact.
+
+One ``telemetry_out=`` file per run; every line is one JSON object. This is
+the artifact BENCH_r0N trajectories and regression triage diff against, so
+the schema is versioned and validated (``validate_record`` /
+``validate_file`` — used by tests/test_obs.py and the run_full_suite.sh
+telemetry gate).
+
+Record types (``"type"`` field; full table in docs/observability.md):
+
+- ``run_header`` — first line: schema version, wall time, the resolved
+  training params, device topology, package versions.
+- ``iteration`` — one per boosting iteration: ``iter``, device-complete
+  ``wall_s``, the per-phase exclusive-seconds map ``phases``, ``compiles``
+  (total / steady-state / per-phase) and ``transfers`` counters.
+- ``event`` — anything punctual: steady-state recompile warnings, profiler
+  window start/stop, serve swaps, errors.
+
+Writes flush per line: a crashed run keeps every completed record (the
+whole point of a flight recorder).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+# iteration-record required keys -> validator (docs/observability.md schema)
+_ITER_REQUIRED = {
+    "iter": lambda v: isinstance(v, int) and v >= 0,
+    "wall_s": lambda v: isinstance(v, (int, float)) and v >= 0,
+    "phases": lambda v: isinstance(v, dict) and all(
+        isinstance(k, str) and isinstance(x, (int, float))
+        for k, x in v.items()),
+    "compiles": lambda v: isinstance(v, dict) and "total" in v
+    and "steady" in v,
+    "transfers": lambda v: isinstance(v, dict) and "total" in v,
+}
+
+
+def run_header(params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The run-identity record: enough to reproduce and to diff two runs'
+    environments without parsing logs."""
+    header: Dict[str, Any] = {
+        "type": "run_header",
+        "schema_version": SCHEMA_VERSION,
+        "time_unix": time.time(),
+        "params": params or {},
+        "versions": {"python": sys.version.split()[0]},
+    }
+    try:
+        import jax
+        header["device"] = {
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "devices": [str(d) for d in jax.devices()],
+        }
+        header["versions"]["jax"] = jax.__version__
+    except Exception:  # pragma: no cover - jax import is repo-wide
+        header["device"] = {}
+    try:
+        import numpy
+        header["versions"]["numpy"] = numpy.__version__
+    except Exception:  # pragma: no cover
+        pass
+    return header
+
+
+class RunLog:
+    """Line-per-record JSONL writer with per-line flush."""
+
+    def __init__(self, path: str,
+                 params: Optional[Dict[str, Any]] = None) -> None:
+        self.path = path
+        self._f = open(path, "w", encoding="utf-8")
+        self.write(run_header(params))
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if self._f is None:
+            return
+        self._f.write(json.dumps(record, separators=(",", ":"),
+                                 default=_json_default) + "\n")
+        self._f.flush()
+
+    def event(self, event: str, **fields: Any) -> None:
+        self.write({"type": "event", "event": event,
+                    "time_unix": time.time(), **fields})
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def _json_default(o):
+    """Last-resort coercion for numpy scalars riding in records."""
+    for attr in ("item",):
+        if hasattr(o, attr):
+            return o.item()
+    return str(o)
+
+
+# ---------------------------------------------------------------------------
+# schema validation (tests + the run_full_suite.sh telemetry gate)
+# ---------------------------------------------------------------------------
+def validate_record(obj: Any) -> List[str]:
+    """Errors for one parsed JSONL record; empty when valid."""
+    errs: List[str] = []
+    if not isinstance(obj, dict):
+        return [f"record is {type(obj).__name__}, not an object"]
+    rtype = obj.get("type")
+    if rtype not in ("run_header", "iteration", "event"):
+        return [f"unknown record type {rtype!r}"]
+    if rtype == "run_header":
+        if obj.get("schema_version") != SCHEMA_VERSION:
+            errs.append(f"schema_version {obj.get('schema_version')!r} != "
+                        f"{SCHEMA_VERSION}")
+        if not isinstance(obj.get("params"), dict):
+            errs.append("run_header.params must be an object")
+    elif rtype == "iteration":
+        for key, check in _ITER_REQUIRED.items():
+            if key not in obj:
+                errs.append(f"iteration record missing {key!r}")
+            elif not check(obj[key]):
+                errs.append(f"iteration.{key} failed validation: "
+                            f"{obj[key]!r}")
+    elif rtype == "event":
+        if not isinstance(obj.get("event"), str):
+            errs.append("event record missing 'event' name")
+    return errs
+
+
+def validate_file(path: str) -> List[str]:
+    """Validate a whole JSONL run log. Returns a list of
+    ``"line N: problem"`` strings; empty means the file conforms (non-empty,
+    parses line-by-line, leads with a run_header, every record valid)."""
+    errs: List[str] = []
+    n_lines = 0
+    with open(path, "r", encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            n_lines += 1
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                errs.append(f"line {i}: not JSON ({e})")
+                continue
+            if n_lines == 1 and (not isinstance(obj, dict)
+                                 or obj.get("type") != "run_header"):
+                errs.append(f"line {i}: first record must be a run_header")
+            for e in validate_record(obj):
+                errs.append(f"line {i}: {e}")
+    if n_lines == 0:
+        errs.append("empty run log")
+    return errs

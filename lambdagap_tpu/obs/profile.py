@@ -1,0 +1,78 @@
+"""``jax.profiler`` capture windows keyed to boosting iterations.
+
+The coarse phase spans of :mod:`.telemetry` answer "which phase is slow";
+a profiler trace answers "why". This module turns the
+``profile_start_iter`` / ``profile_n_iters`` / ``profile_dir`` config knobs
+into a bounded ``jax.profiler`` trace window: the trace starts when the
+configured iteration begins and stops ``profile_n_iters`` iterations later,
+so a 500-iteration run captures exactly the requested steady-state slice
+instead of an unboundedly large trace. The fused learner's program sections
+carry ``jax.named_scope`` annotations (histogram / partition / split_scan),
+so the captured trace shows the same phase structure the telemetry reports.
+
+Recipe (docs/observability.md): ``telemetry=true profile_start_iter=10
+profile_n_iters=3 profile_dir=/tmp/trace`` then
+``tensorboard --logdir /tmp/trace``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import log
+
+
+class ProfileWindow:
+    """One bounded trace window; inert when ``profile_dir`` is empty or
+    ``start_iter`` is negative. Exceptions from the profiler never
+    propagate into training."""
+
+    def __init__(self, start_iter: int = -1, n_iters: int = 1,
+                 out_dir: str = "") -> None:
+        self.start_iter = int(start_iter)
+        self.n_iters = max(int(n_iters), 1)
+        self.out_dir = out_dir
+        self.active = False
+        self.done = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.out_dir) and self.start_iter >= 0
+
+    def on_iteration_start(self, iteration: int) -> Optional[str]:
+        """Drive the window from iteration boundaries. Returns
+        "start"/"stop" when the window toggled (for the run-log event),
+        else None."""
+        if not self.enabled or self.done:
+            return None
+        if not self.active and iteration >= self.start_iter:
+            try:
+                import jax.profiler
+                jax.profiler.start_trace(self.out_dir)
+            except Exception as e:  # pragma: no cover - backend-dependent
+                log.warning("profiler window could not start: %s", e)
+                self.done = True
+                return None
+            self.active = True
+            log.info("profiler trace started at iteration %d -> %s",
+                     iteration, self.out_dir)
+            return "start"
+        if self.active and iteration >= self.start_iter + self.n_iters:
+            return self._stop(iteration)
+        return None
+
+    def _stop(self, iteration: int) -> Optional[str]:
+        try:
+            import jax.profiler
+            jax.profiler.stop_trace()
+        except Exception as e:  # pragma: no cover
+            log.warning("profiler window could not stop cleanly: %s", e)
+        self.active = False
+        self.done = True
+        log.info("profiler trace stopped at iteration %d (%d iterations "
+                 "captured)", iteration, self.n_iters)
+        return "stop"
+
+    def close(self, iteration: int = -1) -> None:
+        """Stop a window left open by a short run."""
+        if self.active:
+            self._stop(iteration)

@@ -1,0 +1,144 @@
+"""Prometheus text exposition for training and serving metrics.
+
+Pure text rendering — no client library, no HTTP server: the ``task=serve``
+CLI answers a ``stats`` request line with this exposition (docs/serving.md
+line protocol), and anything that can scrape a file or a pipe can ingest
+it. Format follows the Prometheus exposition format v0.0.4: ``# HELP`` /
+``# TYPE`` headers and ``name{label="v"} value`` samples, one per line
+(tests/test_obs.py parses every line against the grammar).
+
+Metric names (full table in docs/observability.md):
+
+- ``lambdagap_serve_*`` — rendered from a ``ServeStats.snapshot()`` dict.
+- ``lambdagap_train_*`` — rendered from a :class:`~.telemetry.TrainTelemetry`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n")
+
+
+def _num(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Writer:
+    def __init__(self) -> None:
+        self.lines: List[str] = []
+
+    def metric(self, name: str, value, help_: str, type_: str = "gauge",
+               labels: Optional[Dict[str, str]] = None) -> None:
+        self.sample_header(name, help_, type_)
+        self.sample(name, value, labels)
+
+    def sample_header(self, name: str, help_: str, type_: str) -> None:
+        self.lines.append(f"# HELP {name} {help_}")
+        self.lines.append(f"# TYPE {name} {type_}")
+
+    def sample(self, name: str, value,
+               labels: Optional[Dict[str, str]] = None) -> None:
+        lab = ""
+        if labels:
+            inner = ",".join(f'{k}="{_escape(v)}"'
+                             for k, v in sorted(labels.items()))
+            lab = "{" + inner + "}"
+        self.lines.append(f"{name}{lab} {_num(value)}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_serve(snapshot: Dict) -> str:
+    """``ServeStats.snapshot()`` (plus the ForestServer extras when
+    present) -> Prometheus text."""
+    w = _Writer()
+    p = "lambdagap_serve_"
+    w.metric(p + "requests_total", snapshot.get("requests", 0),
+             "Served requests", "counter")
+    w.metric(p + "rows_total", snapshot.get("rows", 0),
+             "Served feature rows", "counter")
+    w.metric(p + "errors_total", snapshot.get("errors", 0),
+             "Failed requests", "counter")
+    w.metric(p + "throughput_rps", snapshot.get("throughput_rps", 0.0),
+             "Requests per second since start")
+    w.metric(p + "throughput_rows_per_s",
+             snapshot.get("throughput_rows_per_s", 0.0),
+             "Rows per second since start")
+    for key, help_ in (("latency_ms", "End-to-end request latency (ms)"),
+                       ("queue_wait_ms", "Batcher queue wait (ms)"),
+                       ("device_ms", "Device dispatch share (ms)")):
+        dist = snapshot.get(key, {})
+        name = p + key
+        w.sample_header(name, help_, "gauge")
+        for q, v in sorted(dist.items()):
+            w.sample(name, v, {"quantile": q})
+    batches = snapshot.get("batches", {})
+    w.metric(p + "batches_total", batches.get("count", 0),
+             "Device batches dispatched", "counter")
+    w.metric(p + "batch_mean_rows", batches.get("mean_rows", 0.0),
+             "Mean rows per batch")
+    w.metric(p + "device_us_per_row",
+             snapshot.get("device_us_per_row", 0.0),
+             "Per-dispatch device microseconds per row")
+    cache = snapshot.get("cache", {})
+    w.metric(p + "cache_hits_total", cache.get("hits", 0),
+             "Padding-bucket executable cache hits", "counter")
+    w.metric(p + "cache_misses_total", cache.get("misses", 0),
+             "Padding-bucket executable cache misses", "counter")
+    w.metric(p + "cache_hit_rate", cache.get("hit_rate", 0.0),
+             "Cache hit fraction")
+    w.metric(p + "forest_builds_total", cache.get("forest_builds", 0),
+             "Device forest (re)builds", "counter")
+    w.metric(p + "bucket_compiles_total", cache.get("bucket_compiles", 0),
+             "Bucket executable compiles", "counter")
+    w.metric(p + "swaps_total", snapshot.get("swaps", 0),
+             "Model hot-swaps", "counter")
+    if "generation" in snapshot:
+        w.metric(p + "generation", snapshot["generation"],
+                 "Active model generation")
+    return w.text()
+
+
+def render_train(telemetry) -> str:
+    """:class:`TrainTelemetry` aggregates -> Prometheus text."""
+    w = _Writer()
+    p = "lambdagap_train_"
+    s = telemetry.summary()
+    w.metric(p + "iterations_total", s.get("iterations", 0),
+             "Boosting iterations recorded", "counter")
+    if not s.get("enabled"):
+        return w.text()
+    name = p + "phase_seconds_total"
+    w.sample_header(name, "Exclusive seconds spent per phase", "counter")
+    for phase, secs in s["phase_seconds_total"].items():
+        w.sample(name, secs, {"phase": phase})
+    name = p + "iter_wall_seconds"
+    w.sample_header(name, "Device-complete per-iteration wall (s)", "gauge")
+    for q, v in sorted(s["iter_wall_s"].items()):
+        w.sample(name, v, {"quantile": q})
+    w.metric(p + "compiles_total", s.get("compiles", 0),
+             "XLA backend compiles observed", "counter")
+    w.metric(p + "steady_compiles_total", s.get("steady_compiles", 0),
+             "Compiles after the warmup window (R2 hazard)", "counter")
+    w.metric(p + "transfers_total", s.get("transfers", 0),
+             "Device transfers observed via jax.monitoring", "counter")
+    w.metric(p + "compile_seconds_total", s.get("compile_secs", 0.0),
+             "Seconds spent in XLA backend compiles", "counter")
+    return w.text()
+
+
+def render(telemetry=None, serve_snapshot: Optional[Dict] = None) -> str:
+    """Combined exposition; either side may be absent."""
+    parts = []
+    if telemetry is not None:
+        parts.append(render_train(telemetry))
+    if serve_snapshot is not None:
+        parts.append(render_serve(serve_snapshot))
+    return "".join(parts)

@@ -1,0 +1,46 @@
+"""Bounded uniform reservoir sample — the percentile backbone shared by the
+serve layer's latency stats and the training telemetry's iteration walls.
+
+Lifted out of ``serve/stats.py`` (which now imports it from here) so both
+sides of the system report percentiles with identical semantics: O(cap)
+memory over unbounded streams, uniform replacement, exact-ish quantiles.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List
+
+
+class Reservoir:
+    """Bounded latency sample with uniform reservoir replacement, so
+    million-request streams keep O(cap) memory but exact-ish percentiles."""
+
+    __slots__ = ("cap", "seen", "vals", "_rng")
+
+    def __init__(self, cap: int = 100_000, seed: int = 0) -> None:
+        self.cap = cap
+        self.seen = 0
+        self.vals: List[float] = []
+        self._rng = random.Random(seed)
+
+    def add(self, v: float) -> None:
+        self.seen += 1
+        if len(self.vals) < self.cap:
+            self.vals.append(v)
+        else:
+            j = self._rng.randrange(self.seen)
+            if j < self.cap:
+                self.vals[j] = v
+
+    def percentiles(self, qs=(0.5, 0.95, 0.99)) -> Dict[str, float]:
+        if not self.vals:
+            return {f"p{int(q * 100)}": 0.0 for q in qs} | {
+                "mean": 0.0, "max": 0.0}
+        s = sorted(self.vals)
+        out = {}
+        for q in qs:
+            k = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+            out[f"p{int(q * 100)}"] = s[k]
+        out["mean"] = sum(s) / len(s)
+        out["max"] = s[-1]
+        return out

@@ -138,6 +138,13 @@ class ForestServer:
         kwargs.setdefault("indent", 2)
         return json.dumps(self.stats_snapshot(), **kwargs)
 
+    def prometheus(self) -> str:
+        """Prometheus text exposition of the serving metrics (the
+        ``stats`` line of the task=serve loop; metric names in
+        docs/observability.md)."""
+        from ..obs import prom
+        return prom.render_serve(self.stats_snapshot())
+
     def close(self, timeout: float = 30.0) -> None:
         """Flush queued requests and stop the batcher thread."""
         if not self._closed:
@@ -195,15 +202,34 @@ class ForestServer:
 
 
 def serve_loop(server: ForestServer, lines, out_stream,
-               on_swap=None) -> int:
+               on_swap=None, stats_stream=None) -> int:
     """Drive a server from an iterable of text request lines (the CLI's
     ``task=serve`` loop; factored here so tests can drive it without a
-    process). One feature row per line (TSV or CSV); ``swap=<model>``
-    lines hot-swap mid-stream. Returns the number of served requests."""
+    process). Line protocol (docs/serving.md):
+
+    - one feature row per line (TSV or CSV) — a predict request;
+    - ``swap=<model>`` — atomic hot-swap mid-stream;
+    - ``stats`` — print the Prometheus exposition of the live serving
+      metrics to ``stats_stream`` (default: stderr);
+    - ``stats json`` — the ``ServeStats.snapshot()`` JSON instead;
+    - ``#``-prefixed lines and blanks are ignored.
+
+    Returns the number of served requests."""
+    import sys as _sys
+    if stats_stream is None:
+        stats_stream = _sys.stderr
     futures = []
     for line in lines:
         line = line.strip()
         if not line or line.startswith("#"):
+            continue
+        if line == "stats" or line == "stats prometheus":
+            stats_stream.write(server.prometheus())
+            stats_stream.flush()
+            continue
+        if line == "stats json":
+            stats_stream.write(server.stats_json() + "\n")
+            stats_stream.flush()
             continue
         if line.startswith("swap="):
             target = line.split("=", 1)[1].strip()

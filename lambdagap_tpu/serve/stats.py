@@ -1,54 +1,21 @@
 """Serving metrics: latency distributions, throughput, cache accounting.
 
-The serving analog of the training loop's ``utils.timer`` — every number a
-production operator needs to size a fleet (the reference ships none of this;
-the schema follows what TF-Serving/Triton-style batchers expose: per-request
+The serving half of ``lambdagap_tpu.obs`` — every number a production
+operator needs to size a fleet (the reference ships none of this; the
+schema follows what TF-Serving/Triton-style batchers expose: per-request
 queue wait, device time, end-to-end percentiles, batch occupancy, cache
 hit rates, swap counts). All methods are thread-safe; ``snapshot`` is cheap
-enough to poll.
+enough to poll, and ``obs.prom.render_serve`` turns it into Prometheus
+text (the ``stats`` line of the task=serve loop, docs/serving.md).
 """
 from __future__ import annotations
 
 import json
-import random
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
-
-class _Reservoir:
-    """Bounded latency sample with uniform reservoir replacement, so
-    million-request streams keep O(cap) memory but exact-ish percentiles."""
-
-    __slots__ = ("cap", "seen", "vals", "_rng")
-
-    def __init__(self, cap: int = 100_000, seed: int = 0) -> None:
-        self.cap = cap
-        self.seen = 0
-        self.vals: List[float] = []
-        self._rng = random.Random(seed)
-
-    def add(self, v: float) -> None:
-        self.seen += 1
-        if len(self.vals) < self.cap:
-            self.vals.append(v)
-        else:
-            j = self._rng.randrange(self.seen)
-            if j < self.cap:
-                self.vals[j] = v
-
-    def percentiles(self, qs=(0.5, 0.95, 0.99)) -> Dict[str, float]:
-        if not self.vals:
-            return {f"p{int(q * 100)}": 0.0 for q in qs} | {
-                "mean": 0.0, "max": 0.0}
-        s = sorted(self.vals)
-        out = {}
-        for q in qs:
-            k = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
-            out[f"p{int(q * 100)}"] = s[k]
-        out["mean"] = sum(s) / len(s)
-        out["max"] = s[-1]
-        return out
+from ..obs.reservoir import Reservoir as _Reservoir
 
 
 class ServeStats:

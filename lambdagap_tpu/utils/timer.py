@@ -1,10 +1,17 @@
-"""Coarse per-phase wall-clock timers.
+"""Coarse per-phase wall-clock timers (DEPRECATED shim).
 
 TPU-native analog of the reference's ``Common::Timer global_timer`` +
 ``FunctionTimer`` RAII (reference: include/LightGBM/utils/common.h:984-1068,
-compiled in with USE_TIMETAG). Here the equivalent fine-grained story is
-``jax.profiler`` traces; this module provides the same coarse per-phase table
-the reference prints at exit.
+compiled in with USE_TIMETAG). Superseded by ``lambdagap_tpu.obs``
+(docs/observability.md): when telemetry is active, ``TrainTelemetry`` feeds
+its phase spans into ``global_timer`` under the historical scope names, so
+the end-of-train report keeps working — but new code should read
+``booster._booster.telemetry`` instead.
+
+Enablement is evaluated at USE time (``timer_enabled()``), not snapshotted
+at import: flipping ``LAMBDAGAP_TIMETAG`` (or monkeypatching ``_ENABLED``)
+after import now takes effect, and the ``telemetry`` config knob enables
+the same accounting without the env var.
 """
 from __future__ import annotations
 
@@ -14,7 +21,16 @@ import time
 from collections import defaultdict
 from typing import Dict, Iterator
 
+# import-time snapshot kept ONLY as a monkeypatch/back-compat override;
+# timer_enabled() re-reads the environment on every call
 _ENABLED = os.environ.get("LAMBDAGAP_TIMETAG", "0") not in ("0", "", "false")
+
+
+def timer_enabled() -> bool:
+    """Legacy-timer enablement, evaluated now (env var or the
+    ``_ENABLED`` override)."""
+    return _ENABLED or os.environ.get(
+        "LAMBDAGAP_TIMETAG", "0") not in ("0", "", "false")
 
 
 class Timer:
@@ -24,7 +40,7 @@ class Timer:
 
     @contextlib.contextmanager
     def scope(self, name: str) -> Iterator[None]:
-        if not _ENABLED:
+        if not timer_enabled():
             yield
             return
         t0 = time.perf_counter()

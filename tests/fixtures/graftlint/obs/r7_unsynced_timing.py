@@ -1,0 +1,51 @@
+"""R7 fixture: perf_counter deltas bracketing async device dispatch.
+
+Bad brackets time a jax dispatch (or a boosting-loop method that returns
+device values) with no completion sync before the clock is read; good
+brackets either sync inside the bracket or time host-returning calls.
+"""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def bad_jnp_delta(x):
+    t0 = time.perf_counter()
+    y = jnp.sin(x) * 2.0
+    return y, time.perf_counter() - t0  # BAD:R7
+
+
+def bad_update_loop(booster):
+    t0 = time.time()
+    for _ in range(10):
+        booster.update()
+    return time.time() - t0  # BAD:R7
+
+
+def good_synced_loop(booster):
+    t0 = time.perf_counter()
+    for _ in range(10):
+        booster.update()
+    np.asarray(booster.scores[:1])      # forces device completion
+    return time.perf_counter() - t0
+
+
+def good_float_forced(x):
+    t0 = time.perf_counter()
+    s = float(jnp.sum(x))               # float() over the device scalar
+    return s, time.perf_counter() - t0
+
+
+def good_host_returning(booster, x):
+    t0 = time.perf_counter()
+    y = booster.predict(x)              # predict syncs internally
+    return y, time.perf_counter() - t0
+
+
+def suppressed_warmup(booster):
+    t0 = time.time()
+    booster.update()
+    # graftlint: disable=R7 — warmup bracket intentionally includes only
+    # dispatch+compile; the steady-state loop below it is the synced one
+    return time.time() - t0

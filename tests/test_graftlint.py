@@ -61,6 +61,7 @@ def fixture_findings():
     "r4_dtype_drift.py",
     "serve/r5_locks.py",
     "r6_collective_axis.py",
+    "obs/r7_unsynced_timing.py",
 ])
 def test_rule_fixture_exact_findings(fixture_findings, relpath):
     got = fixture_findings.get(relpath, set())

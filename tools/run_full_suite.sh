@@ -1,17 +1,25 @@
 #!/bin/bash
 cd /root/repo
 {
-echo "=== G0 pre-test gates: graftlint + docs drift $(date)"
+echo "=== G0 pre-test gates: graftlint + docs drift + telemetry $(date)"
 # fail-fast: a hazard finding or stale generated doc aborts before any
 # test group burns wall-clock (graftlint exits nonzero on non-baselined
-# findings; see docs/static-analysis.md)
-if ! python -m lambdagap_tpu.analysis lambdagap_tpu; then
+# findings; see docs/static-analysis.md). The scan covers the package AND
+# the timing surfaces R7 guards (bench*.py, tools/bench_*).
+if ! python -m lambdagap_tpu.analysis lambdagap_tpu bench.py bench_serve.py tools; then
     echo "FAIL-FAST: graftlint found non-baselined hazards (fix them, "
     echo "suppress with a justification, or regenerate the baseline)"
     exit 1
 fi
 if ! python tools/gen_params_doc.py --check; then
     echo "FAIL-FAST: docs/Parameters.md is stale; run python tools/gen_params_doc.py"
+    exit 1
+fi
+# telemetry gate (ISSUE 4): short telemetry=true training, JSONL validated
+# against the documented schema, zero steady-state recompiles
+if ! env JAX_PLATFORMS=cpu python tools/telemetry_gate.py; then
+    echo "FAIL-FAST: telemetry gate failed (obs/ run log invalid or a"
+    echo "steady-state recompile appeared; see docs/observability.md)"
     exit 1
 fi
 echo "=== G1 $(date)"

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Telemetry gate for tools/run_full_suite.sh (ISSUE 4 CI satellite).
+
+Runs a short ``telemetry=true, telemetry_out=...`` training, validates the
+emitted JSONL run log against the documented schema
+(``lambdagap_tpu.obs.events.validate_file``), and checks the record
+inventory: one run_header, one iteration record per boosting round, every
+iteration carrying phase spans that tile its wall, zero steady-state
+recompiles (a steady compile in this shape-stable config is exactly the
+R2-at-runtime regression the watchdog exists to catch).
+
+Exit 0 on success, 1 with a diagnostic on any violation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ROUNDS = 6
+
+
+def main() -> int:
+    import numpy as np
+
+    import lambdagap_tpu as lgb
+    from lambdagap_tpu.obs import events
+
+    out = os.path.join(tempfile.mkdtemp(prefix="lambdagap_gate_"),
+                       "run.jsonl")
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 16).astype(np.float32)
+    y = (X[:, 0] - 0.4 * X[:, 1] + 0.2 * rng.randn(2000) > 0
+         ).astype(np.float32)
+    # the fused whole-tree learner is the shape-stable program (one
+    # executable per tree shape); the host-orchestrated serial learner
+    # legitimately compiles new power-of-2 pad buckets as leaves shrink,
+    # which would make a zero-steady-compile assertion flaky
+    booster = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "verbose": -1, "telemetry": True,
+                         "telemetry_out": out, "tpu_fused_learner": "1"},
+                        lgb.Dataset(X, label=y), num_boost_round=ROUNDS)
+
+    errs = events.validate_file(out)
+    if errs:
+        print("telemetry gate: JSONL schema violations:\n  "
+              + "\n  ".join(errs[:20]), file=sys.stderr)
+        return 1
+
+    records = [json.loads(ln) for ln in open(out) if ln.strip()]
+    iters = [r for r in records if r["type"] == "iteration"]
+    if [r["iter"] for r in iters] != list(range(ROUNDS)):
+        print(f"telemetry gate: expected iterations 0..{ROUNDS - 1}, got "
+              f"{[r['iter'] for r in iters]}", file=sys.stderr)
+        return 1
+    for r in iters[1:]:
+        span = sum(v for k, v in r["phases"].items() if k != "eval")
+        if not (0.9 * r["wall_s"] - 1e-3 <= span <= 1.05 * r["wall_s"]
+                + 1e-3):
+            print(f"telemetry gate: iteration {r['iter']} phase spans "
+                  f"({span:.4f}s) do not tile wall ({r['wall_s']:.4f}s)",
+                  file=sys.stderr)
+            return 1
+    steady = sum(r["compiles"]["steady"] for r in iters)
+    if steady:
+        print(f"telemetry gate: {steady} steady-state recompile(s) in a "
+              "shape-stable training config — the R2-at-runtime regression",
+              file=sys.stderr)
+        return 1
+    tel = booster._booster.telemetry
+    if tel.iterations != ROUNDS or len(tel.records) != ROUNDS:
+        print("telemetry gate: ring buffer lost records", file=sys.stderr)
+        return 1
+    print(f"telemetry gate: OK ({ROUNDS} iterations, "
+          f"{len(records)} JSONL records, 0 steady compiles; {out})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
