@@ -141,6 +141,8 @@ def _configure_jax_cache() -> None:
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # graftlint: disable=R8 — best-effort persistent-compile-cache enable;
+    # older jax without the knob just pays cold compiles, which bench tolerates
     except Exception:
         pass
 

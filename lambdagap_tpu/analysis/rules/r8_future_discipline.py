@@ -1,0 +1,95 @@
+"""R8: future/exception discipline.
+
+Two hazards that turn failures into hangs or silence:
+
+- **R8a — swallowed exception**: an ``except`` handler whose body is
+  nothing but ``pass`` (or a bare ``...``/constant). The failure vanishes:
+  no log, no counter, no re-raise. In a serving or training pipeline this
+  is how a real fault becomes an unexplained wrong answer. Handle it,
+  count it, log it, or re-raise — an intentional best-effort probe gets an
+  inline justification or a baseline entry.
+- **R8b — unresolved request futures** (``serve/`` only): a batch-runner
+  function that resolves request futures (calls ``.set_result``) but
+  contains an ``except`` handler with neither a ``.set_exception`` call
+  nor a ``raise``. If that handler path exits the runner, every request in
+  the batch hangs its caller forever — the exact bug class of a batcher
+  worker eating an error mid-dispatch. Every exception path out of a
+  future-resolving function must either resolve the futures exceptionally
+  or propagate to a layer that does.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
+                    register_rule)
+
+
+def _is_swallow_body(body) -> bool:
+    """True when a handler body does nothing: only pass/.../constants."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue                     # bare `...` or a stray literal
+        return False
+    return True
+
+
+def _handler_resolves(handler: ast.ExceptHandler) -> bool:
+    """Does this except handler re-raise or resolve futures exceptionally?"""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            tail = call_name(node).rsplit(".", 1)[-1]
+            if tail in ("set_exception", "cancel"):
+                return True
+    return False
+
+
+@register_rule
+class FutureDisciplineRule(Rule):
+    id = "R8"
+    severity = "error"
+    description = ("future/exception discipline: except-pass swallows, and "
+                   "serve batch runners whose except paths can exit without "
+                   "resolving every request future")
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        in_serve = "/serve/" in ("/" + ctx.relpath)
+        # R8a: swallowed exceptions, anywhere in the scanned tree
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) \
+                    and _is_swallow_body(node.body):
+                yield ctx.finding(
+                    self, node,
+                    "exception swallowed (handler body is only 'pass'): the "
+                    "failure leaves no log line, no counter, no re-raise; "
+                    "record it or justify the swallow inline")
+        if not in_serve:
+            return
+        # R8b: future-resolving functions with non-resolving except paths
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            resolves = any(
+                isinstance(n, ast.Call)
+                and call_name(n).rsplit(".", 1)[-1] == "set_result"
+                for n in ast.walk(fn))
+            if not resolves:
+                continue
+            for handler in ast.walk(fn):
+                if not isinstance(handler, ast.ExceptHandler):
+                    continue
+                if _is_swallow_body(handler.body):
+                    continue             # already an R8a finding
+                if not _handler_resolves(handler):
+                    yield ctx.finding(
+                        self, handler,
+                        f"batch runner '{fn.name}' resolves request futures "
+                        "but this except path neither set_exception()s them "
+                        "nor re-raises: an error here exits the runner with "
+                        "every caller in the batch hung forever")

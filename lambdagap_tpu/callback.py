@@ -119,4 +119,9 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                     state["best_list"][max(state["best_iter"],
                                            key=state["best_iter"].get)])
     _callback.order = 30
+    # crash-safe snapshots (lambdagap_tpu.guard) capture and restore the
+    # best-score bookkeeping through these attributes, so an auto-resumed
+    # run stops at the same iteration the uninterrupted one would
+    _callback.state = state
+    _callback.is_early_stopping = True
     return _callback

@@ -76,7 +76,28 @@ def run_train(cfg: Config) -> None:
     else:
         train = load_data_file(cfg.data, cfg)
     booster = create_boosting(cfg, train)
-    if cfg.input_model:
+    start_it = 0
+    resumed = False
+    if cfg.resume == "auto":
+        # crash-safe auto-resume: pick up the latest valid snapshot (atomic
+        # write + checksum + state sidecar; guard/snapshot.py) and continue
+        # bit-consistently from its iteration
+        from .guard.snapshot import latest_snapshot, restore_state
+        from .models.model_text import load_model_from_string
+        found = latest_snapshot(cfg.output_model)
+        if found is not None:
+            snap_path, model_text, state = found
+            if cfg.input_model:
+                log.warning("resume=auto found snapshot %s; input_model is "
+                            "ignored", snap_path)
+            _, trees = load_model_from_string(model_text)
+            booster.resume_from(trees)
+            restore_state(booster, state)
+            start_it = booster.iter_
+            resumed = True
+            log.info("Resumed from snapshot %s (%d completed iterations)",
+                     snap_path, start_it)
+    if cfg.input_model and not resumed:
         # continued training (reference: application.cpp InitTrain with
         # input_model -> Boosting::CreateBoosting(type, filename))
         from .models.model_text import load_model_from_string
@@ -88,7 +109,7 @@ def run_train(cfg: Config) -> None:
         for i, vf in enumerate(str(cfg.valid).split(",")):
             vds = load_data_file(vf.strip(), cfg, reference=train)
             booster.add_valid_set(vds, f"valid_{i}")
-    for it in range(cfg.num_iterations):
+    for it in range(start_it, cfg.num_iterations):
         stop = booster.train_one_iter()
         if cfg.metric_freq > 0 and (it + 1) % cfg.metric_freq == 0:
             msgs = []
@@ -101,7 +122,9 @@ def run_train(cfg: Config) -> None:
             if msgs:
                 log.info("[%d] %s", it + 1, "  ".join(msgs))
         if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
-            booster.save_model(f"{cfg.output_model}.snapshot_iter_{it + 1}")
+            from .guard.snapshot import write_training_snapshot
+            write_training_snapshot(booster, cfg.output_model,
+                                    faults=booster.guard.plan)
         if stop:
             break
     if booster.telemetry.enabled:
@@ -181,11 +204,14 @@ def run_serve(cfg: Config) -> None:
         import json
         with open(cfg.serve_stats_file, "w") as f:
             json.dump(snap, f, indent=2)
-    log.info("Served %d requests (gen %d): %.0f req/s, p50=%.3fms "
-             "p99=%.3fms, cache hit rate %.0f%%; predictions in %s", n,
-             snap["generation"], snap["throughput_rps"],
-             snap["latency_ms"]["p50"], snap["latency_ms"]["p99"],
-             100.0 * snap["cache"]["hit_rate"], out_path)
+    log.info("Served %d requests (gen %d, health %s): %.0f req/s, "
+             "p50=%.3fms p99=%.3fms, cache hit rate %.0f%%, %d shed, "
+             "%d rejected, %d swap failures; predictions in %s", n,
+             snap["generation"], snap["health"]["state"],
+             snap["throughput_rps"], snap["latency_ms"]["p50"],
+             snap["latency_ms"]["p99"], 100.0 * snap["cache"]["hit_rate"],
+             snap["timeouts"], snap["rejected"], snap["swap_failures"],
+             out_path)
 
 
 def run_refit(cfg: Config) -> None:

@@ -338,6 +338,16 @@ class Config:
     serve_workers: int = 0               # parallel batch dispatchers; 0=auto
     serve_warmup: bool = True            # pre-compile buckets before serving
     serve_stats_file: str = ""           # task=serve: dump metrics JSON here
+    serve_max_queue: int = 0             # bounded request queue (rows); 0 = unbounded
+    serve_backpressure: str = "reject"   # full-queue policy: reject (ServeOverloaded) / block
+    serve_timeout_ms: float = 0.0        # per-request deadline; expired requests are shed before dispatch; 0 = none
+    serve_swap_breaker: int = 3          # consecutive swap failures opening the swap circuit; 0 = off
+
+    # -- guard (lambdagap_tpu.guard; docs/robustness.md) ------------------
+    guard_nonfinite: str = "raise"       # non-finite grad/hess/score policy: raise / skip_tree / clip / off
+    guard_clip: float = 1e30             # clip bound for guard_nonfinite=clip
+    resume: str = ""                     # "auto": continue from the latest valid training snapshot
+    guard_faults: str = ""               # fault-injection spec (testing; merges over LAMBDAGAP_FAULTS)
 
     # -- observability (lambdagap_tpu.obs; docs/observability.md) ---------
     telemetry: bool = False              # per-iteration phase spans + recompile watchdog
@@ -516,6 +526,16 @@ class Config:
             (self.serve_max_delay_ms >= 0, "serve_max_delay_ms must be >= 0"),
             (all(b > 0 for b in self.serve_buckets),
              "serve_buckets must be positive"),
+            (self.serve_max_queue >= 0, "serve_max_queue must be >= 0"),
+            (self.serve_backpressure in ("reject", "block"),
+             f"unknown serve_backpressure {self.serve_backpressure!r}"),
+            (self.serve_timeout_ms >= 0, "serve_timeout_ms must be >= 0"),
+            (self.serve_swap_breaker >= 0, "serve_swap_breaker must be >= 0"),
+            (self.guard_nonfinite in ("off", "raise", "skip_tree", "clip"),
+             f"unknown guard_nonfinite {self.guard_nonfinite!r}"),
+            (self.guard_clip > 0, "guard_clip must be > 0"),
+            (self.resume in ("", "auto"),
+             f"unknown resume mode {self.resume!r} (only 'auto')"),
             (self.telemetry_ring >= 1, "telemetry_ring must be >= 1"),
             (self.telemetry_warmup >= 0, "telemetry_warmup must be >= 0"),
             (self.profile_n_iters >= 1, "profile_n_iters must be >= 1"),

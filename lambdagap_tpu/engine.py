@@ -24,8 +24,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
           feval: Optional[Callable] = None,
           init_model: Optional[Union[str, Booster]] = None,
           keep_training_booster: bool = False,
-          callbacks: Optional[List[Callable]] = None) -> Booster:
-    """Train a booster (reference: engine.py:109)."""
+          callbacks: Optional[List[Callable]] = None,
+          resume: str = "") -> Booster:
+    """Train a booster (reference: engine.py:109).
+
+    ``resume="auto"`` (or the ``resume=auto`` parameter) continues from the
+    latest valid crash-safe snapshot for ``output_model`` — model trees,
+    sampling RNG, DART state and early-stopping bests are all restored, so
+    the resumed run is bit-consistent with an uninterrupted one
+    (docs/robustness.md)."""
+    from .guard import snapshot as guard_snapshot
     params = dict(params)
     cfg = Config.from_params(params)
     if "num_iterations" not in {Config.canonical_name(k) for k in params}:
@@ -33,6 +41,21 @@ def train(params: Dict[str, Any], train_set: Dataset,
     num_boost_round = cfg.num_iterations
 
     booster = Booster(params=params, train_set=train_set)
+    resumed_state: Optional[Dict[str, Any]] = None
+    if (resume or cfg.resume) == "auto":
+        found = guard_snapshot.latest_snapshot(cfg.output_model)
+        if found is not None:
+            snap_path, model_str, resumed_state = found
+            if init_model is not None:
+                log.warning("resume=auto found snapshot %s; init_model is "
+                            "ignored", snap_path)
+                init_model = None
+            from .models.model_text import load_model_from_string
+            _, trees = load_model_from_string(model_str)
+            booster._booster.resume_from(trees)
+            guard_snapshot.restore_state(booster._booster, resumed_state)
+            log.info("Resumed from snapshot %s (%d completed iterations)",
+                     snap_path, booster._booster.iter_)
     if init_model is not None:
         from .models.model_text import load_model_from_string
         if isinstance(init_model, Booster):
@@ -72,8 +95,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
     for group in (cbs_before, cbs_after):
         group.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    # early-stopping bookkeeping rides in the snapshot sidecar so a resumed
+    # run keeps counting patience from the recorded best, not from scratch
+    es_state = next((cb.state for cb in cbs_after
+                     if getattr(cb, "is_early_stopping", False)), None)
+    if resumed_state is not None and es_state is not None \
+            and resumed_state.get("early_stop"):
+        es_state.update(resumed_state["early_stop"])
+
     telemetry = booster._booster.telemetry
-    for i in range(num_boost_round):
+    start_iteration = booster._booster.iter_ if resumed_state is not None else 0
+    evals: List[Tuple[str, str, float, bool]] = []
+    for i in range(start_iteration, num_boost_round):
         env0 = CallbackEnv(model=booster, params=params, iteration=i,
                            begin_iteration=0, end_iteration=num_boost_round,
                            evaluation_result_list=[], telemetry=telemetry)
@@ -81,11 +114,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
             cb(env0)
         stop = booster.update()
         if cfg.snapshot_freq > 0 and (i + 1) % cfg.snapshot_freq == 0:
-            # periodic model snapshots (reference: gbdt.cpp:252-256)
-            booster.save_model(
-                f"{cfg.output_model}.snapshot_iter_{booster.current_iteration}")
+            # periodic crash-safe snapshots (reference: gbdt.cpp:252-256;
+            # atomic write + state sidecar, guard/snapshot.py)
+            guard_snapshot.write_training_snapshot(
+                booster._booster, cfg.output_model, early_stop=es_state,
+                faults=booster._booster.guard.plan)
 
-        evals: List[Tuple[str, str, float, bool]] = []
+        evals = []
         with telemetry.phase("eval"):
             if valid_contains_train:
                 name = getattr(booster, "_train_name", "training")

@@ -1,0 +1,37 @@
+"""lambdagap_tpu.guard — fault tolerance for training and serving.
+
+Production posture for the whole framework (docs/robustness.md):
+
+- :mod:`.nonfinite` — device-side finiteness sentinels over
+  gradients/hessians/scores with a ``guard_nonfinite`` policy
+  (raise / skip_tree / clip), folded into the once-per-iteration
+  device-complete boundary so the steady train loop stays sync-free.
+- :mod:`.snapshot` — crash-safe checkpointing: atomic snapshot writes
+  (tmp + fsync + rename) carrying a training-state sidecar (iteration,
+  sampling RNG, DART drop state, early-stopping bests) with a trailing
+  checksum, plus discovery/validation for ``resume=auto``.
+- :mod:`.degrade` — serving degradation primitives: request deadlines
+  (``ServeTimeout``), bounded-queue backpressure (``ServeOverloaded``),
+  a swap circuit breaker (``SwapFailed``/``SwapRejected``) and the
+  OK/DEGRADED/DRAINING health state machine.
+- :mod:`.faults` — config/env-driven fault injection (crash-at-iteration,
+  non-finite gradients, failing/slow serve dispatch, torn snapshot
+  writes) powering tests/test_guard*.py and tools/chaos_gate.py.
+"""
+from __future__ import annotations
+
+from .degrade import (CircuitBreaker, HealthMonitor, ServeOverloaded,  # noqa: F401
+                      ServeTimeout, SwapFailed, SwapRejected)
+from .faults import FaultPlan, InjectedFault, plan_for  # noqa: F401
+from .nonfinite import NonFiniteError, TrainGuard  # noqa: F401
+from .snapshot import (SnapshotError, atomic_write_text,  # noqa: F401
+                       capture_state, latest_snapshot, read_snapshot,
+                       restore_state, snapshot_path, write_training_snapshot)
+
+__all__ = [
+    "CircuitBreaker", "HealthMonitor", "ServeOverloaded", "ServeTimeout",
+    "SwapFailed", "SwapRejected", "FaultPlan", "InjectedFault", "plan_for",
+    "NonFiniteError", "TrainGuard", "SnapshotError", "atomic_write_text",
+    "capture_state", "latest_snapshot", "read_snapshot", "restore_state",
+    "snapshot_path", "write_training_snapshot",
+]

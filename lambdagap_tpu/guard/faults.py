@@ -1,0 +1,163 @@
+"""Fault injection: deterministic failure points for robustness testing.
+
+Chaos testing a trained-in-minutes GBDT does not need a service mesh — it
+needs a handful of precisely placed fault points that the guard layer must
+survive. A :class:`FaultPlan` parses a spec string of comma-separated
+``name=value`` tokens from the ``guard_faults`` config parameter and/or the
+``LAMBDAGAP_FAULTS`` environment variable (config wins per fault point) and
+arms these points:
+
+- ``crash_at_iter=N`` — SIGKILL the process at the start of boosting
+  iteration N (after N completed iterations). The hard-crash half of the
+  kill-and-resume acceptance test: no atexit handlers, no flushes, exactly
+  what a preempted TPU VM looks like.
+- ``nonfinite_grad=N`` / ``nonfinite_grad=N:M`` — poison the gradient and
+  hessian tensors with NaN/Inf at iteration N (or each iteration in
+  [N, M]). Fires once per armed iteration value even if the guard's
+  skip_tree policy rewinds the iteration counter.
+- ``serve_dispatch_fail=K`` — the next K serve batch dispatches raise
+  :class:`InjectedFault` before touching the device.
+- ``serve_dispatch_slow_ms=T`` — every serve dispatch sleeps T ms first
+  (deadline/shedding tests).
+- ``torn_snapshot=K`` — the K-th snapshot write of the process bypasses
+  the atomic tmp+rename protocol and writes a truncated file in place:
+  the torn-write crash window, materialized.
+
+All points are inert unless armed; parsing happens once per plan. Plans
+are per-booster / per-server (``plan_for(config)``), so two servers in one
+process can run different fault schedules.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional, Tuple
+
+from ..utils import log
+
+ENV_VAR = "LAMBDAGAP_FAULTS"
+
+
+class InjectedFault(RuntimeError):
+    """An error raised by an armed fault point (never by real code paths)."""
+
+
+def _parse_spec(spec: str) -> dict:
+    out: dict = {}
+    for tok in (spec or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" not in tok:
+            log.warning("guard_faults token %r has no '=value'; ignored", tok)
+            continue
+        k, v = tok.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _parse_range(v: str) -> Tuple[int, int]:
+    if ":" in v:
+        lo, hi = v.split(":", 1)
+        return int(lo), int(hi)
+    return int(v), int(v)
+
+
+class FaultPlan:
+    """Parsed, armed fault points. One instance per booster/server."""
+
+    def __init__(self, spec: str = "") -> None:
+        kv = _parse_spec(spec)
+        self.crash_at_iter: Optional[int] = (
+            int(kv["crash_at_iter"]) if "crash_at_iter" in kv else None)
+        self.nonfinite_grad: Optional[Tuple[int, int]] = (
+            _parse_range(kv["nonfinite_grad"])
+            if "nonfinite_grad" in kv else None)
+        self.serve_dispatch_fail: int = int(kv.get("serve_dispatch_fail", 0))
+        self.serve_dispatch_slow_ms: float = float(
+            kv.get("serve_dispatch_slow_ms", 0.0))
+        self.torn_snapshot: int = int(kv.get("torn_snapshot", 0))
+        self._fired_nonfinite: set = set()
+        self._snapshot_writes = 0
+        unknown = set(kv) - {"crash_at_iter", "nonfinite_grad",
+                             "serve_dispatch_fail", "serve_dispatch_slow_ms",
+                             "torn_snapshot"}
+        if unknown:
+            log.warning("unknown fault point(s) ignored: %s",
+                        ", ".join(sorted(unknown)))
+
+    @property
+    def active(self) -> bool:
+        return (self.crash_at_iter is not None
+                or self.nonfinite_grad is not None
+                or self.serve_dispatch_fail > 0
+                or self.serve_dispatch_slow_ms > 0
+                or self.torn_snapshot > 0)
+
+    # -- training points ------------------------------------------------
+    def crash_point(self, iteration: int) -> None:
+        """SIGKILL self at the armed iteration (no cleanup runs — the point
+        is to leave whatever a hard preemption would leave)."""
+        if self.crash_at_iter is not None and iteration == self.crash_at_iter:
+            log.warning("fault injection: SIGKILL at iteration %d", iteration)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def corrupt_gradients(self, iteration: int, grad, hess):
+        """Poison grad/hess with NaN + Inf at armed iterations (each armed
+        iteration value fires once per process, so a skip_tree rewind does
+        not re-trigger an endless loop)."""
+        rng = self.nonfinite_grad
+        if rng is None or not (rng[0] <= iteration <= rng[1]) \
+                or iteration in self._fired_nonfinite:
+            return grad, hess
+        self._fired_nonfinite.add(iteration)
+        import jax.numpy as jnp
+        log.warning("fault injection: non-finite gradients at iteration %d",
+                    iteration)
+        n = grad.shape[-1]
+        poison = jnp.where(jnp.arange(n, dtype=jnp.int32) % 7 == 0,
+                           jnp.nan, jnp.inf)
+        grad = grad + poison.astype(grad.dtype)
+        hess = hess.at[..., 0].set(jnp.nan)
+        return grad, hess
+
+    # -- serve points ---------------------------------------------------
+    def dispatch_fault(self) -> None:
+        """Called at the top of every serve batch dispatch."""
+        if self.serve_dispatch_slow_ms > 0:
+            time.sleep(self.serve_dispatch_slow_ms / 1e3)
+        if self.serve_dispatch_fail > 0:
+            self.serve_dispatch_fail -= 1
+            raise InjectedFault("injected serve dispatch failure "
+                                f"({self.serve_dispatch_fail} left)")
+
+    # -- snapshot point -------------------------------------------------
+    def tear_snapshot(self, path: str, data: str) -> bool:
+        """If this is the armed write, simulate a crash mid-write: half the
+        bytes land in the final path, no checksum, no rename. Returns True
+        when the write was torn (the caller must skip the atomic write)."""
+        if self.torn_snapshot <= 0:
+            return False
+        self._snapshot_writes += 1
+        if self._snapshot_writes != self.torn_snapshot:
+            return False
+        log.warning("fault injection: torn snapshot write to %s", path)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(data[:max(1, len(data) // 2)])
+        return True
+
+
+_NULL = FaultPlan("")
+
+
+def plan_for(config=None) -> FaultPlan:
+    """Build the fault plan for one booster/server: the ``guard_faults``
+    config spec merged over ``LAMBDAGAP_FAULTS`` (config points win).
+    Returns a shared inert plan when nothing is armed."""
+    env = os.environ.get(ENV_VAR, "")
+    cfg_spec = getattr(config, "guard_faults", "") if config is not None else ""
+    spec = ",".join(s for s in (env, cfg_spec) if s)
+    if not spec:
+        return _NULL
+    return FaultPlan(spec)

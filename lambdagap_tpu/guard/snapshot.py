@@ -1,0 +1,224 @@
+"""Crash-safe training snapshots: atomic writes, state sidecar, auto-resume.
+
+A snapshot is ONE file — the standard model text (loadable by every
+existing model reader: the sidecar rides after ``end of parameters`` where
+the parser ignores it) followed by two trailer lines::
+
+    !snapshot_state=<one-line JSON sidecar>
+    !snapshot_checksum=<sha256 of everything above>
+
+The sidecar carries what the model text cannot: the completed-iteration
+count, the sampling RNG state (bagging/GOSS key + the live bagging-mask
+subkey), DART's dropout RNG / tree weights, and the engine's early-stopping
+bests. Restoring it after ``resume_from`` makes continued training
+bit-consistent with the uninterrupted run — the kill-and-resume test in
+tests/test_guard.py asserts byte-identical final model text.
+
+Write protocol (the reference's ``save_model`` is a bare ``open(w)`` —
+a crash mid-write leaves a torn file that a later load trusts):
+
+1. serialize everything to memory;
+2. write to ``<path>.tmp.<pid>`` in the target directory;
+3. ``fsync`` the file, then atomically ``os.replace`` onto the final name.
+
+A crash before (3) leaves only a tmp file; a crash during (3) is atomic at
+the filesystem level. Readers verify the checksum, so even a torn write
+that bypassed the protocol (``torn_snapshot`` fault point) is detected and
+the next-older snapshot is used instead.
+"""
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+STATE_PREFIX = "!snapshot_state="
+CHECKSUM_PREFIX = "!snapshot_checksum="
+STATE_VERSION = 1
+
+
+class SnapshotError(ValueError):
+    """A snapshot file is torn, corrupt, or state-incompatible."""
+
+
+# ---------------------------------------------------------------------------
+# atomic writes
+# ---------------------------------------------------------------------------
+def atomic_write_text(path: str, data: str) -> None:
+    """tmp + fsync + rename. The tmp name embeds the pid and the target
+    basename, so a final-model write and a snapshot write (or two
+    concurrent trainers) can never tear each other through a shared tmp
+    file."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _sha256(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# sidecar capture / restore
+# ---------------------------------------------------------------------------
+def _rng_state_to_json(rs: np.random.RandomState) -> Dict[str, Any]:
+    alg, keys, pos, has_gauss, cached = rs.get_state()
+    return {"alg": alg, "keys": np.asarray(keys, np.uint32).tolist(),
+            "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached": float(cached)}
+
+
+def _rng_state_from_json(d: Dict[str, Any]) -> tuple:
+    return (d["alg"], np.asarray(d["keys"], np.uint32), int(d["pos"]),
+            int(d["has_gauss"]), float(d["cached"]))
+
+
+def capture_state(gbdt, early_stop: Optional[Dict] = None) -> Dict[str, Any]:
+    """The training-state sidecar for one booster at its current iteration
+    (everything resume needs beyond the model text)."""
+    cfg = gbdt.config
+    st: Dict[str, Any] = {
+        "version": STATE_VERSION,
+        "iteration": int(gbdt.iter_),
+        "boosting": cfg.boosting,
+        "objective": cfg.objective,
+        "seed": int(cfg.seed),
+        "num_tree_per_iteration": int(gbdt.num_tree_per_iteration),
+    }
+    ss = getattr(gbdt, "sample_strategy", None)
+    if ss is not None:
+        st["sample"] = ss.get_state()
+    if hasattr(gbdt, "drop_rng"):        # DART
+        st["dart"] = {
+            "rng": _rng_state_to_json(gbdt.drop_rng),
+            "tree_weight": [float(w) for w in gbdt.tree_weight],
+            "sum_weight": float(gbdt.sum_weight),
+        }
+    if early_stop:
+        st["early_stop"] = early_stop
+    return st
+
+
+def restore_state(gbdt, state: Dict[str, Any]) -> None:
+    """Apply a sidecar captured by :func:`capture_state`. Call AFTER
+    ``resume_from`` (which rebuilds scores and the iteration count from the
+    model text); this fills in the RNG/weight state the text cannot carry."""
+    cfg = gbdt.config
+    for key, want in (("boosting", cfg.boosting), ("objective", cfg.objective)):
+        if state.get(key) not in (None, want):
+            log.fatal("snapshot was written with %s=%s but the current run "
+                      "uses %s=%s; refusing to resume", key, state.get(key),
+                      key, want)
+    if state.get("iteration") != gbdt.iter_:
+        log.fatal("snapshot sidecar says %s completed iterations but the "
+                  "model text holds %d; snapshot is inconsistent",
+                  state.get("iteration"), gbdt.iter_)
+    ss = getattr(gbdt, "sample_strategy", None)
+    if ss is not None and state.get("sample"):
+        ss.set_state(state["sample"])
+    dart = state.get("dart")
+    if dart is not None and hasattr(gbdt, "drop_rng"):
+        gbdt.drop_rng.set_state(_rng_state_from_json(dart["rng"]))
+        gbdt.tree_weight = [float(w) for w in dart["tree_weight"]]
+        gbdt.sum_weight = float(dart["sum_weight"])
+
+
+# ---------------------------------------------------------------------------
+# snapshot files
+# ---------------------------------------------------------------------------
+def snapshot_path(output_model: str, iteration: int) -> str:
+    return f"{output_model}.snapshot_iter_{int(iteration)}"
+
+
+def _json_default(o):
+    """Numpy scalars riding in sidecar state (metric values etc.)."""
+    if hasattr(o, "item"):
+        return o.item()
+    return str(o)
+
+
+def compose_snapshot(model_text: str, state: Dict[str, Any]) -> str:
+    if not model_text.endswith("\n"):
+        model_text += "\n"
+    body = (model_text + STATE_PREFIX
+            + json.dumps(state, separators=(",", ":"),
+                         default=_json_default) + "\n")
+    return body + CHECKSUM_PREFIX + _sha256(body) + "\n"
+
+
+def write_training_snapshot(gbdt, output_model: str,
+                            early_stop: Optional[Dict] = None,
+                            faults=None) -> str:
+    """The one snapshot writer (deduplicates the former copy-pasted
+    ``save_model`` calls in engine.py and cli.py, and makes both atomic).
+    Returns the snapshot path."""
+    path = snapshot_path(output_model, gbdt.iter_)
+    state = capture_state(gbdt, early_stop=early_stop)
+    data = compose_snapshot(gbdt.save_model_to_string(), state)
+    if faults is not None and faults.tear_snapshot(path, data):
+        return path                      # fault point: torn write simulated
+    atomic_write_text(path, data)
+    return path
+
+
+def read_snapshot(path: str) -> Tuple[str, Dict[str, Any]]:
+    """Validate + parse one snapshot file -> (model_text, state sidecar).
+    Raises :class:`SnapshotError` on any torn/corrupt/mismatched content."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = f.read()
+    except OSError as e:
+        raise SnapshotError(f"cannot read snapshot {path}: {e}")
+    lines = data.splitlines(keepends=True)
+    if len(lines) < 3 or not lines[-1].startswith(CHECKSUM_PREFIX):
+        raise SnapshotError(f"snapshot {path} has no checksum trailer "
+                            "(torn write?)")
+    body = "".join(lines[:-1])
+    want = lines[-1][len(CHECKSUM_PREFIX):].strip()
+    got = _sha256(body)
+    if got != want:
+        raise SnapshotError(f"snapshot {path} checksum mismatch "
+                            f"({got[:12]}… != {want[:12]}…)")
+    if not lines[-2].startswith(STATE_PREFIX):
+        raise SnapshotError(f"snapshot {path} has no state sidecar")
+    try:
+        state = json.loads(lines[-2][len(STATE_PREFIX):])
+    except json.JSONDecodeError as e:
+        raise SnapshotError(f"snapshot {path} sidecar is not JSON: {e}")
+    if state.get("version") != STATE_VERSION:
+        raise SnapshotError(f"snapshot {path} sidecar version "
+                            f"{state.get('version')!r} is unsupported")
+    model_text = "".join(lines[:-2])
+    return model_text, state
+
+
+def latest_snapshot(output_model: str
+                    ) -> Optional[Tuple[str, str, Dict[str, Any]]]:
+    """Newest VALID snapshot for ``output_model`` -> (path, model_text,
+    state), or None. Corrupt/truncated candidates are logged and skipped —
+    a torn final write must fall back to the previous good snapshot."""
+    pattern = glob.escape(output_model) + ".snapshot_iter_*"
+    candidates = []
+    for p in glob.glob(pattern):
+        suffix = p.rsplit(".snapshot_iter_", 1)[-1]
+        try:
+            candidates.append((int(suffix), p))
+        except ValueError:
+            continue
+    for _, p in sorted(candidates, reverse=True):
+        try:
+            model_text, state = read_snapshot(p)
+        except SnapshotError as e:
+            log.warning("skipping invalid snapshot: %s", e)
+            continue
+        return p, model_text, state
+    return None

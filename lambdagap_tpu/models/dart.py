@@ -111,11 +111,29 @@ class DART(GBDT):
                                    cfg.learning_rate / (cfg.learning_rate + k_drop))
         return drop_index
 
+    def _guard_state_capture(self) -> dict:
+        st = super()._guard_state_capture()
+        st["tree_weight"] = list(self.tree_weight)
+        st["sum_weight"] = self.sum_weight
+        return st
+
+    def _guard_state_restore(self, st: dict) -> None:
+        super()._guard_state_restore(st)
+        self.tree_weight = list(st["tree_weight"])
+        self.sum_weight = st["sum_weight"]
+
     def train_one_iter(self, grad=None, hess=None) -> bool:
+        # capture the skip_tree restore point BEFORE dropout mutates scores
+        # and shrinkage (the base-class capture then no-ops)
+        self.guard.begin_iteration(self)
         drop_index = self._dropping_trees()
         ret = super().train_one_iter(grad, hess)
         if ret:
             return ret
+        if self.last_iteration_skipped:
+            # guard restored the pre-dropout state; the dropped trees were
+            # never renormalized, so there is nothing to undo
+            return False
         self._normalize(drop_index)
         if not self.config.uniform_drop:
             self.tree_weight.append(self.shrinkage_rate)
@@ -190,8 +208,11 @@ class RF(GBDT):
     def train_one_iter(self, grad=None, hess=None) -> bool:
         if self.objective is None:
             log.fatal("RF mode does not support custom objective functions")
-        grad, hess, mask = self.sample_strategy.sample(
-            self.iter_, self._rf_grad, self._rf_hess)
+        self.guard.begin_iteration(self)
+        self.last_iteration_skipped = False
+        grad, hess = self.guard.admit_gradients(self, self._rf_grad,
+                                                self._rf_hess)
+        grad, hess, mask = self.sample_strategy.sample(self.iter_, grad, hess)
 
         should_continue = False
         for k in range(self.num_tree_per_iteration):
@@ -216,10 +237,14 @@ class RF(GBDT):
                         self.valid_scores[vi][k] / (it + 1))
             self.models.append(tree)
         if not should_continue:
+            if self.guard.end_iteration(self):
+                self.last_iteration_skipped = True
+                return False
             log.warning("Stopped training: no more leaves meet split requirements")
             del self.models[-self.num_tree_per_iteration:]
             return True
         self.iter_ += 1
+        self.last_iteration_skipped = self.guard.end_iteration(self)
         return False
 
     def _renew_tree_output_rf(self, tree: Tree, k: int, mask) -> None:

@@ -29,6 +29,7 @@ from ..ops.predict import (_round_depth, build_forest_blocks,
                            tree_to_arrays)
 from ..ops.predict_tensor import (build_tree_tiles, predict_forest_leaf_tensor,
                                   predict_forest_tensor)
+from ..guard.nonfinite import NULL_GUARD, TrainGuard
 from ..obs.telemetry import NULL_TELEMETRY, TrainTelemetry
 from ..utils import log
 from .learner import SerialTreeLearner
@@ -171,6 +172,8 @@ class GBDT:
         self.valid_metrics: List[List[Metric]] = []
         self.valid_scores: List[jax.Array] = []
         self.telemetry: TrainTelemetry = NULL_TELEMETRY
+        self.guard: TrainGuard = NULL_GUARD
+        self.last_iteration_skipped = False
 
         if train_set is not None:
             self._setup_training(train_set)
@@ -186,6 +189,7 @@ class GBDT:
                           self.objective.name)
             self.objective.init(ds.metadata, ds.num_data)
         self.telemetry = TrainTelemetry.from_config(self.config)
+        self.guard = TrainGuard.from_config(self.config)
         self.learner = self._create_learner(ds)
         # learners that host-orchestrate (SerialTreeLearner) record their
         # histogram/split/partition sub-phases through this handle; the
@@ -443,6 +447,10 @@ class GBDT:
         cfg = self.config
         tel = self.telemetry
         tel.begin_iteration(self.iter_)
+        # crash fault point + skip_tree restore capture (a no-op when DART
+        # already captured the pre-dropout state for this iteration)
+        self.guard.begin_iteration(self)
+        self.last_iteration_skipped = False
         init_scores = [0.0] * self.num_tree_per_iteration
         if grad is None or hess is None:
             if self.objective is None:
@@ -476,6 +484,7 @@ class GBDT:
                         log.info("Start training from score %f", init)
             with tel.phase("gradients"):
                 grad, hess = self.boosting()
+        grad, hess = self.guard.admit_gradients(self, grad, hess)
 
         with tel.phase("sampling"):
             grad, hess, mask = self.sample_strategy.sample(self.iter_, grad,
@@ -512,6 +521,7 @@ class GBDT:
                             self._add_valid_tree_score(vi, tree, k)
             self.iter_ += 1
             tel.end_iteration(sync=self.scores)
+            self.last_iteration_skipped = self.guard.end_iteration(self)
             return False
 
         should_continue = False
@@ -546,15 +556,38 @@ class GBDT:
             self.models.append(tree)
 
         if not should_continue:
+            tel.end_iteration(sync=self.scores)
+            if self.guard.end_iteration(self):
+                # non-finite gradients made every leaf unsplittable: this is
+                # a skipped iteration, not convergence — keep training
+                self.last_iteration_skipped = True
+                return False
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
             if len(self.models) > self.num_tree_per_iteration:
                 del self.models[-self.num_tree_per_iteration:]
-            tel.end_iteration(sync=self.scores)
             return True
         self.iter_ += 1
         tel.end_iteration(sync=self.scores)
+        self.last_iteration_skipped = self.guard.end_iteration(self)
         return False
+
+    def _guard_state_capture(self) -> dict:
+        """Restore point for guard_nonfinite=skip_tree: scores are immutable
+        jax arrays, so holding the old references IS the snapshot (no
+        copies). DART extends this with its dropout bookkeeping."""
+        return {"scores": self.scores,
+                "valid_scores": list(self.valid_scores),
+                "n_models": len(self.models),
+                "iter": self.iter_,
+                "shrinkage": self.shrinkage_rate}
+
+    def _guard_state_restore(self, st: dict) -> None:
+        self.scores = st["scores"]
+        self.valid_scores[:] = st["valid_scores"]
+        del self.models[st["n_models"]:]
+        self.iter_ = st["iter"]
+        self.shrinkage_rate = st["shrinkage"]
 
     def _host_leaf_index(self, tree: Tree) -> np.ndarray:
         """Per-row leaf assignment from the serial learner's partition."""
@@ -1148,9 +1181,13 @@ class GBDT:
 
     def save_model(self, filename: str, start_iteration: int = 0,
                    num_iteration: int = -1, importance_type: int = 0) -> None:
-        with open(filename, "w") as f:
-            f.write(self.save_model_to_string(start_iteration, num_iteration,
-                                              importance_type))
+        # atomic (tmp + fsync + rename): a crash mid-save must never leave a
+        # torn model file that a later load or auto-resume trusts
+        from ..guard.snapshot import atomic_write_text
+        atomic_write_text(filename,
+                          self.save_model_to_string(start_iteration,
+                                                    num_iteration,
+                                                    importance_type))
 
     @classmethod
     def from_model_string(cls, text: str, config: Optional[Config] = None):

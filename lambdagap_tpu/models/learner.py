@@ -545,7 +545,15 @@ class SerialTreeLearner:
 
         tree.leaf_value[0] = float(jax.device_get(root_out))
         tree.leaf_weight[0] = float(jax.device_get(totals[1]))
-        tree.leaf_count[0] = int(float(jax.device_get(totals[2])))
+        # non-finite gradients poison the histogram count channel; the int
+        # conversion must not crash mid-iteration — the guard layer decides
+        # what to do with the tree at the iteration boundary
+        # (guard_nonfinite policy, docs/robustness.md)
+        # graftlint: disable=R1 — pre-guard root-stat D2H, one per tree:
+        # the host-orchestrated learner already syncs per split (documented
+        # grandfathered cost); this read rides the same boundary
+        root_cnt = float(jax.device_get(totals[2]))
+        tree.leaf_count[0] = int(root_cnt) if np.isfinite(root_cnt) else 0
 
         # intermediate monotone method: per-tree node topology + subtree
         # markers (reference: IntermediateLeafConstraints state). The

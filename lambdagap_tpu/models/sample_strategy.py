@@ -44,6 +44,16 @@ class SampleStrategy:
         """Returns (grad, hess, mask). mask=None means all rows in-bag."""
         return grad, hess, None
 
+    # -- snapshot sidecar (guard/snapshot.py): RNG state capture ---------
+    def get_state(self) -> dict:
+        """JSON-safe RNG state for crash-safe snapshots; subclasses with
+        randomness override. Restoring this state makes a resumed run draw
+        the exact sampling sequence of the uninterrupted one."""
+        return {"type": "none"}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
 
 class BaggingStrategy(SampleStrategy):
     """(reference: src/boosting/bagging.hpp — per-``bagging_freq`` Bernoulli
@@ -67,31 +77,57 @@ class BaggingStrategy(SampleStrategy):
         c = self.config
         return c.bagging_freq > 0 and (c.bagging_fraction < 1.0 or self.balanced)
 
+    def _make_mask(self, sub) -> jax.Array:
+        """The in-bag mask for one resample subkey. Factored out so a
+        snapshot restore can regenerate the live mask from the recorded
+        subkey instead of serializing [N] booleans."""
+        c = self.config
+        if c.bagging_by_query and self.query_boundaries is not None:
+            nq = len(self.query_boundaries) - 1
+            qmask = jax.random.uniform(sub, (nq,)) < c.bagging_fraction
+            qb = jnp.asarray(self.query_boundaries)
+            qid = jnp.searchsorted(
+                qb, jnp.arange(self.num_data, dtype=jnp.int32),
+                side="right") - 1
+            return qmask[qid]
+        if self.balanced:
+            u = jax.random.uniform(sub, (self.num_data,))
+            frac = jnp.where(self.is_pos, c.pos_bagging_fraction,
+                             c.neg_bagging_fraction)
+            return u < frac
+        u = jax.random.uniform(sub, (self.num_data,))
+        return u < c.bagging_fraction
+
     def sample(self, iter_, grad, hess):
         c = self.config
         if not self.enabled:
             return grad, hess, None
         if iter_ % c.bagging_freq == 0:
             self.key, sub = jax.random.split(self.key)
-            if c.bagging_by_query and self.query_boundaries is not None:
-                nq = len(self.query_boundaries) - 1
-                qmask = jax.random.uniform(sub, (nq,)) < c.bagging_fraction
-                qb = jnp.asarray(self.query_boundaries)
-                qid = jnp.searchsorted(
-                    qb, jnp.arange(self.num_data, dtype=jnp.int32),
-                    side="right") - 1
-                self.cur_mask = qmask[qid]
-            elif self.balanced:
-                u = jax.random.uniform(sub, (self.num_data,))
-                frac = jnp.where(self.is_pos, c.pos_bagging_fraction,
-                                 c.neg_bagging_fraction)
-                self.cur_mask = u < frac
-            else:
-                u = jax.random.uniform(sub, (self.num_data,))
-                self.cur_mask = u < c.bagging_fraction
+            self._mask_key = sub
+            self.cur_mask = self._make_mask(sub)
         m = self.cur_mask
         mf = m.astype(grad.dtype)
         return grad * mf, hess * mf, m
+
+    def get_state(self) -> dict:
+        st = {"type": "bagging",
+              "key": np.asarray(self.key).tolist()}
+        mk = getattr(self, "_mask_key", None)
+        if mk is not None:
+            st["mask_key"] = np.asarray(mk).tolist()
+        return st
+
+    def set_state(self, state: dict) -> None:
+        if state.get("type") != "bagging":
+            return
+        self.key = jnp.asarray(np.asarray(state["key"], np.uint32))
+        if state.get("mask_key") is not None:
+            self._mask_key = jnp.asarray(
+                np.asarray(state["mask_key"], np.uint32))
+            # the live mask matters when resuming mid-window
+            # (bagging_freq > 1): regenerate it from the recorded subkey
+            self.cur_mask = self._make_mask(self._mask_key)
 
 
 class GossStrategy(SampleStrategy):
@@ -115,6 +151,13 @@ class GossStrategy(SampleStrategy):
             return grad, hess, None
         self.key, sub = jax.random.split(self.key)
         return _goss_mask(grad, hess, sub, c.top_rate, c.other_rate)
+
+    def get_state(self) -> dict:
+        return {"type": "goss", "key": np.asarray(self.key).tolist()}
+
+    def set_state(self, state: dict) -> None:
+        if state.get("type") == "goss":
+            self.key = jnp.asarray(np.asarray(state["key"], np.uint32))
 
 
 @functools.partial(jax.jit, static_argnames=("top_rate", "other_rate"))

@@ -14,7 +14,10 @@ Record types (``"type"`` field; full table in docs/observability.md):
   ``wall_s``, the per-phase exclusive-seconds map ``phases``, ``compiles``
   (total / steady-state / per-phase) and ``transfers`` counters.
 - ``event`` — anything punctual: steady-state recompile warnings, profiler
-  window start/stop, serve swaps, errors.
+  window start/stop, serve swaps, errors, and the guard layer's
+  ``guard_nonfinite`` diagnostics (lambdagap_tpu.guard: policy + iteration
+  when gradients/hessians/scores went non-finite — the last record a
+  ``guard_nonfinite=raise`` run writes before failing).
 
 Writes flush per line: a crashed run keeps every completed record (the
 whole point of a flight recorder).

@@ -66,6 +66,12 @@ def render_serve(snapshot: Dict) -> str:
              "Served feature rows", "counter")
     w.metric(p + "errors_total", snapshot.get("errors", 0),
              "Failed requests", "counter")
+    w.metric(p + "timeouts_total", snapshot.get("timeouts", 0),
+             "Requests shed before dispatch (deadline expired)", "counter")
+    w.metric(p + "rejected_total", snapshot.get("rejected", 0),
+             "Submits rejected by full-queue backpressure", "counter")
+    w.metric(p + "swap_failures_total", snapshot.get("swap_failures", 0),
+             "Hot-swaps that failed and rolled back", "counter")
     w.metric(p + "throughput_rps", snapshot.get("throughput_rps", 0.0),
              "Requests per second since start")
     w.metric(p + "throughput_rows_per_s",
@@ -103,6 +109,19 @@ def render_serve(snapshot: Dict) -> str:
     if "generation" in snapshot:
         w.metric(p + "generation", snapshot["generation"],
                  "Active model generation")
+    health = snapshot.get("health")
+    if health:
+        # enum-as-labeled-gauge: exactly one state samples 1
+        name = p + "health"
+        w.sample_header(name, "Serving health state (ok/degraded/draining)",
+                        "gauge")
+        for state in ("ok", "degraded", "draining"):
+            w.sample(name, 1 if health.get("state") == state else 0,
+                     {"state": state})
+        if "swap_breaker" in health:
+            name = p + "swap_breaker_open"
+            w.metric(name, 0 if health["swap_breaker"] == "closed" else 1,
+                     "Swap circuit breaker tripped (open or probing)")
     return w.text()
 
 

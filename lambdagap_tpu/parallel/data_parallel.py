@@ -242,7 +242,14 @@ class DataParallelTreeLearner(SerialTreeLearner):
                           root_out, fmask)}
         tree.leaf_value[0] = float(jax.device_get(root_out))
         tree.leaf_weight[0] = float(jax.device_get(totals[1]))
-        tree.leaf_count[0] = int(float(jax.device_get(totals[2])))
+        # NaN-tolerant count conversion (same contract as the serial
+        # learner): non-finite gradients must reach the guard's iteration
+        # boundary instead of crashing the host loop here
+        # graftlint: disable=R1 — root-stat D2H, one per tree: the
+        # host-loop distributed learner pays a documented per-split sync;
+        # this read shares that boundary
+        root_cnt = float(jax.device_get(totals[2]))
+        tree.leaf_count[0] = int(root_cnt) if np.isfinite(root_cnt) else 0
 
         def shard_scalars(vals: np.ndarray) -> jax.Array:
             return jax.device_put(jnp.asarray(vals.astype(np.int32)),

@@ -13,6 +13,8 @@ hot-swap (swap.py) and a serving metrics layer (stats.py), fronted by
 See docs/serving.md for bucket policy, swap semantics and the metrics
 schema.
 """
+from ..guard.degrade import (ServeOverloaded, ServeTimeout, SwapFailed,
+                             SwapRejected)
 from .batcher import MicroBatcher, Request
 from .cache import DEFAULT_BUCKETS, CompiledForestCache
 from .server import ForestServer, ServeResult, serve_loop
@@ -21,4 +23,5 @@ from .swap import SwapController, load_booster
 
 __all__ = ["ForestServer", "ServeResult", "serve_loop", "MicroBatcher",
            "Request", "CompiledForestCache", "DEFAULT_BUCKETS",
-           "ServeStats", "SwapController", "load_booster"]
+           "ServeStats", "SwapController", "load_booster",
+           "ServeOverloaded", "ServeTimeout", "SwapFailed", "SwapRejected"]

@@ -12,6 +12,18 @@ serve's throughput win (the compile-once half lives in cache.py).
 
 All device work happens on the worker thread; ``submit`` only enqueues, so
 any number of client threads can call it concurrently.
+
+Degradation contract (lambdagap_tpu.guard, docs/robustness.md): the queue
+is bounded by ``max_queue`` requests with a ``reject``-or-``block``
+backpressure policy (reject raises :class:`ServeOverloaded` at submit
+time); each request carries an optional deadline (``timeout_ms``) and is
+SHED before dispatch once expired — its future resolves with
+:class:`ServeTimeout` instead of wasting a device batch on a response
+nobody is waiting for. Submit-after-close raises immediately, and the
+submit/close race is closed by a mutex: a submit that won the race is
+strictly FIFO-before the shutdown sentinels, so its future always
+resolves. Every submitted future therefore terminates: result, error, or
+timeout — never a hang.
 """
 from __future__ import annotations
 
@@ -19,20 +31,29 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
-from typing import Callable, List
+from typing import Callable, List, Optional
 
 import numpy as np
+
+from ..guard.degrade import ServeOverloaded, ServeTimeout
 
 
 class Request:
     """One queued predict: rows + the future its caller waits on."""
 
-    __slots__ = ("x", "future", "t_submit")
+    __slots__ = ("x", "future", "t_submit", "deadline")
 
-    def __init__(self, x: np.ndarray) -> None:
+    def __init__(self, x: np.ndarray,
+                 deadline: Optional[float] = None) -> None:
         self.x = x
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        self.deadline = deadline         # absolute perf_counter time, or None
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.perf_counter())
+                >= self.deadline)
 
 
 _SENTINEL = object()
@@ -49,15 +70,27 @@ class MicroBatcher:
     def __init__(self, run_batch: Callable[[List[Request]], None],
                  max_batch: int = 4096, max_delay_ms: float = 2.0,
                  workers: int = 1, stats=None,
+                 max_queue: int = 0, backpressure: str = "reject",
+                 timeout_ms: float = 0.0, health=None,
                  name: str = "lambdagap-serve-batcher") -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if backpressure not in ("reject", "block"):
+            raise ValueError(f"unknown backpressure policy {backpressure!r}")
         self._run = run_batch
         self.max_batch = int(max_batch)
         self.max_delay = max(float(max_delay_ms), 0.0) / 1e3
+        self.timeout = max(float(timeout_ms), 0.0) / 1e3
+        self.backpressure = backpressure
         self.stats = stats
-        self._q: "queue.Queue" = queue.Queue()
+        self.health = health
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(int(max_queue), 0))
         self._closed = False
+        # serializes the closed-flag check against enqueue: a submit that
+        # saw _closed == False enqueued BEFORE close() put the sentinels,
+        # so FIFO guarantees a worker resolves it (the old check-then-put
+        # race could strand a future on a dead queue forever)
+        self._submit_lock = threading.Lock()
         # >1 workers overlap independent batch dispatches (jitted calls
         # release the GIL while executing); correctness is per-batch, so
         # workers share nothing but the queue and the stats lock
@@ -70,27 +103,57 @@ class MicroBatcher:
     # ------------------------------------------------------------------
     def submit(self, x: np.ndarray) -> Future:
         """Enqueue [n, D] float32 rows; returns the Future the worker will
-        resolve. Thread-safe."""
-        if self._closed:
-            raise RuntimeError("MicroBatcher is closed")
-        req = Request(x)
-        self._q.put(req)
-        return req.future
+        resolve. Thread-safe. Raises ``RuntimeError`` after close and
+        :class:`ServeOverloaded` when the bounded queue is full under the
+        ``reject`` policy (``block`` waits for space instead)."""
+        deadline = (time.perf_counter() + self.timeout
+                    if self.timeout > 0 else None)
+        req = Request(x, deadline=deadline)
+        while True:
+            with self._submit_lock:
+                if self._closed:
+                    raise RuntimeError("batcher closed")
+                try:
+                    self._q.put_nowait(req)
+                    return req.future
+                except queue.Full:
+                    if self.backpressure == "reject":
+                        if self.stats is not None:
+                            self.stats.record_rejected()
+                        raise ServeOverloaded(
+                            f"serve queue full ({self._q.maxsize} requests); "
+                            "retry later or raise serve_max_queue") from None
+            # block policy: wait for the workers to drain, outside the lock
+            # (never hold the submit lock across a blocking wait)
+            time.sleep(0.0005)
 
     def close(self, timeout: float = 30.0) -> None:
         """Stop accepting work, flush everything already queued, join the
         workers. Queued requests are never dropped: FIFO ordering puts the
         sentinels after every prior submit, and a worker that misses its
         sentinel still exits once the queue drains (closed + empty)."""
-        if self._closed:
-            return
-        self._closed = True
+        with self._submit_lock:
+            if self._closed:
+                return
+            self._closed = True
         for _ in self._threads:
+            # blocking put: on a bounded full queue, wait for the workers
+            # to make room (they are draining toward these sentinels)
             self._q.put(_SENTINEL)
         for t in self._threads:
             t.join(timeout)
 
     # ------------------------------------------------------------------
+    def _shed(self, req: Request) -> None:
+        """Resolve an expired request with ServeTimeout (pre-dispatch)."""
+        if not req.future.done():
+            waited = time.perf_counter() - req.t_submit
+            req.future.set_exception(ServeTimeout(
+                f"request deadline expired after {waited * 1e3:.1f}ms in "
+                "queue (serve_timeout_ms); shed before dispatch"))
+        if self.stats is not None:
+            self.stats.record_timeout()
+
     def _loop(self) -> None:
         drain = False
         while True:
@@ -102,6 +165,9 @@ class MicroBatcher:
                 continue
             if first is _SENTINEL:
                 break
+            if first.expired():
+                self._shed(first)
+                continue
             batch = [first]
             rows = first.x.shape[0]
             deadline = first.t_submit + self.max_delay
@@ -122,6 +188,9 @@ class MicroBatcher:
                 if nxt is _SENTINEL:
                     drain = True
                     break
+                if nxt.expired():
+                    self._shed(nxt)
+                    continue
                 batch.append(nxt)
                 rows += nxt.x.shape[0]
             self._dispatch(batch, rows)
@@ -129,13 +198,30 @@ class MicroBatcher:
                 break
 
     def _dispatch(self, batch: List[Request], rows: int) -> None:
+        # final shed pass: a request can expire between joining the batch
+        # window and the dispatch itself
+        now = time.perf_counter()
+        live = []
+        for r in batch:
+            if r.expired(now):
+                self._shed(r)
+            else:
+                live.append(r)
+        if not live:
+            return
         if self.stats is not None:
-            self.stats.record_batch(len(batch), rows)
+            self.stats.record_batch(len(live), sum(r.x.shape[0]
+                                                   for r in live))
         try:
-            self._run(batch)
+            self._run(live)
         except BaseException as e:  # noqa: BLE001 — worker must survive
-            for r in batch:
+            for r in live:
                 if not r.future.done():
                     r.future.set_exception(e)
             if self.stats is not None:
                 self.stats.record_error()
+            if self.health is not None:
+                self.health.note_error()
+        else:
+            if self.health is not None:
+                self.health.note_ok()

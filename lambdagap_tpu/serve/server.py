@@ -24,6 +24,8 @@ from typing import List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
+from ..guard.degrade import CircuitBreaker, HealthMonitor
+from ..guard.faults import plan_for
 from ..utils import log
 from .batcher import MicroBatcher, Request
 from .cache import DEFAULT_BUCKETS, CompiledForestCache
@@ -52,7 +54,11 @@ class ForestServer:
                  warmup: Optional[bool] = None,
                  raw_score: bool = False,
                  start_iteration: int = 0, num_iteration: int = -1,
-                 stats: Optional[ServeStats] = None) -> None:
+                 stats: Optional[ServeStats] = None,
+                 max_queue: Optional[int] = None,
+                 backpressure: Optional[str] = None,
+                 timeout_ms: Optional[float] = None,
+                 swap_breaker: Optional[int] = None) -> None:
         gbdt = model._booster if hasattr(model, "_booster") else model
         cfg = gbdt.config
         self.raw_score = bool(raw_score)
@@ -63,7 +69,13 @@ class ForestServer:
         self._ni = int(num_iteration)
         self.stats = stats if stats is not None else ServeStats()
         self._closed = False
-        self._swap = SwapController(self._build_cache, stats=self.stats)
+        self._faults = plan_for(cfg)
+        breaker = CircuitBreaker(
+            threshold=int(cfg.serve_swap_breaker if swap_breaker is None
+                          else swap_breaker))
+        self.health = HealthMonitor(breaker=breaker)
+        self._swap = SwapController(self._build_cache, stats=self.stats,
+                                    breaker=breaker)
         self._swap.install(gbdt)
         nw = int(cfg.serve_workers if workers is None else workers)
         if nw <= 0:                      # auto: overlap dispatches, bounded
@@ -76,7 +88,14 @@ class ForestServer:
             max_delay_ms=float(cfg.serve_max_delay_ms if max_delay_ms is None
                                else max_delay_ms),
             workers=nw,
-            stats=self.stats)
+            stats=self.stats,
+            max_queue=int(cfg.serve_max_queue if max_queue is None
+                          else max_queue),
+            backpressure=(cfg.serve_backpressure if backpressure is None
+                          else backpressure),
+            timeout_ms=float(cfg.serve_timeout_ms if timeout_ms is None
+                             else timeout_ms),
+            health=self.health)
 
     # ------------------------------------------------------------------
     def _build_cache(self, gbdt, generation: int) -> CompiledForestCache:
@@ -131,6 +150,7 @@ class ForestServer:
         snap["generation"] = self.generation
         snap["buckets"] = list(self._swap.active.buckets)
         snap["engine"] = getattr(self._swap.active, "engine", "scan")
+        snap["health"] = self.health.snapshot()
         return snap
 
     def stats_json(self, **kwargs) -> str:
@@ -146,9 +166,11 @@ class ForestServer:
         return prom.render_serve(self.stats_snapshot())
 
     def close(self, timeout: float = 30.0) -> None:
-        """Flush queued requests and stop the batcher thread."""
+        """Flush queued requests and stop the batcher thread. Health
+        reports DRAINING from the first close() call onward."""
         if not self._closed:
             self._closed = True
+            self.health.set_draining()
             self._batcher.close(timeout)
 
     def __enter__(self) -> "ForestServer":
@@ -162,6 +184,7 @@ class ForestServer:
         """Worker-thread batch execution: snapshot the active generation
         once, validate widths against it, run ONE padded dispatch, scatter
         results back to futures."""
+        self._faults.dispatch_fault()    # inert unless a fault plan is armed
         slot = self._swap.active         # one generation per batch
         t0 = time.perf_counter()
         W = slot.width
@@ -232,8 +255,15 @@ def serve_loop(server: ForestServer, lines, out_stream,
             stats_stream.flush()
             continue
         if line.startswith("swap="):
+            from ..guard.degrade import SwapFailed, SwapRejected
             target = line.split("=", 1)[1].strip()
-            gen = server.swap(target)
+            try:
+                gen = server.swap(target)
+            except (SwapFailed, SwapRejected) as e:
+                # degraded, not dead: the active generation keeps serving
+                # (stats carry swap_failures + the breaker state)
+                log.warning("serve loop: %s", e)
+                continue
             if on_swap is not None:
                 on_swap(target, gen)
             continue

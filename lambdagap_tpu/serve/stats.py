@@ -36,6 +36,9 @@ class ServeStats:
         self.n_dispatch_rows = 0
         self.dispatch_device_s = 0.0
         self.n_errors = 0
+        self.n_timeouts = 0
+        self.n_rejected = 0
+        self.n_swap_failures = 0
         self.cache_hits = 0
         self.cache_misses = 0
         self.per_bucket: Dict[int, Dict[str, int]] = {}
@@ -76,6 +79,22 @@ class ServeStats:
         with self._lock:
             self.n_errors += 1
 
+    def record_timeout(self) -> None:
+        """A request shed before dispatch (deadline expired in queue)."""
+        with self._lock:
+            self.n_timeouts += 1
+
+    def record_rejected(self) -> None:
+        """A submit refused by full-queue backpressure (reject policy)."""
+        with self._lock:
+            self.n_rejected += 1
+
+    def record_swap_failure(self) -> None:
+        """A hot-swap that failed to build/compile; the previous
+        generation kept serving (rollback)."""
+        with self._lock:
+            self.n_swap_failures += 1
+
     def record_cache(self, hit: bool, bucket: Optional[int] = None) -> None:
         with self._lock:
             if hit:
@@ -112,6 +131,9 @@ class ServeStats:
                 "requests": self.n_requests,
                 "rows": self.n_rows,
                 "errors": self.n_errors,
+                "timeouts": self.n_timeouts,
+                "rejected": self.n_rejected,
+                "swap_failures": self.n_swap_failures,
                 "elapsed_s": elapsed,
                 "throughput_rps": self.n_requests / elapsed,
                 "throughput_rows_per_s": self.n_rows / elapsed,

@@ -16,11 +16,21 @@ In-flight batches therefore finish on the forest they started with and new
 batches see the new one: no request is ever dropped, and none can observe
 a torn mix of generations — every response carries exactly one
 generation's predictions.
+
+Failure semantics (lambdagap_tpu.guard, docs/robustness.md): a swap whose
+load/compile raises never touches ``active`` — rollback is structural, the
+old generation simply keeps serving — and the failure feeds a
+consecutive-failure circuit breaker. With the circuit open, further swaps
+are rejected fast (:class:`~lambdagap_tpu.guard.SwapRejected`) until the
+cooldown admits a probe, so a flapping model publisher cannot convoy the
+serving path behind repeated doomed compiles.
 """
 from __future__ import annotations
 
 import threading
 from typing import Callable, Optional
+
+from ..guard.degrade import CircuitBreaker, SwapFailed, SwapRejected
 
 
 def load_booster(source, params=None, config=None):
@@ -46,9 +56,11 @@ class SwapController:
     serializes writers (concurrent swaps apply in call order).
     """
 
-    def __init__(self, build_cache: Callable, stats=None) -> None:
+    def __init__(self, build_cache: Callable, stats=None,
+                 breaker: Optional[CircuitBreaker] = None) -> None:
         self._build = build_cache        # (gbdt, generation) -> cache
         self._stats = stats
+        self.breaker = breaker if breaker is not None else CircuitBreaker(0)
         self._swap_lock = threading.Lock()
         self.active = None               # CompiledForestCache
 
@@ -72,19 +84,37 @@ class SwapController:
         Synchronous by default: returns the new generation once the flip
         happened. ``background=True`` runs load+warm+flip on a daemon
         thread and returns it immediately (serving continues on the old
-        generation until the flip)."""
+        generation until the flip).
+
+        A failed load/compile raises :class:`SwapFailed` WITHOUT touching
+        the active generation (rollback by construction) and trips the
+        circuit breaker; an open circuit rejects the swap up front with
+        :class:`SwapRejected`."""
 
         def work() -> int:
-            gbdt = load_booster(source, params)
-            with self._swap_lock:
-                gen = self.active.generation + 1
-                # graftlint: disable=R5 — deliberate, same as install():
-                # writer-only lock; the serving path never contends on it
-                cache = self._build(gbdt, gen)
-                self.active = cache      # atomic flip
+            from ..utils import log
+            if not self.breaker.allow():
+                raise SwapRejected(
+                    "swap circuit open after "
+                    f"{self.breaker.consecutive_failures} consecutive "
+                    "failures; serving continues on generation "
+                    f"{self.active.generation} (cooldown "
+                    f"{self.breaker.cooldown_s:g}s)")
+            try:
+                gbdt = load_booster(source, params)
+                with self._swap_lock:
+                    gen = self.active.generation + 1
+                    # graftlint: disable=R5 — deliberate, same as install():
+                    # writer-only lock; the serving path never contends on it
+                    cache = self._build(gbdt, gen)
+                    self.active = cache      # atomic flip
+            except Exception as e:
+                self._swap_failed(e)
+                raise SwapFailed(f"swap failed ({e}); serving continues on "
+                                 f"generation {self.active.generation}") from e
+            self.breaker.record_success()
             if self._stats is not None:
                 self._stats.record_swap()
-            from ..utils import log
             log.info("serve: swapped to generation %d (%s engine, "
                      "pre-warmed before the flip)", gen,
                      getattr(cache, "engine", "?"))
@@ -96,3 +126,13 @@ class SwapController:
             t.start()
             return t
         return work()
+
+    def _swap_failed(self, exc) -> None:
+        from ..utils import log
+        self.breaker.record_failure()
+        if self._stats is not None:
+            self._stats.record_swap_failure()
+        log.warning("serve: model swap failed (%s); the active generation %d "
+                  "keeps serving (breaker: %s)", exc,
+                  self.active.generation if self.active is not None else -1,
+                  self.breaker.state())

@@ -1,0 +1,46 @@
+"""R8 fixture: future/exception discipline in serve batch runners."""
+
+
+def swallow_everything(probe):
+    try:
+        probe()
+    except Exception:  # BAD:R8 — swallowed: no log, no counter, no re-raise
+        pass
+
+
+def run_batch_loses_futures(batch, predict):
+    """Resolves futures on success, but the except path exits the runner
+    without resolving anything: every caller in the batch hangs."""
+    try:
+        out = predict([r.x for r in batch])
+        for r, y in zip(batch, out):
+            r.future.set_result(y)
+    except RuntimeError as e:  # BAD:R8 — futures never resolved on error
+        log_error(e)
+
+
+def run_batch_resolves_futures(batch, predict):
+    """GOOD: the except path fans the error out to every future."""
+    try:
+        out = predict([r.x for r in batch])
+        for r, y in zip(batch, out):
+            r.future.set_result(y)
+    except RuntimeError as e:
+        for r in batch:
+            if not r.future.done():
+                r.future.set_exception(e)
+
+
+def run_batch_reraises(batch, predict):
+    """GOOD: the except path propagates to a resolving caller."""
+    try:
+        out = predict([r.x for r in batch])
+        for r, y in zip(batch, out):
+            r.future.set_result(y)
+    except RuntimeError:
+        log_error("dispatch failed")
+        raise
+
+
+def log_error(e):
+    print("error:", e)

@@ -1,0 +1,295 @@
+"""lambdagap_tpu.guard — training-side fault tolerance.
+
+Covers the ISSUE-5 acceptance surface: atomic snapshot writes with a state
+sidecar + trailing checksum (torn/corrupt snapshots detected and skipped),
+SIGKILL-mid-train auto-resume producing a model identical to the
+uninterrupted run, and the guard_nonfinite policy trio (raise emits a
+diagnostic event then fails; skip_tree drops the iteration and keeps state
+bit-consistent; clip keeps training finite).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.guard import (NonFiniteError, SnapshotError,
+                                 latest_snapshot, read_snapshot)
+from lambdagap_tpu.guard.snapshot import (atomic_write_text, capture_state,
+                                          compose_snapshot, snapshot_path)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PARAMS = {"objective": "regression", "verbose": -1, "min_data_in_leaf": 5}
+
+
+def _data(n=400, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 8).astype(np.float32)
+    y = (X[:, 0] * 2 - X[:, 1] + 0.1 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+def _trees(booster) -> str:
+    """Model text up to 'end of trees' (the parameters echo differs by
+    construction between guard configs; the trees are the model)."""
+    return booster.model_to_string().split("end of trees")[0]
+
+
+# -- snapshot format ----------------------------------------------------
+def test_atomic_write_leaves_no_tmp(tmp_path):
+    p = str(tmp_path / "out.txt")
+    atomic_write_text(p, "hello\n")
+    assert open(p).read() == "hello\n"
+    assert os.listdir(tmp_path) == ["out.txt"]
+
+
+def test_snapshot_roundtrip_and_checksum(tmp_path):
+    X, y = _data()
+    b = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=3)
+    gb = b._booster
+    state = capture_state(gb)
+    assert state["iteration"] == 3
+    p = str(tmp_path / "m.txt.snapshot_iter_3")
+    atomic_write_text(p, compose_snapshot(gb.save_model_to_string(), state))
+    model_text, state2 = read_snapshot(p)
+    assert state2 == json.loads(json.dumps(state))
+    from lambdagap_tpu.models.gbdt import GBDT
+    loaded = GBDT.from_model_string(model_text)
+    assert len(loaded.models) == 3
+
+
+@pytest.mark.parametrize("corruption", ["truncate", "flip", "no_trailer"])
+def test_torn_snapshot_detected(tmp_path, corruption):
+    X, y = _data()
+    b = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=2)
+    p = str(tmp_path / "m.txt.snapshot_iter_2")
+    data = compose_snapshot(b._booster.save_model_to_string(),
+                            capture_state(b._booster))
+    if corruption == "truncate":
+        data = data[: len(data) // 2]
+    elif corruption == "flip":
+        data = data.replace("leaf_value=", "leaf_value=9", 1)
+    else:
+        data = data[: data.rindex("!snapshot_state=")]
+    with open(p, "w") as f:
+        f.write(data)
+    with pytest.raises(SnapshotError):
+        read_snapshot(p)
+
+
+def test_latest_snapshot_skips_corrupt_falls_back_to_older(tmp_path):
+    X, y = _data()
+    out = str(tmp_path / "model.txt")
+    b2 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=2)
+    b3 = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=3)
+    atomic_write_text(snapshot_path(out, 2), compose_snapshot(
+        b2._booster.save_model_to_string(), capture_state(b2._booster)))
+    # newest snapshot torn mid-write: checksum must reject it
+    good = compose_snapshot(b3._booster.save_model_to_string(),
+                            capture_state(b3._booster))
+    with open(snapshot_path(out, 3), "w") as f:
+        f.write(good[: len(good) // 2])
+    found = latest_snapshot(out)
+    assert found is not None
+    path, _, state = found
+    assert path.endswith("iter_2") and state["iteration"] == 2
+    # with the torn file repaired, the newer snapshot wins
+    atomic_write_text(snapshot_path(out, 3), good)
+    assert latest_snapshot(out)[2]["iteration"] == 3
+
+
+def test_torn_snapshot_fault_point(tmp_path):
+    """The torn_snapshot fault writes a checksum-less half file in place;
+    resume must skip it."""
+    X, y = _data()
+    out = str(tmp_path / "m.txt")
+    lgb.train({**PARAMS, "snapshot_freq": 1, "output_model": out,
+               "guard_faults": "torn_snapshot=3"},
+              lgb.Dataset(X, label=y), num_boost_round=3)
+    with pytest.raises(SnapshotError):
+        read_snapshot(snapshot_path(out, 3))
+    assert latest_snapshot(out)[2]["iteration"] == 2
+
+
+# -- non-finite policies ------------------------------------------------
+def test_nonfinite_raise_policy_and_event(tmp_path):
+    X, y = _data()
+    run_log = str(tmp_path / "run.jsonl")
+    with pytest.raises(NonFiniteError):
+        lgb.train({**PARAMS, "guard_faults": "nonfinite_grad=1",
+                   "telemetry_out": run_log},
+                  lgb.Dataset(X, label=y), num_boost_round=4)
+    events = [json.loads(ln) for ln in open(run_log) if ln.strip()]
+    guard_events = [e for e in events if e.get("event") == "guard_nonfinite"]
+    assert len(guard_events) == 1
+    assert guard_events[0]["policy"] == "raise"
+    assert guard_events[0]["iter"] == 1
+
+
+def test_nonfinite_skip_tree_is_state_consistent():
+    """skip_tree drops the poisoned iteration and restores scores exactly:
+    the remaining trees match a clean run with one fewer round."""
+    X, y = _data()
+    b = lgb.train({**PARAMS, "guard_nonfinite": "skip_tree",
+                   "guard_faults": "nonfinite_grad=2"},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    assert b.num_trees() == 4
+    ref = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=4)
+    assert _trees(b) == _trees(ref)
+    assert np.array_equal(b.predict(X[:50]), ref.predict(X[:50]))
+
+
+def test_nonfinite_skip_tree_fused_learner():
+    X, y = _data()
+    fused = {**PARAMS, "tpu_fused_learner": "1"}
+    b = lgb.train({**fused, "guard_nonfinite": "skip_tree",
+                   "guard_faults": "nonfinite_grad=2"},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    ref = lgb.train(fused, lgb.Dataset(X, label=y), num_boost_round=4)
+    assert _trees(b) == _trees(ref)
+
+
+def test_nonfinite_skip_tree_dart():
+    X, y = _data()
+    b = lgb.train({**PARAMS, "boosting": "dart",
+                   "guard_nonfinite": "skip_tree",
+                   "guard_faults": "nonfinite_grad=2"},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    assert b.num_trees() == 4
+    assert np.all(np.isfinite(b.predict(X[:50])))
+
+
+def test_nonfinite_clip_policy_finishes_finite():
+    X, y = _data()
+    b = lgb.train({**PARAMS, "guard_nonfinite": "clip",
+                   "guard_faults": "nonfinite_grad=1"},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+    assert np.all(np.isfinite(b.predict(X[:50])))
+
+
+def test_guard_off_policy_unchecked():
+    """off must not add any sentinel: a clean run's trees are identical to
+    the default-guard run (the guard only acts on non-finite input)."""
+    X, y = _data()
+    b_off = lgb.train({**PARAMS, "guard_nonfinite": "off"},
+                      lgb.Dataset(X, label=y), num_boost_round=4)
+    b_on = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=4)
+    assert _trees(b_off) == _trees(b_on)
+
+
+# -- engine-level resume ------------------------------------------------
+def test_engine_train_resume_auto_bit_consistent(tmp_path):
+    """train(resume='auto') picks up the newest snapshot and finishes
+    bit-identically to an uninterrupted run (bagging RNG restored from the
+    sidecar; boost_from_average=false keeps the replay addition order)."""
+    X, y = _data(600)
+    out = str(tmp_path / "model.txt")
+    p = {**PARAMS, "boost_from_average": False, "bagging_fraction": 0.7,
+         "bagging_freq": 1, "output_model": out, "snapshot_freq": 2}
+    lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=4)
+    assert latest_snapshot(out)[2]["iteration"] == 4
+    resumed = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=8,
+                        resume="auto")
+    assert resumed.num_trees() == 8
+    ref = lgb.train({k: v for k, v in p.items() if k != "snapshot_freq"},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    assert _trees(resumed) == _trees(ref)
+
+
+def test_engine_resume_restores_early_stopping_state(tmp_path):
+    """The early-stopping bests ride the sidecar: a resumed run counts
+    patience from the recorded best instead of restarting it."""
+    X, y = _data(600)
+    Xv, yv = _data(200, seed=9)
+    out = str(tmp_path / "model.txt")
+    p = {**PARAMS, "boost_from_average": False, "output_model": out,
+         "snapshot_freq": 1, "early_stopping_round": 3, "metric": "l2"}
+    ds = lgb.Dataset(X, label=y)
+    b1 = lgb.train(p, ds, num_boost_round=4,
+                   valid_sets=[ds.create_valid(Xv, label=yv)])
+    found = latest_snapshot(out)
+    assert found is not None
+    es = found[2].get("early_stop")
+    assert es and es["best_score"], "sidecar must carry early-stop bests"
+    resumed = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=8,
+                        resume="auto",
+                        valid_sets=[lgb.Dataset(X, label=y).create_valid(
+                            Xv, label=yv)])
+    ref = lgb.train({k: v for k, v in p.items() if k != "snapshot_freq"},
+                    lgb.Dataset(X, label=y), num_boost_round=8,
+                    valid_sets=[lgb.Dataset(X, label=y).create_valid(
+                        Xv, label=yv)])
+    assert resumed.best_iteration == ref.best_iteration
+    assert _trees(resumed) == _trees(ref)
+
+
+# -- SIGKILL + CLI auto-resume (the acceptance test) --------------------
+def _cli(args, tmp_path, faults=""):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    if faults:
+        env["LAMBDAGAP_FAULTS"] = faults
+    else:
+        env.pop("LAMBDAGAP_FAULTS", None)
+    return subprocess.run([sys.executable, "-m", "lambdagap_tpu", *args],
+                          cwd=str(tmp_path), env=env, capture_output=True,
+                          text=True, timeout=300)
+
+
+def test_sigkill_mid_train_auto_resume_identical_model(tmp_path):
+    """SIGKILL a CLI train mid-run (crash-at-iteration fault), rerun with
+    resume=auto, and require the final model text to match the
+    uninterrupted run's trees byte-for-byte."""
+    X, y = _data(500, seed=3)
+    np.savetxt(str(tmp_path / "train.csv"),
+               np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+    args = ["task=train", "data=train.csv", "label_column=0",
+            "objective=regression", "boost_from_average=false",
+            "num_iterations=6", "snapshot_freq=1", "bagging_fraction=0.7",
+            "bagging_freq=1", "min_data_in_leaf=5", "verbose=1",
+            "resume=auto"]
+    r = _cli(args + ["output_model=m_crash.txt"], tmp_path,
+             faults="crash_at_iter=3")
+    assert r.returncode == -9, f"expected SIGKILL, got {r.returncode}: " \
+        f"{r.stdout}\n{r.stderr}"
+    assert not (tmp_path / "m_crash.txt").exists()
+    snaps = sorted(tmp_path.glob("m_crash.txt.snapshot_iter_*"))
+    assert snaps, "crash must leave snapshots behind"
+
+    r = _cli(args + ["output_model=m_crash.txt"], tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Resumed from snapshot" in r.stdout + r.stderr
+
+    r = _cli(args + ["output_model=m_ref.txt"], tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    resumed = (tmp_path / "m_crash.txt").read_text()
+    ref = (tmp_path / "m_ref.txt").read_text()
+    split = "end of trees"
+    assert resumed.split(split)[0] == ref.split(split)[0], \
+        "resumed model trees must be byte-identical to the uninterrupted run"
+
+
+def test_cli_resume_skips_torn_final_snapshot(tmp_path):
+    """A snapshot torn by the crash is rejected by its checksum and the
+    previous good snapshot is used."""
+    X, y = _data(300, seed=5)
+    np.savetxt(str(tmp_path / "train.csv"),
+               np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+    args = ["task=train", "data=train.csv", "label_column=0",
+            "objective=regression", "boost_from_average=false",
+            "num_iterations=4", "snapshot_freq=1", "min_data_in_leaf=5",
+            "verbose=1", "resume=auto", "output_model=m.txt"]
+    r = _cli(args, tmp_path, faults="crash_at_iter=3,torn_snapshot=3")
+    assert r.returncode == -9
+    r = _cli(args, tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = r.stdout + r.stderr
+    assert "skipping invalid snapshot" in out
+    assert "snapshot_iter_2" in out          # fell back to the older one
+    assert "Resumed from snapshot" in out
+    final = (tmp_path / "m.txt").read_text()
+    assert final.count("Tree=") == 4         # still completed all rounds

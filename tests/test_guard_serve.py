@@ -1,0 +1,295 @@
+"""lambdagap_tpu.guard x serve — degradation-aware serving.
+
+The acceptance invariant: under fault injection, EVERY submitted future
+resolves — with a result, a ``ServeTimeout``, or an error — within its
+deadline; nothing ever hangs a caller. Covers bounded-queue backpressure
+(reject and block), pre-dispatch deadline shedding, swap-failure rollback
+with the circuit breaker, and the OK/DEGRADED/DRAINING health state.
+"""
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.guard.degrade import (CircuitBreaker, HealthMonitor,
+                                         ServeOverloaded, ServeTimeout,
+                                         SwapFailed, SwapRejected)
+from lambdagap_tpu.serve.batcher import MicroBatcher
+
+
+def _train(rounds=6, seed=0, **extra):
+    X, y = make_classification(800, 10, n_informative=5, random_state=seed)
+    X = X.astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "tpu_fast_predict_rows": 0, **extra}
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds), X
+
+
+# -- circuit breaker unit -----------------------------------------------
+def test_circuit_breaker_states():
+    t = [0.0]
+    br = CircuitBreaker(threshold=2, cooldown_s=10.0, clock=lambda: t[0])
+    assert br.state() == "closed" and br.allow()
+    br.record_failure()
+    assert br.state() == "closed"
+    br.record_failure()
+    assert br.state() == "open" and not br.allow()
+    t[0] = 11.0
+    assert br.state() == "half_open"
+    assert br.allow()                    # the probe
+    assert not br.allow()                # only one probe per cooldown
+    br.record_success()
+    assert br.state() == "closed" and br.allow()
+
+
+def test_breaker_disabled_at_zero_threshold():
+    br = CircuitBreaker(threshold=0)
+    for _ in range(10):
+        br.record_failure()
+    assert br.state() == "closed" and br.allow()
+
+
+def test_health_monitor_transitions():
+    br = CircuitBreaker(threshold=1)
+    h = HealthMonitor(breaker=br)
+    assert h.state() == "ok"
+    h.note_error()
+    assert h.state() == "degraded"
+    h.note_ok()
+    assert h.state() == "ok"
+    br.record_failure()
+    assert h.state() == "degraded"       # breaker open
+    br.record_success()
+    assert h.state() == "ok"
+    h.set_draining()
+    assert h.state() == "draining"
+
+
+# -- bounded queue / backpressure ---------------------------------------
+def _echo_batcher(delay=0.0, **kw):
+    def run(batch):
+        if delay:
+            time.sleep(delay)
+        for r in batch:
+            r.future.set_result(r.x.sum())
+    return MicroBatcher(run, max_batch=4, max_delay_ms=1.0, workers=1, **kw)
+
+
+def test_reject_backpressure_raises_and_accepted_all_resolve():
+    mb = _echo_batcher(delay=0.05, max_queue=2, backpressure="reject")
+    futures, rejected = [], 0
+    try:
+        for i in range(50):
+            try:
+                futures.append(mb.submit(np.ones((1, 4), np.float32)))
+            except ServeOverloaded:
+                rejected += 1
+    finally:
+        mb.close()
+    assert rejected > 0, "a 2-deep queue must reject under burst"
+    for f in futures:
+        assert f.result(timeout=10) == 4.0   # every accepted future resolves
+
+
+def test_block_backpressure_never_rejects():
+    mb = _echo_batcher(delay=0.01, max_queue=2, backpressure="block")
+    futures = [mb.submit(np.ones((1, 4), np.float32)) for _ in range(30)]
+    for f in futures:
+        assert f.result(timeout=10) == 4.0
+    mb.close()
+
+
+# -- deadlines ----------------------------------------------------------
+def test_expired_requests_shed_with_serve_timeout():
+    """With a slow dispatcher and a short deadline, queued requests time
+    out BEFORE dispatch and resolve with ServeTimeout promptly."""
+    dispatched = []
+
+    def run(batch):
+        dispatched.extend(batch)
+        time.sleep(0.15)
+        for r in batch:
+            r.future.set_result(1.0)
+
+    mb = MicroBatcher(run, max_batch=1, max_delay_ms=0.0, workers=1,
+                      timeout_ms=50.0)
+    futures = [mb.submit(np.ones((1, 2), np.float32)) for _ in range(8)]
+    t0 = time.perf_counter()
+    outcomes = []
+    for f in futures:
+        try:
+            outcomes.append(("ok", f.result(timeout=10)))
+        except ServeTimeout:
+            outcomes.append(("timeout", None))
+    elapsed = time.perf_counter() - t0
+    mb.close()
+    kinds = [k for k, _ in outcomes]
+    assert "ok" in kinds and "timeout" in kinds
+    # shed requests never reached the dispatcher
+    assert len(dispatched) < len(futures)
+    # and every future resolved without waiting for 8 full dispatches
+    assert elapsed < 8 * 0.15
+
+
+def test_server_timeout_ms_end_to_end():
+    """serve_timeout_ms + a slowed dispatch (fault point): some requests
+    serve, the rest shed with ServeTimeout — all resolve, none hang."""
+    b, X = _train(guard_faults="serve_dispatch_slow_ms=120")
+    s = b.as_server(buckets=(8,), timeout_ms=40.0, max_delay_ms=0.0,
+                    workers=1)
+    try:
+        futures = [s.submit(X[i]) for i in range(6)]
+        resolved = 0
+        for f in futures:
+            try:
+                f.result(timeout=10)
+                resolved += 1
+            except ServeTimeout:
+                pass
+        assert resolved >= 1
+        snap = s.stats_snapshot()
+        assert snap["timeouts"] + resolved == 6
+    finally:
+        s.close()
+
+
+# -- dispatch faults + health -------------------------------------------
+def test_dispatch_failures_degrade_then_recover():
+    b, X = _train(guard_faults="serve_dispatch_fail=2")
+    s = b.as_server(buckets=(8,), max_delay_ms=0.0, workers=1)
+    try:
+        assert s.health.state() == "ok"
+        failures = 0
+        for i in range(2):
+            fut = s.submit(X[i])
+            with pytest.raises(Exception):
+                fut.result(timeout=10)
+            failures += 1
+        assert failures == 2
+        assert s.health.state() == "degraded"
+        assert s.stats_snapshot()["health"]["state"] == "degraded"
+        # faults exhausted: the next dispatch succeeds and health recovers
+        out = s.submit(X[0]).result(timeout=10)
+        assert np.all(np.isfinite(out.values))
+        assert s.health.state() == "ok"
+    finally:
+        s.close()
+    assert s.health.state() == "draining"
+    assert s.stats_snapshot()["errors"] >= 1
+
+
+def test_prometheus_exposes_health_and_shed_counters():
+    b, X = _train()
+    with b.as_server(buckets=(8,)) as s:
+        s.predict(X[:8])
+        live = s.prometheus()
+    assert 'lambdagap_serve_health{state="ok"} 1' in live
+    text = s.prometheus()                # post-close: draining
+    assert 'lambdagap_serve_health{state="draining"} 1' in text
+    assert "lambdagap_serve_timeouts_total 0" in text
+    assert "lambdagap_serve_rejected_total 0" in text
+    assert "lambdagap_serve_swap_failures_total 0" in text
+
+
+# -- swap failure rollback + breaker ------------------------------------
+def test_swap_failure_rolls_back_and_serving_continues(tmp_path):
+    b, X = _train()
+    ref = b.predict(X[:600])[:16]        # >512 rows -> device path (serve-parity)
+    s = b.as_server(buckets=(8, 16), swap_breaker=3)
+    try:
+        with pytest.raises(SwapFailed):
+            s.swap(str(tmp_path / "missing_model.txt"))
+        assert s.generation == 0          # rollback: old forest kept
+        got = s.predict(X[:16])
+        assert np.array_equal(got, ref)
+        snap = s.stats_snapshot()
+        assert snap["swap_failures"] == 1
+        assert snap["swaps"] == 0
+    finally:
+        s.close()
+
+
+def test_swap_breaker_opens_after_consecutive_failures(tmp_path):
+    b, X = _train()
+    b2, _ = _train(rounds=4, seed=5)
+    good = str(tmp_path / "good.txt")
+    b2.save_model(good)
+    s = b.as_server(buckets=(8,), swap_breaker=2)
+    try:
+        for _ in range(2):
+            with pytest.raises(SwapFailed):
+                s.swap(str(tmp_path / "nope.txt"))
+        assert s.health.state() == "degraded"
+        # circuit open: swaps now rejected FAST without touching the loader
+        with pytest.raises(SwapRejected):
+            s.swap(good)
+        assert s.stats_snapshot()["health"]["swap_breaker"] == "open"
+        # requests keep being served while degraded
+        assert np.all(np.isfinite(s.predict(X[:8])))
+        # cooldown elapsed -> half-open probe succeeds -> breaker closes
+        s._swap.breaker.cooldown_s = 0.0
+        gen = s.swap(good)
+        assert gen == 1
+        assert s.health.state() == "ok"
+        assert s.stats_snapshot()["swaps"] == 1
+    finally:
+        s.close()
+
+
+def test_futures_resolve_during_swap_failure_storm(tmp_path):
+    """Concurrent clients + a failing swap loop: every submitted future
+    resolves; no response mixes generations."""
+    b, X = _train()
+    ref = b.predict(X[:600])[:64]        # device-path reference
+    s = b.as_server(buckets=(1, 8, 64), max_delay_ms=1.0, swap_breaker=0)
+    errors, done = [], []
+    stop = threading.Event()
+
+    def client(cid):
+        i = cid
+        while not stop.is_set():
+            try:
+                r = s.submit(X[i % 64]).result(timeout=30)
+                assert np.array_equal(r.values, ref[i % 64:i % 64 + 1])
+                done.append(i)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+            i += 3
+    threads = [threading.Thread(target=client, args=(c,)) for c in range(3)]
+    for t in threads:
+        t.start()
+    for _ in range(4):
+        with pytest.raises(SwapFailed):
+            s.swap(str(tmp_path / "missing.txt"))
+        time.sleep(0.02)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    s.close()
+    assert not errors
+    assert len(done) > 0
+    assert s.stats_snapshot()["swap_failures"] == 4
+    assert s.generation == 0
+
+
+def test_serve_loop_survives_swap_failure(tmp_path):
+    from lambdagap_tpu.serve import serve_loop
+    import io
+    b, X = _train()
+    lines = ["\t".join(f"{v:.6g}" for v in X[0]),
+             f"swap={tmp_path}/missing.txt",
+             "\t".join(f"{v:.6g}" for v in X[1])]
+    out = io.StringIO()
+    s = b.as_server(buckets=(1, 8))
+    try:
+        n = serve_loop(s, lines, out)
+    finally:
+        s.close()
+    assert n == 2                        # both requests served, swap logged
+    assert s.stats_snapshot()["swap_failures"] == 1

@@ -226,6 +226,59 @@ def test_cli_task_serve_roundtrip(tmp_path):
     assert "p99" in snap["latency_ms"]
 
 
+def test_batcher_submit_after_close_raises():
+    """A post-close submit must fail fast, never enqueue onto the dead
+    queue (the old behavior hung the caller's future forever)."""
+    from lambdagap_tpu.serve.batcher import MicroBatcher
+
+    def run(batch):
+        for r in batch:
+            r.future.set_result(r.x.sum())
+    mb = MicroBatcher(run, max_batch=8, max_delay_ms=0.5)
+    fut = mb.submit(np.ones((1, 3), np.float32))
+    assert fut.result(timeout=10) == 3.0
+    mb.close()
+    with pytest.raises(RuntimeError, match="batcher closed"):
+        mb.submit(np.ones((1, 3), np.float32))
+
+
+def test_batcher_close_submit_race_never_hangs():
+    """Hammer submit() from several threads while close() lands mid-burst:
+    every submit either raises 'batcher closed' or returns a future that
+    RESOLVES — no future may hang on the drained queue."""
+    from lambdagap_tpu.serve.batcher import MicroBatcher
+
+    def run(batch):
+        for r in batch:
+            r.future.set_result(float(r.x.sum()))
+
+    for trial in range(10):
+        mb = MicroBatcher(run, max_batch=4, max_delay_ms=0.2, workers=2)
+        futures, raised = [], []
+        barrier = threading.Barrier(4)
+
+        def submitter():
+            barrier.wait()
+            for _ in range(50):
+                try:
+                    futures.append(mb.submit(np.ones((1, 2), np.float32)))
+                except RuntimeError as e:
+                    assert "batcher closed" in str(e)
+                    raised.append(e)
+                    return
+
+        threads = [threading.Thread(target=submitter) for _ in range(3)]
+        for t in threads:
+            t.start()
+        barrier.wait()                   # close lands inside the burst
+        time.sleep(0.0005 * trial)
+        mb.close()
+        for t in threads:
+            t.join(timeout=30)
+        for f in futures:                # accepted => must resolve
+            assert f.result(timeout=10) == 2.0
+
+
 def test_lambdarank_tile_must_divide_bucket_length():
     """Satellite (ADVICE rank.py:478): a non-divisor tile fails loudly
     instead of silently misaligning rank indices."""

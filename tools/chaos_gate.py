@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Chaos gate for tools/run_full_suite.sh (ISSUE 5 CI satellite).
+
+Two short fault-injection scenarios that must hold before anything ships
+(docs/robustness.md):
+
+1. **Training under gradient NaNs** — a short train with
+   ``nonfinite_grad`` injected and ``guard_nonfinite=skip_tree`` must
+   finish, drop exactly the poisoned iterations, and save a loadable model
+   whose predictions are finite.
+2. **Serving under dispatch failures** — a ForestServer with the first K
+   dispatches failing must shed those requests with errors (no hangs),
+   report DEGRADED while failing, then recover to OK and keep serving the
+   same bits.
+
+Exit 0 on success, 1 with a diagnostic on any violation.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail(msg: str) -> int:
+    print(f"chaos gate: {msg}", file=sys.stderr)
+    return 1
+
+
+def train_under_nan_gradients() -> int:
+    import numpy as np
+
+    import lambdagap_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(1200, 10).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 2] + 0.2 * rng.randn(1200)).astype(np.float32)
+    out = os.path.join(tempfile.mkdtemp(prefix="lambdagap_chaos_"),
+                       "model.txt")
+    rounds = 8
+    b = lgb.train({"objective": "regression", "verbose": -1,
+                   "guard_nonfinite": "skip_tree",
+                   "guard_faults": "nonfinite_grad=2:3",
+                   "output_model": out},
+                  lgb.Dataset(X, label=y), num_boost_round=rounds)
+    if b.num_trees() != rounds - 2:
+        return fail(f"skip_tree kept {b.num_trees()} trees, expected "
+                    f"{rounds - 2} (2 poisoned iterations dropped)")
+    b.save_model(out)
+    loaded = lgb.Booster(model_file=out)
+    preds = loaded.predict(X[:256])
+    if not np.all(np.isfinite(preds)):
+        return fail("saved model predicts non-finite values")
+    print(f"chaos gate: train under NaN gradients OK "
+          f"({b.num_trees()}/{rounds} trees kept, model valid)")
+    return 0
+
+
+def serve_under_dispatch_failures() -> int:
+    import numpy as np
+
+    import lambdagap_tpu as lgb
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(900, 8).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    FAIL_N = 3
+    b = lgb.train({"objective": "binary", "verbose": -1,
+                   "tpu_fast_predict_rows": 0,
+                   "guard_faults": f"serve_dispatch_fail={FAIL_N}"},
+                  lgb.Dataset(X, label=y), num_boost_round=6)
+    ref = b.predict(X[:600])
+    server = b.as_server(buckets=(1, 8), max_delay_ms=0.0, workers=1)
+    try:
+        shed = 0
+        for i in range(FAIL_N):
+            fut = server.submit(X[i])
+            try:
+                fut.result(timeout=30)
+            except Exception:
+                shed += 1
+        if shed != FAIL_N:
+            return fail(f"{FAIL_N} injected dispatch failures but only "
+                        f"{shed} requests resolved with errors")
+        state = server.health.state()
+        if state != "degraded":
+            return fail(f"health is {state!r} mid-failure, want 'degraded'")
+        # faults exhausted: the server must recover, not die
+        for i in range(8):
+            got = server.submit(X[i]).result(timeout=30)
+            if not np.array_equal(got.values, ref[i:i + 1]):
+                return fail(f"post-recovery response for row {i} does not "
+                            "match the device predict reference")
+        state = server.health.state()
+        if state != "ok":
+            return fail(f"health is {state!r} after recovery, want 'ok'")
+        snap = server.stats_snapshot()
+        if snap["errors"] < FAIL_N:
+            return fail(f"errors counter {snap['errors']} < {FAIL_N}")
+    finally:
+        server.close()
+    if server.health.state() != "draining":
+        return fail("health must report 'draining' after close()")
+    print(f"chaos gate: serve under dispatch failures OK "
+          f"({FAIL_N} shed with errors, DEGRADED -> OK -> DRAINING)")
+    return 0
+
+
+def main() -> int:
+    rc = train_under_nan_gradients()
+    if rc:
+        return rc
+    return serve_under_dispatch_failures()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -22,6 +22,14 @@ if ! env JAX_PLATFORMS=cpu python tools/telemetry_gate.py; then
     echo "steady-state recompile appeared; see docs/observability.md)"
     exit 1
 fi
+# chaos gate (ISSUE 5): short train under injected gradient NaNs must
+# finish with a valid model (guard_nonfinite=skip_tree), and a serve loop
+# under injected dispatch failures must shed, degrade, and recover
+if ! env JAX_PLATFORMS=cpu python tools/chaos_gate.py; then
+    echo "FAIL-FAST: chaos gate failed (guard layer let a fault hang,"
+    echo "corrupt, or kill the pipeline; see docs/robustness.md)"
+    exit 1
+fi
 echo "=== G1 $(date)"
 python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_predict_tensor.py tests/test_misc_api.py tests/test_graftlint.py -q 2>&1 | tail -1
 echo "=== G2 $(date)"
