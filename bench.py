@@ -298,6 +298,49 @@ def run_predict_ab(n_trees: int, rows: int) -> None:
     print(json.dumps(out))
 
 
+def _visit_counts(booster, rows: int, n_trees: int = 10):
+    """EXACT per-iteration work counts from the trained trees (the round-4
+    roofline modeled rows*log2(L)*1.35 row-visits; the smaller-child +
+    subtraction trick makes the real count much lower and tree-shape
+    dependent, so the model must read it off the trees):
+      hist visits  = N (root) + sum over splits of min(child rows)
+      part visits  = sum over splits of parent rows
+    Window padding rounds each pass up to the learner's chunk W.
+    Returns None for learners without a chunk window (host serial path —
+    a different cost model)."""
+    if not hasattr(booster._booster.learner, "chunk"):
+        return None
+    W = booster._booster.learner.chunk
+    trees = booster._booster.host_models[-n_trees:]
+    vh = vp = vhp = vpp = 0.0
+    for t in trees:
+        vh_t = float(rows)
+        vhp_t = float(-(-rows // W) * W)
+        vp_t = vpp_t = 0.0
+        for k in range(t.num_internal):
+            lc, rc = t.left_child[k], t.right_child[k]
+            lcnt = (t.internal_count[lc] if lc >= 0
+                    else int(t.leaf_count[~lc]))
+            rcnt = (t.internal_count[rc] if rc >= 0
+                    else int(t.leaf_count[~rc]))
+            small = min(lcnt, rcnt)
+            parent = t.internal_count[k]
+            vh_t += small
+            vp_t += parent
+            vhp_t += -(-small // W) * W
+            vpp_t += -(-parent // W) * W
+        vh += vh_t; vp += vp_t; vhp += vhp_t; vpp += vpp_t
+    nt = max(len(trees), 1)
+    return {
+        "hist_rows_per_iter": int(vh / nt),
+        "hist_rows_padded_per_iter": int(vhp / nt),
+        "part_rows_per_iter": int(vp / nt),
+        "part_rows_padded_per_iter": int(vpp / nt),
+        "chunk_window": int(W),
+        "trees_sampled": nt,
+    }
+
+
 def _telemetry_section(booster, last_n: int) -> dict:
     """BENCH JSON ``telemetry`` section (ISSUE 4): the per-phase breakdown
     from the booster's TrainTelemetry — aggregate summary plus steady-state
@@ -387,46 +430,12 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
     auc = auc_score(np.asarray(yv), pred)
     t_pred = time.time() - t3
 
-    # EXACT per-iteration work counts from the trained trees (the round-4
-    # roofline modeled rows*log2(L)*1.35 row-visits; the smaller-child +
-    # subtraction trick makes the real count much lower and tree-shape
-    # dependent, so the model must read it off the trees):
-    #   hist visits  = N (root) + sum over splits of min(child rows)
-    #   part visits  = sum over splits of parent rows
-    # Window padding rounds each pass up to the learner's chunk W.
-    # Fused program only — the serial-fallback attempts run a different
-    # cost model, so modeling them with these counts would mislead.
-    visit_counts = None
-    if fused and hasattr(booster._booster.learner, "chunk"):
-        W = booster._booster.learner.chunk
-        trees = booster._booster.host_models[-min(10, ITERS_MEASURED):]
-        vh = vp = vhp = vpp = 0.0
-        for t in trees:
-            vh_t = float(rows)
-            vhp_t = float(-(-rows // W) * W)
-            vp_t = vpp_t = 0.0
-            for k in range(t.num_internal):
-                lc, rc = t.left_child[k], t.right_child[k]
-                lcnt = (t.internal_count[lc] if lc >= 0
-                        else int(t.leaf_count[~lc]))
-                rcnt = (t.internal_count[rc] if rc >= 0
-                        else int(t.leaf_count[~rc]))
-                small = min(lcnt, rcnt)
-                parent = t.internal_count[k]
-                vh_t += small
-                vp_t += parent
-                vhp_t += -(-small // W) * W
-                vpp_t += -(-parent // W) * W
-            vh += vh_t; vp += vp_t; vhp += vhp_t; vpp += vpp_t
-        nt = max(len(trees), 1)
-        visit_counts = {
-            "hist_rows_per_iter": int(vh / nt),
-            "hist_rows_padded_per_iter": int(vhp / nt),
-            "part_rows_per_iter": int(vp / nt),
-            "part_rows_padded_per_iter": int(vpp / nt),
-            "chunk_window": int(W),
-            "trees_sampled": nt,
-        }
+    # EXACT per-iteration work counts, read off the trained trees
+    # (_visit_counts). Fused program only — the serial-fallback attempts
+    # run a different cost model, so modeling them with these counts
+    # would mislead.
+    visit_counts = _visit_counts(booster, rows,
+                                 min(10, ITERS_MEASURED)) if fused else None
 
     # predict path A/B: the threaded native traverser (fastpred.cpp, the
     # route for batches <= tpu_fast_predict_rows) vs the jitted device
@@ -463,6 +472,7 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
         "rows": rows,
         "fused": fused,
         "max_bin": max_bin,
+        "tree_layout": getattr(booster._booster.learner, "layout", None),
         "construct_s": round(t_construct, 3),
         "warmup_2iter_s": round(t_warm, 3),
         "per_iter_s": round(per_iter, 4),
@@ -480,6 +490,111 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
         "visit_counts": visit_counts,
         "telemetry": _telemetry_section(booster, ITERS_MEASURED),
         "dataload_s": round(t_gen, 3),
+    }))
+
+
+def run_layout_ab(rows: int, max_bin: int, iters: int) -> None:
+    """Child-process entry (ISSUE 6 satellite): ABAB same-session A/B of
+    ``tree_layout=sorted`` vs ``gather`` on the fused learner — the two
+    boosters share one binned dataset and alternate measured segments, so
+    chip drift hits both arms equally (the same methodology as the
+    telemetry/guard overhead A/Bs in BENCH_NOTES). Reports per-iter for
+    each arm, the sorted arm's permutation-apply (layout_apply) phase cost
+    from telemetry, and the effective histogram-read bandwidth against the
+    ~20 GB/s contiguous-stream bound the sorted layout exists to reach.
+
+    Env: BENCH_LAYOUT_LEAVES overrides num_leaves (the acceptance shape
+    uses 255; CPU-budget validation runs use smaller trees)."""
+    _configure_jax_cache()
+    import jax
+
+    import lambdagap_tpu as lgb
+
+    leaves = int(os.environ.get("BENCH_LAYOUT_LEAVES", NUM_LEAVES))
+    higgs_path = os.environ.get("BENCH_DATA_HIGGS")
+    if higgs_path:
+        X, y, _, _ = _load_higgs_real(higgs_path)
+        rows, synthetic = len(X), False
+    else:
+        z = np.load(_data_cache_path(rows))
+        X, y = z["X"][:rows], z["y"][:rows]
+        synthetic = True
+    params = {"objective": "binary", "num_leaves": leaves,
+              "learning_rate": 0.1, "max_bin": max_bin,
+              "min_data_in_leaf": max(min(100, rows // (leaves * 2)), 2),
+              "verbose": -1, "tpu_fused_learner": "1", "telemetry": True}
+    t0 = time.time()
+    ds = lgb.Dataset(X, label=y)
+    boosters = {
+        layout: lgb.Booster(params={**params, "tree_layout": layout},
+                            train_set=ds)
+        for layout in ("sorted", "gather")
+    }
+    construct_s = time.time() - t0
+
+    def _sync(b):
+        np.asarray(b._booster.scores[0][:1])
+
+    for b in boosters.values():          # compile + warm both arms
+        b.update()
+        b.update()
+        _sync(b)
+
+    seg = max(iters // 4, 3)
+    segs = {"sorted": [], "gather": []}
+    for _rep in range(4):                # A B A B A B A B
+        for layout in ("sorted", "gather"):
+            b = boosters[layout]
+            t0 = time.time()
+            for _ in range(seg):
+                b.update()
+            _sync(b)
+            segs[layout].append((time.time() - t0) / seg)
+    per_iter = {k: float(np.median(v)) for k, v in segs.items()}
+
+    lr = boosters["sorted"]._booster.learner
+    vc = _visit_counts(boosters["sorted"], rows)
+    # bytes per packed row in the sorted buffer: C binned columns + the
+    # 8 B grad/hess pair, padded to the u32 lane multiple (pack32)
+    gh_cols, q_cols, mask_col = lr._packed_meta(False)
+    itemsize = np.dtype(np.asarray(lr.hx_rows).dtype).itemsize
+    cols = lr.hx_rows.shape[1] + gh_cols + q_cols + int(mask_col)
+    row_bytes = -(-cols * itemsize // 4) * 4
+    hist_bytes = (vc["hist_rows_padded_per_iter"] * row_bytes) if vc else None
+    tel_sorted = _telemetry_section(boosters["sorted"], seg * 4)
+    tel_gather = _telemetry_section(boosters["gather"], seg * 4)
+    hist_read = None
+    if hist_bytes:
+        hist_read = {
+            "packed_row_bytes": int(row_bytes),
+            "hist_rows_padded_per_iter": vc["hist_rows_padded_per_iter"],
+            "hist_stream_bytes_per_iter": int(hist_bytes),
+            "stream_bound_s_at_20gbps": round(hist_bytes / 20e9, 4),
+            # a LOWER bound: the denominator is the whole iteration
+            # (partition, scans, fixed costs included), so the true
+            # hist-pass bandwidth is at least this
+            "effective_hist_gbps_lower_bound": round(
+                hist_bytes / per_iter["sorted"] / 1e9, 3),
+        }
+    print(json.dumps({
+        "rows": rows, "max_bin": max_bin, "num_leaves": leaves,
+        "synthetic": synthetic, "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "method": f"ABAB same-session: shared dataset, alternating "
+                  f"{seg}-iter segments x4 per arm, per-iter = median of "
+                  f"segment means, device-complete at every boundary",
+        "construct_s": round(construct_s, 3),
+        "per_iter_s": {k: round(v, 4) for k, v in per_iter.items()},
+        "segments_s_per_iter": {k: [round(s, 4) for s in v]
+                                for k, v in segs.items()},
+        "speedup_sorted_vs_gather": round(
+            per_iter["gather"] / max(per_iter["sorted"], 1e-9), 4),
+        "layout_apply_s_per_iter": tel_sorted.get(
+            "steady_phase_s_per_iter", {}).get("layout_apply"),
+        "visit_counts": vc,
+        "hist_read": hist_read,
+        "telemetry_sorted": tel_sorted.get("steady_phase_s_per_iter"),
+        "telemetry_gather": tel_gather.get("steady_phase_s_per_iter"),
     }))
 
 
@@ -1023,6 +1138,15 @@ def main() -> None:
             ["--predict-ab", "500", "50000"], 1800,
             "predict engine A/B (500 trees x 50k rows)")
 
+    # sorted-vs-gather layout A/B at the headline shape (ISSUE 6): ABAB,
+    # same session, shared dataset; the section the r06 acceptance reads
+    layout_ab = None
+    if os.environ.get("BENCH_LAYOUT_AB", "1") != "0" and result.get("fused"):
+        layout_ab = _run_child(
+            ["--layout-ab", str(chosen["rows"]), str(chosen["max_bin"]),
+             str(ITERS_MEASURED)], ATTEMPT_TIMEOUT,
+            "layout A/B (sorted vs gather)")
+
     # chip ceiling AFTER the attempts
     micro_post = (None if os.environ.get("BENCH_MICRO", "1") == "0"
                   else _run_child(["--micro"], 900, "microbench (post)"))
@@ -1140,6 +1264,7 @@ def main() -> None:
             "note": note,
             "microbench_pre": micro_pre,
             "microbench_post": micro_post,
+            "layout_ab": layout_ab,
             "roofline": roofline,
             "full_run": full_run,
             "predict_tensor_ab": predict_ab,
@@ -1155,6 +1280,8 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 3 and sys.argv[1] == "--rank-attempt":
         run_rank_attempt(int(sys.argv[2]),
                          int(sys.argv[3]) if len(sys.argv) > 3 else None)
+    elif len(sys.argv) >= 5 and sys.argv[1] == "--layout-ab":
+        run_layout_ab(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     elif sys.argv[1:2] == ["--micro"]:
         run_microbench()
     elif len(sys.argv) >= 4 and sys.argv[1] == "--predict-ab":

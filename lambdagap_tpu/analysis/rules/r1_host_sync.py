@@ -13,9 +13,13 @@ Heuristic hot contexts:
   gradient computation, score update, serve dispatch, and tensorized
   predict surfaces), at any nesting depth;
 - any for/while loop body inside a :data:`HOT_PATHS` file — ``serve/``
-  (the request path) and ``ops/predict_tensor.py`` (the inference hot
+  (the request path), ``ops/predict_tensor.py`` (the inference hot
   path: its tile loop runs once per ``predict_tree_tile`` trees per
-  predict call, so one D2H inside it serializes every tile dispatch).
+  predict call, so one D2H inside it serializes every tile dispatch),
+  and ``ops/hist_pallas.py`` (the default TPU histogram kernel and its
+  wrappers: a host read inside the per-feature-block tile loop — or in
+  the wrapper that dispatches one pallas_call per leaf chunk — would
+  serialize every histogram chunk of every split of every tree).
 
 Sync calls flagged: ``jax.device_get``, ``.item()``, ``.block_until_ready()``,
 ``float(...)``/``int(...)`` wrapping a jax/jnp call, and
@@ -40,10 +44,14 @@ HOT_FUNCTIONS = frozenset({
     # per predict dispatch; a sync here stalls every serve bucket
     "predict_forest_tensor", "predict_forest_leaf_tensor",
     "_predict_tensor_tile", "_traverse_tile",
+    # Pallas histogram kernel wrappers (ops/hist_pallas.py): dispatched
+    # once per leaf chunk inside the fused split loop — the hottest call
+    # site in training
+    "hist_pallas", "hist_pallas_q",
 })
 
 # files whose loop bodies are hot regardless of function name
-HOT_PATHS = ("/serve/", "/ops/predict_tensor")
+HOT_PATHS = ("/serve/", "/ops/predict_tensor", "/ops/hist_pallas")
 
 _JAXISH = ("jax.", "jnp.", "lax.")
 

@@ -405,7 +405,17 @@ class Config:
 
     # TPU-specific knobs (no reference analog; tuning surface for XLA/Pallas)
     tpu_rows_per_block: int = 4096
-    tpu_hist_impl: str = "auto"               # auto / onehot / pallas
+    tpu_hist_impl: str = "auto"               # auto / onehot / pallas; auto resolves to the Pallas VMEM kernel on TPU, one-hot contraction elsewhere
+    # physical row layout during training (docs/performance.md):
+    #   gather — rows stay in dataset order; the histogram pass gathers by
+    #            the leaf permutation (the differential oracle)
+    #   sorted — the packed row matrix is physically reordered by leaf
+    #            after each split, so histogram reads are contiguous
+    #            streams instead of row gathers
+    #   auto   — sorted at shapes where gather-issue dominates (>= 2^20
+    #            rows), gather below (the extra resident copy + per-tree
+    #            rebuild is not worth it on small data)
+    tree_layout: str = "auto"                 # auto / gather / sorted
     tpu_num_devices: int = 0                  # 0 = all visible devices
     tpu_fused_learner: str = "auto"           # auto / 1 / 0: whole-tree-on-device
     tpu_fast_predict_rows: int = 10000        # route predict batches up to this many rows through the threaded native traverser
@@ -488,6 +498,10 @@ class Config:
         self._check()
 
     def _check(self) -> None:
+        # one source of truth for the int8 quantized-gradient level cap,
+        # shared with the fused learner's accumulator guard (it used to be
+        # a silent min(..., 127) there; see ops.hist_pallas.exact_accum_limit)
+        from .ops.hist_pallas import MAX_QUANT_BINS
         checks = [
             (self.num_leaves >= 2, "num_leaves must be >= 2"),
             (self.num_iterations >= 0, "num_iterations must be >= 0"),
@@ -536,6 +550,15 @@ class Config:
             (self.guard_clip > 0, "guard_clip must be > 0"),
             (self.resume in ("", "auto"),
              f"unknown resume mode {self.resume!r} (only 'auto')"),
+            (self.tpu_hist_impl in ("auto", "onehot", "pallas"),
+             f"tpu_hist_impl must be auto/onehot/pallas, "
+             f"got {self.tpu_hist_impl!r}"),
+            (self.tree_layout in ("auto", "gather", "sorted"),
+             f"tree_layout must be auto/gather/sorted, "
+             f"got {self.tree_layout!r}"),
+            (2 <= self.num_grad_quant_bins <= MAX_QUANT_BINS,
+             f"num_grad_quant_bins must be in [2, {MAX_QUANT_BINS}] "
+             f"(int8 histogram levels), got {self.num_grad_quant_bins}"),
             (self.telemetry_ring >= 1, "telemetry_ring must be >= 1"),
             (self.telemetry_warmup >= 0, "telemetry_warmup must be >= 0"),
             (self.profile_n_iters >= 1, "profile_n_iters must be >= 1"),

@@ -96,12 +96,14 @@ class FusedTreeLearner(SerialTreeLearner):
         # src/treelearner/gradient_discretizer.hpp): int8 grad/hess levels
         # with stochastic rounding; on TPU the histogram contraction runs
         # as an int8 MXU matmul with exact int32 accumulation
+        from ..ops.hist_pallas import MAX_QUANT_BINS, exact_accum_limit
         self.quant = bool(config.use_quantized_grad)
         # int8-level histograms accumulate into int32 only WITHIN one
         # W-row chunk (cross-chunk accumulation is float32, chunk_hist), so
-        # the worst in-chunk sum is chunk*127 — overflow would need a chunk
-        # of ~16.9M rows; guard the configurable chunk width, not num_data
-        if self.chunk * 127 >= 2**31 - 1:
+        # the worst in-chunk sum is chunk*MAX_QUANT_BINS — overflow would
+        # need a chunk of ~16.9M rows; guard the configurable chunk width,
+        # not num_data
+        if self.chunk * MAX_QUANT_BINS >= 2**31 - 1:
             from ..utils import log
             log.fatal("tpu_rows_per_block=%d makes the histogram chunk too "
                       "large for int32 accumulation", config.tpu_rows_per_block)
@@ -112,10 +114,13 @@ class FusedTreeLearner(SerialTreeLearner):
         # scales only after the cross-shard psum. Integer sums are
         # order-independent, so the distributed reduction is deterministic
         # for any shard count. Falls back to per-chunk scaled f32 when the
-        # worst-case level sum could overflow the accumulator.
+        # worst-case level sum could overflow the accumulator
+        # (exact_accum_limit — the same helper config validation queries
+        # for the num_grad_quant_bins bound).
         if self.quant:
-            qb = max(2, min(config.num_grad_quant_bins, 127))
-            limit = 2**31 - 1 if self.hist_impl == "pallas" else 2**24
+            qb = config.num_grad_quant_bins   # config-validated int in
+            # [2, MAX_QUANT_BINS]; the old silent min(.., 127) cap is gone
+            limit = exact_accum_limit(self.hist_impl)
             self.quant_exact = dataset.num_data * qb < limit
             if not self.quant_exact:
                 from ..utils import log
@@ -154,10 +159,24 @@ class FusedTreeLearner(SerialTreeLearner):
         # only voted columns (set by FusedVotingParallelTreeLearner)
         self.voting: bool = False
         # u32-lane packing of the gathered row matrix (A/B knob; see the
-        # pack32 block in _train_tree_impl)
+        # pack32 block in _pack_rows)
         self.pack32 = os.environ.get("LAMBDAGAP_PACK32", "1") != "0"
-        self._train_jit = jax.jit(self._train_tree_impl,
-                                  static_argnames=("has_mask",))
+        # tree_layout=sorted (docs/performance.md): the packed row matrix
+        # is (re)built leaf-ordered by a separate jitted pre-pass per tree
+        # — dispatched under the layout_apply telemetry span so its cost
+        # tiles the iteration wall — and then carried through the fused
+        # program, which applies the permutation delta of each split
+        # physically to only that leaf's slice. The buffer is donated: it
+        # is per-tree scratch and aliasing it in place saves one
+        # N*(C+8)-byte copy at loop entry.
+        self._srows_dummy = jnp.zeros((1, 1), jnp.uint32)
+        self._layout_jit = jax.jit(self._build_sorted_impl,
+                                   static_argnames=("has_mask",))
+        donate_srows = (self.layout == "sorted"
+                        and jax.default_backend() == "tpu")  # CPU/GPU can't
+        self._train_jit = jax.jit(
+            self._train_tree_impl, static_argnames=("has_mask",),
+            donate_argnums=(6,) if donate_srows else ())
         self.last_row_leaf: Optional[jax.Array] = None
 
     def _build_forced_seq(self, nodes: int):
@@ -194,9 +213,105 @@ class FusedTreeLearner(SerialTreeLearner):
         """Upload the row-major binned matrix plus a column-major copy for
         cheap feature-column reads while partitioning (the analog of
         CUDAColumnData next to CUDARowData,
-        reference: src/io/cuda/cuda_column_data.cpp)."""
+        reference: src/io/cuda/cuda_column_data.cpp). Under
+        ``tree_layout=sorted`` the partition decodes the split feature from
+        the sorted window itself, so the column-major copy would be N*C
+        dead bytes of HBM — a tiny placeholder keeps the jit signature."""
         self.hx_rows = jnp.asarray(hx)
-        self.x_cols = jnp.asarray(np.ascontiguousarray(hx.T))
+        if self.layout == "sorted":
+            self.x_cols = jnp.zeros((1, 1), self.hx_rows.dtype)
+        else:
+            self.x_cols = jnp.asarray(np.ascontiguousarray(hx.T))
+
+    # packed row-matrix layout -------------------------------------------
+    def _window(self, N: int) -> int:
+        """Chunk window of the while-loop'd row passes (shared by the
+        training program and the sorted-layout pre-pass, whose pad row
+        count must match)."""
+        return min(self.chunk, _next_pow2(N))
+
+    def _packed_meta(self, has_mask: bool):
+        """Static column layout of the packed row matrix, in bin-dtype
+        columns after the C binned columns: (gh_cols, q_cols, mask_col).
+
+        * non-quant: 2 f32 grad/hess values bitcast to 8 (uint8) / 4
+          (uint16) columns; the bagging mask rides one more column.
+        * quant + sorted layout: the int8 (g_q, h_q) pair rides 2 uint8 /
+          1 uint16 column(s) (+ mask column) so the physically reordered
+          buffer carries everything the histogram pass reads.
+        * quant + gather layout: nothing extra — gq/hq/mask are gathered
+          by row index alongside the bins (the historical layout).
+        """
+        u8 = self.hx_rows.dtype == jnp.uint8
+        if self.quant:
+            if self.layout == "sorted":
+                return 0, (2 if u8 else 1), bool(has_mask)
+            return 0, 0, False
+        return (8 if u8 else 4), 0, bool(has_mask)
+
+    def _pack_rows(self, grad, hess, row_mask, x_rows, gq, hq,
+                   has_mask: bool):
+        """Pack the binned rows plus their per-row channels into ONE
+        row-major matrix in the bin dtype, bitcast to u32 lanes (pack32):
+        the histogram pass then runs ONE random gather per row window
+        instead of two (the 8 B gh gather pays near-full random latency
+        despite 3.5x fewer bytes than the row fetch; merging them removed
+        it — measured 4.84 -> 4.64 s/iter at full HIGGS size), and one u32
+        element carrying 4 binned uint8 columns (2 uint16) cuts the hot
+        pass's element count ~4x (2x); lanes decode with one bitcast after
+        the fetch (reference analog: cuda_row_data.hpp:32-117 packs rows
+        by bit width for the same reason). Costs: one streaming repack
+        pass per tree (~19 ms at 10.5M rows) and a second resident copy of
+        the binned matrix, ~N*(C+8) bytes — ~380 MB at full HIGGS size
+        against the chip's 16 GB."""
+        gh_cols, q_cols, mask_col = self._packed_meta(has_mask)
+        parts = [x_rows]
+        if gh_cols:
+            gh2 = jnp.stack([grad, hess], axis=1)           # [N, 2] f32
+            if x_rows.dtype == jnp.uint16:
+                ghb = lax.bitcast_convert_type(gh2, jnp.uint16)   # [N,2,2]
+            else:
+                ghb = lax.bitcast_convert_type(gh2, jnp.uint8)    # [N,2,4]
+            parts.append(ghb.reshape(ghb.shape[0], -1))
+        if q_cols:
+            if x_rows.dtype == jnp.uint16:
+                parts.append(lax.bitcast_convert_type(
+                    jnp.stack([gq, hq], axis=1), jnp.uint16)[:, None])
+            else:
+                parts.append(jnp.stack(
+                    [lax.bitcast_convert_type(gq, jnp.uint8),
+                     lax.bitcast_convert_type(hq, jnp.uint8)], axis=1))
+        if mask_col:
+            parts.append(row_mask.astype(x_rows.dtype)[:, None])
+        packed = parts[0] if len(parts) == 1 else jnp.concatenate(parts,
+                                                                  axis=1)
+        if self.pack32:
+            lane_n = 4 if packed.dtype == jnp.uint8 else 2
+            P0 = packed.shape[1]
+            padc = (-P0) % lane_n
+            if padc:
+                packed = jnp.concatenate(
+                    [packed, jnp.zeros((packed.shape[0], padc),
+                                       packed.dtype)], axis=1)
+            packed = lax.bitcast_convert_type(
+                packed.reshape(packed.shape[0], (P0 + padc) // lane_n,
+                               lane_n), jnp.uint32)          # [N, P32]
+        return packed
+
+    def _build_sorted_impl(self, grad, hess, row_mask, x_rows, gq, hq, *,
+                           has_mask: bool):
+        """The ``tree_layout=sorted`` pre-pass: (re)build the physically
+        leaf-ordered packed row buffer for one tree. Each tree starts from
+        the identity permutation, so this is a pure streaming repack (no
+        gather); gradients change every iteration, which is why the buffer
+        cannot persist across trees. The W trailing pad rows let every
+        window read in the fused program be a clamp-free dynamic slice
+        (the same invariant as the permutation buffer's)."""
+        packed = self._pack_rows(grad, hess, row_mask, x_rows, gq, hq,
+                                 has_mask)
+        W = self._window(x_rows.shape[0])
+        return jnp.concatenate(
+            [packed, jnp.zeros((W, packed.shape[1]), packed.dtype)])
 
     @staticmethod
     def _chunk_override() -> Optional[int]:
@@ -258,8 +373,19 @@ class FusedTreeLearner(SerialTreeLearner):
             ekey = jnp.stack([e, b])            # [2, 2]: extra / by-node
         else:
             ekey = jnp.zeros((2, 2), jnp.uint32)
+        if self.layout == "sorted":
+            # the leaf-ordered packed buffer is rebuilt per tree; the span
+            # makes its (streaming-repack) cost tile the iteration wall —
+            # the in-program per-split permutation-apply rides the tree
+            # span like the rest of the fused program
+            with self.telemetry.phase("layout_apply"):
+                srows = self._layout_jit(grad, hess, mask, self.hx_rows,
+                                         gq, hq,
+                                         has_mask=row_mask is not None)
+        else:
+            srows = self._srows_dummy
         rec = self._train_jit(grad, hess, mask, fmask, self.hx_rows,
-                              self.x_cols, gq, hq, gs, hs, ekey,
+                              self.x_cols, srows, gq, hq, gs, hs, ekey,
                               has_mask=row_mask is not None)
         self.last_row_leaf = rec.row_leaf
         return rec
@@ -332,7 +458,7 @@ class FusedTreeLearner(SerialTreeLearner):
     # the fused program
     # ------------------------------------------------------------------
     def _train_tree_impl(self, grad, hess, row_mask, fmask, x_rows, x_cols,
-                         gq, hq, gs, hs, ekey, *, has_mask: bool):
+                         srows, gq, hq, gs, hs, ekey, *, has_mask: bool):
         """One whole tree as a single XLA program.
 
         Design notes for the ``fori_loop`` body (the per-split step):
@@ -383,52 +509,38 @@ class FusedTreeLearner(SerialTreeLearner):
         bin_iota = jnp.arange(Bb, dtype=x_rows.dtype)
         quant = self.quant
         qexact = self.quant_exact
-        # grad+hess PACKED INTO the binned row matrix, bitcast to its
-        # dtype: the histogram pass then runs ONE random gather per row
-        # window instead of two (the 8 B gh gather pays near-full random
-        # latency despite 3.5x fewer bytes than the row fetch; merging
-        # them removed it — measured 4.84 -> 4.64 s/iter at full HIGGS
-        # size). Costs: one streaming repack pass per tree (~19 ms at
-        # 10.5M rows) and a SECOND resident copy of the binned matrix
-        # (x_rows stays alive as a non-donated jit argument), ~N*(C+8)
-        # bytes — ~380 MB at full HIGGS size against the chip's 16 GB.
-        if quant:
-            packed_rows = x_rows
-            gh_cols = 0
-        else:
-            gh2 = jnp.stack([grad, hess], axis=1)       # [N, 2] f32
-            if x_rows.dtype == jnp.uint16:
-                ghb = lax.bitcast_convert_type(gh2, jnp.uint16)   # [N,2,2]
-            else:
-                ghb = lax.bitcast_convert_type(gh2, jnp.uint8)    # [N,2,4]
-            ghb = ghb.reshape(ghb.shape[0], -1)
-            gh_cols = ghb.shape[1]
-            parts = [x_rows, ghb]
-            if has_mask:
-                # the bagging/GOSS mask rides the same gather as one more
-                # packed column
-                parts.append(row_mask.astype(x_rows.dtype)[:, None])
-            packed_rows = jnp.concatenate(parts, axis=1)
-        # ... and the packed matrix bitcast into uint32 LANES: TPU gathers
-        # cost per gathered element, not per byte (measured round 4), so
-        # one u32 element carrying 4 binned uint8 columns (2 uint16) cuts
-        # the hot pass's element count ~4x (2x); lanes decode with one
-        # bitcast after the gather (reference analog: cuda_row_data.hpp
-        # :32-117 packs rows by bit width for the same reason)
+        # physical row layout (docs/performance.md). gather: grad+hess (and
+        # the bagging mask) are PACKED INTO the binned row matrix and the
+        # histogram pass gathers one packed row per visit (_pack_rows has
+        # the full story + measured history). sorted: the packed matrix
+        # arrives PRE-BUILT and leaf-ordered in ``srows`` (the layout_apply
+        # pre-pass) and is carried through the split loop, which applies
+        # each split's permutation delta physically to only that leaf's
+        # slice — the histogram pass then reads contiguous streams at
+        # stream bandwidth instead of issuing row gathers.
+        layout_sorted = self.layout == "sorted"
+        gh_cols, q_cols, mask_col = self._packed_meta(has_mask)
         pack32 = self.pack32
-        if pack32:
-            lane_n = 4 if packed_rows.dtype == jnp.uint8 else 2
-            P0 = packed_rows.shape[1]
-            padc = (-P0) % lane_n
-            if padc:
-                packed_rows = jnp.concatenate(
-                    [packed_rows,
-                     jnp.zeros((packed_rows.shape[0], padc),
-                               packed_rows.dtype)], axis=1)
-            P32 = (P0 + padc) // lane_n
-            packed_rows = lax.bitcast_convert_type(
-                packed_rows.reshape(packed_rows.shape[0], P32, lane_n),
-                jnp.uint32)                             # [N, P32]
+        if layout_sorted:
+            packed_rows = None          # rows live in the carried srows
+            SW = srows.shape[1]
+        else:
+            packed_rows = self._pack_rows(grad, hess, row_mask, x_rows,
+                                          gq, hq, has_mask)
+
+        def unpack(prow):
+            """u32 lanes -> bin-dtype columns (no-op when pack32 is off)."""
+            if pack32:
+                return lax.bitcast_convert_type(
+                    prow, x_rows.dtype).reshape(prow.shape[0], -1)
+            return prow
+
+        def srow_slice(buf, start):
+            """Contiguous W-row window of the (N+W padded) sorted payload
+            — a dynamic-slice DMA, the sorted layout's whole point."""
+            # same pad invariant as perm_slice: starts stay <= N
+            assert buf.shape[0] == N + W
+            return lax.dynamic_slice(buf, (start, 0), (W, SW))
 
         def perm_slice(perm, start):
             """Contiguous W-row window of the (N+W padded) permutation —
@@ -438,38 +550,55 @@ class FusedTreeLearner(SerialTreeLearner):
             assert perm.shape[0] == N + W
             return lax.dynamic_slice(perm, (start,), (W,))
 
-        def chunk_hist(perm, begin, count, acc, c):
-            """Histogram of rows perm[begin+cW : begin+(c+1)W]."""
-            rows = perm_slice(perm, begin + c * W)
+        def chunk_hist(perm, srows_c, begin, count, acc, c):
+            """Histogram of the leaf rows at positions
+            begin+cW : begin+(c+1)W — a permutation gather under the
+            gather layout, a contiguous window DMA under sorted."""
+            if layout_sorted:
+                rows = None
+                prow = unpack(srow_slice(srows_c, begin + c * W))
+            else:
+                rows = perm_slice(perm, begin + c * W)
+                prow = unpack(packed_rows[rows])    # [W, C(+gh+mask)]
             valid = (c * W + lane) < count
-            if has_mask and quant:
-                valid = valid & row_mask[rows]
-            prow = packed_rows[rows]            # [W, P32] u32 lanes, or
-            if pack32:                          # [W, C(+gh+mask)] unpacked
-                prow = lax.bitcast_convert_type(
-                    prow, x_rows.dtype).reshape(W, -1)
             bins = prow[:, :C]
-            if has_mask and not quant:
-                valid = valid & (prow[:, C + gh_cols] > 0)
             if quant:
+                if layout_sorted:
+                    # int8 levels decoded out of the sorted payload
+                    if x_rows.dtype == jnp.uint16:
+                        qw = lax.bitcast_convert_type(prow[:, C], jnp.int8)
+                        gq_w, hq_w = qw[:, 0], qw[:, 1]
+                    else:
+                        gq_w = lax.bitcast_convert_type(prow[:, C],
+                                                        jnp.int8)
+                        hq_w = lax.bitcast_convert_type(prow[:, C + 1],
+                                                        jnp.int8)
+                    if mask_col:
+                        valid = valid & (prow[:, C + q_cols] > 0)
+                else:
+                    gq_w, hq_w = gq[rows], hq[rows]
+                    if has_mask:
+                        valid = valid & row_mask[rows]
                 qscale = jnp.stack([gs, hs, jnp.float32(1.0)])
                 if self.hist_impl == "pallas":
                     from ..ops.hist_pallas import hist_pallas_q, pack_ghq8
                     live = jnp.clip(count - c * W, 0, W)
-                    ghq = pack_ghq8(gq[rows], hq[rows], valid)
+                    ghq = pack_ghq8(gq_w, hq_w, valid)
                     hist_i = hist_pallas_q(bins, ghq, Bb, live)
                     if qexact:          # raw level sums; scaled post-psum
                         return acc + hist_i
                     return acc + hist_i.astype(jnp.float32) * qscale
                 gsc = jnp.float32(1.0) if qexact else gs
                 hsc = jnp.float32(1.0) if qexact else hs
-                g = jnp.where(valid, gq[rows].astype(jnp.float32) * gsc, 0.0)
-                h = jnp.where(valid, hq[rows].astype(jnp.float32) * hsc, 0.0)
+                g = jnp.where(valid, gq_w.astype(jnp.float32) * gsc, 0.0)
+                h = jnp.where(valid, hq_w.astype(jnp.float32) * hsc, 0.0)
                 gh = jnp.stack([g, h, valid.astype(jnp.float32)], axis=1)
                 onehot = (bins[:, :, None] == bin_iota).astype(jnp.bfloat16)
                 part = gh_contract(gh, onehot.reshape(W, C * Bb),
                                    self.hist_precision)
                 return acc + part.reshape(HIST_C, C, Bb).transpose(1, 2, 0)
+            if has_mask:
+                valid = valid & (prow[:, C + gh_cols] > 0)
             ghr = lax.bitcast_convert_type(
                 prow[:, C:C + gh_cols].reshape(W, 2, gh_cols // 2),
                 jnp.float32)                            # [W, 2]
@@ -486,7 +615,7 @@ class FusedTreeLearner(SerialTreeLearner):
                                self.hist_precision)
             return acc + part.reshape(HIST_C, C, Bb).transpose(1, 2, 0)
 
-        def leaf_hist(perm, begin, count):
+        def leaf_hist(perm, srows_c, begin, count):
             # jax.named_scope labels below tag the traced ops so profiler
             # windows (obs/profile.py) show the same histogram/partition/
             # split phase structure the host-side telemetry reports
@@ -494,7 +623,7 @@ class FusedTreeLearner(SerialTreeLearner):
 
             def body(st):
                 c, acc = st
-                return c + 1, chunk_hist(perm, begin, count, acc, c)
+                return c + 1, chunk_hist(perm, srows_c, begin, count, acc, c)
 
             acc_dtype = (jnp.int32 if qexact and self.hist_impl == "pallas"
                          else jnp.float32)
@@ -762,7 +891,7 @@ class FusedTreeLearner(SerialTreeLearner):
         # dynamic slice; pad rows point at row 0 and are always masked
         perm0 = jnp.concatenate([jnp.arange(N, dtype=jnp.int32),
                                  jnp.zeros(W, jnp.int32)])
-        hist_root = leaf_hist(perm0, jnp.int32(0), jnp.int32(N))
+        hist_root = leaf_hist(perm0, srows, jnp.int32(0), jnp.int32(N))
         totals = jnp.sum(hist_root[0], axis=0)
         if voting:
             # local root hist: global parent sums need their own (tiny) psum
@@ -820,6 +949,11 @@ class FusedTreeLearner(SerialTreeLearner):
             hist=jnp.zeros((L + 1, C, Bb, HIST_C), f32).at[0].set(hist_root),
             num_leaves=jnp.int32(1),
         )
+        if layout_sorted:
+            # the leaf-ordered payload + its partition double buffer ride
+            # the carry so each split's permutation delta applies in place
+            state["srows"] = srows
+            state["srows_buf"] = jnp.zeros_like(srows)
         if ic_on:
             state["path"] = jnp.zeros((L + 1, PW), jnp.uint32)
         if inter:
@@ -957,7 +1091,14 @@ class FusedTreeLearner(SerialTreeLearner):
 
             begin = li[0]
             count_eff = jnp.where(ok, li[1], 0)
-            if fax is not None:
+            srows_cur = st["srows"] if layout_sorted else None
+            if layout_sorted:
+                # the split feature's bin value is decoded from the sorted
+                # window itself inside pbody — no column gather, and no
+                # column-major matrix at all (x_cols is a placeholder)
+                col = None
+                colidx = self.bcol[feat] if bundled else feat
+            elif fax is not None:
                 # the winning feature's column lives on ONE shard: psum
                 # broadcasts it for the (row-replicated) partition — the
                 # analog of the reference's best-split partition broadcast
@@ -977,12 +1118,26 @@ class FusedTreeLearner(SerialTreeLearner):
             perm_in = st["perm"]
 
             # -- chunked stable partition into perm_buf ----------------
+            # under the sorted layout the SAME scatter positions route the
+            # full packed row payload into srows_buf: the permutation
+            # delta of this split applied physically, over only this
+            # leaf's slice — positions form two monotone runs (lefts
+            # ascending, rights descending), so the writes are two nearly
+            # contiguous streams, not random scatters
             def pbody(s):
-                c, lcur, rcur, pbuf = s
+                if layout_sorted:
+                    c, lcur, rcur, pbuf, sbuf = s
+                else:
+                    c, lcur, rcur, pbuf = s
                 live = jnp.clip(count_eff - c * W, 0, W)
                 valid = lane < live
                 rows = perm_slice(perm_in, begin + c * W)
-                cv = col[rows].astype(jnp.int32)
+                if layout_sorted:
+                    dw = srow_slice(srows_cur, begin + c * W)
+                    cv = jnp.take(unpack(dw), colidx,
+                                  axis=1).astype(jnp.int32)
+                else:
+                    cv = col[rows].astype(jnp.int32)
                 if bundled:
                     # rank-decode the feature's bin out of its bundle column
                     r = cv - self.boff[feat]
@@ -1005,21 +1160,35 @@ class FusedTreeLearner(SerialTreeLearner):
                 rpos = rcur - (prefix_valid - cums_gl)
                 pos = jnp.where(gl, lpos, jnp.where(valid, rpos, N))
                 pbuf = pbuf.at[pos].set(rows, mode="drop")
+                if layout_sorted:
+                    sbuf = sbuf.at[pos].set(dw, mode="drop")
+                    return c + 1, lcur + nl, rcur - (live - nl), pbuf, sbuf
                 return c + 1, lcur + nl, rcur - (live - nl), pbuf
 
             with jax.named_scope("partition"):
-                _, lend, _, pbuf = lax.while_loop(
-                    lambda s: s[0] < nch, pbody,
-                    (jnp.int32(0), begin, begin + count_eff,
-                     st["perm_buf"]))
+                if layout_sorted:
+                    _, lend, _, pbuf, sbuf = lax.while_loop(
+                        lambda s: s[0] < nch, pbody,
+                        (jnp.int32(0), begin, begin + count_eff,
+                         st["perm_buf"], st["srows_buf"]))
+                else:
+                    _, lend, _, pbuf = lax.while_loop(
+                        lambda s: s[0] < nch, pbody,
+                        (jnp.int32(0), begin, begin + count_eff,
+                         st["perm_buf"]))
+                    sbuf = None
             left_count = lend - begin
             right_count = count_eff - left_count
 
             # copy the partitioned slice back into perm (chunked); both reads
             # and the write are contiguous-window DMAs, with the stale tail
-            # of the last window re-written from perm itself
+            # of the last window re-written from perm itself. The sorted
+            # payload copies back the same way — stream reads, stream write.
             def cbody(s):
-                c, pm = s
+                if layout_sorted:
+                    c, pm, sr = s
+                else:
+                    c, pm = s
                 # same window-pad invariant as perm_slice: starts stay
                 # <= N, the W-row tail pad absorbs the last window
                 assert pbuf.shape[0] == N + W
@@ -1028,11 +1197,22 @@ class FusedTreeLearner(SerialTreeLearner):
                 vals = jnp.where(valid, perm_slice(pbuf, start),
                                  perm_slice(pm, start))
                 pm = lax.dynamic_update_slice(pm, vals, (start,))
+                if layout_sorted:
+                    sw = jnp.where(valid[:, None], srow_slice(sbuf, start),
+                                   srow_slice(sr, start))
+                    sr = lax.dynamic_update_slice(sr, sw, (start, 0))
+                    return c + 1, pm, sr
                 return c + 1, pm
 
             with jax.named_scope("partition_copyback"):
-                _, perm = lax.while_loop(lambda s: s[0] < nch, cbody,
-                                         (jnp.int32(0), perm_in))
+                if layout_sorted:
+                    _, perm, srows_new = lax.while_loop(
+                        lambda s: s[0] < nch, cbody,
+                        (jnp.int32(0), perm_in, srows_cur))
+                else:
+                    _, perm = lax.while_loop(lambda s: s[0] < nch, cbody,
+                                             (jnp.int32(0), perm_in))
+                    srows_new = None
 
             # -- masked write indices (dump rows swallow no-op steps) --
             # nodes are indexed by the number of REALIZED splits, not the
@@ -1093,7 +1273,7 @@ class FusedTreeLearner(SerialTreeLearner):
                 small_is_left = lc <= pc - lc
             sb = jnp.where(small_is_left, begin, begin + left_count)
             sc = jnp.where(small_is_left, left_count, right_count)
-            hist_small = leaf_hist(perm, sb, sc)
+            hist_small = leaf_hist(perm, srows_new, sb, sc)
             hist_large = st["hist"][leaf] - hist_small
             hist_left = jnp.where(small_is_left, hist_small, hist_large)
             hist_right = jnp.where(small_is_left, hist_large, hist_small)
@@ -1250,6 +1430,9 @@ class FusedTreeLearner(SerialTreeLearner):
                 hist=hist,
                 num_leaves=st["num_leaves"] + ok.astype(jnp.int32),
             )
+            if layout_sorted:
+                out["srows"] = srows_new
+                out["srows_buf"] = sbuf
             if forced is not None:
                 out["forcing"] = forcing_next
             if ic_on:

@@ -113,10 +113,20 @@ def dispatch_forest_leaf(cfg, x, forest, max_depth: int, binned: bool,
 
 
 def _finalize_tree(tree: "Tree", shrinkage: float, bias: float) -> "Tree":
-    """Shrinkage + boost-from-average bias fold shared by every
+    """Shrinkage + boost-from-average bias fold shared by every FUSED
     materialization path (reference: Tree::Shrinkage + Tree::AddBias,
-    gbdt.cpp:415-421)."""
+    gbdt.cpp:415-421).
+
+    The leaf multiply is rounded in float32: the fused fast path already
+    added ``f32(leaf_value * shrinkage)`` into the device training scores
+    before this tree ever materialized, and auto-resume replays scores
+    from the serialized leaf values — a float64 multiply here would
+    disagree with the device product by 1 ulp and silently break
+    kill-and-resume byte-identity (tests/test_guard.py)."""
+    lv32 = (tree.leaf_value[:tree.num_leaves].astype(np.float32)
+            * np.float32(shrinkage)).astype(np.float32)
     tree.apply_shrinkage(shrinkage)
+    tree.leaf_value[:tree.num_leaves] = lv32.astype(np.float64)
     if abs(bias) > K_EPSILON:
         tree.leaf_value[:tree.num_leaves] += bias
         tree.internal_value = [v + bias for v in tree.internal_value]

@@ -24,8 +24,9 @@ import numpy as np
 from ..config import Config
 from ..data.dataset import BinnedDataset
 from ..obs.telemetry import NULL_TELEMETRY
-from ..ops.histogram import full_histogram, leaf_histogram
-from ..ops.partition import split_partition
+from ..ops.histogram import (full_histogram, leaf_histogram,
+                             leaf_histogram_sorted)
+from ..ops.partition import split_partition, split_partition_sorted
 from ..ops.split import (SplitParams, find_best_split, gather_threshold_split,
                          monotone_split_penalty)
 from ..utils import log
@@ -106,6 +107,11 @@ class SerialTreeLearner:
         self.rows_per_block = config.tpu_rows_per_block
         self.hist_precision = config.tpu_hist_precision
         self.hist_impl = self._resolve_hist_impl(config.tpu_hist_impl)
+        self.layout = self._resolve_layout(config)
+        # physical leaf-ordered copies under tree_layout=sorted (rebuilt per
+        # tree in train(); None under the gather layout)
+        self._x_sorted: Optional[jax.Array] = None
+        self._gh_sorted: Optional[jax.Array] = None
         self._col_rng = np.random.RandomState(config.feature_fraction_seed)
 
         # monotone constraints, mapped original-feature -> used-feature
@@ -216,20 +222,49 @@ class SerialTreeLearner:
         self.last_leaf_begin: Optional[np.ndarray] = None
         self.last_leaf_count: Optional[np.ndarray] = None
 
+    #: learners whose histogram/partition passes cannot consume the
+    #: physically leaf-ordered layout override this to False and fall back
+    #: to the gather layout (the host-loop distributed learners, whose
+    #: device matrices are shared per-shard views, and the fused
+    #: feature-parallel learner, whose winning split column lives on
+    #: another shard)
+    supports_sorted_layout = True
+
     @staticmethod
     def _resolve_hist_impl(impl: str) -> str:
         """Pick the histogram strategy (the analog of TrainingShareStates'
         col/row-wise probe, reference: src/io/train_share_states.cpp — here
         the choice is XLA one-hot contraction vs the Pallas VMEM kernel;
-        'auto' = Pallas wherever Mosaic can compile, i.e. any non-CPU
-        backend)."""
+        'auto' = Pallas on TPU, where Mosaic compiles it; one-hot
+        elsewhere. An explicit 'pallas' off-TPU runs the kernel in
+        interpret mode — exact but slow, the tier-1 CPU parity path)."""
         from ..ops.hist_pallas import HAS_PALLAS
         if impl == "auto":
-            return ("pallas" if HAS_PALLAS and jax.default_backend() != "cpu"
+            return ("pallas" if HAS_PALLAS and jax.default_backend() == "tpu"
                     else "onehot")
         if impl not in ("onehot", "pallas"):
             log.fatal("tpu_hist_impl must be auto/onehot/pallas, got %r", impl)
+        if impl == "pallas" and not HAS_PALLAS:
+            log.fatal("tpu_hist_impl=pallas but jax.experimental.pallas is "
+                      "unavailable in this jax build")
         return impl
+
+    def _resolve_layout(self, config: Config) -> str:
+        """Resolve ``tree_layout``: 'auto' picks the physically sorted-leaf
+        layout at shapes where gather-issue cost dominates the histogram
+        pass (the BENCH_r05 roofline: random row-gathers issue at
+        ~30 Mrows/s where the same bytes stream at ~20 GB/s); small data
+        keeps the gather layout — the sorted copy's rebuild-per-tree and
+        extra residency are not worth it there (docs/performance.md)."""
+        layout = config.tree_layout
+        if not self.supports_sorted_layout:
+            if layout == "sorted":
+                log.info("tree_layout=sorted is not supported by %s; using "
+                         "the gather layout", type(self).__name__)
+            return "gather"
+        if layout == "auto":
+            return "sorted" if self.num_data >= (1 << 20) else "gather"
+        return layout
 
     # ------------------------------------------------------------------
     def _pad_size(self, count: int) -> int:
@@ -454,6 +489,16 @@ class SerialTreeLearner:
                               self.rows_per_block, self.hist_precision)
 
     def _leaf_histogram(self, perm, grad, hess, begin, count, padded, row_mask):
+        if self._x_sorted is not None:
+            # sorted layout: the leaf is a contiguous position slice of the
+            # physically reordered matrix — consecutive-index read, no
+            # row gather (identical rows in identical order, so the
+            # histogram is bit-identical to the gather oracle's)
+            return leaf_histogram_sorted(self._x_sorted, self._gh_sorted,
+                                         jnp.int32(begin), jnp.int32(count),
+                                         padded, self.B,
+                                         self.rows_per_block,
+                                         self.hist_precision)
         return leaf_histogram(self.x_binned, perm, grad, hess,
                               jnp.int32(begin), jnp.int32(count), padded,
                               self.B, self.rows_per_block, row_mask,
@@ -524,6 +569,19 @@ class SerialTreeLearner:
                                  else np.asarray(jax.device_get(row_mask)))
 
         perm = self.perm0
+        if self.layout == "sorted":
+            # physical leaf-ordered copies, rebuilt per tree (gradients
+            # change every iteration and the permutation restarts at
+            # identity); the layout_apply span makes the rebuild cost tile
+            # the iteration wall like every other phase
+            with self.telemetry.phase("layout_apply"):
+                parts = [grad[:, None], hess[:, None]]
+                if row_mask is not None:
+                    parts.append(row_mask.astype(jnp.float32)[:, None])
+                self._x_sorted = self.x_binned
+                self._gh_sorted = jnp.concatenate(parts, axis=1)
+        else:
+            self._x_sorted = self._gh_sorted = None
         leaf_begin = np.zeros(num_leaves, dtype=np.int64)
         leaf_count = np.zeros(num_leaves, dtype=np.int64)
         leaf_count[0] = self.num_data
@@ -581,16 +639,31 @@ class SerialTreeLearner:
             P = self._pad_size(count)
             feat = int(s.feature)
             with self.telemetry.phase("partition"):
-                perm, left_cnt_dev = split_partition(
-                    self.x_binned, perm,
-                    jnp.int32(begin), jnp.int32(count),
-                    jnp.int32(feat), jnp.int32(s.threshold),
-                    jnp.asarray(bool(s.default_left)),
-                    self.default_bins_arr[feat],
-                    self.missing_types_arr[feat],
-                    self.num_bins_arr[feat],
-                    jnp.asarray(bool(s.is_categorical)),
-                    jnp.asarray(s.cat_bitset), P)
+                if self._x_sorted is not None:
+                    # sorted layout: apply the stable partition physically
+                    # to the row payload + gradient channels as well
+                    (perm, self._x_sorted, self._gh_sorted,
+                     left_cnt_dev) = split_partition_sorted(
+                        self._x_sorted, self._gh_sorted, perm,
+                        jnp.int32(begin), jnp.int32(count),
+                        jnp.int32(feat), jnp.int32(s.threshold),
+                        jnp.asarray(bool(s.default_left)),
+                        self.default_bins_arr[feat],
+                        self.missing_types_arr[feat],
+                        self.num_bins_arr[feat],
+                        jnp.asarray(bool(s.is_categorical)),
+                        jnp.asarray(s.cat_bitset), P)
+                else:
+                    perm, left_cnt_dev = split_partition(
+                        self.x_binned, perm,
+                        jnp.int32(begin), jnp.int32(count),
+                        jnp.int32(feat), jnp.int32(s.threshold),
+                        jnp.asarray(bool(s.default_left)),
+                        self.default_bins_arr[feat],
+                        self.missing_types_arr[feat],
+                        self.num_bins_arr[feat],
+                        jnp.asarray(bool(s.is_categorical)),
+                        jnp.asarray(s.cat_bitset), P)
                 left_cnt = int(jax.device_get(left_cnt_dev))
             right_cnt = count - left_cnt
             if _DEBUG_CHECKS and row_mask is None:

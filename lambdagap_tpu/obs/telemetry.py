@@ -42,9 +42,12 @@ from .xla_watch import XlaWatchdog
 # canonical phase names (docs/observability.md); "tree" holds whatever the
 # learner does not attribute to a finer phase (the fused learner's whole
 # on-device program lands here — its internal structure shows up in
-# profiler windows via jax.named_scope, not host spans)
-PHASES = ("gradients", "sampling", "histogram", "split", "partition",
-          "tree", "score_update", "eval", "device_wait")
+# profiler windows via jax.named_scope, not host spans). "layout_apply"
+# is the tree_layout=sorted reorder pre-pass (the per-tree leaf-ordered
+# rebuild of the packed row matrix); the in-program per-split
+# permutation-apply rides the tree span like the rest of the fused program
+PHASES = ("gradients", "sampling", "layout_apply", "histogram", "split",
+          "partition", "tree", "score_update", "eval", "device_wait")
 
 # phase -> the utils.timer scope name it replaces (the deprecation shim:
 # the legacy global_timer report keeps its historical row names)

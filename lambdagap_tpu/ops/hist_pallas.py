@@ -1,4 +1,5 @@
-"""Pallas TPU histogram kernel.
+"""Pallas TPU histogram kernel (the default on TPU since tpu_hist_impl=auto
+graduated it from prototype; docs/performance.md).
 
 The performance-critical replacement for the XLA one-hot histogram
 (see :mod:`lambdagap_tpu.ops.histogram`): the CUDA analog builds per-block
@@ -10,14 +11,27 @@ VMEM, block by block, instead of being materialized to HBM by XLA (round
 1's main bandwidth sink: at HIGGS shape the XLA intermediate is ~28x the
 size of the uint8 rows it encodes).
 
-Grid layout: ``(feature_blocks, row_blocks)`` with the row dimension inner,
-revisiting one ``[8, FBLK*B]`` f32 output block per feature block — the
-Pallas accumulate-over-grid pattern. Each feature contributes one
+Grid layout: ``(feature_blocks, row_blocks)`` with the row dimension inner.
+Each ``[row_tile, feature_tile]`` grid cell accumulates into an explicit
+f32/int32 VMEM scratch block (``acc_ref``); the HBM output block is written
+ONCE, when the last row block of a feature block retires — the canonical
+Pallas accumulate-then-flush pattern. Each feature contributes one
 ``[BLK, B]`` one-hot built in registers and contracted against the per-row
 channel matrix; channels are the split-precision pair
 (g_hi, g_lo, h_hi, h_lo, count, pad...) so a single bf16 matmul chain
 yields ~f32-accurate sums (same trick as ops.histogram.gh_contract
 'split'). The channel dim (8) rides the f32 sublane tile exactly.
+
+Ragged leaf slices: the kernel masks rows past the dynamic ``count``
+IN-KERNEL (a per-block row iota against the live count), so the tail of
+the final row block may carry arbitrary junk — under ``tree_layout=sorted``
+a leaf's window routinely runs into the next leaf's rows, which are NOT
+zero-channel. Callers still zero the channels of rows excluded by a
+bagging mask (that information is per-row, not a prefix).
+
+Off TPU the kernel runs in Pallas interpret mode (pure XLA semantics, slow
+but exact), which keeps the tier-1 CPU parity tests honest about the code
+path the TPU default actually takes.
 """
 from __future__ import annotations
 
@@ -29,6 +43,10 @@ from jax import lax
 
 HIST_C = 3
 
+# int8 gradient levels fit signed int8: the hard cap on num_grad_quant_bins
+# (config validation names the knob; see exact_accum_limit)
+MAX_QUANT_BINS = 127
+
 try:  # pallas is TPU-only at runtime; import-guarded for CPU-only setups
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -37,50 +55,97 @@ except ImportError:  # pragma: no cover
     HAS_PALLAS = False
 
 
-def _hist_kernel(count_ref, bins_ref, gh_ref, out_ref, *, num_bins: int,
-                 fblk: int, blk: int):
+def exact_accum_limit(hist_impl: str) -> int:
+    """Largest integer the quantized-histogram level accumulator holds
+    exactly under ``hist_impl`` — the ONE source of the row-limit guard
+    queried by both the fused learner and config validation (it used to be
+    two diverging literals at models/fused_learner.py and here):
+
+    * ``pallas`` — raw int8 levels accumulate in int32 inside the kernel:
+      int32 max.
+    * anything else — levels accumulate as integer-valued float32 in the
+      one-hot contraction: 2**24, the last exactly-representable contiguous
+      integer.
+    """
+    return 2**31 - 1 if hist_impl == "pallas" else 2**24
+
+
+def _interpret() -> bool:
+    """Mosaic compiles only for TPU; everywhere else the kernel runs in
+    interpret mode (slow, exact — the CPU tier-1 parity path)."""
+    return jax.default_backend() != "tpu"
+
+
+def _hist_kernel(count_ref, bins_ref, gh_ref, out_ref, acc_ref, *,
+                 num_bins: int, fblk: int, blk: int, nrb: int):
     r = pl.program_id(1)
 
     @pl.when(r == 0)
     def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
     # compute is gated on the dynamic row count: a call padded to a large
     # static row budget only pays DMA for the dead blocks (the analog of the
-    # CUDA kernel's early-exit on out-of-range rows). Rows beyond count in
-    # the live boundary block carry zeroed gh channels.
+    # CUDA kernel's early-exit on out-of-range rows). Rows past count in
+    # the live boundary block are masked in-kernel — their bins/channels
+    # may be junk (a sorted-layout window running into the next leaf).
     @pl.when(r * blk < count_ref[0])
     def _():
         bins = bins_ref[:].astype(jnp.int32)                # [BLK, FBLK]
-        gh = gh_ref[:]                                      # [BLK, 8] bf16
+        live = count_ref[0] - r * blk
+        rmask = lax.broadcasted_iota(jnp.int32, (blk, 1), 0) < live
+        gh = jnp.where(rmask, gh_ref[:], 0)                 # [BLK, 8] bf16
         iota_b = lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
         B = num_bins
         for f in range(fblk):
             onehot = (bins[:, f:f + 1] == iota_b).astype(jnp.bfloat16)
-            out_ref[:, f * B:(f + 1) * B] += lax.dot_general(
+            acc_ref[:, f * B:(f + 1) * B] += lax.dot_general(
                 gh, onehot,
                 dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)         # [8, B]
 
+    # one HBM flush per [row_tile, feature_tile] grid column
+    @pl.when(r == nrb - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
 
 def _pick_blocks(F: int, B: int, P: int):
     """Row block 1024 (2048 for small feature counts); feature block sized
-    so the revisited [8, FBLK*B] f32 output block stays ~2 MB VMEM."""
+    so the VMEM accumulator block [8, FBLK*B] f32 stays ~2 MB."""
     blk = 2048 if F * B <= 8192 else 1024
     blk = min(blk, max(256, P))
     fblk = max(1, min(F, (2 * 1024 * 1024 // 4) // (8 * B)))
     return blk, fblk
 
 
+def _grid_spec(P: int, Fp: int, B: int, blk: int, fblk: int, acc_dtype):
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Fp // fblk, P // blk),
+        in_specs=[
+            pl.BlockSpec((blk, fblk), lambda f, r, c: (r, f),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((blk, 8), lambda f, r, c: (r, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, fblk * B), lambda f, r, c: (0, f),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((8, fblk * B), acc_dtype)],
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("num_bins",))
 def hist_pallas(bins: jax.Array, gh8: jax.Array, num_bins: int,
                 count=None) -> jax.Array:
-    """Histogram of a padded row block via the Pallas kernel.
+    """Histogram of a row block via the Pallas kernel.
 
-    bins : uint8/uint16 [P, F] gathered binned rows (invalid rows may hold
-           any bin value; their gh8 channels must be zero)
+    bins : uint8/uint16 [P, F] binned rows — either a gathered block or a
+           contiguous sorted-layout leaf slice; rows past ``count`` may
+           hold anything (masked in-kernel)
     gh8  : bf16 [P, 8] — (g_hi, g_lo, h_hi, h_lo, count, 0, 0, 0),
-           see :func:`pack_gh8`
+           see :func:`pack_gh8`; bagging-masked rows must carry zero
+           channels (the count mask only covers the ragged tail)
     count: optional dynamic number of live rows (<= P); blocks past it skip
            compute, so heavily padded calls cost ~DMA only
     Returns f32 [F, B, 3] (sum_grad, sum_hess, count).
@@ -99,22 +164,12 @@ def hist_pallas(bins: jax.Array, gh8: jax.Array, num_bins: int,
         bins = jnp.pad(bins, ((0, 0), (0, Fp - F)))
     count = jnp.asarray([P if count is None else count], jnp.int32)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(Fp // fblk, P // blk),
-        in_specs=[
-            pl.BlockSpec((blk, fblk), lambda f, r, c: (r, f),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((blk, 8), lambda f, r, c: (r, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((8, fblk * B), lambda f, r, c: (0, f),
-                               memory_space=pltpu.VMEM),
-    )
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, num_bins=B, fblk=fblk, blk=blk),
+        functools.partial(_hist_kernel, num_bins=B, fblk=fblk, blk=blk,
+                          nrb=P // blk),
         out_shape=jax.ShapeDtypeStruct((8, Fp * B), jnp.float32),
-        grid_spec=grid_spec,
+        grid_spec=_grid_spec(P, Fp, B, blk, fblk, jnp.float32),
+        interpret=_interpret(),
     )(count, bins, gh8)
 
     out = out.reshape(8, Fp, B)[:, :F]                      # [8, F, B]
@@ -149,26 +204,32 @@ def pack_gh8(grad: jax.Array, hess: jax.Array, valid: jax.Array) -> jax.Array:
 # (larger N/F or full-speed MXU).
 # ---------------------------------------------------------------------------
 
-def _hist_kernel_q(count_ref, bins_ref, gh_ref, out_ref, *, num_bins: int,
-                   fblk: int, blk: int):
+def _hist_kernel_q(count_ref, bins_ref, gh_ref, out_ref, acc_ref, *,
+                   num_bins: int, fblk: int, blk: int, nrb: int):
     r = pl.program_id(1)
 
     @pl.when(r == 0)
     def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
     @pl.when(r * blk < count_ref[0])
     def _():
         bins = bins_ref[:].astype(jnp.int32)                # [BLK, FBLK]
-        gh = gh_ref[:]                                      # [BLK, 8] int8
+        live = count_ref[0] - r * blk
+        rmask = lax.broadcasted_iota(jnp.int32, (blk, 1), 0) < live
+        gh = jnp.where(rmask, gh_ref[:], 0)                 # [BLK, 8] int8
         iota_b = lax.broadcasted_iota(jnp.int32, (1, num_bins), 1)
         B = num_bins
         for f in range(fblk):
             onehot = (bins[:, f:f + 1] == iota_b).astype(jnp.int8)
-            out_ref[:, f * B:(f + 1) * B] += lax.dot_general(
+            acc_ref[:, f * B:(f + 1) * B] += lax.dot_general(
                 gh, onehot,
                 dimension_numbers=(((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32)           # [8, B] i32
+
+    @pl.when(r == nrb - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
 
 
 @functools.partial(jax.jit, static_argnames=("num_bins",))
@@ -177,7 +238,9 @@ def hist_pallas_q(bins: jax.Array, ghq8: jax.Array, num_bins: int,
     """Quantized histogram: int8 channels, exact int32 accumulation.
 
     ghq8: int8 [P, 8] — (g_q, h_q, in_bag, 0...), see :func:`pack_ghq8`.
-    Returns int32 [F, B, 3] (sum_gq, sum_hq, count).
+    Rows past ``count`` are masked in-kernel (sorted-layout windows may
+    carry the next leaf's rows there). Returns int32 [F, B, 3]
+    (sum_gq, sum_hq, count).
     """
     P, F = bins.shape
     B = num_bins
@@ -192,22 +255,12 @@ def hist_pallas_q(bins: jax.Array, ghq8: jax.Array, num_bins: int,
         bins = jnp.pad(bins, ((0, 0), (0, Fp - F)))
     count = jnp.asarray([P if count is None else count], jnp.int32)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(Fp // fblk, P // blk),
-        in_specs=[
-            pl.BlockSpec((blk, fblk), lambda f, r, c: (r, f),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((blk, 8), lambda f, r, c: (r, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((8, fblk * B), lambda f, r, c: (0, f),
-                               memory_space=pltpu.VMEM),
-    )
     out = pl.pallas_call(
-        functools.partial(_hist_kernel_q, num_bins=B, fblk=fblk, blk=blk),
+        functools.partial(_hist_kernel_q, num_bins=B, fblk=fblk, blk=blk,
+                          nrb=P // blk),
         out_shape=jax.ShapeDtypeStruct((8, Fp * B), jnp.int32),
-        grid_spec=grid_spec,
+        grid_spec=_grid_spec(P, Fp, B, blk, fblk, jnp.int32),
+        interpret=_interpret(),
     )(count, bins, ghq8)
     out = out.reshape(8, Fp, B)[:, :F]
     return jnp.stack([out[0], out[1], out[2]], axis=-1)     # [F, B, 3] i32
@@ -234,7 +287,7 @@ def quantize_gradients(grad: jax.Array, hess: jax.Array, key,
     pre-partitioned multi-process path passes GLOBAL maxima so every rank
     derives identical scales (the distributed analog of the reference
     syncing gradient scales before histogram reduction)."""
-    qb = max(2, min(num_bins, 127))   # int8 hessian levels reach qb
+    qb = max(2, min(num_bins, MAX_QUANT_BINS))
     half = max(qb // 2, 1)
     if gmax is None:
         gmax = jnp.maximum(jnp.max(jnp.abs(grad)), 1e-12)
@@ -252,6 +305,6 @@ def quantize_gradients(grad: jax.Array, hess: jax.Array, key,
     else:
         g = jnp.round(g)
         h = jnp.round(h)
-    gq = jnp.clip(g, -127, 127).astype(jnp.int8)
-    hq = jnp.clip(h, 0, 127).astype(jnp.int8)
+    gq = jnp.clip(g, -MAX_QUANT_BINS, MAX_QUANT_BINS).astype(jnp.int8)
+    hq = jnp.clip(h, 0, MAX_QUANT_BINS).astype(jnp.int8)
     return gq, hq, gs, hs

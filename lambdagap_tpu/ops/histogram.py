@@ -165,6 +165,34 @@ def leaf_histogram(x_binned: jax.Array, perm: jax.Array, grad: jax.Array,
                                precision)
 
 
+@functools.partial(jax.jit, static_argnames=("padded_size", "num_bins",
+                                             "rows_per_block", "precision"))
+def leaf_histogram_sorted(x_sorted: jax.Array, gh_sorted: jax.Array,
+                          begin: jax.Array, count: jax.Array,
+                          padded_size: int, num_bins: int,
+                          rows_per_block: int = 4096,
+                          precision: str = "split") -> jax.Array:
+    """Histogram for one leaf under ``tree_layout=sorted``: the leaf's rows
+    occupy a contiguous position slice of the physically reordered matrix
+    (maintained by :func:`..ops.partition.split_partition_sorted`), so the
+    read is a consecutive-index window — no row gather through the
+    permutation (docs/performance.md).
+
+    gh_sorted: f32 [N, 2 or 3] — (grad, hess[, in-bag]) permuted alongside
+    the rows; the optional third channel carries the bagging mask so the
+    count channel matches the gather path's ``row_mask`` semantics.
+    """
+    lane = jnp.arange(padded_size, dtype=jnp.int32)
+    idx = jnp.clip(begin + lane, 0, x_sorted.shape[0] - 1)
+    valid = lane < count
+    bins = x_sorted[idx]
+    gh = gh_sorted[idx]
+    if gh_sorted.shape[1] > 2:
+        valid = valid & (gh[:, 2] > 0)
+    return histogram_from_rows(bins, gh[:, 0], gh[:, 1], valid, num_bins,
+                               rows_per_block, precision)
+
+
 def unbundle_hist(hist_b: jax.Array, src: jax.Array, kind: jax.Array,
                   parent_g, parent_h, parent_c) -> jax.Array:
     """Expand a bundled-column histogram back to per-feature space.

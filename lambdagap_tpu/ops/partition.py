@@ -80,3 +80,51 @@ def split_partition(x_binned: jax.Array, perm: jax.Array,
     # original values (they sort after all valid lanes, preserving order)
     new_perm = perm.at[idx].set(new_slice, mode="drop")
     return new_perm, left_count
+
+
+@functools.partial(jax.jit, static_argnames=("padded_size",))
+def split_partition_sorted(x_sorted: jax.Array, gh_sorted: jax.Array,
+                           perm: jax.Array, begin: jax.Array,
+                           count: jax.Array, feature: jax.Array,
+                           threshold: jax.Array, default_left: jax.Array,
+                           default_bin: jax.Array, missing_type: jax.Array,
+                           num_bin: jax.Array, is_categorical: jax.Array,
+                           cat_bitset: jax.Array, padded_size: int):
+    """:func:`split_partition` under ``tree_layout=sorted``: the stable
+    partition of one leaf's slice is applied PHYSICALLY — the binned row
+    payload (``x_sorted``, position-ordered [N, F]) and the gradient
+    channels (``gh_sorted``, [N, 2 or 3] f32 grad/hess[/in-bag]) are
+    permuted alongside the permutation array, so the next histogram pass
+    reads the leaf as a contiguous stream (docs/performance.md).
+
+    The split feature's bin values come straight out of the sorted window
+    (a consecutive-index read) instead of a row gather through ``perm``.
+    Functional updates (no donation): this is the host-orchestrated oracle
+    path; the zero-copy production variant lives inside the fused program.
+
+    Returns ``(new_perm, new_x_sorted, new_gh_sorted, left_count)``.
+    """
+    N = perm.shape[0]
+    lane = jnp.arange(padded_size, dtype=jnp.int32)
+    idx = begin + lane
+    safe_idx = jnp.clip(idx, 0, N - 1)
+    rows = perm[safe_idx]
+    valid = lane < count
+
+    bin_vals = x_sorted[safe_idx, feature]
+    go_left = decision_go_left(bin_vals, threshold, default_left, default_bin,
+                               missing_type, num_bin, is_categorical,
+                               cat_bitset)
+    go_left = go_left & valid
+
+    key = jnp.where(go_left, 0, jnp.where(valid, 1, 2)).astype(jnp.int32)
+    order = jnp.argsort(key * padded_size + lane)
+    left_count = jnp.sum(go_left, dtype=jnp.int32)
+
+    # the same scatter-back contract as split_partition: padding lanes sort
+    # after all valid lanes in their original order, so they rewrite their
+    # own values; out-of-range lanes drop
+    new_perm = perm.at[idx].set(rows[order], mode="drop")
+    new_x = x_sorted.at[idx].set(x_sorted[safe_idx][order], mode="drop")
+    new_gh = gh_sorted.at[idx].set(gh_sorted[safe_idx][order], mode="drop")
+    return new_perm, new_x, new_gh, left_count

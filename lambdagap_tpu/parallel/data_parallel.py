@@ -53,6 +53,11 @@ from .mesh import DATA_AXIS, make_mesh
 class DataParallelTreeLearner(SerialTreeLearner):
     """Rows sharded over the mesh; histograms psum-reduced over ICI."""
 
+    # the host-loop distributed learners histogram through their own
+    # sharded-matrix hooks; they opt out of the physically sorted layout
+    # (the fused data-parallel learner supports it in-program)
+    supports_sorted_layout = False
+
     def __init__(self, dataset: BinnedDataset, config: Config,
                  mesh: Optional[Mesh] = None) -> None:
         super().__init__(dataset, config)

@@ -40,6 +40,10 @@ from .mesh import DATA_AXIS, make_mesh
 class FeatureParallelTreeLearner(SerialTreeLearner):
     """Serial loop + feature-blocked histogram construction."""
 
+    # feature-blocked histogram hooks read the shared column layout;
+    # explicit opt-out of the physically sorted row layout
+    supports_sorted_layout = False
+
     def __init__(self, dataset: BinnedDataset, config: Config,
                  mesh: Optional[Mesh] = None) -> None:
         super().__init__(dataset, config)

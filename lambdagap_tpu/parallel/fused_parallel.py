@@ -107,8 +107,22 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
         # static replication tracker cannot see through.
         body = functools.partial(self._train_tree_impl, has_mask=True)
         qspec = P(DATA_AXIS) if self.quant else P()
+        # tree_layout=sorted: the leaf-ordered packed buffer is built by a
+        # separate shard_map pre-pass (rows sharded, per-shard W pad rows
+        # included in the global layout) and consumed by the training body
+        # as one more row-sharded input; everything the per-split
+        # permutation-apply touches is shard-local, so the histogram psum
+        # stays the only collective per split
+        srows_spec = P(DATA_AXIS, None) if self.layout == "sorted" else P()
+        if self.layout == "sorted":
+            self._layout_jit_dp = jax.jit(shard_map(
+                functools.partial(self._build_sorted_impl, has_mask=True),
+                mesh=self.mesh,
+                in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                          P(DATA_AXIS, None), qspec, qspec),
+                out_specs=P(DATA_AXIS, None), check_vma=False))
         in_specs = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(),
-                    P(DATA_AXIS, None), P(None, DATA_AXIS),
+                    P(DATA_AXIS, None), P(None, DATA_AXIS), srows_spec,
                     qspec, qspec, P(), P(), P())
         out_specs = DeviceTree(
             node_feature=P(), node_threshold=P(), node_default_left=P(),
@@ -258,8 +272,13 @@ class FusedDataParallelTreeLearner(FusedTreeLearner):
             ekey = jnp.stack([e, b])            # [2, 2]: extra / by-node
         else:
             ekey = jnp.zeros((2, 2), jnp.uint32)
+        if self.layout == "sorted":
+            with self.telemetry.phase("layout_apply"):
+                srows = self._layout_jit_dp(g, h, m, self.hx_rows, gq, hq)
+        else:
+            srows = self._srows_dummy
         rec = self._train_jit_dp(g, h, m, fmask, self.hx_rows, self.x_cols,
-                                 gq, hq, gs, hs, ekey)
+                                 srows, gq, hq, gs, hs, ekey)
         if _DEBUG_CHECKS:
             self._check_shard_agreement(rec)
         # consumers (score update, leaf renewal) see an unpadded [N] leaf map
@@ -291,6 +310,12 @@ class FusedFeatureParallelTreeLearner(FusedTreeLearner):
     psum broadcast of the winning feature's column for the partition —
     zero per-split host syncs (the host-loop variant in
     feature_parallel.py pays a D2H per split; this one does not)."""
+
+    # the winning split's column lives on ONE shard and is psum-broadcast
+    # for the (row-replicated) partition; the sorted layout's
+    # decode-from-window shortcut cannot express that, so this learner
+    # explicitly opts out and keeps the gather layout
+    supports_sorted_layout = False
 
     def __init__(self, dataset: BinnedDataset, config: Config,
                  mesh: Optional[Mesh] = None) -> None:
@@ -336,17 +361,18 @@ class FusedFeatureParallelTreeLearner(FusedTreeLearner):
         else:
             self._real_F = self.num_features
 
-        def sharded(grad, hess, mask, fmask, xr, xc, gq, hq, gs, hs, ekey,
-                    *, has_mask):
+        def sharded(grad, hess, mask, fmask, xr, xc, srows, gq, hq, gs, hs,
+                    ekey, *, has_mask):
             body = functools.partial(self._train_tree_impl,
                                      has_mask=has_mask)
             return shard_map(
                 body, mesh=self.mesh,
                 in_specs=(P(), P(), P(), P(), P(None, DATA_AXIS),
-                          P(DATA_AXIS, None), P(), P(), P(), P(), P()),
+                          P(DATA_AXIS, None), P(), P(), P(), P(), P(),
+                          P()),
                 out_specs=DeviceTree(*([P()] * len(DeviceTree._fields))),
-                check_vma=False)(grad, hess, mask, fmask, xr, xc, gq, hq,
-                                 gs, hs, ekey)
+                check_vma=False)(grad, hess, mask, fmask, xr, xc, srows,
+                                 gq, hq, gs, hs, ekey)
 
         self._train_jit = jax.jit(sharded, static_argnames=("has_mask",))
 
@@ -402,9 +428,12 @@ class FusedVotingParallelTreeLearner(FusedDataParallelTreeLearner):
             # voting stores RAW integer level sums in the float32 per-leaf
             # histogram state until the voted-column psum (the full-histogram
             # paths scale immediately after their psum), so exactness is
-            # bounded by the f32 integer range, not the int32 accumulator
-            qb = max(2, min(config.num_grad_quant_bins, 127))
-            self.quant_exact = dataset.num_data * qb < 2**24
+            # bounded by the f32 integer range, not the int32 accumulator —
+            # i.e. the one-hot limit regardless of the configured kernel
+            from ..ops.hist_pallas import exact_accum_limit
+            qb = config.num_grad_quant_bins
+            self.quant_exact = (dataset.num_data * qb
+                                < exact_accum_limit("onehot"))
             if not self.quant_exact:
                 log.warning("quantized voting-parallel level sums may exceed "
                             "the float32-exact range (%d rows x %d levels); "

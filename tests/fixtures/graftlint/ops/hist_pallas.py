@@ -1,0 +1,24 @@
+"""R1 fixture (Pallas histogram path): a D2H read inside the per-tile
+loop of ops/hist_pallas.py serializes every histogram chunk of every
+split — flagged even though the enclosing function name is arbitrary."""
+import jax
+import jax.numpy as jnp
+
+
+def tiled_hist_kernel_wrapper(bins, gh, fblk):
+    acc = jnp.zeros((8, bins.shape[1]), jnp.float32)
+    for f in range(fblk):
+        acc = acc + gh
+        _ = float(jnp.sum(acc))  # BAD:R1
+    return acc
+
+
+def hist_pallas(bins, gh8, num_bins):
+    # hot by function name, no loop needed
+    out = jnp.sum(gh8)
+    return jax.device_get(out)  # BAD:R1
+
+
+def pick_blocks_host(shape):
+    # not a hot name, not in a loop: fine (one-time block-shape choice)
+    return jax.device_get(jnp.asarray(shape))

@@ -56,6 +56,7 @@ def fixture_findings():
     "r1_host_sync.py",
     "serve/r1_serve_loop.py",
     "ops/predict_tensor.py",
+    "ops/hist_pallas.py",
     "r2_recompile.py",
     "r3_clamped_slice.py",
     "r4_dtype_drift.py",

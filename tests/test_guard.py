@@ -273,6 +273,37 @@ def test_sigkill_mid_train_auto_resume_identical_model(tmp_path):
         "resumed model trees must be byte-identical to the uninterrupted run"
 
 
+def test_sigkill_resume_sorted_layout_identical_model(tmp_path):
+    """ISSUE-6 satellite: SIGKILL + resume=auto under tree_layout=sorted
+    must stay byte-identical to an uninterrupted run. The sorted physical
+    layout is rebuilt from scratch every tree (gradients change per
+    iteration, the permutation restarts at identity), so nothing about it
+    is — or needs to be — serialized in the snapshot."""
+    X, y = _data(500, seed=7)
+    np.savetxt(str(tmp_path / "train.csv"),
+               np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+    args = ["task=train", "data=train.csv", "label_column=0",
+            "objective=regression", "boost_from_average=false",
+            "num_iterations=6", "snapshot_freq=1", "bagging_fraction=0.7",
+            "bagging_freq=1", "min_data_in_leaf=5", "verbose=1",
+            "resume=auto", "tpu_fused_learner=1", "tree_layout=sorted"]
+    r = _cli(args + ["output_model=m_crash.txt"], tmp_path,
+             faults="crash_at_iter=3")
+    assert r.returncode == -9, f"expected SIGKILL, got {r.returncode}: " \
+        f"{r.stdout}\n{r.stderr}"
+    r = _cli(args + ["output_model=m_crash.txt"], tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Resumed from snapshot" in r.stdout + r.stderr
+
+    r = _cli(args + ["output_model=m_ref.txt"], tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    resumed = (tmp_path / "m_crash.txt").read_text()
+    ref = (tmp_path / "m_ref.txt").read_text()
+    split = "end of trees"
+    assert resumed.split(split)[0] == ref.split(split)[0], \
+        "sorted-layout resumed model must be byte-identical"
+
+
 def test_cli_resume_skips_torn_final_snapshot(tmp_path):
     """A snapshot torn by the crash is rejected by its checksum and the
     previous good snapshot is used."""

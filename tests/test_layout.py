@@ -1,0 +1,302 @@
+"""Sorted-leaf physical layout (tree_layout) + the Pallas histogram kernel.
+
+The ISSUE-6 acceptance surface, all runnable on CPU in tier-1:
+
+- ``tree_layout=sorted`` must be bit-identical to the gather oracle —
+  same rows through the same arithmetic in the same order — across ragged
+  leaf slices, bagging masks, categorical splits, EFB-bundled features,
+  the quantized path, and both learners (host-serial and fused).
+- The Pallas kernel (the TPU default since ``tpu_hist_impl=auto``
+  graduated it) runs here in interpret mode: exact-reference parity for
+  the int32 quantized path, split-precision tolerance for f32, in-kernel
+  masking of ragged tails whose rows carry junk (a sorted window running
+  into the next leaf), and layout invariance (gathered block == contiguous
+  pre-sorted block, bit for bit).
+"""
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.config import Config
+
+
+def _trees(booster) -> str:
+    return booster.model_to_string().split("end of trees")[0]
+
+
+def _data(n=900, d=8, seed=11, cat=False):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d)
+    if cat:
+        X[:, 0] = rng.randint(0, 9, n)
+    y = (X[:, 1] + np.sin(X[:, 2] * 2)
+         + ((X[:, 0] % 3) if cat else X[:, 3]) * 0.5 + 0.1 * rng.randn(n))
+    return X, y
+
+
+def _train(X, y, layout, extra=None, rounds=4, cat=False):
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 10, "learning_rate": 0.1, "verbose": -1,
+              "tpu_fused_learner": "1", "tpu_hist_impl": "onehot",
+              "tree_layout": layout}
+    if extra:
+        params.update(extra)
+    ds = lgb.Dataset(X, label=y,
+                     categorical_feature=([0] if cat else "auto"),
+                     params=params)
+    return lgb.train(params, ds, num_boost_round=rounds)
+
+
+# -- sorted vs gather: bit-identical trees ------------------------------
+@pytest.mark.parametrize("extra", [
+    None,                             # ragged leaf slices happen on every
+    {"max_depth": 3, "lambda_l1": 0.5, "lambda_l2": 2.0},   # config: 900
+    {"bagging_fraction": 0.7, "bagging_freq": 1},  # rows never tile W
+])
+def test_fused_sorted_matches_gather(extra):
+    X, y = _data()
+    bg = _train(X, y, "gather", extra)
+    bs = _train(X, y, "sorted", extra)
+    assert _trees(bg) == _trees(bs)
+    assert np.array_equal(bg.predict(X[:100]), bs.predict(X[:100]))
+
+
+def test_fused_sorted_matches_gather_categorical():
+    X, y = _data(cat=True)
+    bg = _train(X, y, "gather", cat=True)
+    bs = _train(X, y, "sorted", cat=True)
+    assert _trees(bg) == _trees(bs)
+
+
+def test_fused_sorted_matches_gather_efb_bundled():
+    """EFB-active dataset: the sorted buffer holds BUNDLED columns and the
+    partition rank-decodes the split feature out of its bundle column from
+    the sorted window."""
+    rng = np.random.RandomState(0)
+    cols = []
+    for c in (8, 6, 5, 7):
+        k = rng.randint(0, c, 1400)
+        blk = np.zeros((1400, c))
+        blk[np.arange(1400), k] = 1.0
+        cols.append(blk)
+    X = np.column_stack(cols + [rng.randn(1400, 2)])
+    y = X[:, 0] * 0.5 - X[:, 9] * 0.3 + X[:, -2] + 0.05 * rng.randn(1400)
+    extra = {"min_data_in_bin": 1, "enable_bundle": True}
+    bg = _train(X, y, "gather", extra, rounds=5)
+    bs = _train(X, y, "sorted", extra, rounds=5)
+    assert bs._booster.learner.bundled, "EFB bundle did not form"
+    assert _trees(bg) == _trees(bs)
+
+
+def test_fused_sorted_matches_gather_quantized():
+    """int8-quantized path: the (g_q, h_q) levels ride the sorted payload;
+    identical RNG keys -> identical levels -> identical integer sums, so
+    the two layouts agree exactly (well inside the documented quantization
+    tolerance vs full precision)."""
+    X, y = _data()
+    extra = {"use_quantized_grad": True, "num_grad_quant_bins": 16}
+    bg = _train(X, y, "gather", extra)
+    bs = _train(X, y, "sorted", extra)
+    assert _trees(bg) == _trees(bs)
+
+
+def test_fused_sorted_matches_gather_quantized_bagged():
+    X, y = _data()
+    extra = {"use_quantized_grad": True, "num_grad_quant_bins": 16,
+             "bagging_fraction": 0.6, "bagging_freq": 1}
+    bg = _train(X, y, "gather", extra)
+    bs = _train(X, y, "sorted", extra)
+    assert _trees(bg) == _trees(bs)
+
+
+def test_serial_sorted_matches_gather():
+    X, y = _data()
+    extra = {"tpu_fused_learner": "0",
+             "bagging_fraction": 0.7, "bagging_freq": 1}
+    bg = _train(X, y, "gather", extra)
+    bs = _train(X, y, "sorted", extra)
+    assert _trees(bg) == _trees(bs)
+
+
+def test_fused_data_parallel_sorted_matches_gather():
+    """The fused data-parallel learner builds the sorted buffer with a
+    shard_map pre-pass; the per-split apply is shard-local."""
+    X, y = _data(n=1200)
+    extra = {"tree_learner": "data", "enable_bundle": False}
+    bg = _train(X, y, "gather", extra)
+    bs = _train(X, y, "sorted", extra)
+    assert _trees(bg) == _trees(bs)
+
+
+def test_feature_parallel_opts_out_of_sorted():
+    """The fused feature-parallel learner cannot decode the winning
+    column from the sorted window (it lives on another shard): explicit
+    opt-out, training still works."""
+    X, y = _data(n=800)
+    b = _train(X, y, "sorted", {"tree_learner": "feature"}, rounds=3)
+    assert b._booster.learner.layout == "gather"
+    assert np.isfinite(b.predict(X[:50])).all()
+
+
+def test_layout_auto_resolution():
+    """auto -> gather below the 2^20-row threshold, explicit knobs
+    honored; sorted drops the dead column-major copy."""
+    X, y = _data(n=500)
+    b_auto = _train(X, y, "auto", rounds=2)
+    assert b_auto._booster.learner.layout == "gather"
+    b_sorted = _train(X, y, "sorted", rounds=2)
+    lr = b_sorted._booster.learner
+    assert lr.layout == "sorted"
+    assert lr.x_cols.shape == (1, 1)      # placeholder, not a resident copy
+    assert b_auto._booster.learner.x_cols.shape[0] == lr.hx_rows.shape[1]
+
+
+def test_tree_layout_knob_validated():
+    with pytest.raises(Exception):
+        Config.from_params({"tree_layout": "bogus"})
+    with pytest.raises(Exception):
+        Config.from_params({"num_grad_quant_bins": 300})
+
+
+def test_telemetry_layout_apply_span_tiles_wall():
+    """The sorted rebuild cost shows up as its own phase and the spans
+    still tile the iteration wall (the ±10% discipline test_obs enforces
+    for every other phase)."""
+    X, y = _data(n=900)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 10, "verbose": -1, "telemetry": True,
+              "tpu_fused_learner": "1", "tree_layout": "sorted"}
+    b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)
+    tel = b._booster.telemetry
+    recs = list(tel.records)
+    assert recs, "telemetry produced no records"
+    assert any("layout_apply" in r["phases"] for r in recs)
+    for r in recs[1:]:
+        span = sum(v for k, v in r["phases"].items() if k != "eval")
+        assert span <= r["wall_s"] * 1.1 + 1e-3
+
+
+# -- Pallas kernel (interpret mode on CPU) ------------------------------
+def _np_hist(bins, g, h, count, B):
+    F = bins.shape[1]
+    ref = np.zeros((F, B, 3), np.float64)
+    for i in range(count):
+        for f in range(F):
+            ref[f, bins[i, f]] += [g[i], h[i], 1.0]
+    return ref
+
+
+def test_hist_pallas_matches_reference():
+    import jax.numpy as jnp
+    from lambdagap_tpu.ops.hist_pallas import hist_pallas, pack_gh8
+    rng = np.random.RandomState(0)
+    P, F, B, count = 300, 5, 16, 257          # ragged final tile
+    bins = rng.randint(0, B, (P, F)).astype(np.uint8)
+    g = rng.randn(P).astype(np.float32)
+    h = np.abs(rng.randn(P)).astype(np.float32)
+    gh8 = pack_gh8(jnp.asarray(g), jnp.asarray(h), jnp.ones(P, bool))
+    out = np.asarray(hist_pallas(jnp.asarray(bins), gh8, B, count))
+    ref = _np_hist(bins, g, h, count, B)
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=1e-4)
+    # the count channel is exact
+    np.testing.assert_array_equal(out[..., 2], ref[..., 2])
+
+
+def test_hist_pallas_ignores_junk_past_count():
+    """Rows past the dynamic count may hold ANYTHING (a sorted-layout
+    window running into the next leaf): masked in-kernel."""
+    import jax.numpy as jnp
+    from lambdagap_tpu.ops.hist_pallas import hist_pallas, pack_gh8
+    rng = np.random.RandomState(1)
+    P, F, B, count = 256, 4, 8, 100
+    bins = rng.randint(0, B, (P, F)).astype(np.uint8)
+    g = rng.randn(P).astype(np.float32)
+    h = np.abs(rng.randn(P)).astype(np.float32)
+    # junk channels past count: NOT zeroed
+    gh8 = np.asarray(pack_gh8(jnp.asarray(g), jnp.asarray(h),
+                              jnp.ones(P, bool)))
+    gh8_junk = gh8.copy()
+    gh8_junk[count:] = 99.0
+    out = np.asarray(hist_pallas(jnp.asarray(bins), jnp.asarray(gh8_junk),
+                                 B, count))
+    ref = _np_hist(bins, g, h, count, B)
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=1e-4)
+
+
+def test_hist_pallas_slice_order_invariance():
+    """A gathered row block and the physically pre-sorted contiguous block
+    holding the same rows in the same order produce bit-identical
+    histograms — the layout cannot change values, only the access
+    pattern."""
+    import jax.numpy as jnp
+    from lambdagap_tpu.ops.hist_pallas import hist_pallas, pack_gh8
+    rng = np.random.RandomState(2)
+    P, F, B = 512, 6, 16
+    bins = rng.randint(0, B, (P, F)).astype(np.uint8)
+    g = rng.randn(P).astype(np.float32)
+    h = np.abs(rng.randn(P)).astype(np.float32)
+    perm = rng.permutation(P)
+    gh8 = np.asarray(pack_gh8(jnp.asarray(g), jnp.asarray(h),
+                              jnp.ones(P, bool)))
+    out_gather = np.asarray(hist_pallas(jnp.asarray(bins[perm]),
+                                        jnp.asarray(gh8[perm]), B, P))
+    sb, sg = np.ascontiguousarray(bins[perm]), np.ascontiguousarray(gh8[perm])
+    out_sorted = np.asarray(hist_pallas(jnp.asarray(sb), jnp.asarray(sg),
+                                        B, P))
+    np.testing.assert_array_equal(out_gather, out_sorted)
+
+
+def test_hist_pallas_q_exact_int32():
+    import jax.numpy as jnp
+    from lambdagap_tpu.ops.hist_pallas import hist_pallas_q, pack_ghq8
+    rng = np.random.RandomState(3)
+    P, F, B, count = 300, 5, 16, 201
+    bins = rng.randint(0, B, (P, F)).astype(np.uint8)
+    gq = rng.randint(-127, 128, P).astype(np.int8)
+    hq = rng.randint(0, 128, P).astype(np.int8)
+    ghq8 = pack_ghq8(jnp.asarray(gq), jnp.asarray(hq), jnp.ones(P, bool))
+    out = np.asarray(hist_pallas_q(jnp.asarray(bins), ghq8, B, count))
+    ref = np.zeros((F, B, 3), np.int64)
+    for i in range(count):
+        for f in range(F):
+            ref[f, bins[i, f]] += [gq[i], hq[i], 1]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_fused_pallas_interpret_close_to_onehot():
+    """End-to-end: tpu_hist_impl=pallas (interpret mode on CPU) trains a
+    model whose predictions track the one-hot contraction's — the two
+    accumulate in different orders/precisions, so this is a tolerance
+    check, not bit-parity (bit-parity is asserted per layout, per impl)."""
+    X, y = _data(n=400)
+    p = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 10,
+         "verbose": -1, "tpu_fused_learner": "1"}
+    b1 = lgb.train({**p, "tpu_hist_impl": "onehot"},
+                   lgb.Dataset(X, label=y), num_boost_round=3)
+    b2 = lgb.train({**p, "tpu_hist_impl": "pallas"},
+                   lgb.Dataset(X, label=y), num_boost_round=3)
+    np.testing.assert_allclose(b2.predict(X[:100]), b1.predict(X[:100]),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_fused_pallas_sorted_bit_identical_to_gather():
+    """The f32 acceptance bar: under the Pallas kernel, tree_layout=sorted
+    is bit-identical to the gather oracle."""
+    X, y = _data(n=400)
+    p = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 10,
+         "verbose": -1, "tpu_fused_learner": "1", "tpu_hist_impl": "pallas"}
+    bg = lgb.train({**p, "tree_layout": "gather"},
+                   lgb.Dataset(X, label=y), num_boost_round=2)
+    bs = lgb.train({**p, "tree_layout": "sorted"},
+                   lgb.Dataset(X, label=y), num_boost_round=2)
+    assert _trees(bg) == _trees(bs)
+
+
+def test_exact_accum_limit_single_source():
+    """The quantized-accumulator guard and config validation share one
+    helper (the old code had two diverging literals)."""
+    from lambdagap_tpu.ops.hist_pallas import (MAX_QUANT_BINS,
+                                               exact_accum_limit)
+    assert exact_accum_limit("pallas") == 2**31 - 1
+    assert exact_accum_limit("onehot") == 2**24
+    assert MAX_QUANT_BINS == 127

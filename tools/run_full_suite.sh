@@ -37,7 +37,7 @@ python -m pytest tests/test_train.py tests/test_rank.py tests/test_cli_io.py -q 
 echo "=== G3 $(date)"
 python -m pytest tests/test_monotone.py tests/test_tree_options.py tests/test_extra_contri.py tests/test_forced_splits.py -q 2>&1 | tail -1
 echo "=== G4 $(date)"
-python -m pytest tests/test_fused.py tests/test_distributed.py tests/test_quantized.py tests/test_continued.py tests/test_model_io.py tests/test_shap_json.py -q 2>&1 | tail -1
+python -m pytest tests/test_fused.py tests/test_layout.py tests/test_distributed.py tests/test_quantized.py tests/test_continued.py tests/test_model_io.py tests/test_shap_json.py -q 2>&1 | tail -1
 echo "=== G5 $(date)"
 python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py tests/test_serve.py tests/test_serve_stress.py -q 2>&1 | tail -1
 echo "=== G6 full-length consistency $(date)"
