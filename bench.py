@@ -532,13 +532,10 @@ def run_layout_ab(rows: int, max_bin: int, iters: int) -> None:
     }
     construct_s = time.time() - t0
 
-    def _sync(b):
-        np.asarray(b._booster.scores[0][:1])
-
     for b in boosters.values():          # compile + warm both arms
         b.update()
         b.update()
-        _sync(b)
+        np.asarray(b._booster.scores[0][:1])   # device-complete warmup
 
     seg = max(iters // 4, 3)
     segs = {"sorted": [], "gather": []}
@@ -548,7 +545,8 @@ def run_layout_ab(rows: int, max_bin: int, iters: int) -> None:
             t0 = time.time()
             for _ in range(seg):
                 b.update()
-            _sync(b)
+            # device-complete before the clock read (graftlint R7)
+            np.asarray(b._booster.scores[0][:1])
             segs[layout].append((time.time() - t0) / seg)
     per_iter = {k: float(np.median(v)) for k, v in segs.items()}
 
