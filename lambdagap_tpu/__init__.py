@@ -10,7 +10,7 @@ from .basic import Booster, Dataset, Sequence
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
-from .data import BinnedDataset, Metadata
+from .data import BinnedDataset, Metadata, ShardedBinnedDataset
 from .engine import CVBooster, cv, train
 from .parallel.cluster import train_cluster
 from .models import GBDT, Tree
@@ -20,7 +20,7 @@ from .utils.log import register_logger
 __version__ = "0.1.0"
 
 __all__ = ["Booster", "Dataset", "Sequence", "Config", "BinnedDataset",
-           "train_cluster",
+           "ShardedBinnedDataset", "train_cluster",
            "Metadata", "GBDT", "Tree", "train", "cv", "CVBooster",
            "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
            "early_stopping", "EarlyStopException", "log_evaluation",

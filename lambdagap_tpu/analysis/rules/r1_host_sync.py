@@ -48,10 +48,20 @@ HOT_FUNCTIONS = frozenset({
     # once per leaf chunk inside the fused split loop — the hottest call
     # site in training
     "hist_pallas", "hist_pallas_q",
+    # out-of-core stream surfaces (data/stream.py + the learners' stream
+    # modes): a blocking host sync inside the shard-ring fill or the
+    # window pump defeats the H2D/compute overlap SILENTLY — training
+    # still converges, just at un-overlapped link speed; the intentional
+    # syncs (ring-slot completion, the per-split pick/go_left fetches)
+    # carry written justifications
+    "stream_windows", "wait_ready", "_train_tree_stream",
+    "_stream_small_hist", "_root_histogram_stream",
+    "_leaf_histogram_stream", "_split_partition_stream",
 })
 
 # files whose loop bodies are hot regardless of function name
-HOT_PATHS = ("/serve/", "/ops/predict_tensor", "/ops/hist_pallas")
+HOT_PATHS = ("/serve/", "/ops/predict_tensor", "/ops/hist_pallas",
+             "/data/stream")
 
 _JAXISH = ("jax.", "jnp.", "lax.")
 

@@ -48,9 +48,13 @@ _ASYNC_TAILS = frozenset({"update", "train_device", "train_one_iter",
                           "get_gradients", "get_gradients_fast", "boosting"})
 
 # a call with any of these names anywhere in the bracket forces device
-# completion (or converts to host data) before the delta is read
+# completion (or converts to host data) before the delta is read.
+# "wait_ready" is the stream ring's slot-completion sync
+# (data/stream.py ShardRing.wait_ready): a timing bracket closed by
+# draining the ring IS device-complete for the transfers it measures —
+# the legitimate bracket of the prefetch-overlap instrumentation
 _SYNC_TAILS = frozenset({"block_until_ready", "device_get", "asarray",
-                         "array", "item", "result"})
+                         "array", "item", "result", "wait_ready"})
 _SYNC_NAMES = frozenset({"float", "int"})
 
 
@@ -90,7 +94,7 @@ class UnsyncedTimingRule(Rule):
                    "dispatch with no completion sync (block_until_ready/"
                    "device_get/np.asarray/float) — measures dispatch "
                    "latency, not device work")
-    path_filter = ("/obs/", "/bench", "/tools/bench_")
+    path_filter = ("/obs/", "/bench", "/tools/bench_", "/data/stream")
 
     def check(self, ctx: ModuleContext, index: PackageIndex
               ) -> Iterator[Finding]:

@@ -170,6 +170,22 @@ class Dataset:
     def construct(self, config: Optional[Config] = None) -> BinnedDataset:
         if self._constructed is not None:
             return self._constructed
+        if isinstance(self.data, BinnedDataset):
+            # pre-constructed dataset passthrough — the route for a
+            # streamingly built ShardedBinnedDataset (data/stream.py),
+            # whose matrix should never round-trip through a raw array
+            self._constructed = self.data
+            md = self._constructed.metadata
+            if self.label is not None and md.label is None:
+                md.label = np.asarray(self.label,
+                                      np.float32).reshape(-1)
+            if self.weight is not None and md.weight is None:
+                md.weight = np.asarray(self.weight,
+                                       np.float32).reshape(-1)
+            if self.group is not None and md.query_boundaries is None:
+                md.set_group(np.asarray(self.group))
+            md.check(self._constructed.num_data)
+            return self._constructed
         cfg = config or Config.from_params(self.params)
         # Arrow metadata vectors normalize once at the boundary (reference:
         # the Arrow field paths of LGBM_DatasetSetField, src/c_api.cpp)

@@ -419,6 +419,27 @@ class Config:
     tpu_num_devices: int = 0                  # 0 = all visible devices
     tpu_fused_learner: str = "auto"           # auto / 1 / 0: whole-tree-on-device
     tpu_fast_predict_rows: int = 10000        # route predict batches up to this many rows through the threaded native traverser
+    # -- out-of-core streaming training (docs/performance.md) -------------
+    # where the packed binned matrix lives during training:
+    #   hbm    — device-resident for the whole run (the historical path;
+    #            rows capped by what one chip's HBM holds)
+    #   stream — host-RAM (optionally disk-backed) row shards with async
+    #            double-buffered H2D window prefetch overlapped with the
+    #            histogram/partition passes; trees are bit-identical to
+    #            the resident path
+    #   auto   — stream when the training set is a ShardedBinnedDataset
+    #            (or its estimated device residency exceeds
+    #            stream_hbm_budget_mb when that budget is set), hbm
+    #            otherwise
+    data_residency: str = "auto"              # auto / hbm / stream
+    stream_shard_rows: int = 1 << 20          # rows per host shard (last one ragged)
+    stream_prefetch_depth: int = 2            # in-flight H2D window transfers (2 = classic double buffer)
+    stream_goss_compact: bool = True          # with a sampling mask, transfer only in-bag rows per window (device re-expands; bit-identical)
+    stream_spill_dir: str = ""                # when set, shards are np.memmap files here (disk-backed out-of-core)
+    stream_hbm_budget_mb: int = 0             # data_residency=auto streams above this estimated residency; 0 = only pre-sharded datasets stream
+    stream_sketch_budget: int = 65536         # distinct values kept per feature by the streaming quantile sketch (exact below, GK-compacted above)
+    stream_ingest_threshold_mb: int = 256     # data files larger than this load block-wise through the sketch/push path
+
     # gradient operand precision for the MXU histogram contraction:
     #   split — two-term bf16 (hi + residual) decomposition, ~f32-accurate
     #           at one extra matmul row-block (default; the reference
@@ -556,6 +577,19 @@ class Config:
             (self.tree_layout in ("auto", "gather", "sorted"),
              f"tree_layout must be auto/gather/sorted, "
              f"got {self.tree_layout!r}"),
+            (self.data_residency in ("auto", "hbm", "stream"),
+             f"data_residency must be auto/hbm/stream, "
+             f"got {self.data_residency!r}"),
+            (self.stream_shard_rows >= 1,
+             "stream_shard_rows must be >= 1"),
+            (1 <= self.stream_prefetch_depth <= 16,
+             "stream_prefetch_depth must be in [1, 16]"),
+            (self.stream_hbm_budget_mb >= 0,
+             "stream_hbm_budget_mb must be >= 0"),
+            (self.stream_sketch_budget >= 256,
+             "stream_sketch_budget must be >= 256"),
+            (self.stream_ingest_threshold_mb >= 0,
+             "stream_ingest_threshold_mb must be >= 0"),
             (2 <= self.num_grad_quant_bins <= MAX_QUANT_BINS,
              f"num_grad_quant_bins must be in [2, {MAX_QUANT_BINS}] "
              f"(int8 histogram levels), got {self.num_grad_quant_bins}"),

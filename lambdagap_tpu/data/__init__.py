@@ -1,4 +1,6 @@
-from .binning import BinMapper
+from .binning import BinMapper, QuantileSketch
 from .dataset import BinnedDataset, Metadata
+from .stream import ShardedBinnedDataset
 
-__all__ = ["BinMapper", "BinnedDataset", "Metadata"]
+__all__ = ["BinMapper", "QuantileSketch", "BinnedDataset", "Metadata",
+           "ShardedBinnedDataset"]

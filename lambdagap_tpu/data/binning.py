@@ -234,11 +234,40 @@ class BinMapper:
         src/io/bin.cpp:302+ FindBin); zero count is inferred from
         ``total_sample_cnt``. NaNs may be present.
         """
-        m = cls(bin_type=bin_type)
         vals = np.asarray(sample_values, dtype=np.float64)
         na_mask = np.isnan(vals)
         na_cnt = int(na_mask.sum())
         non_na = vals[~na_mask]
+        if len(non_na) > 0:
+            distinct, counts = _distinct_with_counts(np.sort(non_na))
+        else:
+            distinct, counts = np.empty(0), np.empty(0, dtype=np.int64)
+        return cls.find_bin_distinct(
+            distinct, counts, nonzero_cnt=len(non_na), na_cnt=na_cnt,
+            total_sample_cnt=total_sample_cnt, max_bin=max_bin,
+            min_data_in_bin=min_data_in_bin, bin_type=bin_type,
+            use_missing=use_missing, zero_as_missing=zero_as_missing,
+            forced_bounds=forced_bounds)
+
+    @classmethod
+    def find_bin_distinct(cls, distinct: np.ndarray, counts: np.ndarray,
+                          nonzero_cnt: int, na_cnt: int,
+                          total_sample_cnt: int,
+                          max_bin: int, min_data_in_bin: int,
+                          bin_type: str = BIN_NUMERICAL,
+                          use_missing: bool = True,
+                          zero_as_missing: bool = False,
+                          forced_bounds: Sequence[float] = ()) -> "BinMapper":
+        """:meth:`find_bin` over a pre-aggregated (distinct, counts) pair —
+        the entry point for the incremental :class:`QuantileSketch`, which
+        never holds raw sample values. ``nonzero_cnt`` is the number of
+        non-NaN values the aggregation covers; the zero count is inferred
+        from ``total_sample_cnt`` exactly like the raw-sample path."""
+        m = cls(bin_type=bin_type)
+        distinct = np.asarray(distinct, dtype=np.float64)
+        # the zero-count insertion below mutates counts in place; the
+        # caller's aggregation (a reusable sketch) must not see it
+        counts = np.array(counts, dtype=np.int64, copy=True)
 
         if not use_missing:
             m.missing_type = MISSING_NONE
@@ -251,15 +280,10 @@ class BinMapper:
         # (reference: src/io/bin.cpp:318-340)
         if m.missing_type != MISSING_NAN:
             na_cnt = 0
-        zero_cnt = max(int(total_sample_cnt - len(non_na) - na_cnt), 0)
+        zero_cnt = max(int(total_sample_cnt - nonzero_cnt - na_cnt), 0)
 
         # distinct values with counts, zero inserted with its inferred count
         # (reference: src/io/bin.cpp:341-380)
-        if len(non_na) > 0:
-            sorted_vals = np.sort(non_na)
-            distinct, counts = _distinct_with_counts(sorted_vals)
-        else:
-            distinct, counts = np.empty(0), np.empty(0, dtype=np.int64)
         if zero_cnt > 0 or len(distinct) == 0:
             idx = int(np.searchsorted(distinct, 0.0))
             if idx < len(distinct) and abs(distinct[idx]) <= K_ZERO_THRESHOLD:
@@ -397,3 +421,89 @@ def _distinct_with_counts(sorted_vals: np.ndarray):
         return np.empty(0), np.empty(0, dtype=np.int64)
     distinct, counts = np.unique(sorted_vals, return_counts=True)
     return distinct, counts.astype(np.int64)
+
+
+class QuantileSketch:
+    """Bounded-memory incremental (distinct value, count) sketch for one
+    feature, feeding :meth:`BinMapper.find_bin_distinct`.
+
+    The streaming construction path (``BinnedDataset.from_sequences``,
+    ``ShardedBinnedDataset``, the block-wise file loader) pushes row
+    batches through one sketch per feature, so bin boundaries are found
+    without ever materializing the raw float matrix — the out-of-core
+    construction prerequisite ("Out-of-Core GPU Gradient Boosting",
+    arXiv:2005.09148 §3.1; GK-style summaries).
+
+    Exact while the number of distinct non-zero values stays within
+    ``budget`` (the common case for binned-feature workloads: the greedy
+    boundary search only ever wants ~8*max_bin groups). Beyond the budget,
+    adjacent distinct values merge into equal-count groups represented by
+    their largest member (:func:`_compress_distinct` — the same compaction
+    the in-memory path applies before its boundary search), so boundaries
+    shift by less than one group's count — a GK-flavored rank-error bound
+    of ~total/budget per boundary.
+    """
+
+    __slots__ = ("budget", "distinct", "counts", "na_cnt", "total",
+                 "_pend", "_pend_n")
+
+    def __init__(self, budget: int = 65536) -> None:
+        self.budget = max(int(budget), 256)
+        self.distinct = np.empty(0, np.float64)
+        self.counts = np.empty(0, np.int64)
+        self.na_cnt = 0
+        self.total = 0
+        self._pend: list = []
+        self._pend_n = 0
+
+    def push(self, values: np.ndarray) -> None:
+        """Absorb one row-block's raw column (zeros included — like the
+        sparse find_bin convention they are inferred from ``total`` rather
+        than stored)."""
+        v = np.asarray(values, np.float64).ravel()
+        self.total += len(v)
+        nan_mask = np.isnan(v)
+        self.na_cnt += int(nan_mask.sum())
+        # same non-zero convention as BinnedDataset._find_bins: exact 0.0
+        # is inferred, near-zeros are kept (K_ZERO_THRESHOLD banding
+        # happens inside the boundary search)
+        nz = v[~nan_mask]
+        nz = nz[nz != 0.0]
+        if nz.size:
+            self._pend.append(nz)
+            self._pend_n += nz.size
+        if self._pend_n >= self.budget * 4:
+            self._merge_pending()
+
+    def _merge_pending(self) -> None:
+        if not self._pend:
+            return
+        pend, pcnt = _distinct_with_counts(
+            np.sort(np.concatenate([np.asarray(v, np.float64).ravel()
+                                    for v in self._pend])))
+        self._pend = []
+        self._pend_n = 0
+        d = np.concatenate([self.distinct, pend])
+        c = np.concatenate([self.counts, pcnt])
+        order = np.argsort(d, kind="mergesort")
+        d, c = d[order], c[order]
+        du, inverse = np.unique(d, return_inverse=True)
+        cu = np.zeros(len(du), np.int64)
+        np.add.at(cu, inverse, c)
+        if len(du) > self.budget:
+            du, cu = _compress_distinct(du, cu, self.budget)
+        self.distinct, self.counts = du, cu
+
+    def to_mapper(self, max_bin: int, min_data_in_bin: int,
+                  bin_type: str = BIN_NUMERICAL, use_missing: bool = True,
+                  zero_as_missing: bool = False,
+                  forced_bounds: Sequence[float] = ()) -> BinMapper:
+        """Finalize into a BinMapper over everything pushed so far."""
+        self._merge_pending()
+        return BinMapper.find_bin_distinct(
+            self.distinct, self.counts,
+            nonzero_cnt=int(self.counts.sum()),
+            na_cnt=self.na_cnt, total_sample_cnt=self.total,
+            max_bin=max_bin, min_data_in_bin=min_data_in_bin,
+            bin_type=bin_type, use_missing=use_missing,
+            zero_as_missing=zero_as_missing, forced_bounds=forced_bounds)

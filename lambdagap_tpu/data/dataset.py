@@ -78,6 +78,57 @@ def _looks_like_sizes(group: np.ndarray, num_data: int) -> bool:
         return False
 
 
+def _load_forced_bounds(config: Config) -> Dict[int, List[float]]:
+    """forced bin boundaries (reference: DatasetLoader forced_bin_bounds_,
+    examples/regression/forced_bins.json)."""
+    forced: Dict[int, List[float]] = {}
+    if config.forcedbins_filename:
+        import json
+        with open(config.forcedbins_filename) as f:
+            for entry in json.load(f):
+                forced[int(entry["feature"])] = \
+                    [float(v) for v in entry["bin_upper_bound"]]
+    return forced
+
+
+def _finish_bins(ds: "BinnedDataset") -> None:
+    """used_features / bin offsets from freshly built mappers."""
+    ds.used_features = []
+    ds.feature_num_bins = []
+    for j, mapper in enumerate(ds.mappers):
+        if not mapper.is_trivial:
+            ds.used_features.append(j)
+            ds.feature_num_bins.append(mapper.num_bin)
+    if not ds.used_features:
+        log.fatal("Cannot construct Dataset: all features are trivial "
+                  "(constant); check your input data")
+    ds.bin_offsets = list(np.concatenate(
+        [[0], np.cumsum(ds.feature_num_bins)[:-1]]).astype(int))
+    ds.num_total_bins = int(np.sum(ds.feature_num_bins))
+
+
+def _mappers_from_sketches(ds: "BinnedDataset", sketches, config: Config,
+                           categorical: set) -> None:
+    """Build per-feature BinMappers from incremental quantile sketches —
+    the streaming-construction analog of ``_find_bins`` (boundaries found
+    without ever materializing the raw matrix; data/binning.py
+    QuantileSketch has the error story)."""
+    forced = _load_forced_bounds(config)
+    ds.mappers = []
+    for j, sk in enumerate(sketches):
+        bin_type = BIN_CATEGORICAL if j in categorical else BIN_NUMERICAL
+        ds.mappers.append(sk.to_mapper(
+            max_bin=(config.max_bin_by_feature[j]
+                     if j < len(config.max_bin_by_feature)
+                     else config.max_bin),
+            min_data_in_bin=config.min_data_in_bin,
+            bin_type=bin_type,
+            use_missing=config.use_missing,
+            zero_as_missing=config.zero_as_missing,
+            forced_bounds=forced.get(j, ())))
+    _finish_bins(ds)
+
+
 class BinnedDataset:
     """The constructed, immutable training matrix
     (reference analog: Dataset after ``Construct``, src/io/dataset.cpp:~350).
@@ -124,8 +175,15 @@ class BinnedDataset:
         Mirrors DatasetLoader::ConstructFromSampleData
         (reference: src/io/dataset_loader.cpp:593): sample rows, find bins,
         then push all rows.
+
+        Peak-memory contract: the input matrix is NOT converted or copied
+        whole — bin finding samples bounded row subsets and the push runs
+        row-blockwise — so the transient footprint on top of the caller's
+        matrix is ~1x the packed output (asserted by
+        tests/test_stream.py::test_from_matrix_peak_memory), not
+        raw-float64 + packed.
         """
-        data = np.ascontiguousarray(np.asarray(data, dtype=np.float64))
+        data = np.asarray(data)
         if data.ndim != 2:
             log.fatal("Training data must be 2-dimensional, got shape %s", data.shape)
         ds = cls()
@@ -173,12 +231,16 @@ class BinnedDataset:
                        feature_names=None,
                        reference: Optional["BinnedDataset"] = None
                        ) -> "BinnedDataset":
-        """Streaming construction from row-batch readers: bins are found on
-        a row sample, then batches are pushed straight into the uint8
-        matrix — the full float matrix never materializes (the analog of
-        the C-API streaming push path, reference:
+        """Streaming construction from row-batch readers: an incremental
+        per-feature quantile sketch (data/binning.py QuantileSketch) finds
+        bin boundaries over EVERY row in one bounded-memory pass — no row
+        sample matrix, no rng — then batches are pushed straight into the
+        uint8 matrix, so the full float matrix never materializes (the
+        analog of the C-API streaming push path, reference:
         include/LightGBM/dataset.h:593 PushOneRow / tests/cpp_tests/
-        test_stream.cpp; Python lightgbm.Sequence, basic.py:903)."""
+        test_stream.cpp; Python lightgbm.Sequence, basic.py:903; sketch
+        construction per "Out-of-Core GPU Gradient Boosting",
+        arXiv:2005.09148 §3.1)."""
         lens = [len(s) for s in seqs]
         total = int(sum(lens))
         if total == 0:
@@ -203,34 +265,18 @@ class BinnedDataset:
             ds.feature_names = reference.feature_names
             ds.max_bin = reference.max_bin
         else:
-            # sample rows across sequences for bin finding, reading only
-            # batch-bounded contiguous windows
-            sample_cnt = min(config.bin_construct_sample_cnt, total)
-            rng = np.random.RandomState(config.data_random_seed)
-            picks = np.sort(rng.choice(total, sample_cnt, replace=False))
-            sample = np.empty((sample_cnt, F), dtype=np.float64)
-            offset = 0
-            si = 0
+            from .binning import QuantileSketch
+            sketches = [QuantileSketch(
+                budget=getattr(config, "stream_sketch_budget", 65536))
+                for _ in range(F)]
             for s, ln in zip(seqs, lens):
                 bs = max(int(getattr(s, "batch_size", 4096)), 1)
-                in_seq = picks[(picks >= offset) & (picks < offset + ln)]                     - offset
-                i = 0
-                while i < len(in_seq):
-                    j = i
-                    while j + 1 < len(in_seq) and                             in_seq[j + 1] - in_seq[i] < bs:
-                        j += 1
-                    rows = np.asarray(s[int(in_seq[i]):int(in_seq[j]) + 1],
-                                      dtype=np.float64)
-                    sample[si:si + (j - i + 1)] = rows[in_seq[i:j + 1]
-                                                       - in_seq[i]]
-                    si += j - i + 1
-                    i = j + 1
-                offset += ln
-            # _find_bins samples over self.num_data rows of its argument;
-            # the sample matrix IS the sample, so scope num_data to it
-            ds.num_data = sample_cnt
-            ds._find_bins(sample, config, set(categorical_features))
-            ds.num_data = total
+                for lo in range(0, ln, bs):
+                    blk = np.asarray(s[lo:min(lo + bs, ln)], np.float64)
+                    for j in range(F):
+                        sketches[j].push(blk[:, j])
+            _mappers_from_sketches(ds, sketches, config,
+                                   set(categorical_features))
 
         # push batches straight into the binned matrix
         dtype = np.uint8 if max(ds.feature_num_bins, default=2) <= 256 \
@@ -269,23 +315,18 @@ class BinnedDataset:
         n = self.num_data
         sample_cnt = min(config.bin_construct_sample_cnt, n)
         rng = np.random.RandomState(config.data_random_seed)
-        sample_idx = (np.arange(n) if sample_cnt >= n
-                      else np.sort(rng.choice(n, sample_cnt, replace=False)))
-        sample = data[sample_idx]
+        if sample_cnt >= n:
+            # whole-data "sample": no fancy-index copy of the matrix (the
+            # from_matrix peak-memory contract — the old arange gather
+            # silently duplicated the input)
+            sample = data
+        else:
+            sample = data[np.sort(rng.choice(n, sample_cnt,
+                                             replace=False))]
 
-        # forced bin boundaries (reference: DatasetLoader
-        # forced_bin_bounds_, examples/regression/forced_bins.json)
-        forced: Dict[int, List[float]] = {}
-        if config.forcedbins_filename:
-            import json
-            with open(config.forcedbins_filename) as f:
-                for entry in json.load(f):
-                    forced[int(entry["feature"])] = \
-                        [float(v) for v in entry["bin_upper_bound"]]
+        forced = _load_forced_bounds(config)
 
         self.mappers = []
-        self.used_features = []
-        self.feature_num_bins = []
         for j in range(self.num_total_features):
             col = sample[:, j]
             bin_type = BIN_CATEGORICAL if j in categorical else BIN_NUMERICAL
@@ -301,15 +342,7 @@ class BinnedDataset:
                 zero_as_missing=config.zero_as_missing,
                 forced_bounds=forced.get(j, ()))
             self.mappers.append(mapper)
-            if not mapper.is_trivial:
-                self.used_features.append(j)
-                self.feature_num_bins.append(mapper.num_bin)
-        if not self.used_features:
-            log.fatal("Cannot construct Dataset: all features are trivial "
-                      "(constant); check your input data")
-        self.bin_offsets = list(np.concatenate(
-            [[0], np.cumsum(self.feature_num_bins)[:-1]]).astype(int))
-        self.num_total_bins = int(np.sum(self.feature_num_bins))
+        _finish_bins(self)
 
     def _push_data(self, data: np.ndarray) -> None:
         dtype = np.uint8 if max(self.feature_num_bins, default=2) <= 256 else np.uint16
@@ -318,16 +351,36 @@ class BinnedDataset:
         # the multi-threaded push, src/io/dataset_loader.cpp:203) — the
         # numpy per-column route pays ~6 full-size temporaries per feature
         from ..native import bin_matrix_native
-        if bin_matrix_native(data, self.used_features, self.mappers, binned):
+        if (data.dtype in (np.float64, np.float32)
+                and data.flags["C_CONTIGUOUS"]):
+            self._push_block(data, binned, 0)
+        else:
+            # other dtypes / non-contiguous layouts convert row-blockwise
+            # so the float64 temporary stays bounded (the from_matrix
+            # peak-memory contract) instead of shadowing the whole matrix
+            block = max((1 << 24) // max(data.shape[1], 1), 1024)
+            for r0 in range(0, self.num_data, block):
+                blk = np.ascontiguousarray(
+                    data[r0:r0 + block], dtype=np.float64)
+                self._push_block(blk, binned, r0)
+                del blk
+        self.binned = binned
+
+    def _push_block(self, blk: np.ndarray, binned: np.ndarray,
+                    row0: int) -> None:
+        """Bin one contiguous float row block into ``binned[row0:...]``."""
+        from ..native import bin_matrix_native
+        out = binned[row0:row0 + blk.shape[0]]
+        dtype = binned.dtype
+        if bin_matrix_native(blk, self.used_features, self.mappers, out):
             for k, j in enumerate(self.used_features):
                 if self.mappers[j].bin_type == BIN_CATEGORICAL:
-                    binned[:, k] = self.mappers[j].values_to_bins(
-                        data[:, j]).astype(dtype)
+                    out[:, k] = self.mappers[j].values_to_bins(
+                        blk[:, j]).astype(dtype)
         else:
             for k, j in enumerate(self.used_features):
-                binned[:, k] = self.mappers[j].values_to_bins(
-                    data[:, j]).astype(dtype)
-        self.binned = binned
+                out[:, k] = self.mappers[j].values_to_bins(
+                    blk[:, j]).astype(dtype)
 
     # ------------------------------------------------------------------
     def ensure_bundle(self, config: Config):

@@ -342,23 +342,20 @@ def _load_two_round(path: str, config: Config,
         ds.feature_names = reference.feature_names
         ds.max_bin = reference.max_bin
     else:
-        sample_cnt = min(config.bin_construct_sample_cnt, n)
-        rng = np.random.RandomState(config.data_random_seed)
-        picks = np.sort(rng.choice(n, sample_cnt, replace=False))
-        sample = np.empty((sample_cnt, len(keep)), np.float64)
-        si = 0
-        i = 0
-        while i < len(picks):        # contiguous runs parse in one read
-            j = i
-            while j + 1 < len(picks) and picks[j + 1] == picks[j] + 1:
-                j += 1
-            rows, _, _, _ = parse_rows(int(picks[i]), int(picks[j]) + 1)
-            sample[si:si + (j - i + 1)] = rows
-            si += j - i + 1
-            i = j + 1
-        ds.num_data = sample_cnt
-        ds._find_bins(sample, config, set(categorical))
-        ds.num_data = n
+        # bin boundaries via the incremental per-feature quantile sketch,
+        # streamed over bounded row chunks: EVERY row contributes (no line
+        # sample, no rng) while the dense float window stays one chunk —
+        # the 100M-row construction path (data/binning.py QuantileSketch)
+        from .binning import QuantileSketch
+        from .dataset import _mappers_from_sketches
+        sketches = [QuantileSketch(budget=config.stream_sketch_budget)
+                    for _ in range(len(keep))]
+        step0 = 65536
+        for lo in range(0, n, step0):
+            X, _, _, _ = parse_rows(lo, min(lo + step0, n))
+            for j in range(len(keep)):
+                sketches[j].push(X[:, j])
+        _mappers_from_sketches(ds, sketches, config, set(categorical))
 
     # ---- pass 2: chunked parse + bin -------------------------------------
     dtype = np.uint8 if max(ds.feature_num_bins, default=2) <= 256 \
@@ -449,6 +446,19 @@ def load_data_file(path: str, config: Config,
     if path.endswith(".bin") and os.path.exists(path):
         return load_binary(path)
     if config.two_round:
+        return _load_two_round(path, config, reference)
+    thr = getattr(config, "stream_ingest_threshold_mb", 0)
+    try:
+        fsize = os.path.getsize(path)
+    except OSError:
+        fsize = 0
+    if thr > 0 and fsize > thr << 20:
+        # big files never materialize as one ndarray: ingest in bounded
+        # row blocks through the sketch/push path (the two_round
+        # machinery); the eager single-parse path stays for small files
+        log.info("data file %s is %.0f MB (> stream_ingest_threshold_mb="
+                 "%d); ingesting in bounded row blocks", path,
+                 fsize / 2**20, thr)
         return _load_two_round(path, config, reference)
     X, y, weight, qgroups, fnames = _parse_text_file(path, config)
     init_score = None

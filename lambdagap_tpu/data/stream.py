@@ -1,0 +1,400 @@
+"""Out-of-core sharded binned storage + the async H2D window pump.
+
+The HBM wall: the packed binned matrix (plus its packed-gh copy) had to be
+device-resident for the whole run, capping rows at what one chip holds.
+This module keeps the binned matrix in host-RAM (optionally disk-backed,
+memory-mapped) row shards and streams fixed-width row windows to the
+device through a small double-buffered ring — the H2D transfer of window
+``k+1`` is issued while the jitted histogram/partition program consumes
+window ``k`` ("Out-of-Core GPU Gradient Boosting", arXiv:2005.09148 §3;
+"XGBoost: Scalable GPU Accelerated Learning", arXiv:1806.11248 §4 —
+gradients are tiny, the binned matrix is read once per pass, so the pass
+streams).
+
+Three pieces:
+
+* :class:`ShardedBinnedDataset` — a BinnedDataset whose packed matrix
+  lives as host row shards, built streamingly (one
+  :class:`~lambdagap_tpu.data.binning.QuantileSketch` per feature finds
+  bin boundaries without materializing the raw float matrix; blocks are
+  binned straight into the shards).
+* :class:`ShardRing` — the bounded async H2D ring. ``put`` issues
+  ``jax.device_put`` (asynchronous on accelerators) under the
+  ``h2d_prefetch`` telemetry phase; ``wait_ready`` blocks on the oldest
+  slot under ``chunk_wait`` — so overlap efficiency is a measured number
+  (``chunk_wait`` ~ 0 when prefetch hides the transfer), not a hope.
+* :func:`stream_windows` — the pump loop the learners drive their
+  histogram passes through.
+
+The learners' stream modes (``data_residency=stream``,
+docs/performance.md) replicate the resident paths' accumulation order
+window-for-window, so streamed training is bit-identical to resident
+training — asserted by tests/test_stream.py.
+"""
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..obs.telemetry import NULL_TELEMETRY
+from ..utils import log
+from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, QuantileSketch
+from .dataset import BinnedDataset
+
+# below this, sharding is pure overhead (and pow2 keeps window math clean)
+MIN_SHARD_ROWS = 1 << 10
+
+
+def _shard_sizes(total: int, shard_rows: int) -> List[int]:
+    """Row counts per shard: fixed-size shards plus one ragged tail."""
+    shard_rows = max(int(shard_rows), MIN_SHARD_ROWS)
+    sizes = [shard_rows] * (total // shard_rows)
+    if total % shard_rows:
+        sizes.append(total % shard_rows)
+    return sizes or [0]
+
+
+class ShardedBinnedDataset(BinnedDataset):
+    """A BinnedDataset whose packed bin matrix lives as host row shards.
+
+    ``shards[i]`` is a C-contiguous ``uint8``/``uint16`` array of
+    ``shard_rows`` rows (the last one ragged). With ``spill_dir`` set the
+    shards are ``np.memmap`` files, so construction and training scale to
+    datasets larger than host RAM as well. All mapper/metadata machinery is
+    inherited — only the storage of the binned matrix differs.
+
+    Resident consumers keep working: the ``binned`` property materializes
+    (and caches) the concatenated matrix, so an hbm-residency learner or
+    the EFB bundler can still consume a sharded dataset — they just pay
+    the full-residency footprint the stream path avoids.
+    """
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.shards: List[np.ndarray] = []
+        self.shard_rows: int = 0
+        self.spill_dir: Optional[str] = None
+        self._binned_cache: Optional[np.ndarray] = None
+
+    # -- storage -------------------------------------------------------
+    def _alloc_shard(self, idx: int, rows: int, cols: int,
+                     dtype) -> np.ndarray:
+        if self.spill_dir:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            path = os.path.join(self.spill_dir, f"shard_{idx:05d}.bin")
+            return np.memmap(path, dtype=dtype, mode="w+",
+                             shape=(rows, cols))
+        return np.empty((rows, cols), dtype=dtype)
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shards)
+
+    @property
+    def binned(self) -> Optional[np.ndarray]:
+        """Dataset-order materialization (lazy, cached) — the resident
+        fallback; stream-residency learners never touch it."""
+        if self._binned_cache is None and self.shards:
+            self._binned_cache = np.concatenate(self.shards, axis=0)
+        return self._binned_cache
+
+    @binned.setter
+    def binned(self, value) -> None:
+        # BinnedDataset.__init__ assigns binned=None before shards exist
+        self._binned_cache = value
+
+    def drop_materialized(self) -> None:
+        self._binned_cache = None
+
+    # -- window / gather access (host side of the stream pump) ---------
+    def row_block(self, lo: int, hi: int,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
+        """Rows [lo, hi) in dataset order, copied across shard boundaries
+        into ``out`` (sequential memcpys — the prefetch-friendly path)."""
+        rows = hi - lo
+        if out is None:
+            out = np.empty((rows, self.num_features),
+                           dtype=self.shards[0].dtype)
+        filled = 0
+        s = lo // self.shard_rows if self.shard_rows else 0
+        pos = lo
+        while filled < rows:
+            base = s * self.shard_rows
+            sh = self.shards[s]
+            a = pos - base
+            b = min(hi - base, sh.shape[0])
+            out[filled:filled + (b - a)] = sh[a:b]
+            filled += b - a
+            pos += b - a
+            s += 1
+        return out
+
+    def gather_rows(self, indices: np.ndarray,
+                    out: Optional[np.ndarray] = None) -> np.ndarray:
+        """Arbitrary rows by dataset index (the gather-layout fetch)."""
+        if out is None:
+            out = np.empty((len(indices), self.num_features),
+                           dtype=self.shards[0].dtype)
+        sidx = indices // self.shard_rows
+        local = indices - sidx * self.shard_rows
+        for s in np.unique(sidx):
+            m = sidx == s
+            out[m] = self.shards[s][local[m]]
+        return out
+
+    def gather_col(self, feature_k: int, indices: np.ndarray) -> np.ndarray:
+        """One used-feature column for arbitrary rows (the partition-pass
+        fetch: 1-2 bytes per row instead of the full row)."""
+        out = np.empty(len(indices), dtype=self.shards[0].dtype)
+        sidx = indices // self.shard_rows
+        local = indices - sidx * self.shard_rows
+        for s in np.unique(sidx):
+            m = sidx == s
+            out[m] = self.shards[s][local[m], feature_k]
+        return out
+
+    def dataset_order_copy(self) -> np.ndarray:
+        """A fresh dataset-order copy of the packed matrix — the per-tree
+        host payload the sorted-layout stream path physically reorders
+        (the host analog of the fused learner's layout_apply repack)."""
+        return np.concatenate(self.shards, axis=0)
+
+    # -- construction --------------------------------------------------
+    @classmethod
+    def from_dataset(cls, ds: BinnedDataset, shard_rows: int,
+                     spill_dir: Optional[str] = None
+                     ) -> "ShardedBinnedDataset":
+        """Re-shard an already-constructed resident dataset (the test /
+        auto-residency path; streaming construction never goes through a
+        resident matrix — see :meth:`from_matrix` / :meth:`from_sequences`)."""
+        out = cls()
+        out.__dict__.update({k: v for k, v in ds.__dict__.items()
+                             if k not in ("binned", "_device_cache")})
+        out._device_cache = {}
+        out.shards = []
+        out._binned_cache = None
+        out.spill_dir = spill_dir or None
+        out.shard_rows = max(int(shard_rows), MIN_SHARD_ROWS)
+        mat = ds.binned
+        lo = 0
+        for i, rows in enumerate(_shard_sizes(ds.num_data, out.shard_rows)):
+            sh = out._alloc_shard(i, rows, mat.shape[1], mat.dtype)
+            sh[:] = mat[lo:lo + rows]
+            out.shards.append(sh)
+            lo += rows
+        return out
+
+    @classmethod
+    def from_matrix(cls, data, config: Config, shard_rows: int = 0,
+                    spill_dir: Optional[str] = None,
+                    **kwargs) -> "ShardedBinnedDataset":
+        """Streaming construction from a dense matrix: row blocks feed the
+        per-feature sketches, then are binned straight into shards — peak
+        transient memory is one row block, never raw + packed."""
+        data = np.asarray(data)
+        if data.ndim != 2:
+            log.fatal("Training data must be 2-dimensional, got shape %s",
+                      data.shape)
+
+        class _View:
+            batch_size = 65536
+
+            def __len__(self) -> int:
+                return data.shape[0]
+
+            def __getitem__(self, sl):
+                return data[sl]
+
+        return cls.from_sequences([_View()], config, shard_rows=shard_rows,
+                                  spill_dir=spill_dir, **kwargs)
+
+    @classmethod
+    def from_sequences(cls, seqs, config: Config, shard_rows: int = 0,
+                       spill_dir: Optional[str] = None,
+                       label=None, weight=None, group=None,
+                       init_score=None, position=None,
+                       categorical_features: Sequence = (),
+                       feature_names=None,
+                       reference: Optional[BinnedDataset] = None
+                       ) -> "ShardedBinnedDataset":
+        """Fully streaming construction: one sketch pass over the row-batch
+        readers finds bin boundaries, a second pass pushes packed shards.
+        The raw float matrix never materializes — required for 100M-row
+        construction (ROADMAP item 1)."""
+        ds = cls()
+        ds.spill_dir = spill_dir or None
+        ds.shard_rows = max(int(shard_rows or config.stream_shard_rows),
+                            MIN_SHARD_ROWS)
+        ds._ingest_sequences(seqs, config, categorical_features,
+                             feature_names, reference)
+        ds._attach_metadata(label, weight, group, init_score, position)
+        return ds
+
+    def _ingest_sequences(self, seqs, config: Config,
+                          categorical_features, feature_names,
+                          reference: Optional[BinnedDataset]) -> None:
+        lens = [len(s) for s in seqs]
+        total = int(sum(lens))
+        if total == 0:
+            log.fatal("Cannot construct Dataset from empty sequences")
+        probe = np.asarray(seqs[0][0:1], dtype=np.float64)
+        F = probe.shape[1]
+        self.num_data = total
+        self.num_total_features = F
+        self.max_bin = config.max_bin
+        self.feature_names = (list(feature_names) if feature_names
+                              else [f"Column_{i}" for i in range(F)])
+
+        if reference is not None:
+            self._adopt_reference(reference)
+        else:
+            sketches = [QuantileSketch(budget=config.stream_sketch_budget)
+                        for _ in range(F)]
+            for s, ln in zip(seqs, lens):
+                bs = max(int(getattr(s, "batch_size", 65536)), 1)
+                for lo in range(0, ln, bs):
+                    blk = np.asarray(s[lo:min(lo + bs, ln)], np.float64)
+                    for j in range(F):
+                        sketches[j].push(blk[:, j])
+            from .dataset import _mappers_from_sketches
+            _mappers_from_sketches(self, sketches, config,
+                                   set(categorical_features))
+
+        dtype = (np.uint8 if max(self.feature_num_bins, default=2) <= 256
+                 else np.uint16)
+        C = len(self.used_features)
+        sizes = _shard_sizes(total, self.shard_rows)
+        self.shards = [self._alloc_shard(i, rows, C, dtype)
+                       for i, rows in enumerate(sizes)]
+        row0 = 0
+        for s, ln in zip(seqs, lens):
+            bs = max(int(getattr(s, "batch_size", 65536)), 1)
+            for lo in range(0, ln, bs):
+                hi = min(lo + bs, ln)
+                blk = np.asarray(s[lo:hi], np.float64)
+                packed = np.empty((hi - lo, C), dtype=dtype)
+                for k, j in enumerate(self.used_features):
+                    packed[:, k] = self.mappers[j].values_to_bins(
+                        blk[:, j]).astype(dtype)
+                self._write_rows(row0 + lo, packed)
+            row0 += ln
+
+    def _adopt_reference(self, reference: BinnedDataset) -> None:
+        self.mappers = reference.mappers
+        self.used_features = reference.used_features
+        self.feature_num_bins = reference.feature_num_bins
+        self.bin_offsets = reference.bin_offsets
+        self.num_total_bins = reference.num_total_bins
+        self.feature_names = reference.feature_names
+        self.max_bin = reference.max_bin
+
+    def _write_rows(self, row0: int, packed: np.ndarray) -> None:
+        """Scatter a packed row block into the (fixed-size) shards."""
+        lo = row0
+        hi = row0 + packed.shape[0]
+        filled = 0
+        s = lo // self.shard_rows
+        while filled < packed.shape[0]:
+            base = s * self.shard_rows
+            a = (lo + filled) - base
+            b = min(hi - base, self.shards[s].shape[0])
+            self.shards[s][a:b] = packed[filled:filled + (b - a)]
+            filled += b - a
+            s += 1
+
+    def _attach_metadata(self, label, weight, group, init_score,
+                         position) -> None:
+        md = self.metadata
+        if label is not None:
+            md.label = np.asarray(label, dtype=np.float32).reshape(-1)
+        if weight is not None:
+            md.weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        if init_score is not None:
+            md.init_score = np.asarray(init_score, np.float64).reshape(-1)
+        if position is not None:
+            md.position = np.asarray(position, np.int32).reshape(-1)
+        md.set_group(group)
+        md.check(self.num_data)
+
+
+def as_sharded(ds: BinnedDataset, config: Config) -> ShardedBinnedDataset:
+    """A sharded view of ``ds`` for stream-residency training (no-op when
+    it already is one)."""
+    if isinstance(ds, ShardedBinnedDataset):
+        return ds
+    return ShardedBinnedDataset.from_dataset(
+        ds, config.stream_shard_rows,
+        spill_dir=config.stream_spill_dir or None)
+
+
+# ---------------------------------------------------------------------------
+# the async H2D ring
+# ---------------------------------------------------------------------------
+
+class ShardRing:
+    """Bounded async H2D prefetch ring (default two slots — the classic
+    double buffer).
+
+    ``put`` issues ``jax.device_put`` for a window's host buffers —
+    asynchronous on accelerators, so the DMA runs while the device chews
+    the previous window — under the ``h2d_prefetch`` telemetry phase.
+    ``wait_ready`` pops the oldest slot and blocks until its transfer
+    completed, under ``chunk_wait``: with working overlap that span is
+    ~zero, and a fat ``chunk_wait`` in the phase breakdown is the direct
+    symptom of prefetch failing to hide the link.
+    """
+
+    def __init__(self, depth: int = 2, telemetry=NULL_TELEMETRY) -> None:
+        self.depth = max(int(depth), 1)
+        self.telemetry = telemetry
+        self._slots: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    @property
+    def full(self) -> bool:
+        return len(self._slots) >= self.depth
+
+    def put(self, key, host_bufs: Sequence[np.ndarray]) -> None:
+        import jax
+        with self.telemetry.phase("h2d_prefetch"):
+            self._slots.append(
+                (key, tuple(jax.device_put(b) for b in host_bufs)))
+
+    def wait_ready(self):
+        """(key, device_bufs) of the oldest slot, transfer complete."""
+        key, bufs = self._slots.popleft()
+        with self.telemetry.phase("chunk_wait"):
+            for b in bufs:
+                # graftlint: disable=R1 — ring-slot completion sync: this
+                # block is the instrument that MEASURES prefetch overlap
+                # (chunk_wait ~ 0 when the ring hid the transfer); it is
+                # the one legitimate sync of the stream consume path
+                b.block_until_ready()
+        return key, bufs
+
+
+def stream_windows(nch: int, fetch: Callable, consume: Callable,
+                   telemetry=NULL_TELEMETRY, depth: int = 2) -> None:
+    """Drive ``nch`` windows through a :class:`ShardRing`.
+
+    ``fetch(c)`` runs on the host and returns the window's host buffers
+    (bounded gather/memcpy work; with GOSS compaction, only in-bag rows).
+    ``consume(c, *device_bufs)`` dispatches the jitted compute for window
+    ``c``. The pump keeps up to ``depth`` transfers in flight ahead of the
+    consumer — fetch/transfer of window ``c+1`` is issued before window
+    ``c`` is waited on, which is the whole overlap story.
+    """
+    ring = ShardRing(depth=depth, telemetry=telemetry)
+    issued = 0
+    for c in range(nch):
+        while issued < nch and (issued <= c or not ring.full):
+            ring.put(issued, fetch(issued))
+            issued += 1
+        key, bufs = ring.wait_ready()
+        consume(key, *bufs)

@@ -105,6 +105,19 @@ def capture_state(gbdt, early_stop: Optional[Dict] = None) -> Dict[str, Any]:
         }
     if early_stop:
         st["early_stop"] = early_stop
+    learner = getattr(gbdt, "learner", None)
+    if getattr(learner, "residency", "hbm") == "stream":
+        # out-of-core geometry rides the sidecar: snapshots land at
+        # iteration boundaries, where the stream cursor is always at the
+        # start of the shard walk (cursor=0) and every per-tree RNG stream
+        # is already captured above — recording the geometry lets resume
+        # validate it matches instead of silently re-sharding differently
+        st["stream"] = {
+            "residency": "stream",
+            "shard_rows": int(getattr(learner.sdata, "shard_rows", 0)),
+            "num_shards": int(getattr(learner.sdata, "num_shards", 0)),
+            "cursor": 0,
+        }
     return st
 
 
@@ -130,6 +143,18 @@ def restore_state(gbdt, state: Dict[str, Any]) -> None:
         gbdt.drop_rng.set_state(_rng_state_from_json(dart["rng"]))
         gbdt.tree_weight = [float(w) for w in dart["tree_weight"]]
         gbdt.sum_weight = float(dart["sum_weight"])
+    stream = state.get("stream")
+    learner = getattr(gbdt, "learner", None)
+    if stream is not None and getattr(learner, "residency", "hbm") == "stream":
+        have = int(getattr(learner.sdata, "shard_rows", 0))
+        want = int(stream.get("shard_rows", have))
+        if have != want:
+            # trees are bit-identical across shard geometries (the window
+            # math keys on W, not shard size), so this is a warning, not a
+            # refusal — but a surprise geometry change is worth surfacing
+            log.warning("resuming a stream-residency run with "
+                        "stream_shard_rows=%d (snapshot used %d)",
+                        have, want)
 
 
 # ---------------------------------------------------------------------------

@@ -67,6 +67,27 @@ class FusedTreeLearner(SerialTreeLearner):
 
     def __init__(self, dataset: BinnedDataset, config: Config) -> None:
         super().__init__(dataset, config)
+        if self.residency == "stream":
+            # out-of-core mode (docs/performance.md): the binned matrix
+            # stays in host shards; _train_tree_stream drives per-tree
+            # multi-dispatch builds whose kernels replicate the fused
+            # program's math window-for-window. EFB bundling is skipped
+            # (its construction needs the full resident matrix) and the
+            # options _stream_blockers lists fell back to hbm upstream.
+            self.bundled = False
+            self.Bb = self.B
+            self.chunk = self._pick_chunk()
+            self.quant = False
+            self.quant_exact = False
+            self.forced_seq = None
+            self._need_step_keys = False
+            self.axis: Optional[str] = None
+            self.voting = False
+            self.pack32 = False
+            self._srows_dummy = jnp.zeros((1, 1), jnp.uint32)
+            self.last_row_leaf: Optional[jax.Array] = None
+            self._init_stream_jits()
+            return
         # EFB: histograms and partitions run over the bundled matrix when
         # the dataset built one; histograms are un-bundled back to feature
         # space before every split scan, and partition decisions decode the
@@ -356,6 +377,10 @@ class FusedTreeLearner(SerialTreeLearner):
     # ------------------------------------------------------------------
     def train_device(self, grad: jax.Array, hess: jax.Array,
                      row_mask: Optional[jax.Array] = None) -> DeviceTree:
+        if self.residency == "stream":
+            rec = self._train_tree_stream(grad, hess, row_mask)
+            self.last_row_leaf = rec.row_leaf
+            return rec
         fmask = self._feature_mask()
         mask = row_mask if row_mask is not None else jnp.ones(1, dtype=bool)
         if self.quant:
@@ -1528,3 +1553,629 @@ class FusedTreeLearner(SerialTreeLearner):
             num_leaves=state["num_leaves"],
             row_leaf=row_leaf,
         )
+
+    # ------------------------------------------------------------------
+    # data_residency=stream: out-of-core tree build
+    # ------------------------------------------------------------------
+    # The binned matrix lives in host shards (data/stream.py); the device
+    # keeps only the O(N)-scalar per-row state (grad/hess/mask, the
+    # permutation, and — under the sorted layout — the physically ordered
+    # gradient channels). Each tree is built by a host-driven loop of
+    # small jitted kernels whose traced math replicates the fused
+    # program's split step op-for-op for the supported option subset, and
+    # whose histogram windows accumulate in the same W-chunk order — so
+    # streamed trees are bit-identical to resident ones
+    # (tests/test_stream.py). Row windows ride the double-buffered H2D
+    # ring (ShardRing): the transfer of window k+1 is issued while the
+    # device chews window k, instrumented by the h2d_prefetch/chunk_wait
+    # telemetry phases. With a sampling mask (GOSS/bagging), windows are
+    # COMPACTED host-side: only in-bag rows cross the link, the kernel
+    # re-expands them into their window lanes, and the masked lanes'
+    # exact-zero contributions keep bit-identity.
+
+    def _stream_blockers(self, config: Config):
+        """Fused-program options the multi-dispatch stream build does not
+        replicate (config-only: runs from the base __init__)."""
+        blockers = []
+        if config.use_quantized_grad:
+            blockers.append("use_quantized_grad")
+        if config.forcedsplits_filename:
+            blockers.append("forcedsplits_filename")
+        if config.interaction_constraints:
+            blockers.append("interaction_constraints")
+        if config.extra_trees:
+            blockers.append("extra_trees")
+        if config.feature_fraction_bynode < 1.0:
+            blockers.append("feature_fraction_bynode")
+        if config.monotone_constraints and any(
+                int(m) != 0 for m in config.monotone_constraints):
+            blockers.append("monotone_constraints")
+        if config.feature_contri:
+            blockers.append("feature_contri")
+        return blockers
+
+    def _estimate_residency_bytes(self) -> int:
+        """The fused hbm path pins the packed row matrix (bins + gh/mask
+        channels) PLUS either the column-major copy (gather) or the
+        per-tree sorted buffer + double buffer — ~2x the packed bytes."""
+        item = 1 if self.max_num_bins <= 256 else 2
+        C = self.num_features
+        packed = self.num_data * (C * item + 9)
+        return 2 * packed
+
+    def _init_stream_jits(self) -> None:
+        self._sj_init = jax.jit(self._stream_init_impl)
+        self._sj_pick = jax.jit(self._stream_pick_impl)
+        self._sj_part = jax.jit(self._stream_partition_impl)
+        self._sj_chunk = jax.jit(self._stream_chunk_impl,
+                                 static_argnames=("has_mask",))
+        self._sj_finish = jax.jit(self._stream_finish_impl)
+        self._sj_final = jax.jit(self._stream_finalize_impl)
+
+    # -- traced pieces (shared by the jitted stream kernels) -----------
+    def _stream_best_of(self, hist, pg, ph, pc, pout, depth, fm):
+        """best_of of the fused program restricted to the stream-mode
+        option subset (no voting/feature-sharding/bundle/extra/monotone/
+        contri) — the surviving ops are replicated verbatim so gains,
+        tie-breaks, and outputs match the resident program bit-for-bit."""
+        p = self.params
+        gain, thr, dl, lg, lh, lc, bits = per_feature_best(
+            hist, pg, ph, pc, pout, self.num_bins_arr,
+            self.default_bins_arr, self.missing_types_arr,
+            self.is_categorical_arr, fm, p, self.has_categorical,
+            constraints=None, rand_thresholds=None)
+        parent_gain = leaf_gain(pg, ph, p, pc, pout)
+        shift = parent_gain + p.min_gain_to_split
+        f = jnp.argmax(gain, axis=0).astype(jnp.int32)
+        g = gain[f] - shift
+        ok = jnp.isfinite(gain[f]) & (g > 0.0)
+        if self.config.max_depth > 0:
+            ok = ok & (depth < self.config.max_depth)
+        lout = calculate_leaf_output(lg[f], lh[f], p, lc[f], pout)
+        rout = calculate_leaf_output(pg - lg[f], ph - lh[f], p,
+                                     pc - lc[f], pout)
+        return (jnp.where(ok, g, K_MIN_SCORE), f, thr[f], dl[f],
+                self.is_categorical_arr[f], bits[f], lg[f], lh[f], lc[f],
+                lout, rout)
+
+    def _stream_chosen(self, state):
+        """The pending split the argmax selects — the head of the fused
+        split_step, recomputed identically by partition and finish so no
+        host round-trip of split metadata can drift."""
+        L = self.config.num_leaves
+        leaf_f, leaf_i = state["leaf_f"], state["leaf_i"]
+        leaf = jnp.argmax(leaf_f[:L, 4]).astype(jnp.int32)
+        lf = leaf_f[leaf]
+        li = leaf_i[leaf]
+        ok = lf[4] > 0.0
+        return leaf, lf, li, ok
+
+    # -- jitted kernels -------------------------------------------------
+    def _stream_init_impl(self, hist_root, fmask, gs, hs, ms):
+        """State init of the fused program (root totals, root best split,
+        consolidated leaf/node matrices), with the sorted-layout gradient
+        channels riding the carry instead of the packed payload."""
+        cfg = self.config
+        N = self.num_data
+        L = cfg.num_leaves
+        NODES = max(L - 1, 1)
+        W = self._window(N)
+        p = self.params
+        f32, i32 = jnp.float32, jnp.int32
+        totals = jnp.sum(hist_root[0], axis=0)
+        root_out = calculate_leaf_output(totals[0], totals[1], p,
+                                         totals[2], 0.0)
+        neg_inf = jnp.float32(-jnp.inf)
+        pos_inf = jnp.float32(jnp.inf)
+        (bg0, bf0, bt0, bdl0, bcat0, bbits0, blg0, blh0, blc0, blout0,
+         brout0) = self._stream_best_of(hist_root, totals[0], totals[1],
+                                        totals[2], root_out, jnp.int32(0),
+                                        fmask)
+        iota_l1 = jnp.arange(L + 1, dtype=i32)
+        leaf_f = jnp.zeros((L + 1, 12), f32)
+        leaf_f = leaf_f.at[:, 4].set(K_MIN_SCORE) \
+                       .at[:, 10].set(-jnp.inf).at[:, 11].set(jnp.inf)
+        leaf_f = leaf_f.at[0].set(jnp.stack(
+            [totals[0], totals[1], totals[2], root_out, bg0, blg0, blh0,
+             blc0, blout0, brout0, neg_inf, pos_inf]))
+        leaf_i = jnp.zeros((L + 1, 9), i32)
+        leaf_i = leaf_i.at[:, 0].set(N + iota_l1).at[:, 3].set(-1)
+        leaf_i = leaf_i.at[0].set(jnp.stack(
+            [i32(0), i32(N), i32(0), i32(-1), i32(0), bf0, bt0,
+             bdl0.astype(i32), bcat0.astype(i32)]))
+        leaf_bits = jnp.zeros((L + 1, 8), jnp.uint32).at[0].set(bbits0)
+        state = dict(
+            perm=jnp.concatenate([jnp.arange(N, dtype=i32),
+                                  jnp.zeros(W, i32)]),
+            perm_buf=jnp.zeros(N + W, i32),
+            leaf_f=leaf_f, leaf_i=leaf_i, leaf_bits=leaf_bits,
+            node_f=jnp.zeros((NODES + 1, 4), f32),
+            node_i=jnp.zeros((NODES + 1, 6), i32).at[:, 4:6].set(~0),
+            node_bits=jnp.zeros((NODES + 1, 8), jnp.uint32),
+            hist=jnp.zeros((L + 1, self.num_features, self.Bb, HIST_C),
+                           f32).at[0].set(hist_root),
+            num_leaves=jnp.int32(1),
+        )
+        if self.layout == "sorted":
+            state["gs"], state["hs"] = gs, hs
+            state["gs_buf"] = jnp.zeros_like(gs)
+            state["hs_buf"] = jnp.zeros_like(hs)
+            if ms is not None:
+                state["ms"] = ms
+                state["ms_buf"] = jnp.zeros_like(ms)
+        return state
+
+    def _stream_pick_impl(self, state):
+        leaf, lf, li, ok = self._stream_chosen(state)
+        return leaf, ok, li[0], li[1], li[5]
+
+    def _stream_partition_impl(self, state, cvals):
+        """pbody + cbody of the fused split step, with the split feature's
+        bin values arriving as the uploaded ``cvals`` buffer (slice-lane
+        indexed, PV = pow2(count) >= nch*W) instead of a resident
+        column/payload read. Also collects the per-lane go_left flags so
+        the host can mirror the two-monotone-run placement (lefts
+        ascending, rights reversed) onto its shard-side structures."""
+        N = self.num_data
+        W = self._window(N)
+        PV = cvals.shape[0]
+        # window-read invariants (the resident perm_slice/srow_slice
+        # contracts): every start is begin + c*W <= begin + count <= N and
+        # the carried buffers pad one full window past N, so no
+        # dynamic_slice below can clamp; cvals is padded to a whole number
+        # of windows so the c*W reads stay in range
+        assert state["perm"].shape[0] == N + W
+        assert state["perm_buf"].shape[0] == N + W
+        assert PV % W == 0 and PV >= W
+        lane = jnp.arange(W, dtype=jnp.int32)
+        i32 = jnp.int32
+        leaf, lf, li, ok = self._stream_chosen(state)
+        feat = li[5]
+        thrv, dlv, catv = li[6], li[7].astype(bool), li[8].astype(bool)
+        bitsv = state["leaf_bits"][leaf]
+        begin = li[0]
+        count_eff = jnp.where(ok, li[1], 0)
+        nch = (count_eff + W - 1) // W
+        perm_in = state["perm"]
+        sorted_mode = self.layout == "sorted"
+        chans = [k for k in ("gs", "hs", "ms") if k in state]
+
+        def pbody(s):
+            c, lcur, rcur, pbuf, gbuf = s[:5]
+            cbufs = list(s[5:])
+            live = jnp.clip(count_eff - c * W, 0, W)
+            valid = lane < live
+            rows = lax.dynamic_slice(perm_in, (begin + c * W,), (W,))
+            cv = lax.dynamic_slice(cvals, (c * W,), (W,)).astype(i32)
+            gl = decision_go_left(
+                cv, thrv, dlv, self.default_bins_arr[feat],
+                self.missing_types_arr[feat], self.num_bins_arr[feat],
+                catv, bitsv) & valid
+            cums_gl = jnp.cumsum(gl.astype(i32))
+            nl = cums_gl[W - 1]
+            prefix_valid = jnp.minimum(lane + 1, live)
+            lpos = lcur + cums_gl - 1
+            rpos = rcur - (prefix_valid - cums_gl)
+            pos = jnp.where(gl, lpos, jnp.where(valid, rpos, N))
+            pbuf = pbuf.at[pos].set(rows, mode="drop")
+            gbuf = lax.dynamic_update_slice(gbuf, gl, (c * W,))
+            if sorted_mode:
+                cbufs = [
+                    b.at[pos].set(
+                        lax.dynamic_slice(state[k], (begin + c * W,), (W,)),
+                        mode="drop")
+                    for k, b in zip(chans, cbufs)]
+            return tuple([c + 1, lcur + nl, rcur - (live - nl), pbuf, gbuf]
+                         + cbufs)
+
+        init = [jnp.int32(0), begin, begin + count_eff,
+                state["perm_buf"], jnp.zeros(PV, bool)]
+        if sorted_mode:
+            init += [state[k + "_buf"] for k in chans]
+        out = lax.while_loop(lambda s: s[0] < nch, pbody, tuple(init))
+        lend, pbuf, gbuf = out[1], out[3], out[4]
+        cbufs = list(out[5:])
+        left_count = lend - begin
+
+        def cbody(s):
+            c, pm = s[:2]
+            cms = list(s[2:])
+            start = begin + c * W
+            valid = (c * W + lane) < count_eff
+            vals = jnp.where(valid, lax.dynamic_slice(pbuf, (start,), (W,)),
+                             lax.dynamic_slice(pm, (start,), (W,)))
+            pm = lax.dynamic_update_slice(pm, vals, (start,))
+            if sorted_mode:
+                cms = [lax.dynamic_update_slice(
+                    m, jnp.where(valid,
+                                 lax.dynamic_slice(b, (start,), (W,)),
+                                 lax.dynamic_slice(m, (start,), (W,))),
+                    (start,))
+                    for m, b in zip(cms, cbufs)]
+            return tuple([c + 1, pm] + cms)
+
+        cinit = [jnp.int32(0), perm_in]
+        if sorted_mode:
+            cinit += [state[k] for k in chans]
+        cout = lax.while_loop(lambda s: s[0] < nch, cbody, tuple(cinit))
+        new_state = dict(state)
+        new_state["perm"] = cout[1]
+        new_state["perm_buf"] = pbuf
+        if sorted_mode:
+            for k, m, b in zip(chans, cout[2:], cbufs):
+                new_state[k] = m
+                new_state[k + "_buf"] = b
+        return new_state, gbuf, left_count
+
+    def _stream_chunk_impl(self, acc, bins_up, pos, perm, gs, hs, ms,
+                           grad, hess, row_mask, start, done, count, *,
+                           has_mask: bool):
+        """chunk_hist of the fused program with the window's bins uploaded
+        (optionally compacted to the in-bag rows + their lane positions)
+        while the gradient channels read device-resident state. Same
+        values, same gh_contract/hist_pallas shapes, same ``acc + part``
+        → bit-identical accumulation."""
+        N = self.num_data
+        W = self._window(N)
+        C = self.num_features
+        Bb = self.Bb
+        # same pad invariant as the fused program's perm_slice/srow_slice:
+        # start + done <= start + count <= N and the per-row buffers carry
+        # a full window of tail padding, so the slices never clamp
+        assert perm is None or perm.shape[0] == N + W
+        assert gs is None or gs.shape[0] == N + W
+        lane = jnp.arange(W, dtype=jnp.int32)
+        if bins_up.shape[0] == W and pos is None:
+            bins = bins_up
+        else:
+            # re-expand the compacted transfer into its window lanes;
+            # out-of-bag lanes keep zero bins — their gh channels are
+            # exactly 0.0 below, so each contributes the same exact +0.0
+            # the resident program adds for masked rows
+            bins = jnp.zeros((W, C), bins_up.dtype).at[pos].set(
+                bins_up, mode="drop")
+        valid = (done + lane) < count
+        if self.layout == "sorted":
+            g = lax.dynamic_slice(gs, (start + done,), (W,))
+            h = lax.dynamic_slice(hs, (start + done,), (W,))
+            if has_mask:
+                valid = valid & (lax.dynamic_slice(
+                    ms, (start + done,), (W,)) > 0)
+        else:
+            rows = lax.dynamic_slice(perm, (start + done,), (W,))
+            g = grad[rows]
+            h = hess[rows]
+            if has_mask:
+                valid = valid & row_mask[rows]
+        if self.hist_impl == "pallas":
+            from ..ops.hist_pallas import hist_pallas, pack_gh8
+            live = jnp.clip(count - done, 0, W)
+            gh8 = pack_gh8(g, h, valid)
+            return acc + hist_pallas(bins, gh8, Bb, live)
+        g0 = jnp.where(valid, g, 0.0)
+        h0 = jnp.where(valid, h, 0.0)
+        gh = jnp.stack([g0, h0, valid.astype(jnp.float32)], axis=1)
+        bin_iota = jnp.arange(Bb, dtype=bins.dtype)
+        onehot = (bins[:, :, None] == bin_iota).astype(jnp.bfloat16)
+        part = gh_contract(gh, onehot.reshape(W, C * Bb),
+                           self.hist_precision)
+        return acc + part.reshape(HIST_C, C, Bb).transpose(1, 2, 0)
+
+    def _stream_finish_impl(self, state, hist_small, left_count, fmask):
+        """The tail of the fused split step: parent pointers, histogram
+        subtraction, both children's best-split scans, consolidated state
+        writes — everything after the row-touching loops."""
+        cfg = self.config
+        N = self.num_data
+        F = self.num_features
+        L = cfg.num_leaves
+        NODES = max(L - 1, 1)
+        i32 = jnp.int32
+        leaf, lf, li, ok = self._stream_chosen(state)
+        leaf_f, leaf_i = state["leaf_f"], state["leaf_i"]
+        leaf_bits = state["leaf_bits"]
+        bgain = lf[4]
+        feat = li[5]
+        thrv, dlv, catv = li[6], li[7].astype(bool), li[8].astype(bool)
+        bitsv = leaf_bits[leaf]
+        blg, blh, blc = lf[5], lf[6], lf[7]
+        blout, brout = lf[8], lf[9]
+        begin = li[0]
+        count_eff = jnp.where(ok, li[1], 0)
+        right_count = count_eff - left_count
+
+        new_leaf = state["num_leaves"]
+        nidx = new_leaf - 1
+        wl = jnp.where(ok, leaf, L)
+        wn = jnp.where(ok, new_leaf, L)
+        wk = jnp.where(ok, nidx, NODES)
+
+        pnode = li[3]
+        was_left = li[4].astype(bool)
+        safe_p = jnp.where((pnode >= 0) & ok, pnode, NODES)
+        prow = state["node_i"][safe_p]
+        prow = jnp.where(was_left, prow.at[4].set(nidx),
+                         prow.at[5].set(nidx))
+        node_i = state["node_i"].at[safe_p].set(prow)
+
+        pg, ph, pc = lf[0], lf[1], lf[2]
+        lg, lh, lc = blg, blh, blc
+        rg, rh, rc = pg - lg, ph - lh, pc - lc
+        lout, rout = blout, brout
+        depth = li[2] + 1
+
+        pmin, pmax = lf[10], lf[11]
+        mono_f = self.mono_arr[feat]
+        lcap = rcap = (lout + rout) * 0.5
+        lmin = jnp.where(mono_f < 0, jnp.maximum(pmin, lcap), pmin)
+        lmax = jnp.where(mono_f > 0, jnp.minimum(pmax, lcap), pmax)
+        rmin = jnp.where(mono_f > 0, jnp.maximum(pmin, rcap), pmin)
+        rmax = jnp.where(mono_f < 0, jnp.minimum(pmax, rcap), pmax)
+
+        node_f = state["node_f"].at[wk].set(
+            jnp.stack([bgain, lf[3], ph, pc]))
+        node_i = node_i.at[wk].set(jnp.stack(
+            [feat, thrv, dlv.astype(i32), catv.astype(i32),
+             ~leaf, ~new_leaf]))
+        node_bits = state["node_bits"].at[wk].set(bitsv)
+
+        small_is_left = left_count <= right_count
+        hist_large = state["hist"][leaf] - hist_small
+        hist_left = jnp.where(small_is_left, hist_small, hist_large)
+        hist_right = jnp.where(small_is_left, hist_large, hist_small)
+        hist = state["hist"].at[wl].set(hist_left).at[wn].set(hist_right)
+
+        fms = jnp.broadcast_to(fmask, (2, F))
+        best_children = jax.vmap(self._stream_best_of,
+                                 in_axes=(0, 0, 0, 0, 0, None, 0))
+        (bg2, bf2, bt2, bdl2, bcat2, bbits2, blg2, blh2, blc2,
+         blout2, brout2) = best_children(
+            jnp.stack([hist_left, hist_right]),
+            jnp.stack([lg, rg]), jnp.stack([lh, rh]),
+            jnp.stack([lc, rc]), jnp.stack([lout, rout]), depth, fms)
+
+        lrow_f = jnp.stack([lg, lh, lc, lout, bg2[0], blg2[0], blh2[0],
+                            blc2[0], blout2[0], brout2[0], lmin, lmax])
+        rrow_f = jnp.stack([rg, rh, rc, rout, bg2[1], blg2[1], blh2[1],
+                            blc2[1], blout2[1], brout2[1], rmin, rmax])
+        lrow_i = jnp.stack([begin, left_count, depth, nidx, i32(1),
+                            bf2[0], bt2[0], bdl2[0].astype(i32),
+                            bcat2[0].astype(i32)])
+        rrow_i = jnp.stack([begin + left_count, right_count, depth, nidx,
+                            i32(0), bf2[1], bt2[1], bdl2[1].astype(i32),
+                            bcat2[1].astype(i32)])
+
+        out = dict(state)
+        out["leaf_f"] = leaf_f.at[wl].set(lrow_f).at[wn].set(rrow_f)
+        out["leaf_i"] = leaf_i.at[wl].set(lrow_i).at[wn].set(rrow_i)
+        out["leaf_bits"] = leaf_bits.at[wl].set(bbits2[0]) \
+                                    .at[wn].set(bbits2[1])
+        out["node_f"] = node_f
+        out["node_i"] = node_i
+        out["node_bits"] = node_bits
+        out["hist"] = hist
+        out["num_leaves"] = state["num_leaves"] + ok.astype(i32)
+        return out
+
+    def _stream_finalize_impl(self, state):
+        """row->leaf resolution + DeviceTree assembly (the fused
+        program's epilogue, minus the quantized-leaf renewal the stream
+        subset excludes)."""
+        cfg = self.config
+        N = self.num_data
+        L = cfg.num_leaves
+        NODES = max(L - 1, 1)
+        leaf_begin = jnp.where(state["leaf_i"][:L, 1] > 0,
+                               state["leaf_i"][:L, 0],
+                               N + jnp.arange(L, dtype=jnp.int32))
+        order = jnp.argsort(leaf_begin)
+        sorted_begin = leaf_begin[order]
+        which = jnp.searchsorted(sorted_begin,
+                                 jnp.arange(N, dtype=jnp.int32),
+                                 side="right") - 1
+        pos_leaf = order[which]
+        row_leaf = jnp.zeros(N, jnp.int32).at[
+            state["perm"][:N]].set(pos_leaf)
+        node_f = state["node_f"]
+        node_i = state["node_i"]
+        leaf_f = state["leaf_f"]
+        leaf_i = state["leaf_i"]
+        leaf_value_out = jnp.where(state["num_leaves"] > 1,
+                                   leaf_f[:L, 3],
+                                   jnp.zeros_like(leaf_f[:L, 3]))
+        return DeviceTree(
+            node_feature=node_i[:NODES, 0],
+            node_threshold=node_i[:NODES, 1],
+            node_default_left=node_i[:NODES, 2].astype(bool),
+            node_is_cat=node_i[:NODES, 3].astype(bool),
+            node_cat_bits=state["node_bits"][:NODES],
+            node_left=node_i[:NODES, 4],
+            node_right=node_i[:NODES, 5],
+            node_gain=node_f[:NODES, 0],
+            node_value=node_f[:NODES, 1],
+            node_weight=node_f[:NODES, 2],
+            node_count=node_f[:NODES, 3],
+            leaf_value=leaf_value_out,
+            leaf_weight=leaf_f[:L, 1],
+            leaf_count=leaf_f[:L, 2],
+            leaf_depth=leaf_i[:L, 2],
+            leaf_parent_node=leaf_i[:L, 3],
+            num_leaves=state["num_leaves"],
+            row_leaf=row_leaf,
+        )
+
+    # -- the host-driven per-tree loop ----------------------------------
+    def _stream_small_hist(self, state, grad, hess, row_mask, sb: int,
+                           sc: int, payload, perm_host, mask_order):
+        """One leaf's histogram via the window pump: host fetch (shard
+        gather or payload memcpy, compacted to in-bag rows when a
+        sampling mask is live), async device_put through the ring, jitted
+        accumulate in the resident W-chunk order."""
+        from ..data.stream import stream_windows
+        N = self.num_data
+        W = self._window(N)
+        C = self.num_features
+        nch = (sc + W - 1) // W
+        dtype = self.sdata.shards[0].dtype
+        compact = (mask_order is not None
+                   and self.config.stream_goss_compact)
+        acc = [jnp.zeros((C, self.Bb, HIST_C), jnp.float32)]
+        has_mask = row_mask is not None
+        gs = state.get("gs")
+        hs = state.get("hs")
+        ms = state.get("ms")
+        sorted_mode = self.layout == "sorted"
+
+        def fetch(c):
+            lo = sb + c * W
+            live = min(W, sc - c * W)
+            if sorted_mode:
+                lanes = np.arange(live)
+                rows = None
+            else:
+                rows = perm_host[lo:lo + live]
+                lanes = np.arange(live)
+            if compact:
+                inbag = (mask_order[lo:lo + live] if sorted_mode
+                         else mask_order[rows])
+                lanes = lanes[inbag]
+                if rows is not None:
+                    rows = rows[inbag]
+            nsel = len(lanes)
+            if not compact or nsel > (W * 7) // 8:
+                buf = np.zeros((W, C), dtype=dtype)
+                if sorted_mode:
+                    buf[:live] = payload[lo:lo + live]
+                else:
+                    self.sdata.gather_rows(rows if not compact
+                                           else perm_host[lo:lo + live],
+                                           out=buf[:live])
+                return (buf,)
+            wc = max(_next_pow2(max(nsel, 1)), 256)
+            buf = np.zeros((wc, C), dtype=dtype)
+            pos = np.full(wc, W, np.int32)
+            pos[:nsel] = lanes
+            if nsel:
+                if sorted_mode:
+                    buf[:nsel] = payload[lo + lanes]
+                else:
+                    self.sdata.gather_rows(rows, out=buf[:nsel])
+            return (buf, pos)
+
+        def consume(c, bins_dev, *rest):
+            pos_dev = rest[0] if rest else None
+            acc[0] = self._sj_chunk(
+                acc[0], bins_dev, pos_dev, state["perm"], gs, hs, ms,
+                grad, hess, row_mask, jnp.int32(sb), jnp.int32(c * W),
+                jnp.int32(sc), has_mask=has_mask)
+
+        stream_windows(nch, fetch, consume, self.telemetry,
+                       self.config.stream_prefetch_depth)
+        return acc[0]
+
+    def _train_tree_stream(self, grad, hess, row_mask) -> DeviceTree:
+        """Grow one tree out-of-core: root histogram over all shards, then
+        per split — pick (one small D2H), host column fetch + device
+        partition, go_left mirror update, streamed small-child histogram,
+        jitted finish. Breaking when no leaf has positive gain is exact:
+        the remaining fused steps would all be masked no-ops."""
+        cfg = self.config
+        N = self.num_data
+        W = self._window(N)
+        NODES = max(cfg.num_leaves - 1, 1)
+        fmask = self._feature_mask()
+        has_mask = row_mask is not None
+        mask_dev = row_mask if has_mask else None
+        sorted_mode = self.layout == "sorted"
+
+        # host-side per-tree state
+        mask_host = None
+        if has_mask and cfg.stream_goss_compact:
+            # one D2H of the in-bag mask per tree drives window compaction
+            # graftlint: disable=R1 — per-tree (not per-chunk) fetch; the
+            # mask is the host-side input of the GOSS working-set shrink
+            mask_host = np.asarray(jax.device_get(row_mask)).astype(bool)
+        if sorted_mode:
+            with self.telemetry.phase("layout_apply"):
+                payload = self.sdata.dataset_order_copy()
+                gs = jnp.concatenate([grad, jnp.zeros(W, jnp.float32)])
+                hs = jnp.concatenate([hess, jnp.zeros(W, jnp.float32)])
+                ms = (jnp.concatenate([row_mask.astype(jnp.float32),
+                                       jnp.zeros(W, jnp.float32)])
+                      if has_mask else None)
+            perm_host = None
+            mask_order = mask_host
+        else:
+            payload = None
+            gs = hs = ms = None
+            perm_host = np.arange(N, dtype=np.int64)
+            mask_order = mask_host
+
+        # root histogram over every shard window
+        root_perm = jnp.concatenate([jnp.arange(N, dtype=jnp.int32),
+                                     jnp.zeros(W, jnp.int32)])
+        root_state = {"perm": root_perm}
+        if sorted_mode:
+            root_state.update(gs=gs, hs=hs)
+            if ms is not None:
+                root_state["ms"] = ms
+        hist_root = self._stream_small_hist(
+            root_state, grad, hess, mask_dev, 0, N, payload,
+            np.arange(N, dtype=np.int64) if perm_host is None
+            else perm_host, mask_order)
+        state = self._sj_init(hist_root, fmask, gs, hs, ms)
+
+        for _k in range(NODES if cfg.num_leaves > 1 else 0):
+            # graftlint: disable=R1 — the stream mode's per-split sync:
+            # the host must learn which leaf/feature to fetch from its
+            # shards; this is the capacity-for-latency trade the mode IS
+            pick = jax.device_get(self._sj_pick(state))
+            leaf, ok, begin, count, feat = (int(pick[0]), bool(pick[1]),
+                                            int(pick[2]), int(pick[3]),
+                                            int(pick[4]))
+            if not ok:
+                break
+
+            # split column values for the leaf slice: 1-2 B/row H2D
+            pv = max(_next_pow2(max(count, 1)), W)
+            dtype = self.sdata.shards[0].dtype
+            with self.telemetry.phase("h2d_prefetch"):
+                cv_host = np.zeros(pv, dtype=dtype)
+                if sorted_mode:
+                    cv_host[:count] = payload[begin:begin + count, feat]
+                else:
+                    cv_host[:count] = self.sdata.gather_col(
+                        feat, perm_host[begin:begin + count])
+                cvals = jax.device_put(cv_host)
+            state, gbuf, left_cnt_dev = self._sj_part(state, cvals)
+            # graftlint: disable=R1 — go_left + left count drive the host
+            # mirror (payload/permutation) update; one small D2H per split
+            gl, left_count = jax.device_get((gbuf, left_cnt_dev))
+            gl = np.asarray(gl)[:count]
+            left_count = int(left_count)
+            # mirror the fused pbody placement: lefts stable ascending,
+            # rights filled backward (reversed subsequence)
+            if sorted_mode:
+                sl = payload[begin:begin + count]
+                payload[begin:begin + count] = np.concatenate(
+                    [sl[gl], sl[~gl][::-1]])
+                if mask_order is not None:
+                    mo = mask_order[begin:begin + count]
+                    mask_order[begin:begin + count] = np.concatenate(
+                        [mo[gl], mo[~gl][::-1]])
+            else:
+                rs = perm_host[begin:begin + count]
+                perm_host[begin:begin + count] = np.concatenate(
+                    [rs[gl], rs[~gl][::-1]])
+
+            right_count = count - left_count
+            small_is_left = left_count <= right_count
+            sb = begin if small_is_left else begin + left_count
+            sc = left_count if small_is_left else right_count
+            hist_small = self._stream_small_hist(
+                state, grad, hess, mask_dev, sb, sc, payload, perm_host,
+                mask_order)
+            state = self._sj_finish(state, hist_small,
+                                    jnp.int32(left_count), fmask)
+
+        return self._sj_final(state)

@@ -90,7 +90,20 @@ class SerialTreeLearner:
         self.max_num_bins = int(meta["num_bins"].max())
         self.B = max(_next_pow2(self.max_num_bins), 8)
 
-        self.x_binned = jnp.asarray(dataset.binned)
+        # data_residency (docs/performance.md "Out-of-core"): hbm keeps the
+        # binned matrix device-resident; stream keeps it in host shards and
+        # uploads leaf windows on demand (bit-identical trees — the stream
+        # hooks feed the same kernels the same values in the same order)
+        self.residency = self._resolve_residency(config)
+        if self.residency == "stream":
+            from ..data.stream import as_sharded
+            self.sdata = as_sharded(dataset, config)
+            self.x_binned = None
+            self._perm_host: Optional[np.ndarray] = None
+            self._x_sorted_host: Optional[np.ndarray] = None
+        else:
+            self.sdata = None
+            self.x_binned = jnp.asarray(dataset.binned)
         self.perm0 = jnp.arange(self.num_data, dtype=jnp.int32)
 
         self.params = SplitParams(
@@ -229,6 +242,57 @@ class SerialTreeLearner:
     #: feature-parallel learner, whose winning split column lives on
     #: another shard)
     supports_sorted_layout = True
+
+    #: learners that can train with the binned matrix in host shards
+    #: (``data_residency=stream``); the distributed learners keep their
+    #: device matrices resident and override this to False
+    supports_stream = True
+
+    def _stream_blockers(self, config: Config) -> List[str]:
+        """Config combinations this learner's stream mode does not express
+        (checked from config only — subclass __init__ state is not built
+        yet when this runs). Non-empty → fall back to hbm residency."""
+        return []
+
+    def _estimate_residency_bytes(self) -> int:
+        """Approximate device bytes the hbm path would pin for the binned
+        matrix (the ``stream_hbm_budget_mb`` auto-residency input)."""
+        item = 1 if self.max_num_bins <= 256 else 2
+        return self.num_data * self.num_features * item
+
+    def _resolve_residency(self, config: Config) -> str:
+        """Resolve ``data_residency``: auto streams for pre-sharded
+        datasets (and above ``stream_hbm_budget_mb`` when set), stays
+        device-resident otherwise; unsupported learners/options fall back
+        to hbm loudly, never silently change semantics."""
+        from ..data.stream import ShardedBinnedDataset
+        mode = config.data_residency
+        sharded = isinstance(self.dataset, ShardedBinnedDataset)
+        if mode == "hbm":
+            return "hbm"
+        if not self.supports_stream:
+            if mode == "stream" or sharded:
+                log.info("data_residency=stream is not supported by %s; "
+                         "training device-resident", type(self).__name__)
+            return "hbm"
+        blockers = self._stream_blockers(config)
+        if blockers:
+            if mode == "stream" or sharded:
+                log.warning("data_residency=stream does not support %s; "
+                            "training device-resident",
+                            ", ".join(blockers))
+            return "hbm"
+        if mode == "stream" or sharded:
+            return "stream"
+        if config.stream_hbm_budget_mb > 0 and (
+                self._estimate_residency_bytes()
+                > config.stream_hbm_budget_mb << 20):
+            log.info("data_residency=auto: estimated %.0f MB residency "
+                     "exceeds stream_hbm_budget_mb=%d; streaming",
+                     self._estimate_residency_bytes() / 2**20,
+                     config.stream_hbm_budget_mb)
+            return "stream"
+        return "hbm"
 
     @staticmethod
     def _resolve_hist_impl(impl: str) -> str:
@@ -485,10 +549,43 @@ class SerialTreeLearner:
 
     # histogram hook points (overridden by the distributed learners) --------
     def _root_histogram(self, grad, hess, row_mask):
+        if self.residency == "stream":
+            return self._root_histogram_stream(grad, hess, row_mask)
         return full_histogram(self.x_binned, grad, hess, row_mask, self.B,
                               self.rows_per_block, self.hist_precision)
 
+    def _root_histogram_stream(self, grad, hess, row_mask):
+        """Root histogram over host shards: dataset-order windows pumped
+        through the double-buffered H2D ring, accumulated on device in the
+        resident scan's exact block order (data/stream.py)."""
+        from ..data.stream import stream_windows
+        from ..ops.histogram import finish_histogram_acc, histogram_block_acc
+        N, F, B = self.num_data, self.num_features, self.B
+        block = min(self.rows_per_block, N)
+        nch = (N + block - 1) // block
+        acc = [jnp.zeros((3, F * B), jnp.float32)]
+        dtype = self.sdata.shards[0].dtype
+
+        def fetch(c):
+            lo = c * block
+            hi = min(lo + block, N)
+            buf = np.zeros((block, F), dtype=dtype)
+            self.sdata.row_block(lo, hi, out=buf[:hi - lo])
+            return (buf,)
+
+        def consume(c, bins_dev):
+            acc[0] = histogram_block_acc(
+                acc[0], bins_dev, grad, hess, row_mask,
+                jnp.int32(c * block), B, self.hist_precision)
+
+        stream_windows(nch, fetch, consume, self.telemetry,
+                       self.config.stream_prefetch_depth)
+        return finish_histogram_acc(acc[0], F, B)
+
     def _leaf_histogram(self, perm, grad, hess, begin, count, padded, row_mask):
+        if self.residency == "stream":
+            return self._leaf_histogram_stream(grad, hess, begin, count,
+                                               padded, row_mask)
         if self._x_sorted is not None:
             # sorted layout: the leaf is a contiguous position slice of the
             # physically reordered matrix — consecutive-index read, no
@@ -503,6 +600,36 @@ class SerialTreeLearner:
                               jnp.int32(begin), jnp.int32(count), padded,
                               self.B, self.rows_per_block, row_mask,
                               self.hist_precision)
+
+    def _leaf_histogram_stream(self, grad, hess, begin, count, padded,
+                               row_mask):
+        """One leaf's histogram under stream residency: the host supplies
+        the leaf's binned rows (a contiguous payload slice under the
+        sorted layout, a shard gather under the gather layout); the
+        gradient channels stay device-resident. Same kernels, same padded
+        shapes, same values → bit-identical to the resident hooks."""
+        from ..ops.histogram import (leaf_histogram_sorted_streamed,
+                                     leaf_histogram_streamed)
+        N = self.num_data
+        if self.layout == "sorted":
+            with self.telemetry.phase("h2d_prefetch"):
+                buf = np.zeros((padded, self.num_features),
+                               dtype=self.sdata.shards[0].dtype)
+                hi = min(begin + count, N)
+                buf[:hi - begin] = self._x_sorted_host[begin:hi]
+                bins = jax.device_put(buf)
+            return leaf_histogram_sorted_streamed(
+                bins, self._gh_sorted, jnp.int32(begin), jnp.int32(count),
+                self.B, self.rows_per_block, self.hist_precision)
+        with self.telemetry.phase("h2d_prefetch"):
+            idx = np.clip(np.arange(begin, begin + padded), 0, N - 1)
+            rows_np = self._perm_host[idx]
+            bins = jax.device_put(self.sdata.gather_rows(rows_np))
+            rows = jax.device_put(rows_np.astype(np.int32))
+        return leaf_histogram_streamed(bins, rows, grad, hess,
+                                       jnp.int32(count), self.B,
+                                       self.rows_per_block, row_mask,
+                                       self.hist_precision)
 
     def _cat_bitset_real(self, feature_k: int, bitset_bins: np.ndarray) -> np.ndarray:
         """Convert a bin-space bitset to raw-category space for model export.
@@ -554,6 +681,57 @@ class SerialTreeLearner:
             thr_bin = mapper._value_to_bin_scalar(thr)
         return k, int(thr_bin)
 
+    def _split_partition_stream(self, perm, begin: int, count: int,
+                                feat: int, s, P: int):
+        """Stream-residency partition: the host supplies the split
+        feature's bin values for the leaf slice (1-2 B/row over the link),
+        the device runs the identical stable partition on ``perm`` (and
+        the gradient channels under the sorted layout), and the returned
+        go_left flags keep the host mirror — permutation or physical
+        payload — in lockstep. Returns ``(new_perm, left_count_dev)``."""
+        from ..ops.partition import (split_partition_sorted_vals,
+                                     split_partition_vals)
+        N = self.num_data
+        idx = np.clip(np.arange(begin, begin + P), 0, N - 1)
+        if self.layout == "sorted":
+            with self.telemetry.phase("h2d_prefetch"):
+                vals = jax.device_put(self._x_sorted_host[idx, feat])
+            perm, self._gh_sorted, left_cnt_dev, gl = \
+                split_partition_sorted_vals(
+                    vals, self._gh_sorted, perm,
+                    jnp.int32(begin), jnp.int32(count),
+                    jnp.int32(s.threshold),
+                    jnp.asarray(bool(s.default_left)),
+                    self.default_bins_arr[feat],
+                    self.missing_types_arr[feat],
+                    self.num_bins_arr[feat],
+                    jnp.asarray(bool(s.is_categorical)),
+                    jnp.asarray(s.cat_bitset), P)
+            # graftlint: disable=R1 — the go_left fetch IS the stream
+            # design: the host must reorder its payload mirror; one small
+            # D2H per split on the (already host-orchestrated) learner
+            glh = np.asarray(jax.device_get(gl))[:count]
+            sl = self._x_sorted_host[begin:begin + count]
+            self._x_sorted_host[begin:begin + count] = np.concatenate(
+                [sl[glh], sl[~glh]])
+        else:
+            rows_np = self._perm_host[idx]
+            with self.telemetry.phase("h2d_prefetch"):
+                vals = jax.device_put(self.sdata.gather_col(feat, rows_np))
+            perm, left_cnt_dev, gl = split_partition_vals(
+                vals, perm, jnp.int32(begin), jnp.int32(count),
+                jnp.int32(s.threshold), jnp.asarray(bool(s.default_left)),
+                self.default_bins_arr[feat], self.missing_types_arr[feat],
+                self.num_bins_arr[feat], jnp.asarray(bool(s.is_categorical)),
+                jnp.asarray(s.cat_bitset), P)
+            # graftlint: disable=R1 — see above: the permutation mirror
+            # must follow the device partition for the next host gather
+            glh = np.asarray(jax.device_get(gl))[:count]
+            rs = self._perm_host[begin:begin + count]
+            self._perm_host[begin:begin + count] = np.concatenate(
+                [rs[glh], rs[~glh]])
+        return perm, left_cnt_dev
+
     # ------------------------------------------------------------------
     def train(self, grad: jax.Array, hess: jax.Array,
               row_mask: Optional[jax.Array] = None) -> Tree:
@@ -578,10 +756,20 @@ class SerialTreeLearner:
                 parts = [grad[:, None], hess[:, None]]
                 if row_mask is not None:
                     parts.append(row_mask.astype(jnp.float32)[:, None])
-                self._x_sorted = self.x_binned
+                if self.residency == "stream":
+                    # the payload copy the host physically reorders lives
+                    # in host RAM; only the gradient channels ride HBM
+                    self._x_sorted = None
+                    self._x_sorted_host = self.sdata.dataset_order_copy()
+                else:
+                    self._x_sorted = self.x_binned
                 self._gh_sorted = jnp.concatenate(parts, axis=1)
         else:
             self._x_sorted = self._gh_sorted = None
+        if self.residency == "stream" and self.layout != "sorted":
+            # host mirror of the device permutation (kept in lockstep by
+            # the partition go_left flags) drives the per-leaf row gathers
+            self._perm_host = np.arange(self.num_data, dtype=np.int64)
         leaf_begin = np.zeros(num_leaves, dtype=np.int64)
         leaf_count = np.zeros(num_leaves, dtype=np.int64)
         leaf_count[0] = self.num_data
@@ -639,7 +827,10 @@ class SerialTreeLearner:
             P = self._pad_size(count)
             feat = int(s.feature)
             with self.telemetry.phase("partition"):
-                if self._x_sorted is not None:
+                if self.residency == "stream":
+                    perm, left_cnt_dev = self._split_partition_stream(
+                        perm, begin, count, feat, s, P)
+                elif self._x_sorted is not None:
                     # sorted layout: apply the stable partition physically
                     # to the row payload + gradient channels as well
                     (perm, self._x_sorted, self._gh_sorted,

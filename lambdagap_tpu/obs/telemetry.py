@@ -46,8 +46,14 @@ from .xla_watch import XlaWatchdog
 # is the tree_layout=sorted reorder pre-pass (the per-tree leaf-ordered
 # rebuild of the packed row matrix); the in-program per-split
 # permutation-apply rides the tree span like the rest of the fused program
+# "h2d_prefetch" / "chunk_wait" are the data_residency=stream ring phases
+# (data/stream.py ShardRing): prefetch is the host-side window fetch +
+# async device_put issue, chunk_wait is the ring-slot completion block —
+# together they tile the streaming overhead into the iteration wall, so
+# overlap efficiency (chunk_wait ~ 0) is a measured number
 PHASES = ("gradients", "sampling", "layout_apply", "histogram", "split",
-          "partition", "tree", "score_update", "eval", "device_wait")
+          "partition", "tree", "score_update", "eval", "device_wait",
+          "h2d_prefetch", "chunk_wait")
 
 # phase -> the utils.timer scope name it replaces (the deprecation shim:
 # the legacy global_timer report keeps its historical row names)

@@ -221,6 +221,99 @@ def subtract_histogram(parent_hist: jax.Array, child_hist: jax.Array) -> jax.Arr
     return parent_hist - child_hist
 
 
+# ---------------------------------------------------------------------------
+# data_residency=stream kernels (docs/performance.md "Out-of-core"): the
+# binned matrix lives in host shards; windows arrive as UPLOADED buffers
+# while grad/hess/mask stay device-resident. Accumulation replicates the
+# resident kernels' order window-for-window (same gh_contract shapes, same
+# sequential f32 adds), so streamed histograms are bit-identical.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "precision"))
+def histogram_block_acc(acc: jax.Array, bins_blk: jax.Array,
+                        grad: jax.Array, hess: jax.Array,
+                        row_mask: Optional[jax.Array], start: jax.Array,
+                        num_bins: int, precision: str = "split") -> jax.Array:
+    """One streamed block of the root histogram: ``acc + contract(block)``.
+
+    ``bins_blk`` is the uploaded rows ``[start, start+block)`` in dataset
+    order (host zero-pads the ragged tail, matching the resident
+    ``histogram_from_rows`` tail padding); grad/hess/mask index on device.
+    Carrying ``acc`` across dispatches reproduces the resident scan's
+    sequential block adds exactly.
+    """
+    block, F = bins_blk.shape
+    B = num_bins
+    N = grad.shape[0]
+    lane = jnp.arange(block, dtype=jnp.int32)
+    idxg = start + lane
+    in_range = idxg < N
+    idx = jnp.clip(idxg, 0, N - 1)
+    valid = in_range if row_mask is None else in_range & row_mask[idx]
+    vf = valid.astype(jnp.float32)
+    # same construction as the resident gh matrix (grad * valid), with the
+    # tail rows forced to exact 0.0 like jnp.pad's zeros
+    g = jnp.where(in_range, grad[idx] * vf, 0.0)
+    h = jnp.where(in_range, hess[idx] * vf, 0.0)
+    gh_blk = jnp.stack([g, h, vf], axis=1)
+    bin_iota = jnp.arange(B, dtype=bins_blk.dtype)
+    onehot = (bins_blk[:, :, None] == bin_iota).astype(jnp.bfloat16)
+    part = gh_contract(gh_blk, onehot.reshape(block, F * B), precision)
+    return acc + part
+
+
+def finish_histogram_acc(acc: jax.Array, num_features: int,
+                         num_bins: int) -> jax.Array:
+    """[3, F*B] streamed accumulator -> the [F, B, 3] histogram layout."""
+    return acc.reshape(HIST_CHANNELS, num_features,
+                       num_bins).transpose(1, 2, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "rows_per_block",
+                                             "precision"))
+def leaf_histogram_streamed(bins: jax.Array, rows: jax.Array,
+                            grad: jax.Array, hess: jax.Array,
+                            count: jax.Array, num_bins: int,
+                            rows_per_block: int = 4096,
+                            row_mask: Optional[jax.Array] = None,
+                            precision: str = "split") -> jax.Array:
+    """:func:`leaf_histogram` with the row gather done on the HOST: the
+    leaf's binned rows arrive uploaded (``bins``, padded like
+    ``gather_leaf_rows`` pads) together with their dataset row indices
+    (``rows``) so grad/hess/mask still index device-resident arrays.
+    Identical values into the same :func:`histogram_from_rows` → identical
+    histogram."""
+    P = bins.shape[0]
+    lane = jnp.arange(P, dtype=jnp.int32)
+    valid = lane < count
+    if row_mask is not None:
+        valid = valid & row_mask[rows]
+    return histogram_from_rows(bins, grad[rows], hess[rows], valid,
+                               num_bins, rows_per_block, precision)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "rows_per_block",
+                                             "precision"))
+def leaf_histogram_sorted_streamed(bins: jax.Array, gh_sorted: jax.Array,
+                                   begin: jax.Array, count: jax.Array,
+                                   num_bins: int,
+                                   rows_per_block: int = 4096,
+                                   precision: str = "split") -> jax.Array:
+    """:func:`leaf_histogram_sorted` with the contiguous window read done
+    on the HOST (the sorted payload lives in host shards under stream
+    residency); the gradient channels stay device-resident and slice at
+    the same clamped positions as the resident kernel."""
+    P = bins.shape[0]
+    lane = jnp.arange(P, dtype=jnp.int32)
+    idx = jnp.clip(begin + lane, 0, gh_sorted.shape[0] - 1)
+    valid = lane < count
+    gh = gh_sorted[idx]
+    if gh_sorted.shape[1] > 2:
+        valid = valid & (gh[:, 2] > 0)
+    return histogram_from_rows(bins, gh[:, 0], gh[:, 1], valid, num_bins,
+                               rows_per_block, precision)
+
+
 @functools.partial(jax.jit, static_argnames=("num_bins", "rows_per_block",
                                              "precision"))
 def full_histogram(x_binned: jax.Array, grad: jax.Array, hess: jax.Array,

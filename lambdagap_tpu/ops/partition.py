@@ -128,3 +128,81 @@ def split_partition_sorted(x_sorted: jax.Array, gh_sorted: jax.Array,
     new_x = x_sorted.at[idx].set(x_sorted[safe_idx][order], mode="drop")
     new_gh = gh_sorted.at[idx].set(gh_sorted[safe_idx][order], mode="drop")
     return new_perm, new_x, new_gh, left_count
+
+
+# ---------------------------------------------------------------------------
+# data_residency=stream variants (docs/performance.md "Out-of-core"):
+# the split feature's bin values arrive as an UPLOADED buffer (the host
+# gathered them from its shards — 1-2 bytes per row over the link instead
+# of holding the whole matrix in HBM). Decision + permutation math is
+# bit-identical to the resident kernels above; the host mirrors the
+# resulting order from the returned go_left flags (stable: lefts then
+# rights, each in slice order).
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("padded_size",))
+def split_partition_vals(bin_vals: jax.Array, perm: jax.Array,
+                         begin: jax.Array, count: jax.Array,
+                         threshold: jax.Array, default_left: jax.Array,
+                         default_bin: jax.Array, missing_type: jax.Array,
+                         num_bin: jax.Array, is_categorical: jax.Array,
+                         cat_bitset: jax.Array, padded_size: int):
+    """:func:`split_partition` with host-supplied bin values.
+
+    ``bin_vals[i]`` is the split feature's bin for the row at slice lane
+    ``i`` (padding lanes arbitrary — they sort last and never count).
+    Returns ``(new_perm, left_count, go_left)``; ``go_left`` lets the host
+    update its permutation mirror without a second transfer of the slice.
+    """
+    N = perm.shape[0]
+    lane = jnp.arange(padded_size, dtype=jnp.int32)
+    idx = begin + lane
+    safe_idx = jnp.clip(idx, 0, N - 1)
+    rows = perm[safe_idx]
+    valid = lane < count
+
+    go_left = decision_go_left(bin_vals.astype(jnp.int32), threshold,
+                               default_left, default_bin, missing_type,
+                               num_bin, is_categorical, cat_bitset)
+    go_left = go_left & valid
+
+    key = jnp.where(go_left, 0, jnp.where(valid, 1, 2)).astype(jnp.int32)
+    order = jnp.argsort(key * padded_size + lane)
+    left_count = jnp.sum(go_left, dtype=jnp.int32)
+    new_perm = perm.at[idx].set(rows[order], mode="drop")
+    return new_perm, left_count, go_left
+
+
+@functools.partial(jax.jit, static_argnames=("padded_size",))
+def split_partition_sorted_vals(bin_vals: jax.Array, gh_sorted: jax.Array,
+                                perm: jax.Array, begin: jax.Array,
+                                count: jax.Array, threshold: jax.Array,
+                                default_left: jax.Array,
+                                default_bin: jax.Array,
+                                missing_type: jax.Array, num_bin: jax.Array,
+                                is_categorical: jax.Array,
+                                cat_bitset: jax.Array, padded_size: int):
+    """:func:`split_partition_sorted` with host-supplied bin values: the
+    binned payload lives in HOST shards under stream residency, so only
+    ``perm`` and the device-resident gradient channels are permuted here;
+    the host applies the same stable order to its payload slice from the
+    returned ``go_left`` flags. Returns
+    ``(new_perm, new_gh_sorted, left_count, go_left)``."""
+    N = perm.shape[0]
+    lane = jnp.arange(padded_size, dtype=jnp.int32)
+    idx = begin + lane
+    safe_idx = jnp.clip(idx, 0, N - 1)
+    rows = perm[safe_idx]
+    valid = lane < count
+
+    go_left = decision_go_left(bin_vals.astype(jnp.int32), threshold,
+                               default_left, default_bin, missing_type,
+                               num_bin, is_categorical, cat_bitset)
+    go_left = go_left & valid
+
+    key = jnp.where(go_left, 0, jnp.where(valid, 1, 2)).astype(jnp.int32)
+    order = jnp.argsort(key * padded_size + lane)
+    left_count = jnp.sum(go_left, dtype=jnp.int32)
+    new_perm = perm.at[idx].set(rows[order], mode="drop")
+    new_gh = gh_sorted.at[idx].set(gh_sorted[safe_idx][order], mode="drop")
+    return new_perm, new_gh, left_count, go_left

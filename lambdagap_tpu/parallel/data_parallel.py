@@ -57,6 +57,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
     # sharded-matrix hooks; they opt out of the physically sorted layout
     # (the fused data-parallel learner supports it in-program)
     supports_sorted_layout = False
+    supports_stream = False
 
     def __init__(self, dataset: BinnedDataset, config: Config,
                  mesh: Optional[Mesh] = None) -> None:

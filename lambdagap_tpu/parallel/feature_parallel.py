@@ -43,6 +43,7 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
     # feature-blocked histogram hooks read the shared column layout;
     # explicit opt-out of the physically sorted row layout
     supports_sorted_layout = False
+    supports_stream = False
 
     def __init__(self, dataset: BinnedDataset, config: Config,
                  mesh: Optional[Mesh] = None) -> None:

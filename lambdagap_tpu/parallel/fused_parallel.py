@@ -60,6 +60,10 @@ _DEBUG_CHECKS = os.environ.get("LAMBDAGAP_DEBUG", "0") not in ("0", "",
 class FusedDataParallelTreeLearner(FusedTreeLearner):
     """Rows sharded over the mesh; one whole tree per dispatch."""
 
+    # the shard_map program keeps per-shard matrices device-resident;
+    # out-of-core streaming is a single-chip mode for now (ROADMAP 1 x 4)
+    supports_stream = False
+
     def __init__(self, dataset: BinnedDataset, config: Config,
                  mesh: Optional[Mesh] = None) -> None:
         # mesh geometry first: the base-class init places the binned matrix
@@ -316,6 +320,7 @@ class FusedFeatureParallelTreeLearner(FusedTreeLearner):
     # decode-from-window shortcut cannot express that, so this learner
     # explicitly opts out and keeps the gather layout
     supports_sorted_layout = False
+    supports_stream = False
 
     def __init__(self, dataset: BinnedDataset, config: Config,
                  mesh: Optional[Mesh] = None) -> None:

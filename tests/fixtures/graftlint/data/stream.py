@@ -1,0 +1,49 @@
+"""R1/R7 fixture (out-of-core stream path): a blocking host sync inside
+the shard-ring fill loop defeats the H2D/compute overlap silently (the
+run still converges, just at un-overlapped link speed), and a timing
+bracket over the pump is only honest when it closes with the ring-slot
+completion sync (``wait_ready``)."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def stream_windows(nch, fetch, consume):
+    ring = []
+    for c in range(nch):
+        buf = jax.device_put(fetch(c))
+        _ = float(jnp.sum(buf))  # BAD:R1
+        ring.append(buf)
+        consume(c, ring.pop(0))
+
+
+def _train_tree_stream(state, windows):
+    for w in windows:
+        arr = jax.device_put(w)
+        state = state + jnp.sum(arr)
+        host = jax.device_get(state)  # BAD:R1
+    return state
+
+
+def fill_ring_once(host_buf):
+    # not a hot name, not in a loop: a one-time setup upload may sync
+    dev = jax.device_put(host_buf)
+    return jax.device_get(dev)
+
+
+def time_pump_unsynced(ring, windows):
+    t0 = time.perf_counter()
+    for w in windows:
+        jnp.dot(w, w)
+    return time.perf_counter() - t0  # BAD:R7
+
+
+def time_pump_ring_synced(ring, windows):
+    # GOOD: the bracket closes by draining the ring — wait_ready is the
+    # slot-completion sync, so the delta covers finished transfers
+    t0 = time.perf_counter()
+    for w in windows:
+        jnp.dot(w, w)
+    ring.wait_ready()
+    return time.perf_counter() - t0

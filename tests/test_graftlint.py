@@ -64,6 +64,7 @@ def fixture_findings():
     "r6_collective_axis.py",
     "obs/r7_unsynced_timing.py",
     "serve/r8_futures.py",
+    "data/stream.py",
 ])
 def test_rule_fixture_exact_findings(fixture_findings, relpath):
     got = fixture_findings.get(relpath, set())

@@ -22,6 +22,16 @@ if ! env JAX_PLATFORMS=cpu python tools/telemetry_gate.py; then
     echo "steady-state recompile appeared; see docs/observability.md)"
     exit 1
 fi
+# stream gate (ISSUE 7): tiny synthetic dataset forced onto 4 host
+# shards (ragged tail) must train bit-identical to the resident path
+# with zero steady-state recompiles and live h2d_prefetch/chunk_wait
+# ring telemetry
+if ! env JAX_PLATFORMS=cpu python tools/stream_gate.py; then
+    echo "FAIL-FAST: stream gate failed (out-of-core training diverged"
+    echo "from the resident path or recompiles/ring telemetry regressed;"
+    echo "see docs/performance.md)"
+    exit 1
+fi
 # chaos gate (ISSUE 5): short train under injected gradient NaNs must
 # finish with a valid model (guard_nonfinite=skip_tree), and a serve loop
 # under injected dispatch failures must shed, degrade, and recover
@@ -37,7 +47,7 @@ python -m pytest tests/test_train.py tests/test_rank.py tests/test_cli_io.py -q 
 echo "=== G3 $(date)"
 python -m pytest tests/test_monotone.py tests/test_tree_options.py tests/test_extra_contri.py tests/test_forced_splits.py -q 2>&1 | tail -1
 echo "=== G4 $(date)"
-python -m pytest tests/test_fused.py tests/test_layout.py tests/test_distributed.py tests/test_quantized.py tests/test_continued.py tests/test_model_io.py tests/test_shap_json.py -q 2>&1 | tail -1
+python -m pytest tests/test_fused.py tests/test_layout.py tests/test_stream.py tests/test_distributed.py tests/test_quantized.py tests/test_continued.py tests/test_model_io.py tests/test_shap_json.py -q 2>&1 | tail -1
 echo "=== G5 $(date)"
 python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py tests/test_serve.py tests/test_serve_stress.py -q 2>&1 | tail -1
 echo "=== G6 full-length consistency $(date)"
