@@ -704,6 +704,198 @@ def run_stream_ab(rows: int, max_bin: int, iters: int) -> None:
     }))
 
 
+def run_multichip_attempt(n_devices: int, rows: int, max_bin: int,
+                          iters: int) -> None:
+    """Child-process entry (ISSUE 8): one fused data-parallel training run
+    at a fixed device count. The parent (``--multichip-scaling``) launches
+    one child per width with the device topology in the environment
+    (``--xla_force_host_platform_device_count=D`` on CPU; the real mesh
+    as-is on TPU), so every width gets a cold, honest program.
+
+    Emits per-iter steady wall (device-complete via telemetry iteration
+    boundaries), the sha of the built trees (widths must be BIT-identical
+    — the histogram psum reduces shard partials in a width-stable order),
+    steady-state compile count, and the analytic per-iteration histogram
+    psum traffic (payload + ring-allreduce wire bytes).
+    """
+    import hashlib
+
+    _configure_jax_cache()
+    import jax
+
+    import lambdagap_tpu as lgb
+
+    assert len(jax.devices()) >= n_devices, (
+        f"need {n_devices} devices, have {len(jax.devices())}")
+    leaves = int(os.environ.get("BENCH_MULTICHIP_LEAVES", "15"))
+    # default QUANTIZED: integer histogram reduction is width-invariant,
+    # which is what makes the cross-width bit-identity check meaningful
+    # (f32 is reduction-order-equal only; near-ties may flip per width)
+    quant = os.environ.get("BENCH_MULTICHIP_QUANT", "1") == "1"
+    higgs = os.environ.get("BENCH_DATA_HIGGS", "")
+    if higgs:
+        X, y, _, _ = _load_higgs_real(higgs)
+        X, y = X[:rows], y[:rows]
+    else:
+        with np.load(_ensure_data(rows)) as d:
+            X, y = d["X"][:rows], d["y"][:rows]
+    params = {"objective": "binary", "tree_learner": "data",
+              "tpu_fused_learner": "1", "tpu_num_devices": n_devices,
+              "num_leaves": leaves, "max_bin": max_bin,
+              "min_data_in_leaf": 20, "verbose": -1,
+              "use_quantized_grad": quant, "stochastic_rounding": False,
+              "telemetry": True, "telemetry_warmup": 2}
+    t0 = time.perf_counter()
+    ds = lgb.Dataset(X, label=y, params=params)
+    booster = lgb.Booster(params=params, train_set=ds)
+    t_construct = time.perf_counter() - t0
+    from lambdagap_tpu.parallel.fused_parallel import \
+        FusedDataParallelTreeLearner
+    lr = booster._booster.learner
+    assert isinstance(lr, FusedDataParallelTreeLearner), type(lr)
+    warmup = 2
+    for _ in range(warmup + iters):
+        booster.update()
+    tel = booster._booster.telemetry
+    recs = list(tel.records)
+    steady = recs[warmup:]
+    walls = sorted(r["wall_s"] for r in steady)
+    s_per_iter = walls[len(walls) // 2] if walls else float("nan")
+    compiles_steady = sum((r.get("compiles") or {}).get("total", 0)
+                          for r in steady)
+    trees_sha = hashlib.sha256(
+        booster.model_to_string().split("end of trees")[0]
+        .encode()).hexdigest()
+
+    # analytic histogram-psum traffic: one [C, Bb, 3] reduction per split
+    C = int(lr.num_features)
+    Bb = int(lr.Bb)
+    item = 4                              # f32 (quant_exact int32: same)
+    payload = C * Bb * 3 * item
+    splits = leaves - 1
+    ring = 2 * (n_devices - 1) / max(n_devices, 1)
+    print(json.dumps({
+        "n_devices": n_devices,
+        "rows": rows,
+        "max_bin": max_bin,
+        "num_leaves": leaves,
+        "iters_measured": len(steady),
+        "s_per_iter": round(s_per_iter, 5),
+        "construct_s": round(t_construct, 3),
+        "compiles_steady": compiles_steady,
+        "trees_sha": trees_sha,
+        "psum_payload_bytes_per_split": payload,
+        "psum_payload_bytes_per_iter": payload * splits,
+        "psum_wire_bytes_per_iter": int(payload * splits * ring),
+        "mesh": {"axes": ["data", "feature"],
+                 "shape": [n_devices, 1],
+                 "platform": jax.devices()[0].platform},
+    }))
+
+
+def run_multichip_scaling(rows: int, max_bin: int, iters: int) -> None:
+    """Parent entry (ISSUE 8 acceptance): measured multi-chip scaling of
+    the fused data-parallel learner at 1/2/4/8 devices.
+
+    Uses the real mesh when this host exposes enough accelerator devices;
+    elsewhere each width runs on a virtual
+    ``--xla_force_host_platform_device_count=D`` CPU mesh — which measures
+    the *distribution overhead* (padding, collective emulation, per-shard
+    program shape), not parallel speedup, since every virtual device
+    shares the same cores. Efficiency is therefore defined per mode:
+
+    - real mesh:    efficiency(D) = t1 / (D * tD)   (ideal 1.0)
+    - virtual mesh: efficiency(D) = t1 / tD         (ideal 1.0 — total
+      work is constant, so any slowdown is pure distribution overhead)
+
+    Also emits the analytic histogram-psum wire traffic against the ICI
+    bound (v5e ~45 GB/s/link, BENCH_MULTICHIP_ICI_GBPS) and asserts trees
+    are bit-identical across widths. Result JSON lands on stdout AND in
+    MULTICHIP_r06.json (BENCH_MULTICHIP_OUT overrides).
+    """
+    widths = [int(w) for w in os.environ.get(
+        "BENCH_MULTICHIP_WIDTHS", "1,2,4,8").split(",")]
+    import jax
+    real = (jax.default_backend() not in ("cpu",)
+            and len(jax.devices()) >= max(widths))
+    env = {k: v for k, v in os.environ.items() if "AXON" not in k}
+    results = {}
+    for d in widths:
+        child_env = dict(env)
+        if not real:
+            child_env["JAX_PLATFORMS"] = "cpu"
+            flags = " ".join(
+                f for f in child_env.get("XLA_FLAGS", "").split()
+                if not f.startswith("--xla_force_host_platform"))
+            child_env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={d}"
+            ).strip()
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--multichip-attempt", str(d), str(rows), str(max_bin),
+               str(iters)]
+        print(f"[bench] multichip width {d} "
+              f"({'real mesh' if real else 'virtual CPU'})",
+              file=sys.stderr, flush=True)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=3600, env=child_env)
+            if proc.returncode == 0 and proc.stdout.strip():
+                results[d] = json.loads(
+                    proc.stdout.strip().splitlines()[-1])
+            else:
+                results[d] = {"error": f"rc={proc.returncode}: "
+                                       f"{(proc.stderr or '')[-400:]}"}
+        except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
+            results[d] = {"error": str(e)[:200]}
+
+    ok = [d for d in widths if "error" not in results.get(d, {})]
+    t1 = results[1]["s_per_iter"] if 1 in ok else None
+    scaling = {}
+    for d in ok:
+        td = results[d]["s_per_iter"]
+        if t1 is None or not td:
+            continue
+        speedup = t1 / td
+        scaling[str(d)] = {
+            "s_per_iter": td,
+            "speedup_vs_1dev": round(speedup, 4),
+            "efficiency": round(speedup / d if real else speedup, 4),
+        }
+    shas = {d: results[d].get("trees_sha") for d in ok}
+    bit_identical = len(set(shas.values())) == 1 if shas else False
+    ici_gbps = float(os.environ.get("BENCH_MULTICHIP_ICI_GBPS", "45"))
+    wire8 = (results.get(8, {}) or {}).get("psum_wire_bytes_per_iter")
+    out = {
+        "bench": "multichip_scaling",
+        "mode": "real_mesh" if real else "virtual_cpu",
+        "efficiency_definition": ("t1/(D*tD) on a real mesh; t1/tD on a "
+                                  "virtual single-host mesh (constant "
+                                  "total work -> measures distribution "
+                                  "overhead)"),
+        "rows": rows,
+        "max_bin": max_bin,
+        "iters": iters,
+        "widths": widths,
+        "per_width": {str(d): results[d] for d in widths},
+        "scaling": scaling,
+        "trees_bit_identical_across_widths": bit_identical,
+        "ici_bound_gbps": ici_gbps,
+        "psum_wire_s_lower_bound_8dev": (
+            round(wire8 / (ici_gbps * 1e9), 6) if wire8 else None),
+        "compiles_steady_total": sum(
+            int(results[d].get("compiles_steady", 0)) for d in ok),
+    }
+    line = json.dumps(out)
+    out_path = os.environ.get(
+        "BENCH_MULTICHIP_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "MULTICHIP_r06.json"))
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(line)
+
+
 def run_microbench() -> None:
     """Child-process entry: measure THIS session's chip ceiling — HBM copy
     bandwidth (GB/s) and bf16 MXU GEMM throughput (TFLOP/s) — so the bench
@@ -1263,6 +1455,16 @@ def main() -> None:
              str(ITERS_MEASURED)], ATTEMPT_TIMEOUT,
             "stream A/B (out-of-core vs resident)")
 
+    # multi-chip scaling (ISSUE 8): fused data-parallel at 1/2/4/8
+    # devices — real mesh when present, virtual CPU widths elsewhere —
+    # with bit-identity across widths and psum traffic vs the ICI bound
+    multichip = None
+    if os.environ.get("BENCH_MULTICHIP", "1") != "0":
+        multichip = _run_child(
+            ["--multichip-scaling",
+             os.environ.get("BENCH_MULTICHIP_ROWS", str(1 << 16)),
+             "255", "6"], 3600, "multichip scaling (1/2/4/8 devices)")
+
     # chip ceiling AFTER the attempts
     micro_post = (None if os.environ.get("BENCH_MICRO", "1") == "0"
                   else _run_child(["--micro"], 900, "microbench (post)"))
@@ -1382,6 +1584,7 @@ def main() -> None:
             "microbench_post": micro_post,
             "layout_ab": layout_ab,
             "stream_ab": stream_ab,
+            "multichip": multichip,
             "roofline": roofline,
             "full_run": full_run,
             "predict_tensor_ab": predict_ab,
@@ -1401,6 +1604,15 @@ if __name__ == "__main__":
         run_layout_ab(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     elif len(sys.argv) >= 5 and sys.argv[1] == "--stream-ab":
         run_stream_ab(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+    elif sys.argv[1:2] == ["--multichip-scaling"]:
+        run_multichip_scaling(
+            int(sys.argv[2]) if len(sys.argv) > 2
+            else int(os.environ.get("BENCH_MULTICHIP_ROWS", str(1 << 17))),
+            int(sys.argv[3]) if len(sys.argv) > 3 else 255,
+            int(sys.argv[4]) if len(sys.argv) > 4 else 6)
+    elif len(sys.argv) >= 6 and sys.argv[1] == "--multichip-attempt":
+        run_multichip_attempt(int(sys.argv[2]), int(sys.argv[3]),
+                              int(sys.argv[4]), int(sys.argv[5]))
     elif sys.argv[1:2] == ["--micro"]:
         run_microbench()
     elif len(sys.argv) >= 4 and sys.argv[1] == "--predict-ab":

@@ -196,11 +196,20 @@ class PackageIndex:
     def __init__(self) -> None:
         self.str_constants: Dict[str, Dict[str, str]] = {}
         self.axis_names: set = set()
+        # axes declared by the partition-rule registry
+        # (parallel/sharding.py MESH_AXES) — when present in the scanned
+        # set, THIS is the collective-axis universe R6 checks against,
+        # not the union of every string that ever rode a PartitionSpec.
+        # One source of truth: a learner inventing its own axis name is a
+        # finding even if it also declared a matching Mesh.
+        self.registry_axes: set = set()
         self.imports: Dict[str, Dict[str, str]] = {}
 
     def collect(self, ctx: ModuleContext) -> None:
         consts: Dict[str, str] = {}
         imports: Dict[str, str] = {}
+        # the registry module, whatever directory the scan was rooted at
+        is_registry = ctx.relpath.rsplit("/", 1)[-1] == "sharding.py"
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Assign) and isinstance(
                     ctx.parent(node), ast.Module):
@@ -212,6 +221,20 @@ class PackageIndex:
                     consts[name] = node.value.value
                     if name.endswith("_AXIS") or name.endswith("AXIS"):
                         self.axis_names.add(node.value.value)
+                        if is_registry:
+                            self.registry_axes.add(node.value.value)
+                elif (is_registry and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id == "MESH_AXES"
+                        and isinstance(node.value, ast.Tuple)):
+                    # MESH_AXES = (DATA_AXIS, FEATURE_AXIS) — resolve the
+                    # member names against this module's constants
+                    for el in node.value.elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                                el.value, str):
+                            self.registry_axes.add(el.value)
+                        elif isinstance(el, ast.Name) and el.id in consts:
+                            self.registry_axes.add(consts[el.id])
             elif isinstance(node, ast.ImportFrom):
                 for alias in node.names:
                     imports[alias.asname or alias.name] = \

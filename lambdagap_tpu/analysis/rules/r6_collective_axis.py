@@ -9,11 +9,16 @@ constant or hardcode a literal slip through until a TPU run).
 
 The rule resolves each collective's axis argument statically — string
 literal, module-level constant, or a constant imported from another scanned
-module (``from .mesh import DATA_AXIS``) — and checks it against the axis
-universe declared across the scanned files: strings in ``Mesh(devices,
-(axis, ...))`` tuples, ``PartitionSpec``/``P(...)`` arguments, and
-``*_AXIS = "name"`` constants. Unresolvable axis expressions
-(``self.axis``) are skipped — the rule never guesses.
+module (``from .sharding import DATA_AXIS``) — and checks it against the
+axis universe. When the scanned set contains the partition-rule registry
+(``parallel/sharding.py`` declaring ``MESH_AXES``), the registry IS the
+universe — one source of truth, so a learner inventing a private axis name
+is flagged even if it also declared its own Mesh. Without a registry in
+scope (fixture trees, other codebases) the universe falls back to every
+axis declared anywhere: strings in ``Mesh(devices, (axis, ...))`` tuples,
+``PartitionSpec``/``P(...)`` arguments, and ``*_AXIS = "name"`` constants.
+Unresolvable axis expressions (``self.axis``) are skipped — the rule never
+guesses.
 """
 from __future__ import annotations
 
@@ -40,7 +45,8 @@ class CollectiveAxisRule(Rule):
 
     def check(self, ctx: ModuleContext, index: PackageIndex
               ) -> Iterator[Finding]:
-        if not index.axis_names:
+        universe = index.registry_axes or index.axis_names
+        if not universe:
             return
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
@@ -65,12 +71,14 @@ class CollectiveAxisRule(Rule):
             resolved = index.resolve_string(ctx, axis_arg)
             if resolved is None:
                 continue  # dynamic (self.axis etc) — never guess
-            if resolved not in index.axis_names:
-                declared = ", ".join(sorted(repr(a)
-                                            for a in index.axis_names))
+            if resolved not in universe:
+                declared = ", ".join(sorted(repr(a) for a in universe))
+                source = ("the parallel/sharding.py registry"
+                          if index.registry_axes
+                          else "no Mesh/PartitionSpec in the scanned tree")
                 yield ctx.finding(
                     self, node,
                     f"collective {tail}(..., {resolved!r}) names an axis "
-                    f"declared by no Mesh/PartitionSpec in the scanned "
-                    f"tree (declared: {declared}); this fails only at "
+                    f"declared by {source} "
+                    f"(declared: {declared}); this fails only at "
                     f"trace time on a real mesh")

@@ -417,6 +417,7 @@ class Config:
     #            rebuild is not worth it on small data)
     tree_layout: str = "auto"                 # auto / gather / sorted
     tpu_num_devices: int = 0                  # 0 = all visible devices
+    mesh_shape: str = ""                      # device mesh extents "DATAxFEATURE" over parallel/sharding.py axes ("8", "8x1", "1x8"); "" = 1-D on the learner's natural axis with tpu_num_devices devices
     tpu_fused_learner: str = "auto"           # auto / 1 / 0: whole-tree-on-device
     tpu_fast_predict_rows: int = 10000        # route predict batches up to this many rows through the threaded native traverser
     # -- out-of-core streaming training (docs/performance.md) -------------
@@ -600,6 +601,20 @@ class Config:
         for ok, msg in checks:
             if not ok:
                 log.fatal("Config check failed: %s", msg)
+        if self.mesh_shape:
+            # geometry errors (bad syntax, 2-D data x feature execution)
+            # surface at config time, not at first shard_map trace —
+            # including for learners that never build a mesh
+            from .parallel.sharding import parse_mesh_shape
+            try:
+                shape = parse_mesh_shape(self.mesh_shape)
+            except ValueError as e:
+                log.fatal("Config check failed: %s", e)
+            else:
+                if shape and shape[0] > 1 and shape[1] > 1:
+                    log.fatal("Config check failed: mesh_shape %dx%d: 2-D "
+                              "data x feature execution is not implemented "
+                              "yet; set one extent to 1", *shape)
         if self.boosting == "rf":
             if not (self.bagging_freq > 0 and self.bagging_fraction < 1.0):
                 log.fatal("Random forest needs bagging_freq > 0 and bagging_fraction < 1")

@@ -475,16 +475,13 @@ class QuantileSketch:
         if self._pend_n >= self.budget * 4:
             self._merge_pending()
 
-    def _merge_pending(self) -> None:
-        if not self._pend:
-            return
-        pend, pcnt = _distinct_with_counts(
-            np.sort(np.concatenate([np.asarray(v, np.float64).ravel()
-                                    for v in self._pend])))
-        self._pend = []
-        self._pend_n = 0
-        d = np.concatenate([self.distinct, pend])
-        c = np.concatenate([self.counts, pcnt])
+    def _absorb(self, distinct: np.ndarray, counts: np.ndarray) -> None:
+        """Union-merge an aggregated (distinct, counts) pair into this
+        sketch, compacting past the budget — the shared reduction step of
+        the pending-buffer flush, :meth:`merge`, and the cross-process
+        state merge."""
+        d = np.concatenate([self.distinct, distinct])
+        c = np.concatenate([self.counts, counts])
         order = np.argsort(d, kind="mergesort")
         d, c = d[order], c[order]
         du, inverse = np.unique(d, return_inverse=True)
@@ -493,6 +490,61 @@ class QuantileSketch:
         if len(du) > self.budget:
             du, cu = _compress_distinct(du, cu, self.budget)
         self.distinct, self.counts = du, cu
+
+    def _merge_pending(self) -> None:
+        if not self._pend:
+            return
+        pend, pcnt = _distinct_with_counts(
+            np.sort(np.concatenate([np.asarray(v, np.float64).ravel()
+                                    for v in self._pend])))
+        self._pend = []
+        self._pend_n = 0
+        self._absorb(pend, pcnt)
+
+    def merge(self, other: "QuantileSketch") -> "QuantileSketch":
+        """Absorb ``other`` (the psum-style sketch reduction): after the
+        merge this sketch summarizes the union of both input streams.
+
+        Exact when the union's distinct count fits the budget, so merging
+        per-shard sketches equals one sketch over all rows — which is why
+        sharded dataset construction (one sketch set per row shard, merged,
+        boundaries broadcast) bins identically to single-host construction
+        ("XGBoost: Scalable GPU Accelerated Learning", arXiv:1806.11248
+        §5 — only summaries cross the interconnect). Merge order must be
+        deterministic (rank order) so every host derives identical
+        boundaries once compaction kicks in.
+        """
+        other._merge_pending()
+        self._merge_pending()
+        self._absorb(other.distinct, other.counts)
+        self.na_cnt += other.na_cnt
+        self.total += other.total
+        return self
+
+    # -- fixed-size wire form (cross-process allgather) -----------------
+    def state_vector(self) -> np.ndarray:
+        """Serialize to one float64 vector of fixed length
+        ``3 + 2*budget``: [n_entries, na_cnt, total, distinct (padded),
+        counts (padded)]. Counts ride as float64 — exact to 2**53, far
+        beyond any row count a sketch sees."""
+        self._merge_pending()
+        n = len(self.distinct)
+        out = np.zeros(3 + 2 * self.budget, np.float64)
+        out[0], out[1], out[2] = n, self.na_cnt, self.total
+        out[3:3 + n] = self.distinct
+        out[3 + self.budget:3 + self.budget + n] = self.counts
+        return out
+
+    @classmethod
+    def from_state_vector(cls, vec: np.ndarray,
+                          budget: int) -> "QuantileSketch":
+        sk = cls(budget=budget)
+        n = int(vec[0])
+        sk.na_cnt = int(vec[1])
+        sk.total = int(vec[2])
+        sk.distinct = np.asarray(vec[3:3 + n], np.float64)
+        sk.counts = np.asarray(vec[3 + budget:3 + budget + n], np.int64)
+        return sk
 
     def to_mapper(self, max_bin: int, min_data_in_bin: int,
                   bin_type: str = BIN_NUMERICAL, use_missing: bool = True,

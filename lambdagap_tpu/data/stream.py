@@ -252,16 +252,31 @@ class ShardedBinnedDataset(BinnedDataset):
         if reference is not None:
             self._adopt_reference(reference)
         else:
-            sketches = [QuantileSketch(budget=config.stream_sketch_budget)
-                        for _ in range(F)]
+            # sharded construction (ISSUE 8): each sequence is a row-shard
+            # owner that sketches ITS OWN rows; the per-owner sketches are
+            # then reduced psum-style in owner order and the merged
+            # boundaries bin every shard. Single-reader construction is
+            # the 1-owner special case — and below the sketch budget the
+            # merge is exact, so the result is bit-identical to one
+            # sketch over all rows (the pre-merge behavior). This is the
+            # same recipe the multi-host loader uses with a real
+            # allgather (parallel/multiprocess.py load_pre_partitioned).
+            budget = config.stream_sketch_budget
+            merged = None
             for s, ln in zip(seqs, lens):
+                own = [QuantileSketch(budget=budget) for _ in range(F)]
                 bs = max(int(getattr(s, "batch_size", 65536)), 1)
                 for lo in range(0, ln, bs):
                     blk = np.asarray(s[lo:min(lo + bs, ln)], np.float64)
                     for j in range(F):
-                        sketches[j].push(blk[:, j])
+                        own[j].push(blk[:, j])
+                if merged is None:
+                    merged = own
+                else:
+                    for j in range(F):
+                        merged[j].merge(own[j])
             from .dataset import _mappers_from_sketches
-            _mappers_from_sketches(self, sketches, config,
+            _mappers_from_sketches(self, merged, config,
                                    set(categorical_features))
 
         dtype = (np.uint8 if max(self.feature_num_bins, default=2) <= 256

@@ -106,6 +106,19 @@ def capture_state(gbdt, early_stop: Optional[Dict] = None) -> Dict[str, Any]:
     if early_stop:
         st["early_stop"] = early_stop
     learner = getattr(gbdt, "learner", None)
+    mesh = getattr(learner, "mesh", None)
+    if mesh is not None:
+        # distributed runs: record the mesh + row-shard geometry so
+        # resume=auto can restore at a DIFFERENT device count (elastic
+        # resume). Trees are bit-identical across shard counts — the
+        # histogram psum reduces the same integers/floats in a
+        # shard-count-stable order (tools/multichip_gate.py proves it) —
+        # so geometry is advisory: the per-row state is simply re-sharded
+        # over the new mesh at learner construction.
+        from ..parallel.sharding import mesh_geometry
+        st["mesh"] = dict(mesh_geometry(mesh),
+                          n_pad=int(getattr(learner, "n_pad", 0)),
+                          n_loc=int(getattr(learner, "n_loc", 0)))
     if getattr(learner, "residency", "hbm") == "stream":
         # out-of-core geometry rides the sidecar: snapshots land at
         # iteration boundaries, where the stream cursor is always at the
@@ -143,8 +156,28 @@ def restore_state(gbdt, state: Dict[str, Any]) -> None:
         gbdt.drop_rng.set_state(_rng_state_from_json(dart["rng"]))
         gbdt.tree_weight = [float(w) for w in dart["tree_weight"]]
         gbdt.sum_weight = float(dart["sum_weight"])
-    stream = state.get("stream")
     learner = getattr(gbdt, "learner", None)
+    mesh_rec = state.get("mesh")
+    mesh = getattr(learner, "mesh", None)
+    if mesh_rec is not None and mesh is not None:
+        from ..parallel.sharding import mesh_geometry
+        have = mesh_geometry(mesh)
+        if have["axes"] != mesh_rec.get("axes", have["axes"]):
+            log.fatal("snapshot mesh axes %s do not match this build's "
+                      "registry axes %s; refusing to resume",
+                      mesh_rec.get("axes"), have["axes"])
+        if have["n_devices"] != mesh_rec.get("n_devices"):
+            # elastic resume: per-row state (scores, masks, permutations)
+            # was already rebuilt over the CURRENT mesh by learner
+            # construction + resume_from score replay; training continues
+            # bit-identically because the collective reductions are
+            # shard-count-stable
+            log.info("elastic resume: snapshot was written on %s devices, "
+                     "resuming on %s (shape %s -> %s); per-row state "
+                     "re-sharded", mesh_rec.get("n_devices"),
+                     have["n_devices"], mesh_rec.get("shape"),
+                     have["shape"])
+    stream = state.get("stream")
     if stream is not None and getattr(learner, "residency", "hbm") == "stream":
         have = int(getattr(learner.sdata, "shard_rows", 0))
         want = int(stream.get("shard_rows", have))

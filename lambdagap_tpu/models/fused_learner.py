@@ -747,9 +747,20 @@ class FusedTreeLearner(SerialTreeLearner):
             rand_t = None
             if extra_on:
                 # replicated draw over the GLOBAL feature axis, sliced
-                # locally — identical to the serial learner's stream
-                rand_t = sl(jax.random.randint(rkey, (F,), 0, 1 << 30)
-                            % nb_m1)
+                # locally. Drawn at the REAL feature count, then padded:
+                # F here is the shard-padded program width, and a
+                # (padded,)-shaped draw is a DIFFERENT prng stream than
+                # the serial learner's (real,)-shaped one — the splits
+                # would be legitimate but never comparable to serial
+                # (pre-existing divergence unmasked by the ISSUE-8 combo
+                # test rework). Pad columns get threshold 0: their fmask
+                # is False and nb_minus1 is 1, so they can never win.
+                rF = getattr(self, "_real_F", F)
+                draw = jax.random.randint(rkey, (rF,), 0, 1 << 30)
+                if rF != F:
+                    draw = jnp.concatenate(
+                        [draw, jnp.zeros(F - rF, draw.dtype)])
+                rand_t = sl(draw % nb_m1)
             gain, thr, dl, lg, lh, lc, bits = per_feature_best(
                 hist, pg, ph, pc, pout, sl(num_bins), sl(default_bins),
                 sl(missing_types), sl(is_cat_arr), sl(fm), p, has_cat,

@@ -272,8 +272,13 @@ class SerialTreeLearner:
             return "hbm"
         if not self.supports_stream:
             if mode == "stream" or sharded:
-                log.info("data_residency=stream is not supported by %s; "
-                         "training device-resident", type(self).__name__)
+                # LOUD fallback (warning, not info): silently training a
+                # requested-stream distributed run device-resident would
+                # hide an OOM footprint the caller sized for streaming
+                log.warning("data_residency=stream is not supported by %s "
+                            "(distributed learners keep their device "
+                            "matrices resident); falling back to "
+                            "data_residency=hbm", type(self).__name__)
             return "hbm"
         blockers = self._stream_blockers(config)
         if blockers:

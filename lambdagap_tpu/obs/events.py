@@ -61,6 +61,11 @@ def run_header(params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
             "device_count": jax.device_count(),
             "devices": [str(d) for d in jax.devices()],
         }
+        # the registry mesh axes (parallel/sharding.py): run logs of
+        # distributed trainings are diffable on mesh geometry — the
+        # actual placement rides params (tpu_num_devices/mesh_shape)
+        from ..parallel.sharding import MESH_AXES
+        header["device"]["mesh_axes"] = list(MESH_AXES)
         header["versions"]["jax"] = jax.__version__
     except Exception:  # pragma: no cover - jax import is repo-wide
         header["device"] = {}

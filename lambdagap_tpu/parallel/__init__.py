@@ -1,9 +1,11 @@
 from .data_parallel import DataParallelTreeLearner
 from .feature_parallel import FeatureParallelTreeLearner
 from .fused_parallel import FusedDataParallelTreeLearner
-from .mesh import DATA_AXIS, make_mesh
+from .mesh import make_mesh
+from .sharding import DATA_AXIS, FEATURE_AXIS, MESH_AXES, RULES, spec, specs
 from .voting_parallel import VotingParallelTreeLearner
 
 __all__ = ["DataParallelTreeLearner", "FeatureParallelTreeLearner",
            "FusedDataParallelTreeLearner", "VotingParallelTreeLearner",
-           "make_mesh", "DATA_AXIS"]
+           "make_mesh", "DATA_AXIS", "FEATURE_AXIS", "MESH_AXES", "RULES",
+           "spec", "specs"]

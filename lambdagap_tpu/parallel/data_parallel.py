@@ -28,16 +28,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-try:
-    from jax import shard_map
-except ImportError:  # jax < 0.6: experimental namespace, check_rep kwarg
-    from jax.experimental.shard_map import shard_map as _shard_map_old
-
-    def shard_map(*args, **kwargs):
-        if "check_vma" in kwargs:
-            kwargs["check_rep"] = kwargs.pop("check_vma")
-        return _shard_map_old(*args, **kwargs)
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from ..config import Config
 from ..data.dataset import BinnedDataset
@@ -47,7 +38,8 @@ from ..ops.histogram import histogram_from_rows
 from ..ops.partition import decision_go_left
 from ..ops.split import find_best_split
 from ..utils import log
-from .mesh import DATA_AXIS, make_mesh
+from .mesh import shard_rows
+from .sharding import DATA_AXIS, make_mesh, shard_map, spec, specs
 
 
 class DataParallelTreeLearner(SerialTreeLearner):
@@ -74,8 +66,13 @@ class DataParallelTreeLearner(SerialTreeLearner):
         if self.cegb_on or config.feature_fraction_bynode < 1.0:
             log.warning("cegb/feature_fraction_bynode are not applied by "
                         "tree_learner=%s", config.tree_learner)
-        self.mesh = mesh if mesh is not None else make_mesh(config.tpu_num_devices)
-        self.n_dev = int(self.mesh.devices.size)
+        self.mesh = mesh if mesh is not None else make_mesh(
+            config.tpu_num_devices, mesh_shape=config.mesh_shape)
+        if int(self.mesh.shape.get("feature", 1)) > 1:
+            log.fatal("tree_learner=%s shards rows; mesh_shape=%s places "
+                      "devices on the feature axis", config.tree_learner,
+                      config.mesh_shape)
+        self.n_dev = int(self.mesh.shape[DATA_AXIS])
 
         N = self.num_data
         pad = (-N) % self.n_dev
@@ -86,33 +83,29 @@ class DataParallelTreeLearner(SerialTreeLearner):
         if pad:
             xb = np.pad(xb, ((0, pad), (0, 0)))
         self.x_sharded = jax.device_put(
-            jnp.asarray(xb), NamedSharding(self.mesh, P(DATA_AXIS, None)))
+            jnp.asarray(xb), NamedSharding(self.mesh, spec("x_rows")))
         # local permutation per shard (local indices)
         self.perm0_local = jax.device_put(
             jnp.tile(jnp.arange(self.n_loc, dtype=jnp.int32), self.n_dev),
-            NamedSharding(self.mesh, P(DATA_AXIS)))
-        # padding-row mask (True = real row)
-        real = np.ones(self.n_pad, dtype=bool)
-        real[N:] = False
-        self.real_mask = jax.device_put(
-            jnp.asarray(real), NamedSharding(self.mesh, P(DATA_AXIS)))
+            NamedSharding(self.mesh, spec("perm")))
+        # padding-row mask (True = real row): the explicit mask channel of
+        # shard_rows — the ONE place pad rows are decided (ISSUE-8
+        # satellite; histogram/count kernels consume this mask, so pad
+        # rows contribute exact zeros by construction)
+        _, self.real_mask, _ = shard_rows(self.mesh,
+                                          jnp.ones(N, dtype=bool))
 
         self._build_ops()
 
     # -- sharding helpers ----------------------------------------------
     def shard_grad(self, grad: jax.Array) -> jax.Array:
-        pad = self.n_pad - self.num_data
-        if pad:
-            grad = jnp.pad(grad, (0, pad))
-        return jax.device_put(grad, NamedSharding(self.mesh, P(DATA_AXIS)))
+        return shard_rows(self.mesh, grad)[0]
 
     def combine_mask(self, row_mask: Optional[jax.Array]) -> jax.Array:
         if row_mask is None:
             return self.real_mask
-        pad = self.n_pad - self.num_data
-        m = jnp.pad(row_mask, (0, pad)) if pad else row_mask
-        m = jax.device_put(m, NamedSharding(self.mesh, P(DATA_AXIS)))
-        return m & self.real_mask
+        # in-bag mask and pad-row mask combine inside shard_rows
+        return shard_rows(self.mesh, row_mask, mask=row_mask)[1]
 
     # -- shard_map ops --------------------------------------------------
     def _build_ops(self) -> None:
@@ -123,9 +116,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
         @functools.partial(
             shard_map, mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                      P(DATA_AXIS)),
-            out_specs=P())
+            in_specs=specs("x_rows", "grad", "hess", "row_mask"),
+            out_specs=spec("hist"), check_vma=False)
         def root_hist(x_l, g_l, h_l, m_l):
             local = histogram_from_rows(x_l, g_l, h_l, m_l, B, rpb,
                                         precision=prec)
@@ -167,8 +159,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
         @functools.partial(
             shard_map, mesh=mesh,
-            in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(), P(), P()),
-            out_specs=P(DATA_AXIS))
+            in_specs=specs("score", "perm", "leaf_begin", "leaf_count",
+                           "leaf_values"),
+            out_specs=spec("score"), check_vma=False)
         def score_update(score_l, perm_l, leaf_begin, leaf_count, leaf_values):
             # per-shard leaf layout: [D, L] arrays indexed by my axis position
             d = jax.lax.axis_index(DATA_AXIS)
@@ -192,9 +185,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
             fn = functools.partial(self._leaf_hist_fn, padded=padded)
             self._leaf_hist_ops[padded] = jax.jit(shard_map(
                 fn, mesh=self.mesh,
-                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                          P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-                out_specs=P()))
+                in_specs=specs("x_rows", "perm", "grad", "hess", "row_mask",
+                               "begin", "count"),
+                out_specs=spec("hist"), check_vma=False))
         return self._leaf_hist_ops[padded]
 
     def _root_totals(self, hist_root):
@@ -206,9 +199,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
             fn = functools.partial(self._partition_fn, padded=padded)
             self._partition_ops[padded] = jax.jit(shard_map(
                 fn, mesh=self.mesh,
-                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                          P(DATA_AXIS), P(), P(), P(), P(), P(), P(), P(), P()),
-                out_specs=(P(DATA_AXIS), P(DATA_AXIS))))
+                in_specs=specs("x_rows", "perm", "begin", "count")
+                + specs(*["scalar"] * 8),
+                out_specs=specs("perm", "count"), check_vma=False))
         return self._partition_ops[padded]
 
     # ------------------------------------------------------------------
@@ -259,7 +252,8 @@ class DataParallelTreeLearner(SerialTreeLearner):
 
         def shard_scalars(vals: np.ndarray) -> jax.Array:
             return jax.device_put(jnp.asarray(vals.astype(np.int32)),
-                                  NamedSharding(self.mesh, P(DATA_AXIS)))
+                                  NamedSharding(self.mesh,
+                                                spec("shard_scalar")))
 
         for _ in range(num_leaves - 1):
             cand = [(s.gain_f, leaf) for leaf, s in best.items()
@@ -348,7 +342,7 @@ class DataParallelTreeLearner(SerialTreeLearner):
         unpadded out); the scatter itself runs sharded."""
         pad = self.n_pad - self.num_data
         s = jnp.pad(score, (0, pad)) if pad else score
-        s = jax.device_put(s, NamedSharding(self.mesh, P(DATA_AXIS)))
+        s = jax.device_put(s, NamedSharding(self.mesh, spec("score")))
         out = self._score_update_op(
             s, self.last_perm,
             jnp.asarray(self.last_leaf_begin.astype(np.int32)),

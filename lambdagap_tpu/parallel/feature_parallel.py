@@ -10,6 +10,10 @@ TPU shape: data stays replicated, the histogram op runs under ``shard_map``
 with each device slicing its static feature block and an ``all_gather``
 reassembling the full histogram; the reference's Allgather-of-SplitInfo is
 subsumed by running the argmax on the (replicated) gathered histogram.
+
+Devices sit on the ``feature`` axis of the registry mesh (a ``(1, D)``
+placement of :func:`lambdagap_tpu.parallel.sharding.make_mesh`) — column
+ownership is the partition spec, not a hand-rolled block table.
 """
 from __future__ import annotations
 
@@ -19,22 +23,14 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-try:
-    from jax import shard_map
-except ImportError:  # jax < 0.6: experimental namespace, check_rep kwarg
-    from jax.experimental.shard_map import shard_map as _shard_map_old
-
-    def shard_map(*args, **kwargs):
-        if "check_vma" in kwargs:
-            kwargs["check_rep"] = kwargs.pop("check_vma")
-        return _shard_map_old(*args, **kwargs)
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..config import Config
 from ..data.dataset import BinnedDataset
 from ..models.learner import SerialTreeLearner
 from ..ops.histogram import histogram_from_rows
-from .mesh import DATA_AXIS, make_mesh
+from ..utils import log
+from .sharding import FEATURE_AXIS, make_mesh, shard_map, spec, specs
 
 
 class FeatureParallelTreeLearner(SerialTreeLearner):
@@ -48,8 +44,13 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
     def __init__(self, dataset: BinnedDataset, config: Config,
                  mesh: Optional[Mesh] = None) -> None:
         super().__init__(dataset, config)
-        self.mesh = mesh if mesh is not None else make_mesh(config.tpu_num_devices)
-        self.n_dev = int(self.mesh.devices.size)
+        self.mesh = mesh if mesh is not None else make_mesh(
+            config.tpu_num_devices, mesh_shape=config.mesh_shape,
+            shard_axis=FEATURE_AXIS)
+        if int(self.mesh.shape.get("data", 1)) > 1:
+            log.fatal("tree_learner=feature shards columns; mesh_shape=%s "
+                      "places devices on the data axis", config.mesh_shape)
+        self.n_dev = int(self.mesh.shape[FEATURE_AXIS])
         F = self.num_features
         self.f_pad = ((F + self.n_dev - 1) // self.n_dev) * self.n_dev
         self.f_loc = self.f_pad // self.n_dev
@@ -72,7 +73,7 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
         assert f_loc * self.n_dev == self.f_pad
 
         def hist_blocked(x, perm, g, h, begin, count, row_mask):
-            d = jax.lax.axis_index(DATA_AXIS)
+            d = jax.lax.axis_index(FEATURE_AXIS)
             lane = jnp.arange(padded, dtype=jnp.int32)
             idx = jnp.clip(begin + lane, 0, perm.shape[0] - 1)
             rows = perm[idx]
@@ -81,13 +82,19 @@ class FeatureParallelTreeLearner(SerialTreeLearner):
                 x[rows], (0, d * f_loc), (padded, f_loc))
             local = histogram_from_rows(block, g[rows], h[rows], valid, B, rpb,
                                         precision=prec)
-            full = jax.lax.all_gather(local, DATA_AXIS, tiled=True)
+            full = jax.lax.all_gather(local, FEATURE_AXIS, tiled=True)
             return full[:F]
 
         op = jax.jit(shard_map(
             hist_blocked, mesh=self.mesh,
-            in_specs=(P(), P(), P(), P(), P(), P(), P()),
-            out_specs=P(), check_vma=False))
+            # rows replicated: the per-row specs shard over the data axis,
+            # whose extent is 1 on the (1, D) feature placement; begin /
+            # count are replicated scalars here (not the per-shard vectors
+            # of the data-parallel loop)
+            in_specs=(spec("x_replicated"), spec("perm"), spec("grad"),
+                      spec("hess"), spec("scalar"), spec("scalar"),
+                      spec("row_mask")),
+            out_specs=spec("hist"), check_vma=False))
         self._hist_cache[padded] = op
         return op
 

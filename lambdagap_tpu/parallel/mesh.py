@@ -7,40 +7,52 @@ recursive-halving reduce-scatter, network.h:89-275 collectives): here the
 XLA op (``psum``/``all_gather``/``psum_scatter``) emitted inside
 ``shard_map``; schedules (ring vs tree vs Bruck) are XLA's problem, not ours
 (SURVEY.md §2.6).
+
+Axis names and per-array ``PartitionSpec`` come from the rule registry in
+:mod:`lambdagap_tpu.parallel.sharding` — this module only keeps the
+placement helpers (and re-exports the axis constant for back-compat).
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
-DATA_AXIS = "data"
-
-
-def make_mesh(num_devices: int = 0, devices: Optional[Sequence] = None) -> Mesh:
-    """1-D data mesh. ``num_devices=0`` uses all visible devices."""
-    if devices is None:
-        devices = jax.devices()
-    if num_devices and num_devices > 0:
-        devices = devices[:num_devices]
-    return Mesh(np.asarray(devices), (DATA_AXIS,))
+from .sharding import (DATA_AXIS, FEATURE_AXIS, MESH_AXES,  # noqa: F401
+                       make_mesh, mesh_geometry, spec)
 
 
-def shard_rows(mesh: Mesh, array, pad_value=0):
-    """Pad the leading dim to a device multiple and shard it over the mesh."""
+def shard_rows(mesh: Mesh, array, pad_value=0, mask=None
+               ) -> Tuple[jax.Array, jax.Array, int]:
+    """Pad the leading dim to a device multiple and shard it over the
+    ``data`` mesh axis (registry rule: per-row state).
+
+    Returns ``(sharded, mask_sharded, pad)``. ``mask_sharded`` is the
+    explicit in-bag/validity mask the histogram and count kernels must
+    consume: the caller's ``mask`` (all-True when None) padded with False
+    rows — so pad rows contribute exact zeros to histograms and root
+    counts by construction instead of each caller re-deriving a "real
+    rows" mask ad hoc (tests/test_distributed.py pad-row test).
+    """
     import jax.numpy as jnp
-    n_dev = mesh.devices.size
+    n_dev = int(mesh.devices.size)
     n = array.shape[0]
     pad = (-n) % n_dev
+    if mask is None:
+        mask = jnp.ones(n, dtype=bool)
+    elif mask.shape[0] != n:
+        raise ValueError(f"mask length {mask.shape[0]} != rows {n}")
     if pad:
         pad_widths = [(0, pad)] + [(0, 0)] * (array.ndim - 1)
         array = jnp.pad(array, pad_widths, constant_values=pad_value)
-    spec = P(DATA_AXIS, *([None] * (array.ndim - 1)))
-    return jax.device_put(array, NamedSharding(mesh, spec)), pad
+        mask = jnp.pad(mask, (0, pad), constant_values=False)
+    sharded = jax.device_put(
+        array, NamedSharding(mesh, spec("row_mask", ndim=array.ndim)))
+    mask_sharded = jax.device_put(
+        mask, NamedSharding(mesh, spec("row_mask")))
+    return sharded, mask_sharded, pad
 
 
 def replicated(mesh: Mesh, array):
-    import jax
-    return jax.device_put(array, NamedSharding(mesh, P()))
+    return jax.device_put(array, NamedSharding(mesh, spec("rep")))

@@ -19,16 +19,6 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-try:
-    from jax import shard_map
-except ImportError:  # jax < 0.6: experimental namespace, check_rep kwarg
-    from jax.experimental.shard_map import shard_map as _shard_map_old
-
-    def shard_map(*args, **kwargs):
-        if "check_vma" in kwargs:
-            kwargs["check_rep"] = kwargs.pop("check_vma")
-        return _shard_map_old(*args, **kwargs)
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..config import Config
 from ..data.dataset import BinnedDataset
@@ -36,7 +26,7 @@ from ..models.learner import _HostSplit
 from ..ops.histogram import histogram_from_rows
 from ..ops.split import SplitParams, find_best_split, per_feature_best
 from .data_parallel import DataParallelTreeLearner
-from .mesh import DATA_AXIS
+from .sharding import DATA_AXIS, shard_map, spec, specs
 
 
 class VotingParallelTreeLearner(DataParallelTreeLearner):
@@ -56,9 +46,8 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
         # local histograms, stacked sharded over devices: [D*F, B, 3]
         @functools.partial(
             shard_map, mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                      P(DATA_AXIS)),
-            out_specs=P(DATA_AXIS))
+            in_specs=specs("x_rows", "grad", "hess", "row_mask"),
+            out_specs=spec("hist_local"), check_vma=False)
         def root_hist_local(x_l, g_l, h_l, m_l):
             return histogram_from_rows(x_l, g_l, h_l, m_l, B, rpb,
                                         precision=prec)
@@ -83,22 +72,23 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
 
         @functools.partial(
             shard_map, mesh=mesh,
-            in_specs=(P(DATA_AXIS),),
-            out_specs=P())
+            in_specs=(spec("hist_local"),),
+            out_specs=spec("hist"), check_vma=False)
         def root_totals(hist_l):
             return jax.lax.psum(jnp.sum(hist_l[0], axis=0), DATA_AXIS)
 
         self._root_totals_op = jax.jit(root_totals)
 
         extra_on = self.extra_on
-        in_specs = (P(DATA_AXIS), P(), P(), P(), P(), P())
+        in_specs = (spec("hist_local"),) + specs(*["scalar"] * 4) \
+            + (spec("fmask"),)
         if extra_on:
-            in_specs = in_specs + (P(),)
+            in_specs = in_specs + (spec("scalar"),)
 
         @functools.partial(
             shard_map, mesh=mesh,
             in_specs=in_specs,
-            out_specs=P(),
+            out_specs=spec("rep"),
             check_vma=False)   # psum/all_gather make outputs replicated
         def voting_best(hist_l, pg, ph, pc, pout, fmask, *ext):
             """Local top-k vote -> psum of voted columns -> global best."""
@@ -138,10 +128,9 @@ class VotingParallelTreeLearner(DataParallelTreeLearner):
             fn = functools.partial(self._leaf_hist_fn, padded=padded)
             self._leaf_hist_ops[padded] = jax.jit(shard_map(
                 fn, mesh=self.mesh,
-                in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                          P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-                          P(DATA_AXIS)),
-                out_specs=P(DATA_AXIS)))
+                in_specs=specs("x_rows", "perm", "grad", "hess", "row_mask",
+                               "begin", "count"),
+                out_specs=spec("hist_local"), check_vma=False))
         return self._leaf_hist_ops[padded]
 
     def _best(self, hist, pg, ph, pc, parent_output, fmask) -> _HostSplit:

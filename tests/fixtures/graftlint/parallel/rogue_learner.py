@@ -1,0 +1,29 @@
+"""R6 fixture: a learner inventing a private mesh axis.
+
+Before the registry, declaring your own ``Mesh`` legitimized any axis name
+— exactly how ad-hoc per-learner specs drifted. With the registry in the
+scanned set (``parallel/sharding.py`` MESH_AXES), a collective over an axis
+the registry does not declare is a finding even though this module's own
+``Mesh`` mentions it.
+"""
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from .sharding import DATA_AXIS
+
+
+def make_rogue_mesh(devs):
+    return Mesh(np.asarray(devs), ("rows",))    # not a registry axis
+
+
+def good_registry_axis(local):
+    return lax.psum(local, DATA_AXIS)
+
+
+def bad_private_axis(local):
+    return lax.psum(local, "rows")  # BAD:R6
+
+
+def dynamic_axis_skipped(local, axis):
+    return lax.psum(local, axis)

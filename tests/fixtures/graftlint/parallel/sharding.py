@@ -1,0 +1,7 @@
+"""Fixture partition-rule registry (mirrors lambdagap_tpu/parallel/sharding.py):
+when a ``parallel/sharding.py`` declaring MESH_AXES is in the scanned set,
+R6 checks collectives against THESE axes only."""
+
+DATA_AXIS = "data"
+FEATURE_AXIS = "feature"
+MESH_AXES = (DATA_AXIS, FEATURE_AXIS)

@@ -133,3 +133,99 @@ def test_native_binner_matches_python():
     # f64 input path too
     ds64 = BinnedDataset.from_matrix(X.astype(np.float64), cfg, label=y)
     assert np.array_equal(ds64.binned, ds_py.binned)
+
+
+def test_sketch_merge_exact_equals_single_stream():
+    """ISSUE-8 sharded construction: merging per-shard QuantileSketches
+    (psum-style reduction) must equal one sketch over all rows — exactly,
+    below the budget — so sharded binning derives the same boundaries as
+    single-host binning."""
+    from lambdagap_tpu.data.binning import QuantileSketch
+    rng = np.random.RandomState(3)
+    vals = np.concatenate([rng.randn(4000),
+                           np.zeros(500),
+                           np.full(100, np.nan),
+                           np.round(rng.randn(1000) * 2) / 2])
+    rng.shuffle(vals)
+    whole = QuantileSketch(budget=4096)
+    whole.push(vals)
+    parts = [QuantileSketch(budget=4096) for _ in range(4)]
+    for i, chunk in enumerate(np.array_split(vals, 4)):
+        parts[i].push(chunk)
+    merged = parts[0]
+    for p in parts[1:]:
+        merged.merge(p)
+    whole._merge_pending()
+    assert merged.total == whole.total
+    assert merged.na_cnt == whole.na_cnt
+    np.testing.assert_array_equal(merged.distinct, whole.distinct)
+    np.testing.assert_array_equal(merged.counts, whole.counts)
+    # and the finalized mappers agree bit-for-bit
+    ma = merged.to_mapper(max_bin=63, min_data_in_bin=3)
+    mb = whole.to_mapper(max_bin=63, min_data_in_bin=3)
+    assert ma.bin_upper_bound == mb.bin_upper_bound
+    assert ma.missing_type == mb.missing_type
+    assert ma.num_bin == mb.num_bin
+
+
+def test_sketch_state_vector_roundtrip():
+    """The fixed-size wire form (the multi-host allgather payload) must
+    round-trip losslessly — merge over deserialized states equals merge
+    over the live sketches."""
+    from lambdagap_tpu.data.binning import QuantileSketch
+    rng = np.random.RandomState(4)
+    budget = 512
+    a, b = QuantileSketch(budget=budget), QuantileSketch(budget=budget)
+    a.push(np.where(rng.rand(3000) < 0.2, np.nan, rng.randn(3000)))
+    b.push(rng.randn(2000) * 3)
+    va, vb = a.state_vector(), b.state_vector()
+    assert va.shape == (3 + 2 * budget,) and vb.shape == va.shape
+    ra = QuantileSketch.from_state_vector(va, budget)
+    rb = QuantileSketch.from_state_vector(vb, budget)
+    assert (ra.total, ra.na_cnt) == (a.total, a.na_cnt)
+    np.testing.assert_array_equal(ra.distinct, a.distinct)
+    np.testing.assert_array_equal(ra.counts, a.counts)
+    live = a.merge(b)
+    wire = ra.merge(rb)
+    np.testing.assert_array_equal(wire.distinct, live.distinct)
+    np.testing.assert_array_equal(wire.counts, live.counts)
+    assert (wire.total, wire.na_cnt) == (live.total, live.na_cnt)
+
+
+def test_sharded_construction_matches_single_host_binning():
+    """End to end: per-shard sequence construction (sketches merged,
+    boundaries broadcast, shards binned locally) produces the identical
+    packed matrix as single-reader construction — the 1-device special
+    case contract of ISSUE 8's sharded dataset construction."""
+    from lambdagap_tpu.data.stream import ShardedBinnedDataset
+    rng = np.random.RandomState(5)
+    n = 6000
+    X = np.column_stack([rng.randn(n),
+                         np.where(rng.rand(n) < 0.5, 0.0, rng.randn(n)),
+                         rng.randint(0, 7, n).astype(float)])
+    y = rng.rand(n)
+    from lambdagap_tpu.config import Config as _Config
+    cfg = _Config.from_params({"max_bin": 63, "verbose": -1})
+
+    class _View:
+        batch_size = 1024
+
+        def __init__(self, lo, hi):
+            self.lo, self.hi = lo, hi
+
+        def __len__(self):
+            return self.hi - self.lo
+
+        def __getitem__(self, sl):
+            return X[self.lo + sl.start:self.lo + sl.stop]
+
+    single = ShardedBinnedDataset.from_sequences(
+        [_View(0, n)], cfg, shard_rows=2048, label=y)
+    bounds = [0, 1700, 3400, 5100, n]      # 4 uneven shard owners
+    sharded = ShardedBinnedDataset.from_sequences(
+        [_View(a, b) for a, b in zip(bounds, bounds[1:])], cfg,
+        shard_rows=2048, label=y)
+    for ma, mb in zip(single.mappers, sharded.mappers):
+        assert ma.bin_upper_bound == mb.bin_upper_bound
+        assert ma.num_bin == mb.num_bin
+    assert np.array_equal(single.binned, sharded.binned)

@@ -12,6 +12,8 @@ import os
 import numpy as np
 import pytest
 
+from conftest import skip_unless_multiprocess
+
 import lambdagap_tpu as lgb
 
 
@@ -225,6 +227,7 @@ def test_pre_partitioned_random_config(seed, tmp_path):
     both ranks must build byte-identical models under random bagging/GOSS/
     quantized/num_leaves draws (any rank-divergent reduction shows up as a
     model mismatch)."""
+    skip_unless_multiprocess()
     import socket
     import subprocess
     import sys as _sys

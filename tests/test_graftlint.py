@@ -62,6 +62,7 @@ def fixture_findings():
     "r4_dtype_drift.py",
     "serve/r5_locks.py",
     "r6_collective_axis.py",
+    "parallel/rogue_learner.py",
     "obs/r7_unsynced_timing.py",
     "serve/r8_futures.py",
     "data/stream.py",
@@ -84,6 +85,55 @@ def test_every_rule_has_fixture_coverage(fixture_findings):
     covered = {rule for pairs in fixture_findings.values()
                for rule, _ in pairs}
     assert covered == {r.id for r in all_rules()}
+
+
+def test_r6_registry_axes_collected():
+    """PackageIndex reads the axis universe out of parallel/sharding.py
+    (MESH_AXES + *_AXIS constants) — the single source of truth ISSUE 8
+    makes graftlint enforce."""
+    from lambdagap_tpu.analysis.core import ModuleContext, PackageIndex
+    src_path = os.path.join(PKG, "parallel", "sharding.py")
+    with open(src_path) as f:
+        src = f.read()
+    index = PackageIndex()
+    index.collect(ModuleContext(src_path, "parallel/sharding.py", src))
+    assert index.registry_axes == {"data", "feature"}
+
+
+def test_r6_registry_overrides_private_mesh_declarations(tmp_path):
+    """With a registry in scope, a module's own Mesh(("rows",)) no longer
+    legitimizes psum(..., "rows") — the exact ad-hoc drift the unified
+    rules exist to kill. Without the registry the same file scans clean
+    (fallback to declared-anywhere)."""
+    rogue = os.path.join(FIXTURES, "parallel", "rogue_learner.py")
+    # standalone (no registry in the scanned set): own Mesh declares "rows"
+    import shutil
+    shutil.copy(rogue, tmp_path / "rogue_learner.py")
+    alone = scan([str(tmp_path / "rogue_learner.py")], select=["R6"])
+    assert alone == [], [f.format() for f in alone]
+    # with the registry: flagged
+    together = scan([os.path.join(FIXTURES, "parallel")], select=["R6"])
+    assert {(f.rule, os.path.basename(f.path)) for f in together} == {
+        ("R6", "rogue_learner.py")}
+
+
+def test_r6_clean_scan_over_refactored_parallel_package():
+    """The real parallel/ package sources every PartitionSpec from the
+    registry; an R6 scan of it (registry included) must be clean."""
+    findings = scan([os.path.join(PKG, "parallel")], select=["R6"])
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_no_learner_local_partitionspec_literals():
+    """ISSUE-8 acceptance: no learner-local PartitionSpec/P(...) literals
+    remain in the four parallel learner modules — every spec resolves
+    through parallel/sharding.py."""
+    for mod in ("data_parallel", "fused_parallel", "voting_parallel",
+                "feature_parallel"):
+        with open(os.path.join(PKG, "parallel", f"{mod}.py")) as f:
+            src = f.read()
+        assert "PartitionSpec" not in src, mod
+        assert not re.search(r"(?<![\w.])P\(", src), mod
 
 
 def test_select_and_disable_filters():

@@ -304,6 +304,62 @@ def test_sigkill_resume_sorted_layout_identical_model(tmp_path):
         "sorted-layout resumed model must be byte-identical"
 
 
+def test_sigkill_elastic_resume_different_device_count(tmp_path):
+    """ISSUE-8 acceptance: SIGKILL a 4-device fused data-parallel CLI
+    train mid-run, resume with ``resume=auto`` on a 2-device mesh, and
+    require trees byte-identical to an uninterrupted run.
+
+    The snapshot sidecar records the mesh + row-shard geometry
+    (guard/snapshot.py capture_state); resume at a different width simply
+    re-shards the per-row state over the new mesh — legal because fused
+    data-parallel training is bit-identical across device counts on the
+    quantized path (integer gradient levels sum exactly, so the histogram
+    psum is width-invariant by construction; tools/multichip_gate.py
+    gates it — the f32 path is only reduction-order-equal, where
+    near-tied gains may legitimately resolve differently per width)."""
+    X, y = _data(500, seed=11)
+    np.savetxt(str(tmp_path / "train.csv"),
+               np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+    base = ["task=train", "data=train.csv", "label_column=0",
+            "objective=regression", "boost_from_average=false",
+            "num_iterations=6", "snapshot_freq=1", "min_data_in_leaf=5",
+            "verbose=1", "resume=auto", "tree_learner=data",
+            "tpu_fused_learner=1", "use_quantized_grad=true",
+            "stochastic_rounding=false"]
+    r = _cli(base + ["tpu_num_devices=4", "output_model=m_crash.txt"],
+             tmp_path, faults="crash_at_iter=3")
+    assert r.returncode == -9, f"expected SIGKILL, got {r.returncode}: " \
+        f"{r.stdout}\n{r.stderr}"
+    snaps = sorted(tmp_path.glob("m_crash.txt.snapshot_iter_*"))
+    assert snaps, "crash must leave snapshots behind"
+    # the sidecar carries the 4-device mesh + shard geometry
+    from lambdagap_tpu.guard.snapshot import read_snapshot
+    _, state = read_snapshot(str(snaps[-1]))
+    assert state["mesh"]["n_devices"] == 4
+    assert state["mesh"]["axes"] == ["data", "feature"]
+    assert state["mesh"]["shape"] == [4, 1]
+    assert state["mesh"]["n_loc"] * 4 == state["mesh"]["n_pad"]
+
+    # resume at HALF the width
+    r = _cli(base + ["tpu_num_devices=2", "output_model=m_crash.txt"],
+             tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Resumed from snapshot" in r.stdout + r.stderr
+    assert "elastic resume" in r.stdout + r.stderr
+
+    # uninterrupted reference (4-way; widths are bit-identical)
+    r = _cli(base + ["tpu_num_devices=4", "output_model=m_ref.txt"],
+             tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    resumed = (tmp_path / "m_crash.txt").read_text()
+    ref = (tmp_path / "m_ref.txt").read_text()
+    split = "end of trees"
+    assert resumed.split(split)[0] == ref.split(split)[0], \
+        "elastic-resumed trees must be byte-identical to the " \
+        "uninterrupted run"
+
+
 def test_cli_resume_skips_torn_final_snapshot(tmp_path):
     """A snapshot torn by the crash is rejected by its checksum and the
     previous good snapshot is used."""

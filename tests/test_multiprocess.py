@@ -15,6 +15,8 @@ import sys
 import numpy as np
 import pytest
 
+from conftest import skip_unless_multiprocess
+
 _CHILD = r"""
 import os, sys
 import numpy as np
@@ -32,7 +34,7 @@ assert len(jax.devices()) == 4          # 2 processes x 2 local cpu devices
 from lambdagap_tpu.parallel.multiprocess import global_array_from_local
 
 import jax.numpy as jnp
-from jax import shard_map
+from lambdagap_tpu.parallel.sharding import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 from lambdagap_tpu.ops.histogram import histogram_from_rows
 
@@ -70,6 +72,7 @@ print(f"RANK{rank}_OK")
 
 
 def test_two_process_histogram_psum(tmp_path):
+    skip_unless_multiprocess()
     import socket
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -151,6 +154,7 @@ def test_two_process_pre_partitioned_training(tmp_path, quant):
     The quantized variant checks the global-scale agreement: int8
     gradient histograms psum only when every rank quantizes with the
     same (globally-maxed) scales."""
+    skip_unless_multiprocess()
     import socket
     rng = np.random.RandomState(3)
     X = rng.randn(1600, 6)
@@ -220,6 +224,7 @@ def test_cli_pre_partitioned_training(tmp_path):
     joins BEFORE the package import touches the backend (__main__ early
     init), mappers sync, both ranks save identical models (reference: the
     distributed CLI mockup, tests/distributed/_test_distributed.py)."""
+    skip_unless_multiprocess()
     import socket
     rng = np.random.RandomState(4)
     X = rng.randn(1200, 5)
@@ -268,6 +273,7 @@ def test_train_cluster_single_call():
     _train — machine list, ports, per-worker training driven
     automatically): one library call partitions the matrix, launches the
     workers, and returns the (rank-identical) model."""
+    skip_unless_multiprocess()
     import lambdagap_tpu as lgb
     from sklearn.metrics import roc_auc_score
     rng = np.random.RandomState(8)
@@ -292,6 +298,7 @@ def test_train_cluster_single_call():
 def test_train_cluster_rank_groups():
     """Query-aligned partitioning: lambdarank over a cluster keeps every
     query on one rank."""
+    skip_unless_multiprocess()
     import lambdagap_tpu as lgb
     rng = np.random.RandomState(9)
     n_q, per = 40, 30
@@ -317,6 +324,7 @@ def test_train_cluster_multihost_recipe(tmp_path):
     multi-worker tests, python-package/lightgbm/dask.py:375-415). Rank
     models must be identical, and with full-data bin samples the model
     must match single-process training."""
+    skip_unless_multiprocess()
     import lambdagap_tpu as lgb
     from sklearn.metrics import roc_auc_score
     rng = np.random.RandomState(11)

@@ -121,6 +121,31 @@ def test_stream_blocker_falls_back_to_hbm():
     assert b.num_trees() > 0
 
 
+@pytest.mark.parametrize("tree_learner", ["data", "voting", "feature"])
+@pytest.mark.parametrize("fused", [True, False])
+def test_stream_distributed_falls_back_to_hbm_loudly(tree_learner, fused,
+                                                     caplog):
+    """ISSUE-8 satellite: stream x distributed is an unsupported combo —
+    every distributed learner (fused and host-loop) must fall back to
+    device-resident training with the documented WARNING, never silently
+    and never by dying."""
+    import logging
+    X, y = _data(n=1500)
+    # verbose=0 keeps the package logger at WARNING: Config application
+    # calls set_verbosity during train(), overriding caplog's level
+    with caplog.at_level(logging.WARNING, logger="lambdagap_tpu"):
+        b = _train(X, y, "stream", fused, "gather",
+                   {"tree_learner": tree_learner, "tpu_num_devices": 2,
+                    "verbose": 0})
+    learner = b._booster.learner
+    assert learner.residency == "hbm", type(learner).__name__
+    assert b.num_trees() > 0
+    assert any("data_residency=stream is not supported" in r.message
+               and "falling back to data_residency=hbm" in r.message
+               for r in caplog.records), \
+        [r.message for r in caplog.records]
+
+
 def test_auto_residency_picks_stream_for_sharded_dataset():
     X, y = _data(n=2048)
     params = {"objective": "regression", "verbose": -1, "num_leaves": 7,

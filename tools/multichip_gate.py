@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Multi-chip gate for tools/run_full_suite.sh (ISSUE 8 CI satellite).
+
+Self-provisions an 8-virtual-device CPU mesh (subprocess, same recipe as
+``__graft_entry__.dryrun_multichip``) and asserts the distributed training
+contract the unified sharding registry is supposed to guarantee:
+
+1. the fused data-parallel learner on 8 devices builds trees
+   BYTE-IDENTICAL to the 1-device fused serial learner at a small shape
+   (rows not divisible by 8, so pad rows are live). The gate runs the
+   QUANTIZED path (use_quantized_grad, deterministic rounding): integer
+   gradient levels accumulate exactly (order-independent sums below the
+   f32-exact range), so the histogram reduction is width-invariant BY
+   CONSTRUCTION — the invariant elastic resume at a different device
+   count rests on. (The f32 path is correct but only
+   reduction-order-equal: near-tied split gains may legitimately resolve
+   differently across widths, so bit-identity is a quant-mode contract.)
+2. ZERO steady-state recompiles in the 8-device arm (a per-width program
+   that keeps retracing would silently serialize the mesh);
+3. the guard snapshot sidecar carries the mesh + row-shard geometry
+   fields (``mesh.axes/shape/n_devices/n_pad/n_loc``) that
+   ``resume=auto`` reads back for elastic resume.
+
+Exit 0 on success, 1 with a diagnostic on any violation.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+import json
+import numpy as np
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.guard.snapshot import read_snapshot, snapshot_path, \
+    write_training_snapshot
+
+ROUNDS = 6
+WARMUP = 2
+N = 6001          # deliberately not divisible by 8: pad rows are live
+
+rng = np.random.RandomState(0)
+X = rng.randn(N, 10).astype(np.float32)
+y = (X[:, 0] - 0.4 * X[:, 1] + 0.2 * rng.randn(N) > 0).astype(np.float32)
+
+def train(n_dev, tree_learner):
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "tree_learner": tree_learner, "tpu_fused_learner": "1",
+              "min_data_in_leaf": 20, "tpu_num_devices": n_dev,
+              "use_quantized_grad": True, "stochastic_rounding": False,
+              "telemetry": True, "telemetry_warmup": WARMUP}
+    return lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                     num_boost_round=ROUNDS)
+
+b1 = train(1, "serial")
+b8 = train(8, "data")
+from lambdagap_tpu.parallel.fused_parallel import \
+    FusedDataParallelTreeLearner
+assert isinstance(b8._booster.learner, FusedDataParallelTreeLearner)
+
+t1 = b1.model_to_string().split("end of trees")[0]
+t8 = b8.model_to_string().split("end of trees")[0]
+if t1.split("Tree=0")[1] != t8.split("Tree=0")[1]:
+    print("MCGATE_FAIL trees: 8-device fused data-parallel diverged from "
+          "the 1-device fused serial learner")
+    sys.exit(1)
+
+tel = b8._booster.telemetry
+bad = [(r["iter"], r["compiles"]["total"]) for r in tel.records
+       if r.get("iter", 0) >= WARMUP
+       and (r.get("compiles") or {}).get("total", 0)]
+if bad:
+    print("MCGATE_FAIL steady-state recompiles on the 8-device mesh: "
+          + json.dumps(bad))
+    sys.exit(1)
+
+import tempfile
+with tempfile.TemporaryDirectory() as td:
+    out = f"{td}/m.txt"
+    write_training_snapshot(b8._booster, out)
+    _, state = read_snapshot(snapshot_path(out, b8._booster.iter_))
+mesh = state.get("mesh") or {}
+want = {"axes": ["data", "feature"], "shape": [8, 1], "n_devices": 8}
+for k, v in want.items():
+    if mesh.get(k) != v:
+        print(f"MCGATE_FAIL sidecar mesh field {k}={mesh.get(k)!r} "
+              f"(want {v!r}); full sidecar mesh: {json.dumps(mesh)}")
+        sys.exit(1)
+if mesh.get("n_loc", 0) * 8 != mesh.get("n_pad", -1):
+    print("MCGATE_FAIL sidecar shard geometry inconsistent: "
+          + json.dumps(mesh))
+    sys.exit(1)
+
+print("MCGATE_" + "OK 8-device fused data-parallel bit-identical to "
+      "1-device serial, zero steady compiles, sidecar mesh fields "
+      + json.dumps(mesh))
+"""
+
+
+def main() -> int:
+    env = dict(os.environ)
+    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                     if not f.startswith("--xla_force_host_platform"))
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD % {"repo": REPO}],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=900)
+    sys.stderr.write(proc.stderr[-2000:] if proc.stderr else "")
+    tail = (proc.stdout or "").strip().splitlines()
+    for line in tail[-5:]:
+        print(line)
+    if proc.returncode != 0 or not any("MCGATE_OK" in ln for ln in tail):
+        print("multichip gate: FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

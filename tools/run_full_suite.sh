@@ -32,6 +32,17 @@ if ! env JAX_PLATFORMS=cpu python tools/stream_gate.py; then
     echo "see docs/performance.md)"
     exit 1
 fi
+# multichip gate (ISSUE 8): 8 virtual CPU devices — fused data-parallel
+# trees must be bit-identical to the 1-device serial learner (quantized
+# path: width-invariant integer histogram reduction), zero steady-state
+# recompiles, and the snapshot sidecar must carry the mesh/shard fields
+# elastic resume reads back
+if ! env JAX_PLATFORMS=cpu python tools/multichip_gate.py; then
+    echo "FAIL-FAST: multichip gate failed (distributed training diverged"
+    echo "from 1-device, recompiled in steady state, or the snapshot"
+    echo "sidecar lost its mesh fields; see docs/performance.md)"
+    exit 1
+fi
 # chaos gate (ISSUE 5): short train under injected gradient NaNs must
 # finish with a valid model (guard_nonfinite=skip_tree), and a serve loop
 # under injected dispatch failures must shed, degrade, and recover
