@@ -1,6 +1,6 @@
 #!/usr/bin/env python
 """Serving benchmark: compiled-forest micro-batched server vs naive
-per-request ``Booster.predict`` on batch-size-1 request streams.
+per-request ``Booster.predict``, plus the fleet rounds of ISSUE 9.
 
 The naive side calls ``Booster.predict`` once per single-row request — the
 only serving story the framework had before ``lambdagap_tpu.serve`` — so it
@@ -12,13 +12,32 @@ padded device batches. Clients keep a bounded window of in-flight async
 requests (a streaming RPC client), which is what lets the batcher form
 deep batches.
 
+The closed-loop client above cannot measure saturation (offered load
+collapses to whatever the server admits), so the fleet rounds drive the
+OPEN-loop generator (serve/loadgen.py):
+
+- ``open_loop`` — goodput (completed within ``--deadline-ms`` of
+  scheduled arrival) vs offered load, swept up a rate ladder to
+  saturation, for each fleet width in ``--replica-counts`` (shared-nothing
+  local replicas behind the health-aware router);
+- ``registry`` — a 2-model registry under an HBM budget that fits ~one
+  compiled forest: alternating model traffic forces LRU eviction +
+  re-admission, and the JSON carries the counts plus the recompile cost
+  each flip pays;
+- ``chaos`` — a replica killed mid-round behind the router: the gate-level
+  invariant (every accepted request resolves; goodput holds) measured
+  under the bench workload.
+
 Usage::
 
     python bench_serve.py [out.json] [--trees 500] [--feats 32]
         [--requests 4000] [--clients 8] [--window 64] [--naive-requests 400]
+        [--sweep-rates 50,100,200,400,800] [--replica-counts 1,2]
+        [--deadline-ms 50] [--sweep-duration 1.5]
 
-Output JSON: naive + served throughput, speedup, serve p50/p99 latency and
-cache hit stats (the ``ServeStats`` schema of docs/serving.md).
+Output JSON: naive + served throughput, speedup, serve p50/p99 latency,
+cache hit stats, and the three machine-readable fleet sections above
+(the ``ServeStats`` schema of docs/serving.md).
 """
 import argparse
 import json
@@ -153,6 +172,135 @@ def bench_served(booster, X, n_requests: int, clients: int,
             "prometheus_samples": prom_samples}
 
 
+def _make_fleet(booster, n_replicas: int, max_delay_ms: float):
+    """N shared-nothing in-process replicas behind the router (the gate
+    uses real subprocesses; the bench keeps replicas in-process so the
+    sweep measures serving, not interpreter startup)."""
+    from lambdagap_tpu.serve import LocalReplica, Router
+    servers = [booster.as_server(max_delay_ms=max_delay_ms)
+               for _ in range(n_replicas)]
+    if n_replicas == 1:
+        return servers[0], servers
+    router = Router([LocalReplica(f"r{i}", s)
+                     for i, s in enumerate(servers)], own_replicas=True)
+    return router, servers
+
+
+def bench_open_loop_sweep(booster, X, rates, replica_counts,
+                          deadline_ms: float, duration_s: float,
+                          max_delay_ms: float, good_ratio: float = 0.9
+                          ) -> dict:
+    """Goodput vs offered load, per fleet width: the saturation story the
+    closed-loop client cannot tell."""
+    from lambdagap_tpu.serve import run_open_loop
+    out = {"deadline_ms": deadline_ms, "arrival": "poisson",
+           "duration_s": duration_s, "good_ratio": good_ratio,
+           "fleets": {}}
+    for n in replica_counts:
+        target, servers = _make_fleet(booster, n, max_delay_ms)
+        rounds, saturation = [], None
+        try:
+            for rate in rates:
+                n_req = max(50, int(rate * duration_s))
+                r = run_open_loop(target.submit, X, rate, n_req,
+                                  deadline_ms=deadline_ms, seed=17)
+                r.pop("per_tenant", None)      # single-tenant sweep
+                rounds.append(r)
+                if r["goodput_ratio"] >= good_ratio:
+                    saturation = rate
+                print(f"  {n} replica(s) @ {rate:6.0f} rps offered: "
+                      f"goodput {r['goodput_rps']:7.0f} rps "
+                      f"(ratio {r['goodput_ratio']:.2f}, "
+                      f"p99 {r['latency_ms']['p99']:.1f} ms)",
+                      file=sys.stderr)
+        finally:
+            target.close()
+            for s in servers:
+                s.close()
+        out["fleets"][str(n)] = {"rates": list(rates), "rounds": rounds,
+                                 "saturation_rps": saturation}
+    return out
+
+
+def bench_registry(booster, X, flips: int = 10, per_flip: int = 20) -> dict:
+    """2-model registry under an HBM budget that fits ~one forest:
+    alternating traffic pays eviction + re-admission on every flip; the
+    flip-vs-resident latency gap is the recompile cost the budget
+    charges."""
+    server = booster.as_server(buckets=(64,), max_delay_ms=0.5)
+    try:
+        ref = server.predict(X[:64])
+        bytes0 = server.registry.entry("default").bytes
+        server.registry.hbm_budget_bytes = int(1.5 * bytes0)
+        server.add_model("b", booster._booster)   # same forest, own copy
+        flip_ms, resident_ms = [], []
+        for f in range(flips):
+            name = "b" if f % 2 == 0 else "default"
+            t0 = time.perf_counter()
+            first = server.predict(X[:64], model=name)   # pays readmission
+            flip_ms.append(1e3 * (time.perf_counter() - t0))
+            assert np.array_equal(first, ref), "registry parity broke"
+            for i in range(per_flip - 1):                # warm residence
+                t0 = time.perf_counter()
+                server.predict(X[64 * (i % 4):64 * (i % 4) + 64],
+                               model=name)
+                resident_ms.append(1e3 * (time.perf_counter() - t0))
+        snap = server.stats_snapshot()
+        return {
+            "hbm_budget_bytes": server.registry.hbm_budget_bytes,
+            "forest_bytes": bytes0,
+            "models": snap["registry"]["registered_models"],
+            "evictions": snap["evictions"],
+            "readmissions": snap["readmissions"],
+            "flips": flips,
+            "readmit_request_ms_p50": float(np.median(flip_ms)),
+            "resident_request_ms_p50": float(np.median(resident_ms)),
+            "readmit_over_resident": float(
+                np.median(flip_ms) / max(np.median(resident_ms), 1e-9)),
+            "parity_ok": True,
+            "per_model": snap["per_model"],
+        }
+    finally:
+        server.close()
+
+
+def bench_chaos(booster, X, rate: float, deadline_ms: float,
+                duration_s: float, max_delay_ms: float) -> dict:
+    """Kill one of two replicas mid-round: the serve-gate invariant under
+    the bench forest — zero stranded futures, goodput holds."""
+    from lambdagap_tpu.serve import run_open_loop
+    target, servers = _make_fleet(booster, 2, max_delay_ms)
+    n_req = max(100, int(rate * duration_s))
+
+    def killer():
+        time.sleep(duration_s * 0.4)
+        servers[0].close()               # replica death mid-load
+
+    k = threading.Thread(target=killer)
+    k.start()
+    try:
+        r = run_open_loop(target.submit, X, rate, n_req,
+                          deadline_ms=deadline_ms, seed=23)
+    finally:
+        k.join()
+        snap = target.snapshot()
+        target.close()
+        for s in servers:
+            s.close()
+    c = r["counts"]
+    resolved = (c["ok"] + c["rejected"] + c["timeout"] + c["transport"]
+                + c["error"])
+    return {
+        "offered_rps": rate,
+        "n_requests": n_req,
+        "counts": c,
+        "stranded": n_req - resolved,
+        "goodput_ratio": r["goodput_ratio"],
+        "latency_ms": r["latency_ms"],
+        "router": snap,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("out", nargs="?", default="")
@@ -165,6 +313,16 @@ def main(argv=None) -> int:
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--window", type=int, default=64)
     ap.add_argument("--max-delay-ms", type=float, default=2.0)
+    ap.add_argument("--sweep-rates", default="50,100,200,400,800",
+                    help="offered-load ladder (rps) for the open-loop sweep")
+    ap.add_argument("--replica-counts", default="1,2",
+                    help="fleet widths to sweep")
+    ap.add_argument("--deadline-ms", type=float, default=50.0,
+                    help="goodput deadline from scheduled arrival")
+    ap.add_argument("--sweep-duration", type=float, default=1.5,
+                    help="seconds of offered load per sweep round")
+    ap.add_argument("--skip-fleet", action="store_true",
+                    help="skip the open-loop/registry/chaos fleet rounds")
     args = ap.parse_args(argv)
 
     import jax
@@ -216,6 +374,31 @@ def main(argv=None) -> int:
                           args.window, args.max_delay_ms)
     print(f"  {served['throughput_rps']:.0f} req/s", file=sys.stderr)
 
+    open_loop = registry = chaos = None
+    if not args.skip_fleet:
+        rates = [float(r) for r in args.sweep_rates.split(",") if r]
+        widths = [int(n) for n in args.replica_counts.split(",") if n]
+        print(f"open-loop goodput sweep (deadline {args.deadline_ms:g} ms, "
+              f"fleets {widths}, rates {rates})...", file=sys.stderr)
+        open_loop = bench_open_loop_sweep(
+            booster, X, rates, widths, args.deadline_ms,
+            args.sweep_duration, args.max_delay_ms)
+        print("registry eviction round (2 models, budget ~1 forest)...",
+              file=sys.stderr)
+        registry = bench_registry(booster, X)
+        print(f"  evictions {registry['evictions']}, readmissions "
+              f"{registry['readmissions']}, readmit/resident request = "
+              f"{registry['readmit_over_resident']:.1f}x", file=sys.stderr)
+        chaos_rate = rates[min(1, len(rates) - 1)]
+        print(f"chaos round (kill 1 of 2 replicas @ {chaos_rate:g} rps)...",
+              file=sys.stderr)
+        chaos = bench_chaos(booster, X, chaos_rate, args.deadline_ms,
+                            max(args.sweep_duration, 2.0),
+                            args.max_delay_ms)
+        print(f"  stranded {chaos['stranded']}, goodput ratio "
+              f"{chaos['goodput_ratio']:.2f}, counts {chaos['counts']}",
+              file=sys.stderr)
+
     speedup = served["throughput_rps"] / max(naive["throughput_rps"], 1e-9)
     speedup_dev = (served["throughput_rps"]
                    / max(naive_dev["throughput_rps"], 1e-9))
@@ -229,6 +412,9 @@ def main(argv=None) -> int:
         "naive": naive,
         "naive_device": naive_dev,
         "serve": served,
+        "open_loop": open_loop,
+        "registry": registry,
+        "chaos": chaos,
         "speedup": speedup,
         "speedup_vs_device_naive": speedup_dev,
         "serve_engine": served["stats"].get("engine"),

@@ -1,8 +1,11 @@
 """R5: serve-layer lock discipline.
 
 The serving path (``serve/batcher.py``, ``serve/swap.py``,
-``serve/server.py``) mixes client threads, batcher workers, and swap
-controllers. Two statically detectable hazards:
+``serve/server.py`` — and since the fleet PR the registry, replica
+router, and socket frontend: ``serve/registry.py``, ``serve/router.py``,
+``serve/frontend.py``) mixes client threads, batcher workers, swap
+controllers, registry re-admission builders, and per-connection socket
+writers. Two statically detectable hazards:
 
 - **R5a — blocking call under a lock**: a ``threading.Lock`` held across a
   blocking operation (``Future.result``, ``thread.join``, ``queue``
@@ -24,11 +27,15 @@ from typing import Dict, Iterator, List, Set, Tuple
 from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
                     dotted_name, register_rule)
 
-# method names that block the calling thread
+# method names that block the calling thread. "sendall" joined when the
+# socket frontend landed: a frame write under the connection's tx mutex
+# convoys every batcher callback replying on that connection exactly like
+# "send" does, and the frontend's two deliberate sites carry written
+# justifications
 _BLOCKING_METHODS = frozenset({
     "result", "join", "wait", "sleep", "block_until_ready",
     "device_get", "device_put", "warm", "_build", "recv", "send",
-    "acquire",
+    "sendall", "acquire",
 })
 # .get()/.put() only block on queue-ish receivers
 _QUEUEISH = ("q", "queue", "_q", "_queue")

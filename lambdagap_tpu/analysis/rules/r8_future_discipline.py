@@ -15,7 +15,12 @@ Two hazards that turn failures into hangs or silence:
   the batch hangs its caller forever — the exact bug class of a batcher
   worker eating an error mid-dispatch. Every exception path out of a
   future-resolving function must either resolve the futures exceptionally
-  or propagate to a layer that does.
+  or propagate to a layer that does. The fleet PR widened the surface
+  this guards: the replica router's failover paths (serve/router.py —
+  its re-entrant pick loop carries justified suppressions), the socket
+  frontend's reply callbacks and client reader (serve/frontend.py), and
+  the registry's re-admission single-flight (serve/registry.py) all
+  resolve futures on exception paths a dead replica can reach.
 """
 from __future__ import annotations
 

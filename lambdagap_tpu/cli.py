@@ -163,6 +163,79 @@ def _load_raw_matrix(path: str, cfg: Config) -> np.ndarray:
     return X
 
 
+def _parse_serve_models(spec: str):
+    """``serve_models="name=path,name2=path2"`` -> [(name, path), ...]."""
+    out = []
+    for tok in (spec or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" not in tok:
+            log.fatal("serve_models token %r is not name=path", tok)
+        name, path = tok.split("=", 1)
+        out.append((name.strip(), path.strip()))
+    return out
+
+
+def _build_serve_target(cfg: Config, booster):
+    """The CLI's serve target: one ForestServer, or ``serve_replicas``
+    shared-nothing replicas behind the health-aware router. Extra
+    ``serve_models`` are registered on every replica (each keeps its own
+    compiled copy — replicas share nothing)."""
+    from .serve import ForestServer, LocalReplica, Router
+    extra = _parse_serve_models(cfg.serve_models)
+    n = max(int(cfg.serve_replicas), 1)
+    servers = []
+    for i in range(n):
+        s = ForestServer(booster, raw_score=cfg.predict_raw_score,
+                         start_iteration=cfg.start_iteration_predict,
+                         num_iteration=cfg.num_iteration_predict)
+        for name, path in extra:
+            s.add_model(name, path)
+        servers.append(s)
+    if n == 1:
+        return servers[0]
+    return Router([LocalReplica(f"r{i}", s)
+                   for i, s in enumerate(servers)], own_replicas=True)
+
+
+def run_serve_frontend(cfg: Config, booster) -> None:
+    """task=serve with ``serve_port``: bind the newline-JSON TCP front
+    end (docs/serving.md wire protocol) over ``serve_replicas`` local
+    replicas and serve until SIGTERM/SIGINT. The bound port is printed as
+    ``SERVE_PORT=<port>`` on stdout so harnesses can use ``serve_port=0``
+    (ephemeral) and still find the socket."""
+    import signal
+    import threading
+    from .serve import ServeFrontend
+    target = _build_serve_target(cfg, booster)
+    fe = ServeFrontend(target, port=cfg.serve_port).start()
+    print(f"SERVE_PORT={fe.port}", flush=True)
+    stop = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    except ValueError:                   # not the main thread (tests)
+        log.warning("serve frontend: SIGTERM handler unavailable off the "
+                    "main thread; close with SIGINT/KeyboardInterrupt")
+    log.info("task=serve frontend up on port %d (%d replica(s)); "
+             "SIGTERM/SIGINT drains and exits", fe.port,
+             max(int(cfg.serve_replicas), 1))
+    try:
+        stop.wait()
+    except KeyboardInterrupt:
+        log.info("task=serve frontend: interrupt — draining")
+    fe.close()
+    snap = target.stats_snapshot()
+    target.close()
+    if cfg.serve_stats_file:
+        import json
+        with open(cfg.serve_stats_file, "w") as f:
+            json.dump(snap, f, indent=2)
+    log.info("task=serve frontend drained (stats%s)",
+             f" in {cfg.serve_stats_file}" if cfg.serve_stats_file else
+             " not persisted; set serve_stats_file=")
+
+
 def run_serve(cfg: Config) -> None:
     """task=serve: micro-batched inference loop over a request stream.
 
@@ -172,14 +245,23 @@ def run_serve(cfg: Config) -> None:
     prints the live Prometheus exposition (``stats json`` the snapshot
     JSON) to stderr — the scrape hook for a sidecar exporter. Predictions
     go to ``output_result`` (default LightGBM_predict_result.txt); serving
-    metrics JSON goes to ``serve_stats_file`` when set."""
+    metrics JSON goes to ``serve_stats_file`` when set.
+
+    With ``serve_port>=0`` the process instead binds the TCP front end
+    (``serve_replicas`` local replicas behind the health-aware router) —
+    see :func:`run_serve_frontend`."""
     if not cfg.input_model:
         log.fatal("task=serve requires input_model=<model>")
     from .serve import ForestServer, serve_loop
     booster = GBDT.from_model_file(cfg.input_model, cfg)
+    if cfg.serve_port >= 0:
+        run_serve_frontend(cfg, booster)
+        return
     server = ForestServer(booster, raw_score=cfg.predict_raw_score,
                           start_iteration=cfg.start_iteration_predict,
                           num_iteration=cfg.num_iteration_predict)
+    for name, path in _parse_serve_models(cfg.serve_models):
+        server.add_model(name, path)
     if cfg.data:
         src = open(cfg.data)
     else:

@@ -342,6 +342,12 @@ class Config:
     serve_backpressure: str = "reject"   # full-queue policy: reject (ServeOverloaded) / block
     serve_timeout_ms: float = 0.0        # per-request deadline; expired requests are shed before dispatch; 0 = none
     serve_swap_breaker: int = 3          # consecutive swap failures opening the swap circuit; 0 = off
+    serve_hbm_budget_mb: float = 0.0     # registry HBM byte budget for resident forests; LRU eviction above it; 0 = unlimited
+    serve_models: str = ""               # extra registry models at startup: "name=path,name2=path2"
+    serve_tenant_weights: str = ""       # weighted-fair dequeue: "tenant:weight,..."; unlisted tenants weigh 1
+    serve_tenant_max_share: float = 0.0  # one tenant's max fraction of the bounded queue; 0 = off
+    serve_port: int = -1                 # task=serve TCP frontend port: -1 = line loop, 0 = ephemeral, >0 = fixed
+    serve_replicas: int = 1              # task=serve: replica servers behind the health-aware router
 
     # -- guard (lambdagap_tpu.guard; docs/robustness.md) ------------------
     guard_nonfinite: str = "raise"       # non-finite grad/hess/score policy: raise / skip_tree / clip / off
@@ -567,6 +573,12 @@ class Config:
              f"unknown serve_backpressure {self.serve_backpressure!r}"),
             (self.serve_timeout_ms >= 0, "serve_timeout_ms must be >= 0"),
             (self.serve_swap_breaker >= 0, "serve_swap_breaker must be >= 0"),
+            (self.serve_hbm_budget_mb >= 0,
+             "serve_hbm_budget_mb must be >= 0"),
+            (0.0 <= self.serve_tenant_max_share <= 1.0,
+             "serve_tenant_max_share must be in [0, 1]"),
+            (self.serve_port >= -1, "serve_port must be >= -1"),
+            (self.serve_replicas >= 1, "serve_replicas must be >= 1"),
             (self.guard_nonfinite in ("off", "raise", "skip_tree", "clip"),
              f"unknown guard_nonfinite {self.guard_nonfinite!r}"),
             (self.guard_clip > 0, "guard_clip must be > 0"),

@@ -20,8 +20,9 @@ Production posture for the whole framework (docs/robustness.md):
 """
 from __future__ import annotations
 
-from .degrade import (CircuitBreaker, HealthMonitor, ServeOverloaded,  # noqa: F401
-                      ServeTimeout, SwapFailed, SwapRejected)
+from .degrade import (CircuitBreaker, HealthMonitor,  # noqa: F401
+                      ReplicaUnavailable, ServeOverloaded, ServeTimeout,
+                      SwapFailed, SwapRejected)
 from .faults import FaultPlan, InjectedFault, plan_for  # noqa: F401
 from .nonfinite import NonFiniteError, TrainGuard  # noqa: F401
 from .snapshot import (SnapshotError, atomic_write_text,  # noqa: F401
@@ -29,7 +30,8 @@ from .snapshot import (SnapshotError, atomic_write_text,  # noqa: F401
                        restore_state, snapshot_path, write_training_snapshot)
 
 __all__ = [
-    "CircuitBreaker", "HealthMonitor", "ServeOverloaded", "ServeTimeout",
+    "CircuitBreaker", "HealthMonitor", "ReplicaUnavailable",
+    "ServeOverloaded", "ServeTimeout",
     "SwapFailed", "SwapRejected", "FaultPlan", "InjectedFault", "plan_for",
     "NonFiniteError", "TrainGuard", "SnapshotError", "atomic_write_text",
     "capture_state", "latest_snapshot", "read_snapshot", "restore_state",

@@ -44,6 +44,13 @@ class SwapRejected(RuntimeError):
     """Swap refused because the swap circuit breaker is open."""
 
 
+class ReplicaUnavailable(ConnectionError):
+    """A serve replica died (transport failure / closed server) — the
+    router's failover trigger, and the terminal answer when NO replica
+    can take a request. Subclasses ConnectionError so transport-level
+    handlers catch it uniformly."""
+
+
 OK = "ok"
 DEGRADED = "degraded"
 DRAINING = "draining"

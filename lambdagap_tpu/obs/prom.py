@@ -106,6 +106,51 @@ def render_serve(snapshot: Dict) -> str:
              "Bucket executable compiles", "counter")
     w.metric(p + "swaps_total", snapshot.get("swaps", 0),
              "Model hot-swaps", "counter")
+    w.metric(p + "evictions_total", snapshot.get("evictions", 0),
+             "Registry forests evicted under the HBM budget", "counter")
+    w.metric(p + "readmissions_total", snapshot.get("readmissions", 0),
+             "Evicted models recompiled on first use", "counter")
+    # per-model / per-tenant labeled breakdowns (docs/serving.md)
+    for block_key, label in (("per_model", "model"),
+                             ("per_tenant", "tenant")):
+        block = snapshot.get(block_key) or {}
+        if not block:
+            continue
+        for metric, help_, type_ in (
+                ("requests_total", "Requests served", "counter"),
+                ("rows_total", "Feature rows served", "counter"),
+                ("shed_total", "Requests shed before dispatch", "counter"),
+                ("rejected_total", "Submits rejected at admission",
+                 "counter")):
+            name = f"{p}{label}_{metric}"
+            w.sample_header(name, f"{help_} per {label}", type_)
+            key = metric.rsplit("_", 1)[0]
+            for k, g in block.items():
+                w.sample(name, g.get(key, 0), {label: k})
+        name = f"{p}{label}_latency_ms"
+        w.sample_header(name, f"End-to-end latency per {label} (ms)",
+                        "gauge")
+        for k, g in block.items():
+            for q, v in sorted((g.get("latency_ms") or {}).items()):
+                w.sample(name, v, {label: k, "quantile": q})
+    registry = snapshot.get("registry")
+    if registry:
+        w.metric(p + "registry_models", registry.get("registered_models", 0),
+                 "Models registered in the serve registry")
+        w.metric(p + "registry_resident_models",
+                 registry.get("resident_models", 0),
+                 "Models with a resident compiled forest")
+        w.metric(p + "registry_hbm_bytes",
+                 registry.get("hbm_bytes_resident", 0),
+                 "Resident compiled-forest bytes")
+        w.metric(p + "registry_hbm_budget_bytes",
+                 registry.get("hbm_budget_bytes", 0),
+                 "Registry HBM byte budget (0 = unlimited)")
+        name = p + "registry_model_resident"
+        w.sample_header(name, "Per-model residency (1 = compiled forest "
+                        "in HBM)", "gauge")
+        for k, m in (registry.get("models") or {}).items():
+            w.sample(name, 1 if m.get("resident") else 0, {"model": k})
     if "generation" in snapshot:
         w.metric(p + "generation", snapshot["generation"],
                  "Active model generation")
@@ -122,6 +167,36 @@ def render_serve(snapshot: Dict) -> str:
             name = p + "swap_breaker_open"
             w.metric(name, 0 if health["swap_breaker"] == "closed" else 1,
                      "Swap circuit breaker tripped (open or probing)")
+    return w.text()
+
+
+def render_router(snapshot: Dict) -> str:
+    """``Router.snapshot()`` -> Prometheus text: fleet-level dispatch
+    counters plus per-replica routed/inflight/health labels."""
+    w = _Writer()
+    p = "lambdagap_router_"
+    w.metric(p + "failovers_total", snapshot.get("failovers", 0),
+             "Requests failed over to another replica", "counter")
+    w.metric(p + "rejected_no_replica_total",
+             snapshot.get("rejected_no_replica", 0),
+             "Requests rejected with no live replica", "counter")
+    replicas = snapshot.get("replicas") or {}
+    for metric, help_, type_ in (
+            ("routed_total", "Requests routed to the replica", "counter"),
+            ("inflight", "Requests currently in flight", "gauge")):
+        name = p + "replica_" + metric
+        w.sample_header(name, help_, type_)
+        key = metric.rsplit("_", 1)[0] if metric.endswith("_total") \
+            else metric
+        for rname, info in sorted(replicas.items()):
+            w.sample(name, info.get(key, 0), {"replica": rname})
+    name = p + "replica_health"
+    w.sample_header(name, "Replica health (ok/degraded/draining/dead)",
+                    "gauge")
+    for rname, info in sorted(replicas.items()):
+        for state in ("ok", "degraded", "draining", "dead"):
+            w.sample(name, 1 if info.get("health") == state else 0,
+                     {"replica": rname, "state": state})
     return w.text()
 
 

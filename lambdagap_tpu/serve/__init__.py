@@ -1,27 +1,42 @@
-"""lambdagap_tpu.serve — batched, hot-swappable TPU inference.
+"""lambdagap_tpu.serve — batched, hot-swappable, fleet-shaped TPU inference.
 
-A serving layer above the one-shot predict ops: a device-resident
-compiled-forest cache with padding-bucket executables (cache.py), a
-micro-batching request queue (batcher.py), atomic generation-pointer model
-hot-swap (swap.py) and a serving metrics layer (stats.py), fronted by
-:class:`ForestServer` (server.py). Entry points::
+A serving layer above the one-shot predict ops: a multi-model registry of
+device-resident compiled forests under an HBM budget (registry.py, LRU
+eviction + re-admission), a micro-batching request queue with weighted
+tenant fairness (batcher.py), per-model atomic generation-pointer hot-swap
+(registry.py; swap.py keeps the PR 1 single-model controller), a serving
+metrics layer (stats.py), a health-aware replica router with failover
+(router.py), a newline-JSON socket front end (frontend.py), and an
+open-loop load generator (loadgen.py) — fronted by :class:`ForestServer`
+(server.py). Entry points::
 
     server = booster.as_server()                  # Python API
     python -m lambdagap_tpu task=serve \
         input_model=model.txt data=requests.tsv   # CLI request loop
+    python -m lambdagap_tpu task=serve \
+        input_model=model.txt serve_port=0 serve_replicas=2   # TCP fleet
 
-See docs/serving.md for bucket policy, swap semantics and the metrics
-schema.
+See docs/serving.md for bucket policy, registry/tenancy/router semantics
+and the metrics schema.
 """
-from ..guard.degrade import (ServeOverloaded, ServeTimeout, SwapFailed,
-                             SwapRejected)
-from .batcher import MicroBatcher, Request
+from ..guard.degrade import (ReplicaUnavailable, ServeOverloaded,
+                             ServeTimeout, SwapFailed, SwapRejected)
+from .batcher import FairQueue, MicroBatcher, Request
 from .cache import DEFAULT_BUCKETS, CompiledForestCache
-from .server import ForestServer, ServeResult, serve_loop
+from .frontend import FrontendClient, ServeFrontend
+from .loadgen import arrival_times, run_open_loop, sweep
+from .registry import DEFAULT_MODEL, ModelEntry, ModelRegistry
+from .router import LocalReplica, RemoteReplica, Router
+from .server import (ForestServer, ServeResult, parse_tenant_weights,
+                     serve_loop)
 from .stats import ServeStats
 from .swap import SwapController, load_booster
 
 __all__ = ["ForestServer", "ServeResult", "serve_loop", "MicroBatcher",
-           "Request", "CompiledForestCache", "DEFAULT_BUCKETS",
-           "ServeStats", "SwapController", "load_booster",
-           "ServeOverloaded", "ServeTimeout", "SwapFailed", "SwapRejected"]
+           "FairQueue", "Request", "CompiledForestCache", "DEFAULT_BUCKETS",
+           "DEFAULT_MODEL", "ModelEntry", "ModelRegistry", "Router",
+           "LocalReplica", "RemoteReplica", "ServeFrontend",
+           "FrontendClient", "arrival_times", "run_open_loop", "sweep",
+           "parse_tenant_weights", "ServeStats", "SwapController",
+           "load_booster", "ServeOverloaded", "ServeTimeout", "SwapFailed",
+           "SwapRejected", "ReplicaUnavailable"]

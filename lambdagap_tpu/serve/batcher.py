@@ -13,6 +13,17 @@ serve's throughput win (the compile-once half lives in cache.py).
 All device work happens on the worker thread; ``submit`` only enqueues, so
 any number of client threads can call it concurrently.
 
+Multi-tenant fairness (docs/serving.md "Tenancy"): the queue is a
+:class:`FairQueue` — per-tenant FIFO lanes drained by start-time fair
+queuing (each tenant carries a virtual clock advanced by ``1/weight`` per
+dequeued request), so a tenant flooding the queue cannot starve the
+others: dequeue bandwidth converges to the weight ratio, not the arrival
+ratio. On top of the bounded queue sits per-tenant admission control
+(``max_share``): one tenant may hold at most that fraction of the queue's
+capacity, and a submit beyond the quota is rejected at the door with
+:class:`ServeOverloaded` naming the tenant — the hot tenant pays, not the
+fleet.
+
 Degradation contract (lambdagap_tpu.guard, docs/robustness.md): the queue
 is bounded by ``max_queue`` requests with a ``reject``-or-``block``
 backpressure policy (reject raises :class:`ServeOverloaded` at submit
@@ -21,17 +32,18 @@ SHED before dispatch once expired — its future resolves with
 :class:`ServeTimeout` instead of wasting a device batch on a response
 nobody is waiting for. Submit-after-close raises immediately, and the
 submit/close race is closed by a mutex: a submit that won the race is
-strictly FIFO-before the shutdown sentinels, so its future always
-resolves. Every submitted future therefore terminates: result, error, or
-timeout — never a hang.
+strictly FIFO-before the shutdown sentinels (the fair queue hands out
+sentinels only once every lane is empty), so its future always resolves.
+Every submitted future therefore terminates: result, error, or timeout —
+never a hang.
 """
 from __future__ import annotations
 
-import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -39,16 +51,20 @@ from ..guard.degrade import ServeOverloaded, ServeTimeout
 
 
 class Request:
-    """One queued predict: rows + the future its caller waits on."""
+    """One queued predict: rows + the future its caller waits on, plus the
+    registry model it targets and the tenant it bills to."""
 
-    __slots__ = ("x", "future", "t_submit", "deadline")
+    __slots__ = ("x", "future", "t_submit", "deadline", "model", "tenant")
 
-    def __init__(self, x: np.ndarray,
-                 deadline: Optional[float] = None) -> None:
+    def __init__(self, x: np.ndarray, deadline: Optional[float] = None,
+                 model: Optional[str] = None,
+                 tenant: Optional[str] = None) -> None:
         self.x = x
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
         self.deadline = deadline         # absolute perf_counter time, or None
+        self.model = model               # registry model name (None = default)
+        self.tenant = tenant             # accounting/fairness key (optional)
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -57,6 +73,115 @@ class Request:
 
 
 _SENTINEL = object()
+
+
+class Empty(Exception):
+    """FairQueue.get timed out with nothing to hand out."""
+
+
+class FairQueue:
+    """Bounded multi-tenant queue: per-tenant FIFO lanes + weighted fair
+    dequeue (start-time fair queuing) + per-tenant admission quotas.
+
+    ``try_put`` returns ``"ok"``, ``"full"`` (global bound) or ``"quota"``
+    (tenant over its ``max_share`` of capacity) instead of raising, so the
+    caller owns the backpressure policy. Sentinels (worker shutdown
+    markers) are handed out only once every lane is empty, which is what
+    makes close() drain-safe: an accepted request is always dequeued
+    before any worker sees its exit marker.
+    """
+
+    def __init__(self, maxsize: int = 0,
+                 weights: Optional[Dict[str, float]] = None,
+                 max_share: float = 0.0) -> None:
+        self._cond = threading.Condition()
+        self.maxsize = max(int(maxsize), 0)
+        self._weights = {k: float(v) for k, v in (weights or {}).items()
+                         if float(v) > 0}
+        self.max_share = float(max_share)
+        self._lanes: Dict[str, deque] = {}
+        self._vt: Dict[str, float] = {}   # per-tenant virtual finish time
+        self._vnow = 0.0                  # global virtual clock
+        self._size = 0
+        self._sentinels = 0
+
+    def qsize(self) -> int:
+        with self._cond:
+            return self._size
+
+    def _lane_key(self, req: Request) -> str:
+        return req.tenant if req.tenant is not None else ""
+
+    def try_put(self, req: Request) -> str:
+        with self._cond:
+            if self.maxsize and self._size >= self.maxsize:
+                return "full"
+            key = self._lane_key(req)
+            lane = self._lanes.get(key)
+            if (self.maxsize and self.max_share > 0.0
+                    and lane is not None
+                    and len(lane) >= max(1, int(self.max_share
+                                                * self.maxsize))):
+                return "quota"
+            if lane is None:
+                lane = self._lanes[key] = deque()
+                # a tenant joining (or re-joining after idling) starts at
+                # the current virtual clock: idle time earns no backlog
+                # credit against the tenants that kept the device busy
+                self._vt[key] = max(self._vt.get(key, 0.0), self._vnow)
+            lane.append(req)
+            self._size += 1
+            self._cond.notify()
+            return "ok"
+
+    def put_sentinel(self, n: int = 1) -> None:
+        with self._cond:
+            self._sentinels += n
+            self._cond.notify_all()
+
+    def _pop_locked(self):
+        best = None
+        for key, lane in self._lanes.items():
+            if lane and (best is None or self._vt[key] < self._vt[best]):
+                best = key
+        if best is not None:
+            req = self._lanes[best].popleft()
+            self._size -= 1
+            if not self._lanes[best]:
+                del self._lanes[best]    # vt survives for fairness history
+            self._vnow = self._vt[best]
+            self._vt[best] += 1.0 / self._weights.get(best, 1.0)
+            return req
+        if self._sentinels > 0:
+            self._sentinels -= 1
+            return _SENTINEL
+        return None
+
+    def get(self, timeout: Optional[float] = None):
+        deadline = (time.perf_counter() + timeout
+                    if timeout is not None else None)
+        with self._cond:
+            while True:
+                item = self._pop_locked()
+                if item is not None:
+                    return item
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        item = self._pop_locked()
+                        if item is not None:
+                            return item
+                        raise Empty
+                    self._cond.wait(remaining)
+
+    def get_nowait(self):
+        with self._cond:
+            item = self._pop_locked()
+            if item is None:
+                raise Empty
+            return item
 
 
 class MicroBatcher:
@@ -72,6 +197,8 @@ class MicroBatcher:
                  workers: int = 1, stats=None,
                  max_queue: int = 0, backpressure: str = "reject",
                  timeout_ms: float = 0.0, health=None,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 tenant_max_share: float = 0.0,
                  name: str = "lambdagap-serve-batcher") -> None:
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
@@ -84,12 +211,15 @@ class MicroBatcher:
         self.backpressure = backpressure
         self.stats = stats
         self.health = health
-        self._q: "queue.Queue" = queue.Queue(maxsize=max(int(max_queue), 0))
+        self._q = FairQueue(maxsize=max(int(max_queue), 0),
+                            weights=tenant_weights,
+                            max_share=tenant_max_share)
         self._closed = False
         # serializes the closed-flag check against enqueue: a submit that
         # saw _closed == False enqueued BEFORE close() put the sentinels,
-        # so FIFO guarantees a worker resolves it (the old check-then-put
-        # race could strand a future on a dead queue forever)
+        # so the fair queue's drain-first contract guarantees a worker
+        # resolves it (the old check-then-put race could strand a future
+        # on a dead queue forever)
         self._submit_lock = threading.Lock()
         # >1 workers overlap independent batch dispatches (jitted calls
         # release the GIL while executing); correctness is per-batch, so
@@ -101,45 +231,49 @@ class MicroBatcher:
             t.start()
 
     # ------------------------------------------------------------------
-    def submit(self, x: np.ndarray) -> Future:
+    def submit(self, x: np.ndarray, model: Optional[str] = None,
+               tenant: Optional[str] = None) -> Future:
         """Enqueue [n, D] float32 rows; returns the Future the worker will
         resolve. Thread-safe. Raises ``RuntimeError`` after close and
-        :class:`ServeOverloaded` when the bounded queue is full under the
-        ``reject`` policy (``block`` waits for space instead)."""
+        :class:`ServeOverloaded` when the bounded queue is full — or the
+        tenant is over its admission quota — under the ``reject`` policy
+        (``block`` waits for space instead)."""
         deadline = (time.perf_counter() + self.timeout
                     if self.timeout > 0 else None)
-        req = Request(x, deadline=deadline)
+        req = Request(x, deadline=deadline, model=model, tenant=tenant)
         while True:
             with self._submit_lock:
                 if self._closed:
                     raise RuntimeError("batcher closed")
-                try:
-                    self._q.put_nowait(req)
+                verdict = self._q.try_put(req)
+                if verdict == "ok":
                     return req.future
-                except queue.Full:
-                    if self.backpressure == "reject":
-                        if self.stats is not None:
-                            self.stats.record_rejected()
+                if self.backpressure == "reject":
+                    if self.stats is not None:
+                        self.stats.record_rejected(tenant=tenant)
+                    if verdict == "quota":
                         raise ServeOverloaded(
-                            f"serve queue full ({self._q.maxsize} requests); "
-                            "retry later or raise serve_max_queue") from None
+                            f"tenant {tenant!r} is over its admission quota "
+                            f"({self._q.max_share:.0%} of "
+                            f"{self._q.maxsize} queue slots); retry later "
+                            "or raise serve_tenant_max_share") from None
+                    raise ServeOverloaded(
+                        f"serve queue full ({self._q.maxsize} requests); "
+                        "retry later or raise serve_max_queue") from None
             # block policy: wait for the workers to drain, outside the lock
             # (never hold the submit lock across a blocking wait)
             time.sleep(0.0005)
 
     def close(self, timeout: float = 30.0) -> None:
         """Stop accepting work, flush everything already queued, join the
-        workers. Queued requests are never dropped: FIFO ordering puts the
-        sentinels after every prior submit, and a worker that misses its
-        sentinel still exits once the queue drains (closed + empty)."""
+        workers. Queued requests are never dropped: the fair queue hands
+        out shutdown sentinels only once every lane is empty, so a worker
+        always drains accepted requests before exiting."""
         with self._submit_lock:
             if self._closed:
                 return
             self._closed = True
-        for _ in self._threads:
-            # blocking put: on a bounded full queue, wait for the workers
-            # to make room (they are draining toward these sentinels)
-            self._q.put(_SENTINEL)
+        self._q.put_sentinel(len(self._threads))
         for t in self._threads:
             t.join(timeout)
 
@@ -152,14 +286,14 @@ class MicroBatcher:
                 f"request deadline expired after {waited * 1e3:.1f}ms in "
                 "queue (serve_timeout_ms); shed before dispatch"))
         if self.stats is not None:
-            self.stats.record_timeout()
+            self.stats.record_timeout(model=req.model, tenant=req.tenant)
 
     def _loop(self) -> None:
         drain = False
         while True:
             try:
                 first = self._q.get(timeout=0.1)
-            except queue.Empty:
+            except Empty:
                 if drain or self._closed:
                     break
                 continue
@@ -178,12 +312,12 @@ class MicroBatcher:
                     # anything already queued still joins this dispatch
                     try:
                         nxt = self._q.get_nowait()
-                    except queue.Empty:
+                    except Empty:
                         break
                 else:
                     try:
                         nxt = self._q.get(timeout=wait)
-                    except queue.Empty:
+                    except Empty:
                         break
                 if nxt is _SENTINEL:
                     drain = True

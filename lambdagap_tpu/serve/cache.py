@@ -130,6 +130,19 @@ class CompiledForestCache:
         if stats is not None:
             stats.record_forest_build()
 
+    @property
+    def hbm_bytes(self) -> int:
+        """Resident device bytes of this compiled forest: the stacked node
+        tables plus the engine's tile/block slices. The registry charges
+        this against ``serve_hbm_budget_mb`` for LRU eviction; executable
+        code size is not counted (XLA does not expose it), so the budget
+        governs the dominant term — the forest arrays themselves."""
+        total = 0
+        for obj in (self._forest, self._blocks, self._tree_class):
+            for leaf in jax.tree_util.tree_leaves(obj):
+                total += getattr(leaf, "nbytes", 0)
+        return int(total)
+
     # ------------------------------------------------------------------
     def bucket_of(self, n: int) -> int:
         """Smallest pre-compiled bucket holding ``n`` rows (requests larger

@@ -1,0 +1,396 @@
+"""Line-protocol socket front end: callers outside the process.
+
+Until this PR every serve caller lived in-process. ``ServeFrontend``
+binds a TCP socket (loopback by default) and speaks newline-delimited
+JSON — one object per line, matching the ``task=serve`` loop verbs:
+
+    {"op": "predict", "id": 1, "x": [[...]], "model": "m", "tenant": "t"}
+    {"op": "swap",    "id": 2, "source": "model_v2.txt", "model": "m"}
+    {"op": "stats",   "id": 3}            {"op": "prometheus", "id": 5}
+    {"op": "health",  "id": 4}            {"op": "models",     "id": 6}
+
+Responses carry the request ``id`` back (predict responses may arrive out
+of submit order — the id is the correlation key):
+
+    {"id": 1, "ok": true, "values": [...], "generation": 0}
+    {"id": 2, "ok": false, "error": "...", "kind": "SwapFailed"}
+
+A malformed frame (bad JSON, unknown op, bad shapes) answers an
+``ok=false`` frame with a null id and the connection SURVIVES — a
+confused client must not take down its neighbors' streams. Numeric
+fidelity: JSON floats carry Python's shortest-roundtrip repr, and
+float32 -> float64 -> JSON -> float64 -> float32 is exact, so frontend
+responses stay bit-identical to in-process serving (the parity test
+asserts it).
+
+``FrontendClient`` is the matching caller: ``submit`` returns a Future
+resolved by a reader thread; when the socket dies, every pending future
+resolves with :class:`~lambdagap_tpu.guard.ReplicaUnavailable` — never a
+hang (R8 discipline) — which is exactly the signal
+:class:`~lambdagap_tpu.serve.router.RemoteReplica` converts into
+failover.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..guard.degrade import (ReplicaUnavailable, ServeOverloaded,
+                             ServeTimeout, SwapFailed, SwapRejected)
+from ..utils import log
+
+# wire error kinds <-> exception classes (client re-raises the real type,
+# so router/loadgen accounting is identical for local and remote replicas)
+_KINDS = {
+    "ServeOverloaded": ServeOverloaded,
+    "ServeTimeout": ServeTimeout,
+    "SwapFailed": SwapFailed,
+    "SwapRejected": SwapRejected,
+    "ValueError": ValueError,
+    "KeyError": KeyError,
+}
+
+
+def _error_frame(req_id, exc) -> dict:
+    kind = type(exc).__name__
+    return {"id": req_id, "ok": False, "error": str(exc),
+            "kind": kind if kind in _KINDS else "RuntimeError"}
+
+
+class _Conn:
+    """One accepted client connection: a reader loop + a serialized
+    writer. Predict responses are written from batcher worker threads
+    (future callbacks), so the send side takes a per-connection mutex."""
+
+    def __init__(self, sock: socket.socket, frontend: "ServeFrontend"
+                 ) -> None:
+        self.sock = sock
+        self.frontend = frontend
+        self._tx = threading.Lock()
+        self._open = True
+
+    def send(self, frame: dict) -> None:
+        data = (json.dumps(frame) + "\n").encode()
+        try:
+            with self._tx:
+                if self._open:
+                    # graftlint: disable=R5 — deliberate: frames must not
+                    # interleave, so mutual exclusion must span the whole
+                    # write; frames are small, the socket is loopback-class,
+                    # and the only contenders are this conn's reply callbacks
+                    self.sock.sendall(data)
+        except OSError:
+            # client went away mid-response; its futures already resolved
+            # server-side, nothing to strand
+            self._open = False
+
+    def run(self) -> None:
+        f = self.sock.makefile("rb")
+        try:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                self.handle(raw)
+        except OSError as e:
+            log.debug("frontend: connection reset (%s) — normal teardown", e)
+        finally:
+            self._open = False
+            try:
+                self.sock.close()
+            except OSError:
+                log.debug("frontend: close raced the peer reset")
+            self.frontend._forget(self)
+
+    def handle(self, raw: bytes) -> None:
+        try:
+            frame = json.loads(raw.decode())
+            if not isinstance(frame, dict):
+                raise ValueError("frame must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            self.send({"id": None, "ok": False,
+                       "error": f"malformed frame: {e}",
+                       "kind": "ValueError"})
+            return
+        req_id = frame.get("id")
+        op = frame.get("op")
+        try:
+            handler = getattr(self, f"_op_{op}", None) if op else None
+            if handler is None or not isinstance(op, str) \
+                    or op.startswith("_"):
+                raise ValueError(f"unknown op {op!r}")
+            handler(req_id, frame)
+        except Exception as e:           # op-level failure: answer, survive
+            self.send(_error_frame(req_id, e))
+
+    # -- ops ------------------------------------------------------------
+    def _op_predict(self, req_id, frame) -> None:
+        x = np.asarray(frame["x"], dtype=np.float32)
+        fut = self.frontend.target.submit(x, model=frame.get("model"),
+                                          tenant=frame.get("tenant"))
+
+        def reply(f: Future) -> None:
+            exc = f.exception()
+            if exc is not None:
+                self.send(_error_frame(req_id, exc))
+                return
+            res = f.result()
+            self.send({"id": req_id, "ok": True,
+                       "values": np.asarray(res.values).tolist(),
+                       "generation": int(res.generation)})
+
+        fut.add_done_callback(reply)
+
+    def _op_swap(self, req_id, frame) -> None:
+        kwargs = {}
+        if frame.get("model") is not None:
+            kwargs["model"] = frame["model"]
+        gen = self.frontend.target.swap(frame["source"], **kwargs)
+        self.send({"id": req_id, "ok": True, "generation": int(gen)})
+
+    def _op_stats(self, req_id, frame) -> None:
+        self.send({"id": req_id, "ok": True,
+                   "stats": self.frontend.target.stats_snapshot()})
+
+    def _op_prometheus(self, req_id, frame) -> None:
+        self.send({"id": req_id, "ok": True,
+                   "text": self.frontend.target.prometheus()})
+
+    def _op_health(self, req_id, frame) -> None:
+        health = self.frontend.target.health
+        self.send({"id": req_id, "ok": True, "state": health.state(),
+                   "snapshot": health.snapshot()})
+
+    def _op_models(self, req_id, frame) -> None:
+        self.send({"id": req_id, "ok": True,
+                   "models": self.frontend.target.models()})
+
+
+class ServeFrontend:
+    """TCP front end for one serve target (a ForestServer — or anything
+    with the same submit/swap/stats/health surface). ``port=0`` binds an
+    ephemeral port, exposed as :attr:`port` after :meth:`start`."""
+
+    def __init__(self, target, host: str = "127.0.0.1", port: int = 0,
+                 backlog: int = 64) -> None:
+        self.target = target
+        self.host = host
+        self._port = int(port)
+        self._backlog = int(backlog)
+        self._sock: Optional[socket.socket] = None
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> "ServeFrontend":
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind((self.host, self._port))
+        sock.listen(self._backlog)
+        self._port = sock.getsockname()[1]
+        self._sock = sock
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"lambdagap-serve-frontend-{self._port}")
+        self._accept_thread.start()
+        log.info("serve frontend listening on %s:%d (newline-JSON "
+                 "protocol; ops: predict/swap/stats/prometheus/health/"
+                 "models)", self.host, self._port)
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                client, addr = self._sock.accept()
+            except OSError:
+                break                    # listener closed
+            client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(client, self)
+            with self._conn_lock:
+                self._conns.add(conn)
+            threading.Thread(target=conn.run, daemon=True,
+                             name=f"lambdagap-serve-conn-{addr[1]}").start()
+
+    def _forget(self, conn: _Conn) -> None:
+        with self._conn_lock:
+            self._conns.discard(conn)
+
+    def close(self) -> None:
+        """Stop accepting and drop connections. The target server is NOT
+        closed — the frontend is a door, not the house."""
+        self._closed = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                log.debug("frontend: listener close raced")
+        with self._conn_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                log.debug("frontend: conn shutdown raced")
+        if self._accept_thread is not None:
+            self._accept_thread.join(5.0)
+
+    def __enter__(self) -> "ServeFrontend":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class FrontendClient:
+    """Async client for :class:`ServeFrontend`: one socket, one reader
+    thread, futures correlated by request id."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0) -> None:
+        from .server import ServeResult
+        self._result_type = ServeResult
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.settimeout(None)       # reader blocks; writes are sendall
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._tx = threading.Lock()
+        self._pending_lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._next_id = 0
+        self.alive = True
+        self._reader = threading.Thread(
+            target=self._read_loop, daemon=True,
+            name=f"lambdagap-serve-client-{port}")
+        self._reader.start()
+
+    # ------------------------------------------------------------------
+    def _send(self, frame: dict) -> Future:
+        fut: Future = Future()
+        with self._pending_lock:
+            if not self.alive:
+                raise ReplicaUnavailable("frontend connection is closed")
+            self._next_id += 1
+            frame["id"] = self._next_id
+            self._pending[self._next_id] = fut
+        data = (json.dumps(frame) + "\n").encode()
+        try:
+            with self._tx:
+                # graftlint: disable=R5 — deliberate, mirror of
+                # _Conn.send: whole-frame writes must not interleave, and
+                # the submit path is the only contender on this mutex
+                self.sock.sendall(data)
+        except OSError as e:
+            self._die(e)
+            raise ReplicaUnavailable(
+                f"frontend connection died mid-send: {e}") from e
+        return fut
+
+    def _read_loop(self) -> None:
+        f = self.sock.makefile("rb")
+        err: Exception = ReplicaUnavailable("frontend connection closed")
+        try:
+            for raw in f:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    frame = json.loads(raw.decode())
+                except ValueError:
+                    log.warning("frontend client: undecodable frame %r",
+                                raw[:80])
+                    continue
+                self._resolve(frame)
+        except OSError as e:
+            err = ReplicaUnavailable(f"frontend connection died: {e}")
+        self._die(err)
+
+    def _resolve(self, frame: dict) -> None:
+        with self._pending_lock:
+            fut = self._pending.pop(frame.get("id"), None)
+        if fut is None:
+            return                       # stats pushed for a forgotten id
+        if frame.get("ok"):
+            if "values" in frame:
+                fut.set_result(self._result_type(
+                    np.asarray(frame["values"], dtype=np.float32),
+                    int(frame.get("generation", -1))))
+            else:
+                fut.set_result(frame)
+        else:
+            exc_type = _KINDS.get(frame.get("kind"), RuntimeError)
+            fut.set_exception(exc_type(frame.get("error", "remote error")))
+
+    def _die(self, exc: Exception) -> None:
+        """Terminal: resolve EVERY pending future with the transport
+        error so no caller hangs on a dead socket."""
+        with self._pending_lock:
+            if not self.alive:
+                return
+            self.alive = False
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for fut in pending:
+            if not fut.done():
+                fut.set_exception(exc)
+        try:
+            self.sock.close()
+        except OSError:
+            log.debug("frontend client: close raced the reset")
+
+    # -- API ------------------------------------------------------------
+    def submit(self, x, model: Optional[str] = None,
+               tenant: Optional[str] = None) -> Future:
+        x = np.asarray(x, dtype=np.float32)
+        if x.ndim == 1:
+            x = x[None, :]
+        frame = {"op": "predict", "x": x.tolist()}
+        if model is not None:
+            frame["model"] = model
+        if tenant is not None:
+            frame["tenant"] = tenant
+        return self._send(frame)
+
+    def predict(self, x, timeout: Optional[float] = None,
+                model: Optional[str] = None,
+                tenant: Optional[str] = None) -> np.ndarray:
+        return self.submit(x, model=model, tenant=tenant).result(
+            timeout).values
+
+    def _call(self, op: str, timeout: Optional[float] = 30.0, **kw) -> dict:
+        frame = {"op": op}
+        frame.update({k: v for k, v in kw.items() if v is not None})
+        return self._send(frame).result(timeout)
+
+    def swap(self, source, model: Optional[str] = None,
+             timeout: Optional[float] = 120.0) -> int:
+        return int(self._call("swap", timeout=timeout, source=source,
+                              model=model)["generation"])
+
+    def stats(self, timeout: Optional[float] = 30.0) -> dict:
+        return self._call("stats", timeout=timeout)["stats"]
+
+    def prometheus(self, timeout: Optional[float] = 30.0) -> str:
+        return self._call("prometheus", timeout=timeout)["text"]
+
+    def health(self, timeout: Optional[float] = 30.0) -> str:
+        return self._call("health", timeout=timeout)["state"]
+
+    def models(self, timeout: Optional[float] = 30.0) -> list:
+        return self._call("models", timeout=timeout)["models"]
+
+    def close(self) -> None:
+        self._die(ReplicaUnavailable("frontend client closed"))
+
+    def __enter__(self) -> "FrontendClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

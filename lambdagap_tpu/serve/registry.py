@@ -1,0 +1,337 @@
+"""Multi-model registry: N compiled forests resident under an HBM budget.
+
+PR 1's serve stack owned exactly one model: ``ForestServer`` held one
+:class:`~lambdagap_tpu.serve.cache.CompiledForestCache` behind one swap
+pointer. A fleet serves many models from one chip, so ownership moves
+here: the registry owns every compiled forest, its padding buckets, its
+generation pointer, and its hot-swap — the server keeps only policy
+(batching, shedding, health).
+
+Residency is governed by an explicit byte budget (``serve_hbm_budget_mb``):
+each compiled forest charges its device-array footprint
+(:attr:`CompiledForestCache.hbm_bytes`), and admitting a forest past the
+budget evicts least-recently-used models first. Eviction frees the device
+forest and its compiled executables but RETAINS the host-side model and
+the generation pointer, so a later request re-admits it with exactly one
+recompile and an unchanged generation — evictions and re-admissions are
+counted in :class:`~lambdagap_tpu.serve.stats.ServeStats` because every
+one of them is a latency cliff an operator must see.
+
+Lock discipline (graftlint R5): the registry lock guards only the name
+map, LRU metadata, and pointer flips — forest loads and compiles happen
+OUTSIDE it. Concurrent first-uses of an evicted model single-flight
+through a per-entry pending event (waiters park on the event, not on a
+lock held across the compile); concurrent swaps of one model serialize on
+that entry's writer lock exactly like the PR 1 ``SwapController`` did.
+
+Generation semantics are per model: every model's generations count up
+from 0 independently, every response carries the generation that produced
+it, and a swap pre-warms before the pointer flip — in-flight batches
+finish on the forest they started with.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..guard.degrade import CircuitBreaker, SwapFailed, SwapRejected
+from ..utils import log
+from .swap import load_booster
+
+DEFAULT_MODEL = "default"
+
+
+class ModelEntry:
+    """One registered model: host booster + (maybe) its compiled forest.
+
+    ``cache`` is the residency pointer — ``None`` means evicted. It is
+    read lock-free by the dispatch path (an atomic reference under the
+    GIL); all writes happen under the registry lock. ``breaker`` guards
+    this model's hot-swaps. ``active`` aliases ``cache`` for
+    compatibility with the PR 1 single-model swap-controller surface.
+    """
+
+    __slots__ = ("name", "gbdt", "generation", "cache", "bytes", "width",
+                 "engine", "buckets", "builds", "last_used", "breaker",
+                 "pending", "swap_lock")
+
+    def __init__(self, name: str, breaker: CircuitBreaker) -> None:
+        self.name = name
+        self.gbdt = None
+        self.generation = -1             # no generation admitted yet
+        self.cache = None                # CompiledForestCache or None
+        self.bytes = 0
+        self.width = 1
+        self.engine = "tensor"
+        self.buckets: tuple = ()
+        self.builds = 0                  # compiles: install + swaps + readmits
+        self.last_used = 0
+        self.breaker = breaker
+        self.pending: Optional[threading.Event] = None   # single-flight
+        self.swap_lock = threading.Lock()                # writers only
+
+    @property
+    def active(self):
+        return self.cache
+
+    @property
+    def resident(self) -> bool:
+        return self.cache is not None
+
+
+class ModelRegistry:
+    """Name -> :class:`ModelEntry` map with LRU eviction under a byte
+    budget.
+
+    ``build_cache(gbdt, generation) -> CompiledForestCache`` is supplied
+    by the server (it closes over the bucket/engine/warmup policy); the
+    registry decides *when* to call it — install, swap, re-admission —
+    and what to evict to make the result fit.
+    """
+
+    def __init__(self, build_cache: Callable, stats=None,
+                 hbm_budget_bytes: int = 0,
+                 breaker_threshold: int = 3) -> None:
+        self._build = build_cache
+        self._stats = stats
+        self.hbm_budget_bytes = int(hbm_budget_bytes)
+        self._breaker_threshold = int(breaker_threshold)
+        self._lock = threading.Lock()    # name map + LRU metadata + flips
+        self._entries: Dict[str, ModelEntry] = {}
+        self._seq = itertools.count(1)
+
+    # -- introspection --------------------------------------------------
+    def has(self, name: str) -> bool:
+        with self._lock:
+            return name in self._entries
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def entry(self, name: str) -> ModelEntry:
+        with self._lock:
+            e = self._entries.get(name)
+        if e is None:
+            raise KeyError(f"unknown serve model {name!r} "
+                           f"(registered: {self.names() or 'none'})")
+        return e
+
+    def generation(self, name: str = DEFAULT_MODEL) -> int:
+        return self.entry(name).generation
+
+    # -- admission ------------------------------------------------------
+    def install(self, name: str, source, params=None) -> int:
+        """Register a new model under ``name`` and compile it (generation
+        0). Duplicate names are an error — use :meth:`swap` to replace a
+        registered model's forest."""
+        breaker = CircuitBreaker(threshold=self._breaker_threshold)
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(f"serve model {name!r} is already "
+                                 "registered; swap() replaces it")
+            e = self._entries[name] = ModelEntry(name, breaker)
+            # a get() racing the install parks on this event instead of
+            # finding a half-built entry
+            e.pending = threading.Event()
+        try:
+            gbdt = load_booster(source, params)
+            cache = self._build(gbdt, 0)
+            self._admit(e, gbdt, cache)
+        except Exception:
+            with self._lock:             # failed install leaves no entry
+                self._entries.pop(name, None)
+            raise
+        finally:
+            with self._lock:
+                ev, e.pending = e.pending, None
+            ev.set()
+        log.info("serve registry: installed model %r (%d bytes resident, "
+                 "%d models registered)", name, e.bytes, len(self._entries))
+        return 0
+
+    def get(self, name: str = DEFAULT_MODEL):
+        """The resident compiled forest for ``name`` — touching LRU, and
+        re-admitting (ONE recompile, generation preserved) if the model
+        was evicted. Concurrent callers of an evicted model single-flight
+        the rebuild; the losers park on an event, never on a lock held
+        across the compile."""
+        while True:
+            with self._lock:
+                e = self._entries.get(name)
+                if e is None:
+                    raise KeyError(f"unknown serve model {name!r} "
+                                   f"(registered: "
+                                   f"{sorted(self._entries) or 'none'})")
+                e.last_used = next(self._seq)
+                cache = e.cache
+                if cache is not None:
+                    return cache
+                if e.pending is None:
+                    e.pending = threading.Event()
+                    waiter = None
+                else:
+                    waiter = e.pending
+                gbdt, gen = e.gbdt, e.generation
+            if waiter is not None:
+                waiter.wait(60.0)
+                continue
+            try:
+                cache = self._build(gbdt, gen)   # outside every lock
+                admitted = self._admit(e, gbdt, cache, readmission=True,
+                                       expect_generation=gen)
+            finally:
+                with self._lock:
+                    ev, e.pending = e.pending, None
+                ev.set()
+            if not admitted:
+                # a concurrent swap published a newer generation while we
+                # rebuilt the old one: drop the stale build and re-resolve
+                continue
+            log.info("serve registry: re-admitted evicted model %r "
+                     "(generation %d preserved, %d bytes)", name,
+                     e.generation, e.bytes)
+            return cache
+
+    def swap(self, name: str, source, params=None,
+             background: bool = False):
+        """Replace model ``name``'s forest (path / model text / Booster /
+        GBDT): load + compile + pre-warm OFF the serving path, then flip
+        the entry's residency pointer. A failed load/compile raises
+        :class:`SwapFailed` without touching the old forest (structural
+        rollback) and feeds this model's circuit breaker; an open circuit
+        rejects up front with :class:`SwapRejected`. Works on evicted
+        entries too — the swap admits the NEW forest, so the old one is
+        never recompiled just to be replaced."""
+        e = self.entry(name)
+
+        def work() -> int:
+            if not e.breaker.allow():
+                raise SwapRejected(
+                    f"swap circuit for model {name!r} open after "
+                    f"{e.breaker.consecutive_failures} consecutive "
+                    f"failures; serving continues on generation "
+                    f"{e.generation} (cooldown {e.breaker.cooldown_s:g}s)")
+            try:
+                gbdt = load_booster(source, params)
+                with e.swap_lock:
+                    gen = e.generation + 1
+                    # graftlint: disable=R5 — deliberate, the PR 1
+                    # SwapController discipline: swap_lock serializes
+                    # WRITERS of one entry only (concurrent swaps apply in
+                    # call order); the dispatch path reads entry.cache
+                    # lock-free, so the build convoys no request
+                    cache = self._build(gbdt, gen)
+                    self._admit(e, gbdt, cache)
+            except Exception as exc:
+                e.breaker.record_failure()
+                if self._stats is not None:
+                    self._stats.record_swap_failure()
+                log.warning("serve registry: swap of model %r failed (%s); "
+                            "generation %d keeps serving (breaker: %s)",
+                            name, exc, e.generation, e.breaker.state())
+                raise SwapFailed(
+                    f"swap of model {name!r} failed ({exc}); serving "
+                    f"continues on generation {e.generation}") from exc
+            e.breaker.record_success()
+            if self._stats is not None:
+                self._stats.record_swap()
+            log.info("serve registry: swapped model %r to generation %d "
+                     "(%s engine, pre-warmed before the flip)", name, gen,
+                     cache.engine)
+            return gen
+
+        if background:
+            t = threading.Thread(target=work, daemon=True,
+                                 name=f"lambdagap-serve-swap-{name}")
+            t.start()
+            return t
+        return work()
+
+    def remove(self, name: str) -> None:
+        """Forget a model entirely (device AND host side). In-flight
+        batches that already hold its compiled forest finish normally."""
+        with self._lock:
+            e = self._entries.pop(name, None)
+        if e is None:
+            raise KeyError(f"unknown serve model {name!r}")
+        log.info("serve registry: removed model %r", name)
+
+    # -- residency ------------------------------------------------------
+    def _admit(self, e: ModelEntry, gbdt, cache, readmission: bool = False,
+               expect_generation: Optional[int] = None) -> bool:
+        """Flip ``e`` to the freshly built ``cache``, evicting LRU models
+        first when the budget demands it. The build already happened —
+        admission is pointer work under the registry lock. With
+        ``expect_generation`` set (re-admission), the flip is abandoned if
+        a concurrent swap already published a newer generation — a stale
+        rebuild must never roll a model back."""
+        need = cache.hbm_bytes
+        evicted: List[str] = []
+        with self._lock:
+            if (expect_generation is not None
+                    and e.generation != expect_generation):
+                return False
+            if self.hbm_budget_bytes > 0:
+                resident = sorted(
+                    (o for o in self._entries.values()
+                     if o is not e and o.cache is not None),
+                    key=lambda o: o.last_used)
+                used = sum(o.bytes for o in resident) + (
+                    e.bytes if e.cache is not None else 0)
+                for victim in resident:
+                    if used + need <= self.hbm_budget_bytes:
+                        break
+                    victim.cache = None          # atomic un-publish
+                    used -= victim.bytes
+                    evicted.append(victim.name)
+                if used + need > self.hbm_budget_bytes:
+                    log.warning(
+                        "serve registry: model %r alone (%d bytes) exceeds "
+                        "serve_hbm_budget_mb (%d bytes); admitting anyway "
+                        "— the budget bounds the fleet, one model is the "
+                        "floor", e.name, need, self.hbm_budget_bytes)
+            e.gbdt = gbdt
+            e.generation = cache.generation
+            e.cache = cache
+            e.bytes = need
+            e.width = cache.width
+            e.engine = cache.engine
+            e.buckets = tuple(cache.buckets)
+            e.builds += 1
+            e.last_used = next(self._seq)
+        for name in evicted:
+            if self._stats is not None:
+                self._stats.record_eviction(model=name)
+            log.info("serve registry: evicted model %r under the HBM "
+                     "budget (host model retained; next use recompiles)",
+                     name)
+        if readmission and self._stats is not None:
+            self._stats.record_readmission(model=e.name)
+        return True
+
+    # -- reporting ------------------------------------------------------
+    def snapshot(self) -> Dict:
+        with self._lock:
+            models = {}
+            resident_bytes = 0
+            for name, e in sorted(self._entries.items()):
+                models[name] = {
+                    "resident": e.cache is not None,
+                    "generation": e.generation,
+                    "hbm_bytes": e.bytes if e.cache is not None else 0,
+                    "builds": e.builds,
+                    "width": e.width,
+                    "engine": e.engine,
+                }
+                if e.cache is not None:
+                    resident_bytes += e.bytes
+            return {
+                "models": models,
+                "resident_models": sum(1 for m in models.values()
+                                       if m["resident"]),
+                "registered_models": len(models),
+                "hbm_bytes_resident": resident_bytes,
+                "hbm_budget_bytes": self.hbm_budget_bytes,
+            }

@@ -1,0 +1,381 @@
+"""Replica router: health-aware dispatch over shared-nothing serve workers.
+
+A fleet is several :class:`~lambdagap_tpu.serve.server.ForestServer`
+replicas — in-process (:class:`LocalReplica`) or behind a socket front end
+(:class:`RemoteReplica`, serve/frontend.py) — that share NOTHING: each has
+its own registry, batcher, and device executables. The router owns only
+dispatch policy:
+
+- **health-aware placement**: replicas reporting ``ok`` are preferred;
+  ``degraded`` replicas serve only when no ok replica exists; ``draining``
+  and dead replicas never take new work. Among candidates the least
+  outstanding-requests replica wins (join-shortest-queue).
+- **failover, never stranding** (graftlint R8 discipline): a request whose
+  replica dies mid-flight — transport error, closed server, injected
+  dispatch fault — is resubmitted once per remaining live replica; only
+  when every replica has been tried (or none exists) does the caller see
+  :class:`~lambdagap_tpu.guard.ReplicaUnavailable`. Every future the
+  router hands out therefore terminates: result, per-request error
+  (shape/timeout/overload), or an explicit no-replica rejection.
+- **overload spill**: a replica rejecting at admission
+  (:class:`ServeOverloaded`) is treated as momentarily full, and the
+  request spills to the next candidate; only an all-full fleet surfaces
+  the rejection.
+
+Request-level failures (``ServeTimeout``, shape errors, unknown model) are
+NOT failed over: the request itself is at fault, and replaying it
+elsewhere would double latency for a deterministic error.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+from ..guard.degrade import (DEGRADED, DRAINING, OK, ReplicaUnavailable,
+                             ServeOverloaded)
+from ..guard.faults import InjectedFault
+from ..utils import log
+
+# exceptions that indict the REPLICA, not the request: these trigger
+# failover to another replica (transport failures additionally mark the
+# replica dead until the router is rebuilt)
+FAILOVER_EXCEPTIONS = (ReplicaUnavailable, ConnectionError, OSError,
+                       InjectedFault)
+_DEAD_MARKING = (ReplicaUnavailable, ConnectionError, OSError)
+
+
+class LocalReplica:
+    """An in-process ForestServer as a routable replica."""
+
+    def __init__(self, name: str, server) -> None:
+        self.name = name
+        self.server = server
+
+    def submit(self, x, model: Optional[str] = None,
+               tenant: Optional[str] = None) -> Future:
+        try:
+            return self.server.submit(x, model=model, tenant=tenant)
+        except RuntimeError as e:
+            if "closed" in str(e):       # a closed server is a dead replica
+                raise ReplicaUnavailable(
+                    f"replica {self.name!r} is closed") from e
+            raise
+
+    def health(self) -> str:
+        return self.server.health.state()
+
+    def close(self) -> None:
+        self.server.close()
+
+
+class RemoteReplica:
+    """A serve worker behind a socket frontend (serve/frontend.py) as a
+    routable replica. Health is polled over the wire and cached for
+    ``health_ttl_s`` so the dispatch path never blocks on a health RPC; a
+    transport failure reports the replica dead immediately."""
+
+    def __init__(self, name: str, host: str, port: int,
+                 health_ttl_s: float = 0.5, connect_timeout: float = 5.0
+                 ) -> None:
+        from .frontend import FrontendClient
+        self.name = name
+        self.client = FrontendClient(host, port, timeout=connect_timeout)
+        self._ttl = float(health_ttl_s)
+        self._health = OK
+        self._health_at = 0.0
+        self._health_lock = threading.Lock()
+
+    def submit(self, x, model: Optional[str] = None,
+               tenant: Optional[str] = None) -> Future:
+        return self.client.submit(x, model=model, tenant=tenant)
+
+    def health(self) -> str:
+        import time
+        if not self.client.alive:
+            return "dead"
+        now = time.perf_counter()
+        with self._health_lock:
+            fresh = now - self._health_at < self._ttl
+            if fresh:
+                return self._health
+            self._health_at = now        # one prober per TTL window
+        try:
+            state = self.client.health(timeout=self._ttl)
+        except Exception:                # transport failed: replica is dead
+            state = "dead"
+        with self._health_lock:
+            self._health = state
+        return state
+
+    def close(self) -> None:
+        self.client.close()
+
+
+class Router:
+    """Health-aware dispatch + failover over a replica group.
+
+    ``replicas`` can mix :class:`LocalReplica` and :class:`RemoteReplica`.
+    ``own_replicas=True`` makes :meth:`close` close them too.
+    """
+
+    def __init__(self, replicas: Sequence, own_replicas: bool = False
+                 ) -> None:
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        names = [r.name for r in replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        self._replicas = list(replicas)
+        self._own = bool(own_replicas)
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, int] = {r.name: 0 for r in replicas}
+        self._routed: Dict[str, int] = {r.name: 0 for r in replicas}
+        self._dead: Dict[str, bool] = {r.name: False for r in replicas}
+        self._failovers = 0
+        self._rejected_no_replica = 0
+        self._closed = False
+
+    # -- dispatch -------------------------------------------------------
+    def submit(self, x, model: Optional[str] = None,
+               tenant: Optional[str] = None) -> "Future":
+        """Route one request; returns a Future of ``ServeResult``. The
+        future ALWAYS terminates: a dead replica's in-flight requests are
+        failed over to the remaining live replicas, and only a fleet with
+        no live replica rejects (:class:`ReplicaUnavailable`)."""
+        if self._closed:
+            raise RuntimeError("router closed")
+        outer: Future = Future()
+        self._attempt(outer, x, model, tenant, tried=set())
+        return outer
+
+    def predict(self, x, timeout: Optional[float] = None,
+                model: Optional[str] = None,
+                tenant: Optional[str] = None):
+        return self.submit(x, model=model, tenant=tenant).result(
+            timeout).values
+
+    def _pick(self, tried: set):
+        """Least-loaded replica among the healthiest available tier."""
+        with self._lock:
+            candidates = [r for r in self._replicas
+                          if r.name not in tried and not self._dead[r.name]]
+        by_state: Dict[str, List] = {}
+        for r in candidates:
+            try:
+                state = r.health()
+            except Exception:            # pragma: no cover — health probe
+                state = "dead"           # died under us: skip it
+            if state in (DRAINING, "dead"):
+                if state == "dead":
+                    self._mark_dead(r)
+                continue
+            by_state.setdefault(state, []).append(r)
+        tier = by_state.get(OK) or by_state.get(DEGRADED) or []
+        if not tier:
+            return None
+        with self._lock:
+            return min(tier, key=lambda r: self._inflight[r.name])
+
+    def _attempt(self, outer: Future, x, model, tenant, tried: set) -> None:
+        while True:
+            replica = self._pick(tried)
+            if replica is None:
+                with self._lock:
+                    self._rejected_no_replica += 1
+                outer.set_exception(ReplicaUnavailable(
+                    "no live replica can take the request "
+                    f"(tried: {sorted(tried) or 'none'})"))
+                return
+            tried.add(replica.name)
+            try:
+                inner = replica.submit(x, model=model, tenant=tenant)
+            # graftlint: disable=R8 — the continue re-enters the pick
+            # loop, every exit of which terminates the future: a
+            # successful submit chains resolution to on_done, and an
+            # exhausted fleet set_exception()s ReplicaUnavailable above
+            except FAILOVER_EXCEPTIONS as e:
+                self._note_failure(replica, e)
+                continue                 # submit-time failover
+            # graftlint: disable=R8 — same loop contract as above: spill
+            # to a peer, or the empty-pick branch resolves the future
+            except ServeOverloaded:
+                with self._lock:
+                    self._failovers += 1
+                continue                 # overload spill: try a peer
+            except Exception as e:
+                outer.set_exception(e)   # request-level error: no replay
+                return
+            break
+        with self._lock:
+            self._inflight[replica.name] += 1
+            self._routed[replica.name] += 1
+
+        def on_done(f: Future) -> None:
+            with self._lock:
+                self._inflight[replica.name] -= 1
+            exc = f.exception()
+            if exc is None:
+                outer.set_result(f.result())
+            elif isinstance(exc, FAILOVER_EXCEPTIONS):
+                # in-flight failover: the replica died under the request
+                self._note_failure(replica, exc)
+                self._attempt(outer, x, model, tenant, tried)
+            else:
+                outer.set_exception(exc)
+
+        inner.add_done_callback(on_done)
+
+    def _mark_dead(self, replica) -> None:
+        with self._lock:
+            already = self._dead[replica.name]
+            self._dead[replica.name] = True
+        if not already:
+            log.warning("router: replica %r reports dead health; removed "
+                        "from dispatch", replica.name)
+
+    def _note_failure(self, replica, exc) -> None:
+        with self._lock:
+            self._failovers += 1
+            if isinstance(exc, _DEAD_MARKING):
+                self._dead[replica.name] = True
+        log.warning("router: replica %r failed (%s); failing over%s",
+                    replica.name, exc,
+                    " and marking it dead"
+                    if isinstance(exc, _DEAD_MARKING) else "")
+
+    # -- fleet-wide operations (ForestServer-compatible surface, so a
+    # -- ServeFrontend can front a whole replica group) -----------------
+    def swap(self, source, params=None, model: Optional[str] = None,
+             background: bool = False):
+        """Fleet-wide model rollout: swap on EVERY live replica, in name
+        order. Returns the last replica's new generation. A replica whose
+        swap fails keeps its old forest (per-replica rollback) and the
+        failure propagates after the remaining replicas were still
+        attempted — a partial rollout is visible, not silent."""
+        last = None
+        first_exc = None
+        for r in sorted(self._replicas, key=lambda r: r.name):
+            with self._lock:
+                if self._dead[r.name]:
+                    continue
+            kwargs = {} if model is None else {"model": model}
+            try:
+                if hasattr(r, "server"):
+                    last = r.server.swap(source, params=params, **kwargs)
+                else:
+                    last = r.client.swap(source, **kwargs)
+            except Exception as e:
+                if first_exc is None:
+                    first_exc = e
+                log.warning("router: swap on replica %r failed: %s",
+                            r.name, e)
+        if first_exc is not None:
+            raise first_exc
+        return last
+
+    def models(self) -> List[str]:
+        """The first live replica's registry listing (replicas of one
+        fleet serve the same model set by construction)."""
+        for r in self._replicas:
+            with self._lock:
+                if self._dead[r.name]:
+                    continue
+            try:
+                if hasattr(r, "server"):
+                    return r.server.models()
+                return r.client.models()
+            except Exception:            # pragma: no cover — probe only
+                continue
+        return []
+
+    @property
+    def health(self) -> "_FleetHealth":
+        return _FleetHealth(self)
+
+    def stats_snapshot(self) -> dict:
+        """Router snapshot + every live replica's own stats, keyed by
+        replica name — the fleet-level analog of
+        ``ForestServer.stats_snapshot``."""
+        out = {"router": self.snapshot(), "replicas": {}}
+        for r in self._replicas:
+            with self._lock:
+                if self._dead[r.name]:
+                    continue
+            try:
+                if hasattr(r, "server"):
+                    out["replicas"][r.name] = r.server.stats_snapshot()
+                else:
+                    out["replicas"][r.name] = r.client.stats()
+            except Exception as e:
+                out["replicas"][r.name] = {"unreachable": str(e)}
+        return out
+
+    def prometheus(self) -> str:
+        from ..obs import prom
+        return prom.render_router(self.snapshot())
+
+    # -- reporting / lifecycle -----------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            replicas = {
+                r.name: {
+                    "inflight": self._inflight[r.name],
+                    "routed": self._routed[r.name],
+                    "dead": self._dead[r.name],
+                } for r in self._replicas
+            }
+            out = {
+                "replicas": replicas,
+                "failovers": self._failovers,
+                "rejected_no_replica": self._rejected_no_replica,
+            }
+        for r in self._replicas:         # health probes outside the lock
+            try:
+                replicas[r.name]["health"] = (
+                    "dead" if out["replicas"][r.name]["dead"]
+                    else r.health())
+            except Exception:            # pragma: no cover
+                replicas[r.name]["health"] = "dead"
+        return out
+
+    def close(self) -> None:
+        self._closed = True
+        if self._own:
+            for r in self._replicas:
+                try:
+                    r.close()
+                except Exception as e:   # a dead replica may fail to close
+                    log.warning("router: closing replica %r failed: %s",
+                                r.name, e)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _FleetHealth:
+    """Aggregate health view over a router's replicas: ``ok`` while any
+    replica is ok, ``degraded`` while only degraded replicas remain, and
+    ``draining`` when nothing can take a request — the same three honest
+    answers a single server gives, lifted to the fleet."""
+
+    def __init__(self, router: Router) -> None:
+        self._router = router
+
+    def state(self) -> str:
+        states = [info["health"]
+                  for info in self._router.snapshot()["replicas"].values()]
+        if OK in states:
+            return OK
+        if DEGRADED in states:
+            return DEGRADED
+        return DRAINING
+
+    def snapshot(self) -> dict:
+        snap = self._router.snapshot()
+        return {"state": self.state(),
+                "replicas": {name: info["health"]
+                             for name, info in snap["replicas"].items()}}

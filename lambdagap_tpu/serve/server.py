@@ -1,13 +1,15 @@
 """ForestServer: the serving front door.
 
-Composes the three serving pieces — :class:`CompiledForestCache`
-(compile-once device forest + padding buckets), :class:`MicroBatcher`
-(request coalescing) and :class:`SwapController` (atomic hot-swap) — behind
-a two-call API::
+Composes the serving pieces — :class:`ModelRegistry` (N compiled forests
+under an HBM budget, per-model generations + hot-swap),
+:class:`MicroBatcher` (request coalescing with weighted tenant fairness)
+and the guard degradation layer — behind a two-call API::
 
     server = booster.as_server()          # or ForestServer(booster)
     y = server.predict(x_row)             # blocking, batched under the hood
     fut = server.submit(rows)             # async: Future[ServeResult]
+    server.add_model("b", "model_b.txt")  # multi-model registry
+    y_b = server.predict(x_row, model="b")
     server.swap("model_v2.txt")           # zero-downtime model replace
     print(server.stats_json())
     server.close()
@@ -15,22 +17,28 @@ a two-call API::
 Every response is a :class:`ServeResult` carrying the generation that
 produced it, which is what makes hot-swap correctness testable: under a
 concurrent stream, each result matches exactly one generation's forest.
+
+The server owns POLICY (batching windows, shedding, tenant quotas,
+health); the registry owns MECHANISM (which forests are resident, their
+buckets, their generations) — the split ROADMAP item 2 prescribes, and
+what lets several replica servers share nothing behind a router
+(serve/router.py) while each runs its own registry.
 """
 from __future__ import annotations
 
 import time
 from concurrent.futures import Future
-from typing import List, NamedTuple, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import numpy as np
 
-from ..guard.degrade import CircuitBreaker, HealthMonitor
+from ..guard.degrade import HealthMonitor
 from ..guard.faults import plan_for
 from ..utils import log
 from .batcher import MicroBatcher, Request
 from .cache import DEFAULT_BUCKETS, CompiledForestCache
+from .registry import DEFAULT_MODEL, ModelRegistry
 from .stats import ServeStats
-from .swap import SwapController
 
 
 class ServeResult(NamedTuple):
@@ -39,12 +47,29 @@ class ServeResult(NamedTuple):
     generation: int
 
 
-class ForestServer:
-    """Batched, hot-swappable TPU inference server for one booster.
+def parse_tenant_weights(spec: str) -> Dict[str, float]:
+    """``"tenant:weight,tenant2:weight2"`` -> dict (unlisted tenants weigh
+    1.0 in the fair queue)."""
+    out: Dict[str, float] = {}
+    for tok in (spec or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if ":" not in tok:
+            raise ValueError(f"serve_tenant_weights token {tok!r} is not "
+                             "'tenant:weight'")
+        name, w = tok.rsplit(":", 1)
+        out[name.strip()] = float(w)
+    return out
 
-    Accepts a ``basic.Booster`` or a ``models.gbdt.GBDT``. Defaults for the
-    batching/bucket knobs come from the booster's config (``serve_*``
-    parameters); keyword arguments override.
+
+class ForestServer:
+    """Batched, hot-swappable, multi-model TPU inference server.
+
+    Accepts a ``basic.Booster`` or a ``models.gbdt.GBDT`` as the initial
+    (``"default"``) model. Defaults for the batching/bucket/registry knobs
+    come from the booster's config (``serve_*`` parameters); keyword
+    arguments override.
     """
 
     def __init__(self, model, buckets: Optional[Sequence[int]] = None,
@@ -58,7 +83,10 @@ class ForestServer:
                  max_queue: Optional[int] = None,
                  backpressure: Optional[str] = None,
                  timeout_ms: Optional[float] = None,
-                 swap_breaker: Optional[int] = None) -> None:
+                 swap_breaker: Optional[int] = None,
+                 hbm_budget_bytes: Optional[int] = None,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 tenant_max_share: Optional[float] = None) -> None:
         gbdt = model._booster if hasattr(model, "_booster") else model
         cfg = gbdt.config
         self.raw_score = bool(raw_score)
@@ -70,17 +98,22 @@ class ForestServer:
         self.stats = stats if stats is not None else ServeStats()
         self._closed = False
         self._faults = plan_for(cfg)
-        breaker = CircuitBreaker(
-            threshold=int(cfg.serve_swap_breaker if swap_breaker is None
-                          else swap_breaker))
-        self.health = HealthMonitor(breaker=breaker)
-        self._swap = SwapController(self._build_cache, stats=self.stats,
-                                    breaker=breaker)
-        self._swap.install(gbdt)
+        if hbm_budget_bytes is None:
+            hbm_budget_bytes = int(cfg.serve_hbm_budget_mb * (1 << 20))
+        self.registry = ModelRegistry(
+            self._build_cache, stats=self.stats,
+            hbm_budget_bytes=hbm_budget_bytes,
+            breaker_threshold=int(cfg.serve_swap_breaker
+                                  if swap_breaker is None else swap_breaker))
+        self.registry.install(DEFAULT_MODEL, gbdt)
+        self.health = HealthMonitor(
+            breaker=self.registry.entry(DEFAULT_MODEL).breaker)
         nw = int(cfg.serve_workers if workers is None else workers)
         if nw <= 0:                      # auto: overlap dispatches, bounded
             import os
             nw = max(1, min(4, (os.cpu_count() or 1) // 2))
+        if tenant_weights is None:
+            tenant_weights = parse_tenant_weights(cfg.serve_tenant_weights)
         self._batcher = MicroBatcher(
             self._run_batch,
             max_batch=int(cfg.serve_max_batch if max_batch is None
@@ -95,7 +128,11 @@ class ForestServer:
                           else backpressure),
             timeout_ms=float(cfg.serve_timeout_ms if timeout_ms is None
                              else timeout_ms),
-            health=self.health)
+            health=self.health,
+            tenant_weights=tenant_weights,
+            tenant_max_share=float(cfg.serve_tenant_max_share
+                                   if tenant_max_share is None
+                                   else tenant_max_share))
 
     # ------------------------------------------------------------------
     def _build_cache(self, gbdt, generation: int) -> CompiledForestCache:
@@ -108,49 +145,80 @@ class ForestServer:
 
     @property
     def generation(self) -> int:
-        return self._swap.active.generation
+        return self.registry.generation(DEFAULT_MODEL)
 
     @property
     def num_features(self) -> int:
         """Width the active compiled forest consumes (1 + max split
         feature); narrower requests error unless
         predict_disable_shape_check pads them with NaN."""
-        return self._swap.active.width
+        return self.registry.entry(DEFAULT_MODEL).width
+
+    @property
+    def _swap(self):
+        """PR 1 compatibility shim: the default model's registry entry
+        exposes the old SwapController surface (``.active``,
+        ``.breaker``)."""
+        return self.registry.entry(DEFAULT_MODEL)
+
+    # -- model management ----------------------------------------------
+    def add_model(self, name: str, source, params=None) -> int:
+        """Register an additional model (path, model text, Booster or
+        GBDT) under ``name``; it compiles (and warms) now, off the request
+        path, subject to the registry's HBM budget."""
+        return self.registry.install(name, source, params=params)
+
+    def models(self) -> List[str]:
+        return self.registry.names()
 
     # -- request path ---------------------------------------------------
-    def submit(self, x) -> "Future[ServeResult]":
+    def submit(self, x, model: Optional[str] = None,
+               tenant: Optional[str] = None) -> "Future[ServeResult]":
         """Async predict: enqueue rows, return a Future of
-        :class:`ServeResult`. ``x`` is one row [D] or a matrix [n, D]."""
+        :class:`ServeResult`. ``x`` is one row [D] or a matrix [n, D];
+        ``model`` routes to a registry model (default: the initial one);
+        ``tenant`` bills the request to a fairness/accounting lane."""
         if self._closed:
             raise RuntimeError("ForestServer is closed")
+        name = model if model is not None else DEFAULT_MODEL
+        if not self.registry.has(name):
+            raise ValueError(f"unknown serve model {name!r} "
+                             f"(registered: {self.models()})")
         x = np.asarray(x, dtype=np.float32)
         if x.ndim == 1:
             x = x[None, :]
         if x.ndim != 2:
             raise ValueError(f"serve requests are rows [n, D], got {x.shape}")
-        return self._batcher.submit(x)
+        return self._batcher.submit(x, model=name, tenant=tenant)
 
-    def predict(self, x, timeout: Optional[float] = None) -> np.ndarray:
+    def predict(self, x, timeout: Optional[float] = None,
+                model: Optional[str] = None,
+                tenant: Optional[str] = None) -> np.ndarray:
         """Blocking predict with ``Booster.predict`` output semantics:
         [n] for single-class models, [n, K] for multiclass."""
-        return self.submit(x).result(timeout).values
+        return self.submit(x, model=model, tenant=tenant).result(
+            timeout).values
 
     # -- hot swap -------------------------------------------------------
-    def swap(self, source, params=None, background: bool = False):
-        """Atomically replace the served model (path, model text, Booster
+    def swap(self, source, params=None, background: bool = False,
+             model: str = DEFAULT_MODEL):
+        """Atomically replace a served model (path, model text, Booster
         or GBDT). The new forest is compiled and pre-warmed BEFORE the
         generation pointer flips; in-flight requests finish on the old
         forest. Returns the new generation (or the worker thread when
         ``background=True``)."""
-        return self._swap.swap(source, params=params, background=background)
+        return self.registry.swap(model, source, params=params,
+                                  background=background)
 
     # -- metrics / lifecycle -------------------------------------------
     def stats_snapshot(self) -> dict:
+        entry = self.registry.entry(DEFAULT_MODEL)
         snap = self.stats.snapshot()
-        snap["generation"] = self.generation
-        snap["buckets"] = list(self._swap.active.buckets)
-        snap["engine"] = getattr(self._swap.active, "engine", "scan")
+        snap["generation"] = entry.generation
+        snap["buckets"] = list(entry.buckets)
+        snap["engine"] = entry.engine
         snap["health"] = self.health.snapshot()
+        snap["registry"] = self.registry.snapshot()
         return snap
 
     def stats_json(self, **kwargs) -> str:
@@ -181,24 +249,42 @@ class ForestServer:
 
     # ------------------------------------------------------------------
     def _run_batch(self, batch: List[Request]) -> None:
-        """Worker-thread batch execution: snapshot the active generation
-        once, validate widths against it, run ONE padded dispatch, scatter
-        results back to futures."""
+        """Worker-thread batch execution: group the coalesced batch by
+        registry model, snapshot each model's compiled forest once, run
+        ONE padded dispatch per model, scatter results back to futures. A
+        model that fails to resolve (removed, or its re-admission compile
+        failed) fails only ITS requests; the other groups still serve."""
         self._faults.dispatch_fault()    # inert unless a fault plan is armed
-        slot = self._swap.active         # one generation per batch
+        groups: Dict[str, List[Request]] = {}
+        for r in batch:
+            groups.setdefault(r.model or DEFAULT_MODEL, []).append(r)
+        for name, reqs in sorted(groups.items()):
+            try:
+                slot = self.registry.get(name)   # touches LRU; may readmit
+            except Exception as e:
+                for r in reqs:
+                    if not r.future.done():
+                        r.future.set_exception(e)
+                self.stats.record_error()
+                continue
+            self._dispatch_group(name, slot, reqs)
+
+    def _dispatch_group(self, name: str, slot, reqs: List[Request]) -> None:
+        """One model's share of a batch through one padded dispatch."""
         t0 = time.perf_counter()
         W = slot.width
         disable_check = slot.gbdt.config.predict_disable_shape_check
         rows: List[np.ndarray] = []
         good: List[Request] = []
-        for r in batch:
+        for r in reqs:
             x = r.x
             if x.shape[1] < W:
                 if not disable_check:
                     r.future.set_exception(ValueError(
-                        f"request has {x.shape[1]} features but the model "
-                        f"needs {W}; set predict_disable_shape_check=true "
-                        "to pad missing features with NaN"))
+                        f"request has {x.shape[1]} features but model "
+                        f"{name!r} needs {W}; set "
+                        "predict_disable_shape_check=true to pad missing "
+                        "features with NaN"))
                     self.stats.record_error()
                     continue
                 x = np.concatenate(
@@ -221,7 +307,7 @@ class ForestServer:
             self.stats.record_request(queue_wait=t0 - r.t_submit,
                                       device=t1 - t0,
                                       total=time.perf_counter() - r.t_submit,
-                                      rows=n)
+                                      rows=n, model=name, tenant=r.tenant)
 
 
 def serve_loop(server: ForestServer, lines, out_stream,
@@ -231,10 +317,14 @@ def serve_loop(server: ForestServer, lines, out_stream,
     process). Line protocol (docs/serving.md):
 
     - one feature row per line (TSV or CSV) — a predict request;
-    - ``swap=<model>`` — atomic hot-swap mid-stream;
+    - ``swap=<model>`` — atomic hot-swap (``swap=name:<model>`` for a
+      non-default registry model);
+    - ``model=<name>`` — route subsequent predict lines to that registry
+      model (``model=`` resets to the default);
     - ``stats`` — print the Prometheus exposition of the live serving
       metrics to ``stats_stream`` (default: stderr);
     - ``stats json`` — the ``ServeStats.snapshot()`` JSON instead;
+    - ``health`` — one-line health state to ``stats_stream``;
     - ``#``-prefixed lines and blanks are ignored.
 
     Returns the number of served requests."""
@@ -242,6 +332,7 @@ def serve_loop(server: ForestServer, lines, out_stream,
     if stats_stream is None:
         stats_stream = _sys.stderr
     futures = []
+    active_model = None
     for line in lines:
         line = line.strip()
         if not line or line.startswith("#"):
@@ -254,11 +345,27 @@ def serve_loop(server: ForestServer, lines, out_stream,
             stats_stream.write(server.stats_json() + "\n")
             stats_stream.flush()
             continue
+        if line == "health":
+            stats_stream.write(server.health.state() + "\n")
+            stats_stream.flush()
+            continue
+        if line.startswith("model="):
+            name = line.split("=", 1)[1].strip()
+            active_model = name or None
+            continue
         if line.startswith("swap="):
             from ..guard.degrade import SwapFailed, SwapRejected
             target = line.split("=", 1)[1].strip()
+            model = DEFAULT_MODEL
+            if ":" in target:
+                head, rest = target.split(":", 1)
+                # "name:path" routes the swap; bare paths (which may
+                # contain ':' on exotic systems) keep working because a
+                # registered model name wins only when it exists
+                if server.registry.has(head):
+                    model, target = head, rest
             try:
-                gen = server.swap(target)
+                gen = server.swap(target, model=model)
             except (SwapFailed, SwapRejected) as e:
                 # degraded, not dead: the active generation keeps serving
                 # (stats carry swap_failures + the breaker state)
@@ -270,7 +377,7 @@ def serve_loop(server: ForestServer, lines, out_stream,
         delim = "\t" if "\t" in line else ","
         row = np.array([_parse_cell(tok) for tok in line.split(delim)],
                        dtype=np.float32)
-        futures.append(server.submit(row))
+        futures.append(server.submit(row, model=active_model))
     for f in futures:
         vals = np.atleast_1d(np.asarray(f.result().values)).reshape(-1)
         out_stream.write("\t".join(f"{v:.10g}" for v in vals) + "\n")

@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import zlib
 from typing import Dict, Optional
 
 from ..obs.reservoir import Reservoir as _Reservoir
@@ -45,19 +46,44 @@ class ServeStats:
         self.forest_builds = 0
         self.bucket_compiles = 0
         self.swaps = 0
+        self.evictions = 0
+        self.readmissions = 0
         self._lat = _Reservoir(max_samples, seed=1)
         self._queue_wait = _Reservoir(max_samples, seed=2)
         self._device = _Reservoir(max_samples, seed=3)
+        # per-model / per-tenant breakdowns (docs/serving.md): bounded
+        # reservoirs per key so a many-tenant deployment stays O(keys)
+        self._models: Dict[str, Dict] = {}
+        self._tenants: Dict[str, Dict] = {}
+
+    def _group(self, table: Dict[str, Dict], key: str) -> Dict:
+        g = table.get(key)
+        if g is None:
+            g = table[key] = {"requests": 0, "rows": 0, "shed": 0,
+                              "rejected": 0, "evictions": 0,
+                              "readmissions": 0,
+                              "lat": _Reservoir(
+                                  4096,
+                                  seed=zlib.crc32(key.encode()) & 0xffff)}
+        return g
 
     # -- recording ------------------------------------------------------
     def record_request(self, queue_wait: float, device: float, total: float,
-                       rows: int = 1) -> None:
+                       rows: int = 1, model: Optional[str] = None,
+                       tenant: Optional[str] = None) -> None:
         with self._lock:
             self.n_requests += 1
             self.n_rows += rows
             self._lat.add(total)
             self._queue_wait.add(queue_wait)
             self._device.add(device)
+            for table, key in ((self._models, model),
+                               (self._tenants, tenant)):
+                if key is not None:
+                    g = self._group(table, key)
+                    g["requests"] += 1
+                    g["rows"] += rows
+                    g["lat"].add(total)
 
     def record_batch(self, n_requests: int, rows: int) -> None:
         with self._lock:
@@ -79,15 +105,38 @@ class ServeStats:
         with self._lock:
             self.n_errors += 1
 
-    def record_timeout(self) -> None:
+    def record_timeout(self, model: Optional[str] = None,
+                       tenant: Optional[str] = None) -> None:
         """A request shed before dispatch (deadline expired in queue)."""
         with self._lock:
             self.n_timeouts += 1
+            for table, key in ((self._models, model),
+                               (self._tenants, tenant)):
+                if key is not None:
+                    self._group(table, key)["shed"] += 1
 
-    def record_rejected(self) -> None:
-        """A submit refused by full-queue backpressure (reject policy)."""
+    def record_rejected(self, tenant: Optional[str] = None) -> None:
+        """A submit refused by full-queue backpressure (reject policy or a
+        per-tenant admission quota)."""
         with self._lock:
             self.n_rejected += 1
+            if tenant is not None:
+                self._group(self._tenants, tenant)["rejected"] += 1
+
+    def record_eviction(self, model: Optional[str] = None) -> None:
+        """A registry forest evicted under the HBM budget (its compiled
+        executables freed; the host-side model is retained)."""
+        with self._lock:
+            self.evictions += 1
+            if model is not None:
+                self._group(self._models, model)["evictions"] += 1
+
+    def record_readmission(self, model: Optional[str] = None) -> None:
+        """An evicted model recompiled on first use after eviction."""
+        with self._lock:
+            self.readmissions += 1
+            if model is not None:
+                self._group(self._models, model)["readmissions"] += 1
 
     def record_swap_failure(self) -> None:
         """A hot-swap that failed to build/compile; the previous
@@ -122,6 +171,20 @@ class ServeStats:
     @staticmethod
     def _ms(d: Dict[str, float]) -> Dict[str, float]:
         return {k: v * 1e3 for k, v in d.items()}
+
+    @staticmethod
+    def _group_block(table: Dict[str, Dict]) -> Dict[str, Dict]:
+        out = {}
+        for key, g in sorted(table.items()):
+            out[key] = {
+                "requests": g["requests"], "rows": g["rows"],
+                "shed": g["shed"], "rejected": g["rejected"],
+                "evictions": g["evictions"],
+                "readmissions": g["readmissions"],
+                "latency_ms": {k: v * 1e3
+                               for k, v in g["lat"].percentiles().items()},
+            }
+        return out
 
     def snapshot(self) -> Dict:
         with self._lock:
@@ -158,6 +221,10 @@ class ServeStats:
                                    for k, v in self.per_bucket.items()},
                 },
                 "swaps": self.swaps,
+                "evictions": self.evictions,
+                "readmissions": self.readmissions,
+                "per_model": self._group_block(self._models),
+                "per_tenant": self._group_block(self._tenants),
             }
 
     def to_json(self, **kwargs) -> str:

@@ -1,0 +1,20 @@
+"""R5 fixture (ISSUE 9): frontend write-mutex discipline.
+
+A frontend connection serializes frame writes with a mutex; holding it
+across a blocking ``sendall`` to a slow client convoys every batcher
+reply callback targeting that connection. The real frontend
+(serve/frontend.py) accepts exactly this shape on loopback-class sockets
+with a written justification — the rule exists so the trade-off stays a
+decision, not an accident.
+"""
+import threading
+
+
+class BadConn:
+    def __init__(self, sock):
+        self.sock = sock
+        self._tx_lock = threading.Lock()
+
+    def reply(self, payload):
+        with self._tx_lock:
+            self.sock.sendall(payload)  # BAD:R5

@@ -1,0 +1,25 @@
+"""R5 fixture (ISSUE 9): registry lock discipline.
+
+The hazard the fleet registry must not have: compiling a forest while
+holding the registry lock. An XLA forest build takes seconds; every
+dispatch-path reader resolving ANY model convoys behind it, so one cold
+model freezes the whole fleet's p99. The real registry
+(serve/registry.py) builds outside its lock and single-flights concurrent
+re-admissions through a per-entry event instead.
+"""
+import threading
+
+
+class BadRegistry:
+    def __init__(self, build_cache):
+        self._build = build_cache
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def get(self, name, gbdt):
+        with self._lock:
+            cache = self._entries.get(name)
+            if cache is None:
+                cache = self._build(gbdt, 0)  # BAD:R5
+                self._entries[name] = cache
+            return cache

@@ -1,0 +1,19 @@
+"""R8 fixture (ISSUE 9): router failover future discipline.
+
+The hazard of naive failover: a dispatch function that resolves request
+futures, with an except path that drops the dead replica and exits —
+every in-flight request of that replica hangs its caller forever. The
+real router (serve/router.py) re-enters its replica-pick loop, whose
+every exit terminates the future (result, per-request error, or an
+explicit no-replica rejection).
+"""
+
+
+def route_all(replicas, requests):
+    for req in requests:
+        replica = replicas[0]
+        try:
+            out = replica.run(req.x)
+            req.future.set_result(out)
+        except ConnectionError:  # BAD:R8
+            replicas.pop(0)

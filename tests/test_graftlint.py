@@ -61,10 +61,13 @@ def fixture_findings():
     "r3_clamped_slice.py",
     "r4_dtype_drift.py",
     "serve/r5_locks.py",
+    "serve/r5_registry.py",
+    "serve/r5_frontend.py",
     "r6_collective_axis.py",
     "parallel/rogue_learner.py",
     "obs/r7_unsynced_timing.py",
     "serve/r8_futures.py",
+    "serve/r8_router.py",
     "data/stream.py",
 ])
 def test_rule_fixture_exact_findings(fixture_findings, relpath):

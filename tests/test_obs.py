@@ -137,8 +137,21 @@ def test_prometheus_output_parses_line_by_line():
     stats = ServeStats()
     stats.record_request(0.001, 0.002, 0.004, rows=3)
     stats.record_cache(True, bucket=8)
+    # labeled per-model/per-tenant + registry forms (ISSUE 9) must pass
+    # the same line grammar
+    stats.record_request(0.001, 0.001, 0.003, rows=2, model="default",
+                         tenant="acme corp")
+    stats.record_timeout(model="default", tenant="acme corp")
+    stats.record_eviction(model="default")
+    stats.record_readmission(model="default")
+    snapshot = stats.snapshot()
+    snapshot["registry"] = {"registered_models": 2, "resident_models": 1,
+                            "hbm_bytes_resident": 4096,
+                            "hbm_budget_bytes": 8192,
+                            "models": {"default": {"resident": True},
+                                       "b": {"resident": False}}}
     text = prom.render(telemetry=b._booster.telemetry,
-                       serve_snapshot=stats.snapshot())
+                       serve_snapshot=snapshot)
     lines = [ln for ln in text.splitlines() if ln]
     assert len(lines) > 40
     for ln in lines:
@@ -154,8 +167,36 @@ def test_prometheus_output_parses_line_by_line():
                 r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\}', m.group(2))
     # spot-check names and a labeled sample
     assert "lambdagap_train_phase_seconds_total{phase=\"tree\"}" in text
-    assert "lambdagap_serve_requests_total 1" in text
+    assert "lambdagap_serve_requests_total 2" in text
     assert "lambdagap_serve_latency_ms{quantile=\"p99\"}" in text
+    # the ISSUE-9 labeled forms
+    assert 'lambdagap_serve_model_requests_total{model="default"} 1' in text
+    assert 'lambdagap_serve_tenant_shed_total{tenant="acme corp"} 1' in text
+    assert ('lambdagap_serve_tenant_latency_ms{quantile="p50",'
+            'tenant="acme corp"}') in text
+    assert "lambdagap_serve_evictions_total 1" in text
+    assert 'lambdagap_serve_registry_model_resident{model="b"} 0' in text
+    assert "lambdagap_serve_registry_hbm_budget_bytes 8192" in text
+
+
+def test_prometheus_router_exposition_parses_and_labels():
+    snap = {"failovers": 3, "rejected_no_replica": 1,
+            "replicas": {"r0": {"routed": 10, "inflight": 2,
+                                "health": "ok", "dead": False},
+                         "r1": {"routed": 4, "inflight": 0,
+                                "health": "dead", "dead": True}}}
+    text = prom.render_router(snap)
+    for ln in [ln for ln in text.splitlines() if ln]:
+        if ln.startswith("#"):
+            assert _PROM_HEADER.match(ln), ln
+        else:
+            assert _PROM_SAMPLE.match(ln), ln
+    assert "lambdagap_router_failovers_total 3" in text
+    assert 'lambdagap_router_replica_routed_total{replica="r0"} 10' in text
+    assert ('lambdagap_router_replica_health{replica="r1",state="dead"} 1'
+            in text)
+    assert ('lambdagap_router_replica_health{replica="r1",state="ok"} 0'
+            in text)
 
 
 # -- recompile watchdog -------------------------------------------------

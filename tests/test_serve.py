@@ -45,8 +45,29 @@ def test_bucket_outputs_bit_identical_to_device_predict():
             outs.append(s.predict(X[lo:lo + n]))
             lo += n
         got = np.concatenate(outs)
-    assert lo <= 600
-    assert np.array_equal(got, ref[:lo]), "serve outputs must be bit-identical"
+        assert lo <= 600
+        assert np.array_equal(got, ref[:lo]), \
+            "serve outputs must be bit-identical"
+        # ISSUE 9: the same rows through every FLEET path must stay
+        # bit-identical to the device predict — the explicit registry
+        # route, the health-aware router, and the socket frontend (JSON
+        # floats carry shortest-roundtrip reprs; f32->f64->f32 is exact)
+        got_named = np.concatenate([s.predict(X[i:i + 37], model="default",
+                                              tenant="parity")
+                                    for i in range(0, 111, 37)])
+        assert np.array_equal(got_named, ref[:111])
+        from lambdagap_tpu.serve import (FrontendClient, LocalReplica,
+                                         Router, ServeFrontend)
+        with Router([LocalReplica("a", s)]) as router:
+            got_routed = np.concatenate([router.predict(X[i:i + 29],
+                                                        timeout=30)
+                                         for i in range(0, 87, 29)])
+        assert np.array_equal(got_routed, ref[:87])
+        with ServeFrontend(s) as fe:
+            with FrontendClient("127.0.0.1", fe.port) as client:
+                got_wire = np.concatenate([client.predict(X[i:i + 41])
+                                           for i in range(0, 123, 41)])
+        assert np.array_equal(got_wire, np.asarray(ref[:123], np.float32))
 
 
 def test_multiclass_and_raw_score_match():

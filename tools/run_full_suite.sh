@@ -51,6 +51,14 @@ if ! env JAX_PLATFORMS=cpu python tools/chaos_gate.py; then
     echo "corrupt, or kill the pipeline; see docs/robustness.md)"
     exit 1
 fi
+# serve fleet gate (ISSUE 9): a 2-replica loopback fleet under open-loop
+# load survives a SIGKILLed replica with zero stranded futures and
+# goodput recovering to >= 90% of the pre-fault baseline
+if ! env JAX_PLATFORMS=cpu python tools/serve_gate.py; then
+    echo "FAIL-FAST: serve gate failed (a replica death stranded a future"
+    echo "or goodput never recovered; see docs/serving.md)"
+    exit 1
+fi
 echo "=== G1 $(date)"
 python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_predict_tensor.py tests/test_misc_api.py tests/test_graftlint.py -q 2>&1 | tail -1
 echo "=== G2 $(date)"
@@ -60,7 +68,7 @@ python -m pytest tests/test_monotone.py tests/test_tree_options.py tests/test_ex
 echo "=== G4 $(date)"
 python -m pytest tests/test_fused.py tests/test_layout.py tests/test_stream.py tests/test_distributed.py tests/test_quantized.py tests/test_continued.py tests/test_model_io.py tests/test_shap_json.py -q 2>&1 | tail -1
 echo "=== G5 $(date)"
-python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py tests/test_serve.py tests/test_serve_stress.py -q 2>&1 | tail -1
+python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py tests/test_serve.py tests/test_serve_fleet.py tests/test_serve_stress.py -q 2>&1 | tail -1
 echo "=== G6 full-length consistency $(date)"
 LAMBDAGAP_CONSISTENCY_FULL=1 python -m pytest tests/test_consistency.py -q 2>&1 | tail -1
 echo "=== DONE $(date)"
