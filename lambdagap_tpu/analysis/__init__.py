@@ -12,29 +12,40 @@ Programmatic::
     from lambdagap_tpu.analysis import scan
     findings = scan(["lambdagap_tpu"])
 
-Rules (see docs/static-analysis.md for the full rationale):
+Rules (see docs/static-analysis.md for the full rationale). Pass 1 builds
+a package-wide semantic index (module/class/function tables, an
+intra-package call graph with method resolution through ``self``,
+per-function lock-acquisition sets, config-knob declarations and read
+sites, the sharding-registry axis universe); pass 2 runs the rules over
+index + AST:
 
-- R1 host-device sync in hot paths
+- R1 host-device sync in hot paths (incl. helpers REACHED from hot
+  functions via the call graph)
 - R2 jit recompile hazards
 - R3 clamped dynamic_slice starts without a guarding invariant
 - R4 dtype drift (array creation without an explicit dtype)
-- R5 serve-layer lock discipline
+- R5 serve-layer lock discipline (lexical)
 - R6 collective axis-name consistency
 - R7 unsynced timing (perf_counter deltas over async device dispatch)
+- R8 future/exception discipline
+- R9 lock-order deadlock cycles + blocking work reachable under a lock
+- R10 sharding-registry enforcement (spec/mesh construction sites)
+- R11 config-knob drift (unused/typo'd/divergent-default knobs)
 
 Intentionally import-light: no jax import happens here, so the linter runs
-in milliseconds and can scan trees that do not import.
+in well under the 2 s G0 budget and can scan trees that do not import.
 """
 from __future__ import annotations
 
-from .core import (Finding, ModuleContext, PackageIndex, Rule,  # noqa: F401
-                   all_rules, apply_baseline, load_baseline, register_rule,
-                   scan, write_baseline)
-from . import rules  # noqa: F401  (registers R1..R6)
+from .core import (Finding, FunctionInfo, ModuleContext,  # noqa: F401
+                   PackageIndex, Rule, all_rules, apply_baseline,
+                   build_index, load_baseline, register_rule, scan,
+                   write_baseline)
+from . import rules  # noqa: F401  (registers R1..R11)
 from .cli import main  # noqa: F401
 
 __all__ = [
-    "Finding", "ModuleContext", "PackageIndex", "Rule", "all_rules",
-    "apply_baseline", "load_baseline", "register_rule", "scan",
-    "write_baseline", "main",
+    "Finding", "FunctionInfo", "ModuleContext", "PackageIndex", "Rule",
+    "all_rules", "apply_baseline", "build_index", "load_baseline",
+    "register_rule", "scan", "write_baseline", "main",
 ]

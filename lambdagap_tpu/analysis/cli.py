@@ -1,9 +1,25 @@
 """graftlint CLI: ``python -m lambdagap_tpu.analysis [paths...]``.
 
-Exit codes: 0 — clean (every finding baselined or none); 1 — new findings;
-2 — usage error. ``--write-baseline`` regenerates the baseline file from
-the current findings (preserving per-entry ``why`` justifications whose
-keys still match) and exits 0.
+Exit codes: 0 — clean (every finding baselined or none); 1 — new findings
+(or the ``--max-seconds`` budget blown); 2 — usage error.
+``--write-baseline`` regenerates the baseline file from the current
+findings (preserving per-entry ``why`` justifications whose keys still
+match; output deterministic — sorted by rule, path, line) and exits 0.
+
+Output formats (``--format``):
+
+- ``text`` (default) — one ``path:line:col: RULE [severity] message`` per
+  new finding;
+- ``json`` — machine-readable findings + baseline accounting;
+- ``github`` — GitHub Actions workflow commands
+  (``::error file=...,line=...::message``), so CI annotates findings
+  inline on the PR diff;
+- ``sarif`` — SARIF 2.1.0, the code-scanning interchange format GitHub
+  and most IDEs ingest natively.
+
+``--max-seconds`` enforces the G0 wall-clock budget: the two-pass scan
+(index build + rules) must finish inside it or the gate fails — the
+budget is enforced, not hoped (tools/run_full_suite.sh passes 2).
 """
 from __future__ import annotations
 
@@ -11,10 +27,11 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Optional
+import time
+from typing import List, Optional, Sequence
 
-from . import rules  # noqa: F401  (registers R1..R6)
-from .core import (all_rules, apply_baseline, load_baseline, scan,
+from . import rules  # noqa: F401  (registers R1..R11)
+from .core import (Finding, all_rules, apply_baseline, load_baseline, scan,
                    write_baseline)
 
 DEFAULT_BASELINE = os.path.join("tools", "graftlint_baseline.json")
@@ -33,14 +50,75 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-baseline", action="store_true",
                    help="ignore any baseline file")
     p.add_argument("--write-baseline", action="store_true",
-                   help="regenerate the baseline from current findings")
+                   help="regenerate the baseline from current findings "
+                        "(deterministic: sorted by rule, path, line)")
     p.add_argument("--select", default=None,
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--disable", default=None,
                    help="comma-separated rule ids to skip")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "github", "sarif"),
+                   default="text")
+    p.add_argument("--max-seconds", type=float, default=None,
+                   help="fail (exit 1) when the scan exceeds this "
+                        "wall-clock budget — the G0 gate passes 2")
     p.add_argument("--list-rules", action="store_true")
     return p
+
+
+def _severity_level(sev: str) -> str:
+    return {"error": "error", "warning": "warning"}.get(sev, "warning")
+
+
+def render_github(findings: Sequence[Finding]) -> str:
+    """GitHub Actions workflow-command annotations, one per finding."""
+    out = []
+    for f in findings:
+        # workflow commands terminate at newline; escape the message's
+        # control characters per the Actions toolkit rules
+        msg = (f.message.replace("%", "%25").replace("\r", "%0D")
+               .replace("\n", "%0A"))
+        out.append(f"::{_severity_level(f.severity)} file={f.path},"
+                   f"line={f.line},col={f.col + 1},"
+                   f"title=graftlint {f.rule}::{msg}")
+    return "\n".join(out)
+
+
+def render_sarif(findings: Sequence[Finding]) -> str:
+    """Minimal valid SARIF 2.1.0 for code-scanning upload."""
+    rule_ids = sorted({f.rule for f in findings})
+    by_id = {r.id: r for r in all_rules()}
+    sarif = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "docs/static-analysis.md",
+                "rules": [{
+                    "id": rid,
+                    "shortDescription": {
+                        "text": by_id[rid].description
+                        if rid in by_id else rid},
+                } for rid in rule_ids],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": _severity_level(f.severity),
+                "message": {"text": f.message},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path},
+                        "region": {"startLine": f.line,
+                                   "startColumn": f.col + 1},
+                    },
+                }],
+                "fingerprints": {"graftlint/v1": f.fingerprint()},
+            } for f in findings],
+        }],
+    }
+    return json.dumps(sarif, indent=2)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -60,7 +138,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     select = args.select.split(",") if args.select else None
     disable = args.disable.split(",") if args.disable else None
+    t0 = time.perf_counter()
     findings = scan(paths, select=select, disable=disable)
+    elapsed = time.perf_counter() - t0
 
     baseline_path = args.baseline
     if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
@@ -88,7 +168,18 @@ def main(argv: Optional[List[str]] = None) -> int:
             "findings": [f.__dict__ for f in new],
             "baselined": len(findings) - len(new),
             "stale_baseline_entries": stale,
+            "elapsed_s": elapsed,
         }, indent=2))
+    elif args.format == "github":
+        out = render_github(new)
+        if out:
+            print(out)
+        for e in stale:
+            print(f"::warning title=graftlint stale baseline::"
+                  f"{e['rule']} {e['path']}: entry no longer matches — "
+                  f"regenerate with --write-baseline")
+    elif args.format == "sarif":
+        print(render_sarif(new))
     else:
         for f in new:
             print(f.format())
@@ -101,7 +192,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         tail = f" ({n_base} baselined)" if n_base else ""
         print(f"graftlint: {len(new)} finding(s){tail} in "
               f"{len(set(f.path for f in findings)) if findings else 0} "
-              f"file(s)")
+              f"file(s) [{elapsed:.2f}s]")
+    if args.max_seconds is not None and elapsed > args.max_seconds:
+        print(f"graftlint: scan took {elapsed:.2f}s, over the "
+              f"--max-seconds {args.max_seconds:g} budget (the two-pass "
+              f"index+rules run must stay inside the G0 gate)",
+              file=sys.stderr)
+        return 1
     return 1 if new else 0
 
 

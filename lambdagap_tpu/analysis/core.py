@@ -1,21 +1,33 @@
-"""graftlint core: findings, rule registry, suppressions, baseline, engine.
+"""graftlint core: findings, semantic index, rule registry, suppressions,
+baseline, engine.
 
 An AST-level hazard analyzer for the bug classes that have actually bitten
 this codebase (see docs/static-analysis.md): silent host-device syncs in hot
 paths, jit recompile hazards, clamped ``lax.dynamic_slice`` starts, dtype
-drift, serve-layer lock discipline, and collective axis-name mismatches.
+drift, serve-layer lock discipline, collective axis-name mismatches,
+lock-order deadlocks, sharding-registry bypasses, and config-knob drift.
 
-Design notes:
+Design notes — the two-pass architecture:
 
-- Rules are pure functions of a :class:`ModuleContext` (one parsed file)
-  plus a :class:`PackageIndex` (cross-file facts such as declared mesh axis
-  names), so the whole scan is two passes and needs no imports of the
-  scanned code — it runs in milliseconds and can lint broken trees.
+- **Pass 1** parses every file once into a :class:`ModuleContext` (parent
+  links + a by-node-type index built in a single traversal) and feeds it to
+  :class:`PackageIndex`, which accumulates package-wide facts: module-level
+  string constants, declared mesh axes, the partition-rule registry's axis
+  universe, every class's lock attributes, every top-level function/method,
+  the ``Config`` dataclass's knob declarations, and every config-knob read
+  site. ``PackageIndex.finalize`` then resolves the intra-package call
+  graph (method resolution through ``self``, constructor-inferred attribute
+  types, imported names) — the cross-module facts no single file carries.
+- **Pass 2** runs rules as pure functions of ``(ModuleContext,
+  PackageIndex)``. No scanned code is ever imported, so the whole run takes
+  well under the 2 s G0 budget and can lint broken trees.
 - Findings are suppressible inline (``# graftlint: disable=R1,R5``, on the
   offending line or alone on the line above) and grandfatherable in a
   checked-in JSON baseline keyed by (rule, path, normalized source line) —
   line-number drift does not invalidate baseline entries, editing the
-  offending line does.
+  offending line does. ``write_baseline`` output is deterministic (entries
+  sorted by rule, path, first finding line) so baseline diffs review like
+  code.
 """
 from __future__ import annotations
 
@@ -25,7 +37,8 @@ import hashlib
 import json
 import os
 import re
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+from typing import (Dict, Iterable, Iterator, List, Optional, Sequence, Set,
+                    Tuple)
 
 SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
 SUPPRESS_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
@@ -34,7 +47,7 @@ SUPPRESS_FILE_RE = re.compile(r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,\s]+)
 @dataclasses.dataclass(frozen=True)
 class Finding:
     """One hazard at one source location."""
-    rule: str            # "R1".."R6"
+    rule: str            # "R1".."R11"
     path: str            # path relative to the scan root (posix separators)
     line: int            # 1-based
     col: int             # 0-based
@@ -57,7 +70,13 @@ class Finding:
 
 
 class ModuleContext:
-    """One parsed source file with parent links and suppression tables."""
+    """One parsed source file with parent links and suppression tables.
+
+    The whole tree is traversed exactly ONCE at construction, building both
+    the parent map and a by-node-type index; rules iterate
+    ``ctx.nodes(ast.Call)`` instead of re-walking the tree, which is what
+    keeps the full-package scan inside the G0 time budget.
+    """
 
     def __init__(self, path: str, relpath: str, source: str) -> None:
         self.path = path
@@ -66,16 +85,42 @@ class ModuleContext:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
         self._parents: Dict[ast.AST, ast.AST] = {}
-        for parent in ast.walk(self.tree):
-            for child in ast.iter_child_nodes(parent):
-                self._parents[child] = parent
+        self._by_type: Dict[type, List[ast.AST]] = {}
+        # single breadth-first traversal (same order ast.walk would yield)
+        order: List[ast.AST] = [self.tree]
+        i = 0
+        parents = self._parents
+        by_type = self._by_type
+        while i < len(order):
+            node = order[i]
+            i += 1
+            bucket = by_type.get(node.__class__)
+            if bucket is None:
+                bucket = by_type[node.__class__] = []
+            bucket.append(node)
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+                order.append(child)
+        self._order = order
         self._suppress: Dict[int, set] = {}
         self._suppress_file: set = set()
         self._scan_suppressions()
 
+    # -- node index -----------------------------------------------------
+    def nodes(self, *types: type) -> List[ast.AST]:
+        """Every node of the given AST type(s), in traversal order."""
+        if len(types) == 1:
+            return self._by_type.get(types[0], [])
+        out: List[ast.AST] = []
+        for t in types:
+            out.extend(self._by_type.get(t, []))
+        return out
+
     # -- suppressions ---------------------------------------------------
     def _scan_suppressions(self) -> None:
         for i, line in enumerate(self.lines, 1):
+            if "graftlint" not in line:
+                continue
             m = SUPPRESS_FILE_RE.search(line)
             if m:
                 self._suppress_file |= _rule_list(m.group(1))
@@ -98,7 +143,7 @@ class ModuleContext:
             return
         # a suppressed line covers the whole statement that starts there
         # (multi-line calls anchor findings on inner lines)
-        for node in ast.walk(self.tree):
+        for node in self._order:
             if not isinstance(node, ast.stmt):
                 continue
             rules = self._suppress.get(getattr(node, "lineno", -1))
@@ -180,17 +225,90 @@ def call_name(call: ast.Call) -> str:
     return dotted_name(call.func)
 
 
-class PackageIndex:
-    """Cross-file facts collected in the first pass.
+# ---------------------------------------------------------------------------
+# semantic index: pass-1 facts + the finalize() resolution pass
+# ---------------------------------------------------------------------------
+# classes whose construction marks an attribute as a lock identity
+_LOCK_CTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                         "BoundedSemaphore"})
 
-    - ``str_constants``: module relpath -> {NAME: "value"} for module-level
-      string assignments (axis-name constants like ``DATA_AXIS = "data"``).
-    - ``axis_names``: every axis name declared anywhere in the scanned set:
-      strings in ``Mesh(..., (names,))`` axis tuples, strings passed to
-      ``PartitionSpec``/``P(...)``, and the values of ``*_AXIS`` constants.
-    - ``imports``: module relpath -> {local name: source module tail} for
-      ``from X import NAME`` statements, so axis constants resolve across
-      files without executing anything.
+# receivers treated as Config instances for the PRECISE knob checks (typo
+# reads, divergent getattr defaults). The loose read set used by the
+# unused-knob check matches any attribute access by name instead.
+_CONFIG_RECEIVERS = frozenset({"cfg", "config", "conf", "self.config",
+                               "self.cfg", "self._config", "self._cfg"})
+
+
+def _is_config_receiver(dotted: str) -> bool:
+    if not dotted or dotted.startswith("jax"):
+        return False
+    return (dotted in _CONFIG_RECEIVERS
+            or dotted.endswith(".config") or dotted.endswith(".cfg"))
+
+
+class FunctionInfo:
+    """One indexed top-level function or method: the call-graph node."""
+
+    __slots__ = ("relpath", "qualname", "name", "cls", "node", "ctx",
+                 "call_nodes", "resolved_calls", "acquires")
+
+    def __init__(self, relpath: str, qualname: str, name: str,
+                 cls: Optional[str], node: ast.AST, ctx: ModuleContext
+                 ) -> None:
+        self.relpath = relpath
+        self.qualname = qualname          # "func" or "Class.method"
+        self.name = name
+        self.cls = cls                    # enclosing class name or None
+        self.node = node
+        self.ctx = ctx
+        self.call_nodes: List[ast.Call] = []
+        # (call node, callee FunctionInfo) — filled by finalize()
+        self.resolved_calls: List[Tuple[ast.Call, "FunctionInfo"]] = []
+        # lock identities this function acquires anywhere in its body
+        # ((owner, attr) tuples) — filled by finalize()
+        self.acquires: List[Tuple[Tuple[str, str], ast.With]] = []
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.relpath, self.qualname)
+
+    def __repr__(self) -> str:          # pragma: no cover — debugging aid
+        return f"<FunctionInfo {self.relpath}:{self.qualname}>"
+
+
+@dataclasses.dataclass
+class KnobRead:
+    """One config-knob read site (pass 1; consumed by R11)."""
+    name: str
+    relpath: str
+    node: ast.AST
+    kind: str                            # "attr" / "getattr" / "params_get"
+    default: Optional[ast.AST] = None    # inline default expr, if any
+
+
+class PackageIndex:
+    """Cross-file facts collected in pass 1 and resolved by ``finalize``.
+
+    - ``str_constants`` / ``imports`` / ``axis_names`` / ``registry_axes``:
+      the axis-resolution facts R6/R10 consume (the registry —
+      ``parallel/sharding.py`` declaring ``MESH_AXES`` — is THE axis
+      universe when present in the scanned set).
+    - ``functions``: (relpath, qualname) -> :class:`FunctionInfo` for every
+      module-level function and class method; ``finalize`` resolves each
+      function's calls through ``self`` methods, constructor-inferred
+      attribute types (``self.q = FairQueue(...)``), same-module names and
+      ``from X import name`` imports, and builds the reverse ``callers``
+      map R1's hot-path propagation reads.
+    - lock identity tables: every ``self.X = threading.Lock()`` (or RLock/
+      Condition/Semaphore) declares lock ``(ClassName, X)``; module-level
+      lock assignments declare ``(relpath, NAME)``. R9 builds its
+      acquisition graph over these identities.
+    - config-knob tables: the ``Config`` dataclass's declared fields (with
+      default expressions and line numbers), its methods/properties, the
+      alias table, the ``COMPAT_ACCEPTED`` set, and every knob read site in
+      the package (attribute reads on config-like receivers,
+      ``getattr(cfg, "knob", default)``, ``params.get("knob", default)``),
+      plus a loose by-name read set for the unused-knob check.
     """
 
     def __init__(self) -> None:
@@ -203,29 +321,55 @@ class PackageIndex:
         # One source of truth: a learner inventing its own axis name is a
         # finding even if it also declared a matching Mesh.
         self.registry_axes: set = set()
+        self.registry_relpath: Optional[str] = None
         self.imports: Dict[str, Dict[str, str]] = {}
+        # -- call graph ------------------------------------------------
+        self.functions: Dict[Tuple[str, str], FunctionInfo] = {}
+        self.callers: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        self.classes: Dict[str, List[Tuple[str, ast.ClassDef]]] = {}
+        # -- lock identities -------------------------------------------
+        # (ClassName -> {attr: ctor}) and module-global (relpath -> {name})
+        self.class_locks: Dict[str, Dict[str, str]] = {}
+        self.module_locks: Dict[str, Set[str]] = {}
+        self._lock_attr_owners: Dict[str, Set[str]] = {}
+        # constructor-inferred attribute types:
+        # (relpath, ClassName, attr) -> constructed class name
+        self.attr_types: Dict[Tuple[str, str, str], str] = {}
+        self._attr_ctor_raw: List[Tuple[str, str, str, str]] = []
+        # -- config knobs ----------------------------------------------
+        self.config_module: Optional[str] = None
+        # field -> (default expr node or None, lineno)
+        self.config_fields: Dict[str, Tuple[Optional[ast.AST], int]] = {}
+        self.config_methods: Set[str] = set()
+        self.config_aliases: Dict[str, str] = {}     # alias -> canonical
+        self.compat_knobs: Set[str] = set()
+        self.knob_reads: List[KnobRead] = []
+        self.knob_writes: Set[str] = set()
+        self.loose_reads: Set[str] = set()
+        self._finalized = False
 
+    # ------------------------------------------------------------------
     def collect(self, ctx: ModuleContext) -> None:
         consts: Dict[str, str] = {}
         imports: Dict[str, str] = {}
+        rel = ctx.relpath
+        base = rel.rsplit("/", 1)[-1]
         # the registry module, whatever directory the scan was rooted at
-        is_registry = ctx.relpath.rsplit("/", 1)[-1] == "sharding.py"
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.Assign) and isinstance(
-                    ctx.parent(node), ast.Module):
-                if (len(node.targets) == 1
-                        and isinstance(node.targets[0], ast.Name)
-                        and isinstance(node.value, ast.Constant)
+        is_registry = base == "sharding.py"
+        for node in ctx.nodes(ast.Assign):
+            if not isinstance(ctx.parent(node), ast.Module):
+                continue
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                name = node.targets[0].id
+                if (isinstance(node.value, ast.Constant)
                         and isinstance(node.value.value, str)):
-                    name = node.targets[0].id
                     consts[name] = node.value.value
                     if name.endswith("_AXIS") or name.endswith("AXIS"):
                         self.axis_names.add(node.value.value)
                         if is_registry:
                             self.registry_axes.add(node.value.value)
-                elif (is_registry and len(node.targets) == 1
-                        and isinstance(node.targets[0], ast.Name)
-                        and node.targets[0].id == "MESH_AXES"
+                elif (is_registry and name == "MESH_AXES"
                         and isinstance(node.value, ast.Tuple)):
                     # MESH_AXES = (DATA_AXIS, FEATURE_AXIS) — resolve the
                     # member names against this module's constants
@@ -235,21 +379,306 @@ class PackageIndex:
                             self.registry_axes.add(el.value)
                         elif isinstance(el, ast.Name) and el.id in consts:
                             self.registry_axes.add(consts[el.id])
-            elif isinstance(node, ast.ImportFrom):
-                for alias in node.names:
-                    imports[alias.asname or alias.name] = \
-                        (node.module or "").rsplit(".", 1)[-1]
-            elif isinstance(node, ast.Call):
-                name = call_name(node)
-                tail = name.rsplit(".", 1)[-1]
-                if tail == "Mesh" and len(node.args) >= 2:
-                    self._add_strings(node.args[1])
-                elif tail in ("P", "PartitionSpec"):
-                    for a in node.args:
-                        self._add_strings(a)
-        self.str_constants[ctx.relpath] = consts
-        self.imports[ctx.relpath] = imports
+                elif isinstance(node.value, ast.Call):
+                    tail = call_name(node.value).rsplit(".", 1)[-1]
+                    if tail in _LOCK_CTORS:
+                        self.module_locks.setdefault(rel, set()).add(name)
+        if is_registry and self.registry_relpath is None:
+            self.registry_relpath = rel
+        for node in ctx.nodes(ast.ImportFrom):
+            for alias in node.names:
+                imports[alias.asname or alias.name] = \
+                    (node.module or "").rsplit(".", 1)[-1]
+        for node in ctx.nodes(ast.Call):
+            name = call_name(node)
+            tail = name.rsplit(".", 1)[-1]
+            if tail == "Mesh" and len(node.args) >= 2:
+                self._add_strings(node.args[1])
+            elif tail in ("P", "PartitionSpec"):
+                for a in node.args:
+                    self._add_strings(a)
+        self.str_constants[rel] = consts
+        self.imports[rel] = imports
+        self._collect_defs(ctx)
+        if base == "config.py" and self.config_module is None and any(
+                c.name == "Config" for c in ctx.nodes(ast.ClassDef)):
+            self._collect_config(ctx)
+        else:
+            self._collect_knob_reads(ctx)
 
+    # -- definitions / locks / call sites ------------------------------
+    def _collect_defs(self, ctx: ModuleContext) -> None:
+        rel = ctx.relpath
+        infos: List[FunctionInfo] = []
+        for node in ctx.nodes(ast.ClassDef):
+            if isinstance(ctx.parent(node), ast.Module):
+                self.classes.setdefault(node.name, []).append((rel, node))
+        for node in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+            parent = ctx.parent(node)
+            if isinstance(parent, ast.Module):
+                fi = FunctionInfo(rel, node.name, node.name, None, node, ctx)
+            elif (isinstance(parent, ast.ClassDef)
+                    and isinstance(ctx.parent(parent), ast.Module)):
+                fi = FunctionInfo(rel, f"{parent.name}.{node.name}",
+                                  node.name, parent.name, node, ctx)
+            else:
+                continue                 # nested defs are not graph nodes
+            self.functions[fi.key] = fi
+            infos.append(fi)
+        # lock attributes + constructor-typed attributes (self.X = Cls(...))
+        for fi in infos:
+            if fi.cls is None:
+                continue
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                tail = call_name(node.value).rsplit(".", 1)[-1]
+                for t in node.targets:
+                    if not (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        continue
+                    if tail in _LOCK_CTORS:
+                        self.class_locks.setdefault(
+                            fi.cls, {})[t.attr] = tail
+                        self._lock_attr_owners.setdefault(
+                            t.attr, set()).add(fi.cls)
+                    elif isinstance(node.value.func, ast.Name):
+                        self._attr_ctor_raw.append(
+                            (fi.relpath, fi.cls, t.attr,
+                             node.value.func.id))
+        # attribute every call site to its innermost indexed function
+        for call in ctx.nodes(ast.Call):
+            for anc in ctx.ancestors(call):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = self._info_for_def(ctx, anc)
+                    if fi is not None:
+                        fi.call_nodes.append(call)
+                    break
+
+    def _info_for_def(self, ctx: ModuleContext, node: ast.AST
+                      ) -> Optional[FunctionInfo]:
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Module):
+            return self.functions.get((ctx.relpath, node.name))
+        if isinstance(parent, ast.ClassDef):
+            return self.functions.get(
+                (ctx.relpath, f"{parent.name}.{node.name}"))
+        # nested def: attribute to the nearest indexed enclosing function
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return self._info_for_def(ctx, anc)
+        return None
+
+    def function_of(self, ctx: ModuleContext, node: ast.AST
+                    ) -> Optional[FunctionInfo]:
+        """The indexed function (module-level def or method) enclosing
+        ``node`` — nested defs resolve to their outermost indexed owner."""
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = self._info_for_def(ctx, anc)
+                if fi is not None:
+                    return fi
+        return None
+
+    # -- config knobs ---------------------------------------------------
+    def _collect_config(self, ctx: ModuleContext) -> None:
+        self.config_module = ctx.relpath
+        for cls in ctx.nodes(ast.ClassDef):
+            if cls.name != "Config":
+                continue
+            for item in cls.body:
+                if (isinstance(item, ast.AnnAssign)
+                        and isinstance(item.target, ast.Name)):
+                    self.config_fields[item.target.id] = (
+                        item.value, item.lineno)
+                elif isinstance(item, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    self.config_methods.add(item.name)
+            break
+        for node in ctx.nodes(ast.Call):
+            name = call_name(node)
+            if name == "_alias" and node.args and isinstance(
+                    node.args[0], ast.Constant):
+                canonical = node.args[0].value
+                for a in node.args[1:]:
+                    if isinstance(a, ast.Constant) and isinstance(
+                            a.value, str):
+                        self.config_aliases[a.value] = canonical
+        for node in ctx.nodes(ast.Assign):
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "COMPAT_ACCEPTED"):
+                for n in ast.walk(node.value):
+                    if isinstance(n, ast.Constant) and isinstance(
+                            n.value, str):
+                        self.compat_knobs.add(n.value)
+
+    def _collect_knob_reads(self, ctx: ModuleContext) -> None:
+        rel = ctx.relpath
+        for node in ctx.nodes(ast.Attribute):
+            recv = dotted_name(node.value)
+            if isinstance(node.ctx, ast.Load):
+                self.loose_reads.add(node.attr)
+                if _is_config_receiver(recv):
+                    self.knob_reads.append(KnobRead(
+                        node.attr, rel, node, "attr"))
+            elif _is_config_receiver(recv):
+                self.knob_writes.add(node.attr)
+        for node in ctx.nodes(ast.Call):
+            name = call_name(node)
+            if (name == "getattr" and len(node.args) >= 2
+                    and isinstance(node.args[1], ast.Constant)
+                    and isinstance(node.args[1].value, str)
+                    and _is_config_receiver(dotted_name(node.args[0]))):
+                knob = node.args[1].value
+                self.loose_reads.add(knob)
+                self.knob_reads.append(KnobRead(
+                    knob, rel, node, "getattr",
+                    node.args[2] if len(node.args) > 2 else None))
+            elif (name.rsplit(".", 1)[-1] == "get" and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                recv = dotted_name(node.func)
+                recv_head = recv.rsplit(".", 2)
+                owner = recv_head[-2] if len(recv_head) >= 2 else ""
+                if "params" in owner.lower():
+                    knob = node.args[0].value
+                    self.loose_reads.add(knob)
+                    self.knob_reads.append(KnobRead(
+                        knob, rel, node, "params_get",
+                        node.args[1] if len(node.args) > 1 else None))
+        for node in ctx.nodes(ast.Subscript):
+            if (isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                # params["knob"]-style reads (alias mapping happens at
+                # rule time: config.py may be collected after this module)
+                self.loose_reads.add(node.slice.value)
+
+    # -- pass-1 -> pass-2 resolution ------------------------------------
+    def finalize(self) -> None:
+        """Resolve the cross-module facts no single file carries: attribute
+        constructor types, the intra-package call graph (+ reverse callers
+        map), and each function's lock-acquisition list."""
+        if self._finalized:
+            return
+        self._finalized = True
+        for rel, cls, attr, ctor in self._attr_ctor_raw:
+            target = self._resolve_class(rel, ctor)
+            if target is not None:
+                self.attr_types[(rel, cls, attr)] = target
+        for fi in self.functions.values():
+            for call in fi.call_nodes:
+                callee = self.resolve_call(fi, call)
+                if callee is not None and callee is not fi:
+                    fi.resolved_calls.append((call, callee))
+                    self.callers.setdefault(callee.key, set()).add(fi.key)
+            fi.acquires = self._function_acquires(fi)
+
+    def _resolve_class(self, rel: str, name: str) -> Optional[str]:
+        hits = self.classes.get(name)
+        if not hits:
+            return None
+        for hit_rel, _ in hits:
+            if hit_rel == rel:
+                return name
+        src_mod = self.imports.get(rel, {}).get(name)
+        if src_mod:
+            for hit_rel, _ in hits:
+                if hit_rel.rsplit("/", 1)[-1] == src_mod + ".py":
+                    return name
+        return name if len(hits) == 1 else None
+
+    def _method(self, cls: str, meth: str) -> Optional[FunctionInfo]:
+        for rel, _ in self.classes.get(cls, ()):
+            fi = self.functions.get((rel, f"{cls}.{meth}"))
+            if fi is not None:
+                return fi
+        return None
+
+    def resolve_call(self, fi: FunctionInfo, call: ast.Call
+                     ) -> Optional[FunctionInfo]:
+        """Resolve one call site to an indexed function, or None.
+
+        Handles ``self.meth()`` (method resolution through ``self``),
+        ``self.attr.meth()`` when ``attr``'s class was inferred from a
+        constructor assignment, bare ``func()`` (same module, then
+        ``from X import func``), and ``ClassName(...)`` (-> __init__).
+        Never guesses: unresolvable receivers return None.
+        """
+        name = call_name(call)
+        if not name:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and fi.cls is not None:
+            if len(parts) == 2:
+                hit = self.functions.get(
+                    (fi.relpath, f"{fi.cls}.{parts[1]}"))
+                if hit is not None:
+                    return hit
+                return None
+            if len(parts) == 3:
+                target = self.attr_types.get((fi.relpath, fi.cls, parts[1]))
+                if target is not None:
+                    return self._method(target, parts[2])
+            return None
+        if len(parts) == 1:
+            n = parts[0]
+            hit = self.functions.get((fi.relpath, n))
+            if hit is not None:
+                return hit
+            cls = self._resolve_class(fi.relpath, n)
+            if cls is not None:
+                return self._method(cls, "__init__")
+            src_mod = self.imports.get(fi.relpath, {}).get(n)
+            if src_mod:
+                for (rel, qual), other in self.functions.items():
+                    if qual == n and rel.rsplit("/", 1)[-1] == \
+                            src_mod + ".py":
+                        return other
+        return None
+
+    # -- lock identities ------------------------------------------------
+    def lock_identity(self, fi: FunctionInfo, expr: ast.AST
+                      ) -> Optional[Tuple[str, str]]:
+        """Resolve a ``with`` context expression to a lock identity
+        ``(owner, attr)``: ``self.X`` resolves through the enclosing class,
+        a module-global lock through its module, and a foreign-object
+        attribute (``entry.swap_lock``) through the UNIQUE class declaring
+        that lock attribute. Ambiguous receivers return None — the graph
+        never guesses."""
+        d = dotted_name(expr)
+        if not d:
+            return None
+        parts = d.split(".")
+        if parts[0] == "self" and len(parts) == 2 and fi.cls is not None:
+            if parts[1] in self.class_locks.get(fi.cls, ()):
+                return (fi.cls, parts[1])
+            return None
+        if len(parts) == 1:
+            if parts[0] in self.module_locks.get(fi.relpath, ()):
+                return (fi.relpath, parts[0])
+            return None
+        attr = parts[-1]
+        owners = self._lock_attr_owners.get(attr, ())
+        if len(owners) == 1:
+            return (next(iter(owners)), attr)
+        return None
+
+    def _function_acquires(self, fi: FunctionInfo
+                           ) -> List[Tuple[Tuple[str, str], ast.With]]:
+        out = []
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                ident = self.lock_identity(fi, item.context_expr)
+                if ident is not None:
+                    out.append((ident, node))
+        return out
+
+    # ------------------------------------------------------------------
     def _add_strings(self, node: ast.AST) -> None:
         for n in ast.walk(node):
             if isinstance(n, ast.Constant) and isinstance(n.value, str):
@@ -306,7 +735,8 @@ def register_rule(cls):
 
 
 def all_rules() -> List[Rule]:
-    return [_RULES[k] for k in sorted(_RULES)]
+    return [_RULES[k] for k in sorted(_RULES,
+                                      key=lambda r: int(r[1:]))]
 
 
 # -- engine -------------------------------------------------------------
@@ -326,22 +756,19 @@ def iter_py_files(paths: Sequence[str]) -> Iterator[Tuple[str, str]]:
                     yield fp, os.path.relpath(fp, p)
 
 
-def scan(paths: Sequence[str], select: Optional[Iterable[str]] = None,
-         disable: Optional[Iterable[str]] = None) -> List[Finding]:
-    """Run the rule set over ``paths`` (files or directory roots)."""
-    sel = {r.upper() for r in select} if select else None
-    dis = {r.upper() for r in disable} if disable else set()
-    rules = [r for r in all_rules()
-             if (sel is None or r.id in sel) and r.id not in dis]
+def build_index(paths: Sequence[str]
+                ) -> Tuple[List[ModuleContext], PackageIndex, List[Finding]]:
+    """Pass 1: parse every file and build the finalized semantic index.
+    Returns (contexts, index, parse_failures-as-R0-findings)."""
     contexts: List[ModuleContext] = []
     index = PackageIndex()
-    findings: List[Finding] = []
+    failures: List[Finding] = []
     for fp, rel in iter_py_files(paths):
         try:
             with open(fp, "r", encoding="utf-8") as f:
                 ctx = ModuleContext(fp, rel, f.read())
         except (SyntaxError, UnicodeDecodeError) as e:
-            findings.append(Finding(
+            failures.append(Finding(
                 rule="R0", path=rel.replace(os.sep, "/"),
                 line=getattr(e, "lineno", 1) or 1, col=0,
                 message=f"file does not parse: {e.msg if hasattr(e, 'msg') else e}",
@@ -349,6 +776,18 @@ def scan(paths: Sequence[str], select: Optional[Iterable[str]] = None,
             continue
         index.collect(ctx)
         contexts.append(ctx)
+    index.finalize()
+    return contexts, index, failures
+
+
+def scan(paths: Sequence[str], select: Optional[Iterable[str]] = None,
+         disable: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the rule set over ``paths`` (files or directory roots)."""
+    sel = {r.upper() for r in select} if select else None
+    dis = {r.upper() for r in disable} if disable else set()
+    rules = [r for r in all_rules()
+             if (sel is None or r.id in sel) and r.id not in dis]
+    contexts, index, findings = build_index(paths)
     for ctx in contexts:
         for rule in rules:
             if not rule.applies_to(ctx.relpath):
@@ -365,9 +804,12 @@ BASELINE_VERSION = 1
 
 
 def write_baseline(findings: Sequence[Finding], path: str) -> None:
-    """Group current findings by identity key and persist counts. A ``why``
-    field per entry is preserved across regenerations when the key matches;
-    new entries get an empty why for a human to fill in."""
+    """Group current findings by identity key and persist counts,
+    deterministically: entries sort by (rule, path, first finding line,
+    snippet), so regenerating the baseline from the same tree always
+    produces byte-identical output and PR diffs review like code. A
+    ``why`` field per entry is preserved across regenerations when the key
+    matches; new entries get an empty why for a human to fill in."""
     old_whys = {}
     if os.path.exists(path):
         try:
@@ -377,11 +819,17 @@ def write_baseline(findings: Sequence[Finding], path: str) -> None:
         except Exception:
             pass
     grouped: Dict[Tuple[str, str, str], int] = {}
+    first_line: Dict[Tuple[str, str, str], int] = {}
     for f in findings:
-        grouped[f.key()] = grouped.get(f.key(), 0) + 1
-    entries = [{"rule": r, "path": p, "snippet": s, "count": c,
-                "why": old_whys.get((r, p, s), "")}
-               for (r, p, s), c in sorted(grouped.items())]
+        k = f.key()
+        grouped[k] = grouped.get(k, 0) + 1
+        if k not in first_line or f.line < first_line[k]:
+            first_line[k] = f.line
+    ordered = sorted(grouped,
+                     key=lambda k: (k[0], k[1], first_line[k], k[2]))
+    entries = [{"rule": r, "path": p, "snippet": s, "count": grouped[k],
+                "why": old_whys.get(k, "")}
+               for k in ordered for (r, p, s) in (k,)]
     with open(path, "w", encoding="utf-8") as f:
         json.dump({"version": BASELINE_VERSION, "findings": entries}, f,
                   indent=2)

@@ -1,0 +1,115 @@
+"""R10: sharding-registry enforcement — every partition decision lives in
+``parallel/sharding.py``.
+
+PR 8 made the partition-rule registry the single source of truth: every
+training-state array resolves its ``PartitionSpec`` by logical name through
+``spec()``, the mesh is always built by ``make_mesh()`` with the registry's
+2-D ``("data", "feature")`` axes, and the jax<0.6 ``shard_map`` compat shim
+lives there too. Until this rule, the "no learner-local PartitionSpec
+literals" invariant was enforced by a grep inside a test — which covered
+exactly four files and could not see a new module regressing. R10 promotes
+it to a package-wide semantic check, active whenever the scanned set
+contains the registry (``parallel/sharding.py`` declaring ``MESH_AXES``);
+without a registry in scope (foreign trees, fixture subsets) the rule stays
+silent rather than inventing an invariant.
+
+Outside the registry module, four constructions are findings:
+
+- ``PartitionSpec(...)`` / ``P(...)`` — a spec literal: the exact ad-hoc
+  drift the registry killed. Resolve the array's spec by name via
+  ``sharding.spec``/``specs`` instead (``NamedSharding(mesh, spec("x")), ``
+  which is why ``NamedSharding`` itself is allowed — only its spec
+  argument must come from the registry).
+- ``Mesh(...)`` — private mesh construction: geometry built outside
+  ``make_mesh`` silently diverges from the registry's always-2-D contract
+  (and from the ``mesh_shape`` knob validation).
+- ``from jax import shard_map`` (or the experimental namespace) — bypasses
+  the registry's version-compat shim; the bare jax import is the exact
+  seed bug that killed 21 test modules at collection on jax<0.6.
+- a private ``*_AXIS = "name"`` constant whose value is not a registry
+  axis — a parallel axis universe waiting to drift (collective CALLS over
+  such an axis are R6's findings; the constant declaration is R10's).
+
+Axis-name checking for ``psum``/``all_gather``/``shard_map`` call sites is
+R6: it resolves axis strings through the same semantic index (literals,
+module constants, cross-module imports) against ``MESH_AXES``. R6 and R10
+together are the registry invariant — names at use sites, construction at
+declaration sites.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
+                    register_rule)
+
+_SPEC_NAMES = frozenset({"P", "PartitionSpec"})
+
+
+@register_rule
+class ShardingRegistryRule(Rule):
+    id = "R10"
+    severity = "error"
+    description = ("PartitionSpec/P literal, private Mesh construction, "
+                   "bare jax shard_map import, or private axis constant "
+                   "outside the parallel/sharding.py registry")
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        if index.registry_relpath is None:
+            return                       # no registry in scope: no invariant
+        if ctx.relpath == index.registry_relpath:
+            return                       # the registry itself
+        for node in ctx.nodes(ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax" or mod.endswith("shard_map"):
+                for alias in node.names:
+                    if alias.name == "shard_map":
+                        yield ctx.finding(
+                            self, node,
+                            f"'from {mod} import shard_map' bypasses the "
+                            f"registry's version-compat shim (the bare "
+                            f"import is the seed bug that killed test "
+                            f"collection on jax<0.6); import it from "
+                            f"{index.registry_relpath} instead")
+        for node in ctx.nodes(ast.Call):
+            name = call_name(node)
+            tail = name.rsplit(".", 1)[-1]
+            if tail in _SPEC_NAMES and (name == tail
+                                        or name.endswith(".sharding." + tail)
+                                        or name.startswith("jax.")):
+                yield ctx.finding(
+                    self, node,
+                    f"{tail}(...) literal outside the partition-rule "
+                    f"registry: every array's spec must resolve by logical "
+                    f"name through {index.registry_relpath} spec()/specs() "
+                    f"so one rule table owns the layout (and the 2-D mesh "
+                    f"stays expressible)")
+            elif tail == "Mesh" and (name == "Mesh"
+                                     or name.startswith("jax.")):
+                yield ctx.finding(
+                    self, node,
+                    f"private Mesh construction outside the registry: "
+                    f"build meshes with {index.registry_relpath} "
+                    f"make_mesh() so geometry always carries the "
+                    f"registry's 2-D ('data', 'feature') axes and the "
+                    f"mesh_shape validation")
+        for node in ctx.nodes(ast.Assign):
+            if not isinstance(ctx.parent(node), ast.Module):
+                continue
+            if (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                cname = node.targets[0].id
+                if (cname.endswith("_AXIS") or cname.endswith("AXIS")) \
+                        and node.value.value not in index.registry_axes:
+                    declared = ", ".join(sorted(
+                        repr(a) for a in index.registry_axes))
+                    yield ctx.finding(
+                        self, node,
+                        f"private axis constant {cname} = "
+                        f"{node.value.value!r} is not a registry axis "
+                        f"(declared: {declared}); axis names live in "
+                        f"{index.registry_relpath} MESH_AXES only")

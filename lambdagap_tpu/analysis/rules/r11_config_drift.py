@@ -1,0 +1,142 @@
+"""R11: config-knob drift — declarations, reads, and inline defaults.
+
+``config.py``'s ``Config`` dataclass is the single source of truth for
+parameter names and defaults (docs/Parameters.md is generated from it).
+Three drift modes rot that contract silently, and all three are
+cross-module properties only the semantic index can check:
+
+- **R11a — declared but never read**: a knob in ``Config`` that no module
+  in the package reads (any attribute access by that name, a
+  ``getattr(cfg, "knob", ...)``, a ``params.get("knob"/alias)``, or a
+  string-keyed subscript). It parses, validates, documents — and does
+  nothing: either wiring was forgotten or the knob is dead. Knobs that
+  are deliberately accepted-but-inert for reference compatibility are
+  listed in ``config.py``'s ``COMPAT_ACCEPTED`` — the declaration file
+  itself owns the exemption, not a lint baseline.
+- **R11b — reads of undeclared knobs** (the typo class): an attribute
+  read on a config-typed receiver (``cfg.X`` / ``config.X`` /
+  ``self.config.X`` / ``booster.config.X`` / ``getattr(cfg, "X")``)
+  whose name is no Config field, method, or property, is never assigned
+  onto a config receiver anywhere in the package (``cfg.data = ...``
+  dynamic attrs are declarations by assignment), and is not ``extra``.
+  A typo'd knob read raises AttributeError at best — and silently reads
+  a stale getattr default at worst.
+- **R11c — divergent inline defaults**: a ``getattr(cfg, "knob",
+  default)`` or ``params.get("knob", default)`` whose inline default
+  disagrees with the declared Config default. The code path that misses
+  the real config silently behaves differently from the documented
+  default — the exact bug class found twice in this tree (a guard policy
+  defaulting to "off" against a declared "raise", a stream threshold
+  defaulting to 0 against a declared 256). Comparison is by literal
+  value with lenient string/number coercion (``"1"`` vs ``1`` and
+  ``"false"`` vs ``False`` are CLI-string conventions, not drift); a
+  non-literal on either side is skipped — the rule never guesses.
+
+Active only when the scanned set contains ``config.py`` (its absence
+means there is no declaration universe to check against).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import (Finding, ModuleContext, PackageIndex, Rule,
+                    register_rule)
+
+# attributes any dataclass instance legitimately exposes
+_DATACLASS_ATTRS = frozenset({
+    "extra", "__dataclass_fields__", "__dict__", "__class__",
+})
+
+
+def _literal(node: Optional[ast.AST]):
+    """ast.literal_eval that returns a sentinel on non-literals."""
+    if node is None:
+        return _literal
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, TypeError, SyntaxError):
+        return _literal                  # sentinel: not statically known
+
+
+def _defaults_agree(declared, inline) -> bool:
+    if declared == inline:
+        return True
+    # CLI-string conventions: params dicts carry "1"/"false" where the
+    # dataclass declares 1/False — same value, stringly typed
+    return str(declared).strip().lower() == str(inline).strip().lower()
+
+
+@register_rule
+class ConfigDriftRule(Rule):
+    id = "R11"
+    severity = "error"
+    description = ("config-knob drift: declared-but-never-read knob, "
+                   "read of an undeclared knob name (typo class), or an "
+                   "inline getattr/params.get default diverging from the "
+                   "declared Config default")
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        if index.config_module is None:
+            return
+        if ctx.relpath == index.config_module:
+            yield from self._check_unused(ctx, index)
+            return
+        declared = index.config_fields
+        known = (set(declared) | index.config_methods | index.knob_writes
+                 | _DATACLASS_ATTRS)
+        for read in index.knob_reads:
+            if read.relpath != ctx.relpath:
+                continue
+            name = read.name
+            canonical = index.config_aliases.get(name, name)
+            if read.kind in ("attr", "getattr") and name not in known \
+                    and not name.startswith("__"):
+                yield ctx.finding(
+                    self, read.node,
+                    f"read of undeclared config knob {name!r}: no such "
+                    f"Config field, method, or dynamically assigned "
+                    f"attribute — a typo here fails at runtime (or "
+                    f"silently reads a getattr default forever)")
+                continue
+            if read.default is None:
+                continue
+            field = declared.get(canonical if read.kind == "params_get"
+                                 else name)
+            if field is None:
+                continue
+            declared_default = _literal(field[0])
+            inline_default = _literal(read.default)
+            if declared_default is _literal or inline_default is _literal:
+                continue                 # non-literal on either side
+            if not _defaults_agree(declared_default, inline_default):
+                yield ctx.finding(
+                    self, read.node,
+                    f"inline default for {name!r} is "
+                    f"{inline_default!r} but config.py declares "
+                    f"{declared_default!r}: the no-config code path "
+                    f"silently disagrees with the documented default — "
+                    f"align the inline default (or read through a real "
+                    f"Config)")
+
+    def _check_unused(self, ctx: ModuleContext, index: PackageIndex
+                      ) -> Iterator[Finding]:
+        reads = set(index.loose_reads)
+        # params.get("alias") marks the canonical knob as read
+        reads |= {index.config_aliases[r] for r in reads
+                  if r in index.config_aliases}
+        for name, (_default, lineno) in sorted(
+                index.config_fields.items()):
+            if name in reads or name in index.compat_knobs:
+                continue
+            anchor = ast.Name(id=name)
+            anchor.lineno = lineno
+            anchor.col_offset = 0
+            yield ctx.finding(
+                self, anchor,
+                f"config knob {name!r} is declared (and documented in "
+                f"Parameters.md) but never read anywhere in the package: "
+                f"wire it up, delete it, or list it in config.py "
+                f"COMPAT_ACCEPTED if it is deliberately accepted-but-"
+                f"inert for reference compatibility")

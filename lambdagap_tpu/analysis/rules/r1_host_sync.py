@@ -12,6 +12,11 @@ Heuristic hot contexts:
 - any function whose name is in :data:`HOT_FUNCTIONS` (the boosting loop,
   gradient computation, score update, serve dispatch, and tensorized
   predict surfaces), at any nesting depth;
+- any function a HOT function *directly calls* (resolved through the
+  semantic index's call graph: ``self`` methods, same-module functions,
+  imported names) — a host-sync helper extracted into a cold file is
+  still one sync per iteration when ``train_one_iter`` calls it, which
+  per-file linting could never see;
 - any for/while loop body inside a :data:`HOT_PATHS` file — ``serve/``
   (the request path), ``ops/predict_tensor.py`` (the inference hot
   path: its tile loop runs once per ``predict_tree_tile`` trees per
@@ -102,22 +107,52 @@ class HostSyncRule(Rule):
     def check(self, ctx: ModuleContext, index: PackageIndex
               ) -> Iterator[Finding]:
         in_hot_path = any(p in ("/" + ctx.relpath) for p in HOT_PATHS)
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             kind = _sync_kind(node)
             if not kind:
                 continue
             funcs = ctx.enclosing_functions(node)
             hot = any(f.name in HOT_FUNCTIONS for f in funcs)
+            hot_caller = None
             if not hot and in_hot_path and funcs:
                 hot = ctx.in_loop(node)
+            if not hot and funcs:
+                hot_caller = self._hot_caller(ctx, index, node)
+                hot = hot_caller is not None
             if not hot:
                 continue
             where = funcs[0].name if funcs else "<module>"
-            yield ctx.finding(
-                self, node,
-                f"{kind} blocks the host on the device stream inside hot "
-                f"function '{where}'; hoist it out of the per-iteration "
-                f"path, keep the value on device, or suppress with a "
-                f"justification if the sync is inherent")
+            if hot_caller is not None:
+                yield ctx.finding(
+                    self, node,
+                    f"{kind} blocks the host on the device stream inside "
+                    f"'{where}', which hot function '{hot_caller}' calls "
+                    f"(call-graph reach: the helper lives in a cold file "
+                    f"but runs once per iteration/dispatch); hoist the "
+                    f"sync out of the per-iteration path, keep the value "
+                    f"on device, or suppress with a justification if the "
+                    f"sync is inherent")
+            else:
+                yield ctx.finding(
+                    self, node,
+                    f"{kind} blocks the host on the device stream inside "
+                    f"hot function '{where}'; hoist it out of the "
+                    f"per-iteration path, keep the value on device, or "
+                    f"suppress with a justification if the sync is "
+                    f"inherent")
+
+    @staticmethod
+    def _hot_caller(ctx: ModuleContext, index: PackageIndex,
+                    node: ast.AST):
+        """The name of a HOT_FUNCTIONS function that directly calls the
+        indexed function enclosing ``node``, or None. One level through
+        the call graph — the ISSUE-10 retarget: a host-sync helper called
+        from a hot path is hot even when it lives in a cold file."""
+        fi = index.function_of(ctx, node)
+        if fi is None or fi.name in HOT_FUNCTIONS:
+            return None
+        for caller_key in index.callers.get(fi.key, ()):
+            caller = index.functions.get(caller_key)
+            if caller is not None and caller.name in HOT_FUNCTIONS:
+                return caller.qualname
+        return None

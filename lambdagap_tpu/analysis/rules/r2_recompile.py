@@ -104,8 +104,8 @@ class RecompileRule(Rule):
 
     def check(self, ctx: ModuleContext, index: PackageIndex
               ) -> Iterator[Finding]:
-        for node in ast.walk(ctx.tree):
-            if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+        for node in ctx.nodes(ast.Call):
+            if not _is_jit_call(node):
                 continue
             if ctx.in_loop(node):
                 yield ctx.finding(

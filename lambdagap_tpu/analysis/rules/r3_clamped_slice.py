@@ -68,9 +68,7 @@ class ClampedSliceRule(Rule):
 
     def check(self, ctx: ModuleContext, index: PackageIndex
               ) -> Iterator[Finding]:
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             tail = call_name(node).rsplit(".", 1)[-1]
             if tail not in _SLICE_FNS:
                 continue

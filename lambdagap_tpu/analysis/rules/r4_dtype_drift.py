@@ -40,9 +40,7 @@ class DtypeDriftRule(Rule):
 
     def check(self, ctx: ModuleContext, index: PackageIndex
               ) -> Iterator[Finding]:
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             name = call_name(node)
             if not name.startswith(_PREFIXES):
                 continue

@@ -102,9 +102,7 @@ class LockDisciplineRule(Rule):
     def check(self, ctx: ModuleContext, index: PackageIndex
               ) -> Iterator[Finding]:
         # R5a: blocking calls lexically inside `with <lock>:` bodies
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.With):
-                continue
+        for node in ctx.nodes(ast.With):
             if not any(_is_lock_expr(item.context_expr)
                        for item in node.items):
                 continue
@@ -120,9 +118,7 @@ class LockDisciplineRule(Rule):
                         f"behind it; move the blocking work outside the "
                         f"critical section (lock only the pointer flip)")
         # R5b: mixed locked/unlocked writes of the same attribute
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.ClassDef):
-                continue
+        for node in ctx.nodes(ast.ClassDef):
             if not _lock_attrs(node):
                 continue
             locked: Set[str] = set()

@@ -48,9 +48,7 @@ class CollectiveAxisRule(Rule):
         universe = index.registry_axes or index.axis_names
         if not universe:
             return
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
+        for node in ctx.nodes(ast.Call):
             name = call_name(node)
             tail = name.rsplit(".", 1)[-1]
             if tail not in _COLLECTIVES:

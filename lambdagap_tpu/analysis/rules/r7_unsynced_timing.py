@@ -104,8 +104,10 @@ class UnsyncedTimingRule(Rule):
             yield from self._check_scope(ctx, nodes)
 
     def _scopes(self, ctx: ModuleContext) -> Dict:
+        # only the node kinds _check_scope classifies into events — the
+        # full-tree grouping was the old hot spot of the whole scan
         scopes: Dict = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.nodes(ast.Assign, ast.BinOp, ast.Call):
             funcs = ctx.enclosing_functions(node)
             key = funcs[0] if funcs else None
             scopes.setdefault(key, []).append(node)

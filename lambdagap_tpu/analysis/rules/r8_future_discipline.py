@@ -66,9 +66,8 @@ class FutureDisciplineRule(Rule):
               ) -> Iterator[Finding]:
         in_serve = "/serve/" in ("/" + ctx.relpath)
         # R8a: swallowed exceptions, anywhere in the scanned tree
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.ExceptHandler) \
-                    and _is_swallow_body(node.body):
+        for node in ctx.nodes(ast.ExceptHandler):
+            if _is_swallow_body(node.body):
                 yield ctx.finding(
                     self, node,
                     "exception swallowed (handler body is only 'pass'): the "
@@ -77,9 +76,7 @@ class FutureDisciplineRule(Rule):
         if not in_serve:
             return
         # R8b: future-resolving functions with non-resolving except paths
-        for fn in ast.walk(ctx.tree):
-            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
+        for fn in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
             resolves = any(
                 isinstance(n, ast.Call)
                 and call_name(n).rsplit(".", 1)[-1] == "set_result"

@@ -1,0 +1,242 @@
+"""R9: lock-order deadlock detection + blocking work reachable under a lock.
+
+The serve fleet (registry, router, batcher, frontend, stats) holds ~50 lock
+sites across half a dozen classes, each with a hand-written discipline
+docstring. R5 checks each ``with <lock>:`` block *lexically* inside one
+file; what it cannot see is the cross-function structure:
+
+- **R9a — lock-order cycles**: the lock-acquisition graph has an edge
+  ``A -> B`` whenever lock B is acquired while A is held — either a nested
+  ``with`` or, through ONE level of resolved intra-package calls, a callee
+  that acquires B (``submit`` holds the batcher's submit lock and calls
+  ``FairQueue.try_put``, which takes the queue condition). Two threads
+  traversing a cycle in that graph in opposite orders deadlock; the rule
+  flags every edge that participates in a cycle, naming the full cycle.
+  Lock identity is ``(class, attr)`` — ``self._lock`` resolves through the
+  enclosing class, ``entry.swap_lock`` through the unique class declaring
+  that lock attribute, module-global locks through their module. Ambiguous
+  receivers are skipped: the graph never guesses (a missed edge is a
+  false negative, an invented one poisons every cycle report).
+- **R9b — blocking work reachable while holding a lock**: a blocking call
+  (``Event.wait``, socket ``sendall``/``recv``, ``Future.result``,
+  ``join``, ``sleep``, device transfers, forest compiles) that R5's
+  lexical scope misses — either because it sits in a CALLEE one resolved
+  call away, or because the lock's attribute name defeats R5's
+  name-based heuristic (``self._tx``, ``self._mu``) while the semantic
+  index knows the attribute was initialized to a ``threading.Lock``.
+  ``Condition.wait``/``notify`` on the very lock being held are exempt
+  (wait releases it — that is the point of a condition variable).
+
+Scoped to ``serve/`` like R5: that is where client threads, batcher
+workers, swap controllers, registry builders, router callbacks, and socket
+writers all interleave.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import (Finding, FunctionInfo, ModuleContext, PackageIndex,
+                    Rule, call_name, dotted_name, register_rule)
+from .r5_lock_discipline import _BLOCKING_METHODS, _QUEUEISH, _is_lock_expr
+
+LockId = Tuple[str, str]
+
+# condition-variable verbs on the held lock itself: wait RELEASES the lock,
+# notify never blocks — the canonical pattern, not a hazard
+_COND_VERBS = frozenset({"wait", "notify", "notify_all"})
+
+
+def _fmt_lock(lock: LockId) -> str:
+    return f"{lock[0]}.{lock[1]}"
+
+
+def _blocking_kind(call: ast.Call) -> str:
+    """R5's blocking-call classifier (shared so the two rules never
+    disagree about what 'blocking' means)."""
+    name = call_name(call)
+    tail = name.rsplit(".", 1)[-1]
+    if tail in _BLOCKING_METHODS:
+        return name
+    if tail in ("get", "put"):
+        recv = name.rsplit(".", 2)
+        if len(recv) >= 2 and any(recv[-2].lower().endswith(q)
+                                  for q in _QUEUEISH):
+            return name
+    return ""
+
+
+class _Edge:
+    __slots__ = ("src", "dst", "relpath", "node", "via")
+
+    def __init__(self, src: LockId, dst: LockId, relpath: str,
+                 node: ast.AST, via: str) -> None:
+        self.src = src
+        self.dst = dst
+        self.relpath = relpath
+        self.node = node
+        self.via = via
+
+
+class _Analysis:
+    """Whole-scan lock analysis, computed once per PackageIndex and cached
+    on it (check() runs per module; cycles are a package property)."""
+
+    def __init__(self, index: PackageIndex) -> None:
+        self.edges: List[_Edge] = []
+        self.blocking: List[Tuple[str, ast.AST, str]] = []  # rel, node, msg
+        for fi in index.functions.values():
+            # the graph spans serve/ (the issue's concurrency surface);
+            # callees OUTSIDE serve/ still contribute when called from it,
+            # via FunctionInfo.acquires in _check_call
+            if "/serve/" in "/" + fi.relpath:
+                self._analyze(index, fi)
+        graph: Dict[LockId, Set[LockId]] = {}
+        for e in self.edges:
+            graph.setdefault(e.src, set()).add(e.dst)
+        self.cyclic_edges: Dict[int, List[LockId]] = {}
+        for e in self.edges:
+            path = self._path(graph, e.dst, e.src)
+            if path is not None:
+                self.cyclic_edges[id(e)] = [e.src] + path
+
+    @staticmethod
+    def _path(graph: Dict[LockId, Set[LockId]], start: LockId,
+              goal: LockId) -> Optional[List[LockId]]:
+        """A path start -> ... -> goal in the acquisition graph, or None."""
+        stack: List[Tuple[LockId, List[LockId]]] = [(start, [start])]
+        seen: Set[LockId] = set()
+        while stack:
+            node, path = stack.pop()
+            if node == goal:
+                return path
+            if node in seen:
+                continue
+            seen.add(node)
+            for nxt in sorted(graph.get(node, ())):
+                stack.append((nxt, path + [nxt]))
+        return None
+
+    def _analyze(self, index: PackageIndex, fi: FunctionInfo) -> None:
+        callee_of = {id(c): callee for c, callee in fi.resolved_calls}
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.With):
+                continue
+            held: Optional[LockId] = None
+            held_exprs: List[str] = []
+            r5_covers = False
+            for item in node.items:
+                ident = index.lock_identity(fi, item.context_expr)
+                if ident is not None and held is None:
+                    held = ident
+                    held_exprs.append(dotted_name(item.context_expr))
+                    r5_covers = _is_lock_expr(item.context_expr)
+            if held is None:
+                continue
+            for sub in ast.walk(node):
+                if sub is node:
+                    continue
+                if isinstance(sub, ast.With):
+                    for item in sub.items:
+                        inner = index.lock_identity(fi, item.context_expr)
+                        if inner is not None and inner != held:
+                            self.edges.append(_Edge(
+                                held, inner, fi.relpath, sub,
+                                f"nested with in {fi.qualname}"))
+                elif isinstance(sub, ast.Call):
+                    self._check_call(index, fi, callee_of, held,
+                                     held_exprs, r5_covers, sub)
+
+    def _check_call(self, index: PackageIndex, fi: FunctionInfo,
+                    callee_of: Dict[int, FunctionInfo], held: LockId,
+                    held_exprs: List[str], r5_covers: bool,
+                    call: ast.Call) -> None:
+        name = call_name(call)
+        recv = name.rsplit(".", 1)[0] if "." in name else ""
+        callee = callee_of.get(id(call))
+        if callee is not None:
+            # one level through the call graph: locks the callee acquires
+            for (inner, _w) in callee.acquires:
+                if inner != held:
+                    self.edges.append(_Edge(
+                        held, inner, fi.relpath, call,
+                        f"{fi.qualname} -> {callee.qualname}"))
+            # ... and blocking work it performs
+            for sub in ast.walk(callee.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                kind = _blocking_kind(sub)
+                if not kind:
+                    continue
+                sub_name = call_name(sub)
+                sub_recv = sub_name.rsplit(".", 1)[0] \
+                    if "." in sub_name else ""
+                # the callee's own condition-wait on a lock it holds is
+                # its own (legitimate) pattern, not this caller's hazard
+                if (sub_name.rsplit(".", 1)[-1] in _COND_VERBS
+                        and isinstance(sub.func, ast.Attribute)):
+                    cid = index.lock_identity(callee, sub.func.value)
+                    if cid is not None and any(
+                            cid == a for a, _ in callee.acquires):
+                        continue
+                self.blocking.append((
+                    fi.relpath, call,
+                    f"blocking call {kind}(...) inside "
+                    f"{callee.qualname}() is reachable while "
+                    f"'{fi.qualname}' holds {_fmt_lock(held)} (one call "
+                    f"away — outside R5's lexical scope); move the "
+                    f"blocking work out of the critical section"))
+                break                    # one finding per call site
+        elif not r5_covers:
+            # lexical blocking call under an identity-resolved lock whose
+            # name defeats R5's heuristic (self._tx, self._mu, ...)
+            kind = _blocking_kind(call)
+            if not kind:
+                return
+            if name.rsplit(".", 1)[-1] in _COND_VERBS \
+                    and recv in held_exprs:
+                return                   # cond.wait() on the held lock
+            self.blocking.append((
+                fi.relpath, call,
+                f"blocking call {kind}(...) while holding "
+                f"{_fmt_lock(held)} (a threading lock R5's name heuristic "
+                f"does not see); every thread contending on it convoys "
+                f"behind the call — lock only the pointer flip"))
+
+
+@register_rule
+class LockOrderRule(Rule):
+    id = "R9"
+    severity = "error"
+    description = ("lock-order cycle in the serve acquisition graph "
+                   "(potential deadlock), or blocking work reachable "
+                   "while holding a lock through a call R5 cannot see")
+    path_filter = ("/serve/",)
+
+    def _analysis(self, index: PackageIndex) -> _Analysis:
+        cached = getattr(index, "_r9_analysis", None)
+        if cached is None:
+            cached = _Analysis(index)
+            index._r9_analysis = cached
+        return cached
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        ana = self._analysis(index)
+        for e in ana.edges:
+            if e.relpath != ctx.relpath:
+                continue
+            cycle = ana.cyclic_edges.get(id(e))
+            if cycle is None:
+                continue
+            loop = " -> ".join(_fmt_lock(l) for l in cycle + [cycle[0]])
+            yield ctx.finding(
+                self, e.node,
+                f"lock-order cycle: acquiring {_fmt_lock(e.dst)} while "
+                f"holding {_fmt_lock(e.src)} (via {e.via}) closes the "
+                f"cycle {loop}; two threads entering it in opposite "
+                f"orders deadlock — impose one global acquisition order "
+                f"or drop to a single lock")
+        for rel, node, msg in ana.blocking:
+            if rel == ctx.relpath:
+                yield ctx.finding(self, node, msg)

@@ -148,6 +148,34 @@ _alias("telemetry_out", "telemetry_file", "run_log")
 
 # Fork delta aliases (none published; canonical names only)
 
+# ---------------------------------------------------------------------------
+# Knobs accepted for reference compatibility but deliberately inert on TPU:
+# they parse, validate, alias-resolve, and round-trip through model files,
+# but no module in the package reads them at runtime (row/col-wise forcing,
+# histogram pooling, OpenMP threading, sparse toggles, and the GPU device
+# selection block have no TPU analog — XLA owns those decisions). graftlint
+# R11 treats this set as the single source of truth for "declared but
+# intentionally unread": a knob losing its last read site must either be
+# wired back up or be listed here, in the declaration file, where reviewers
+# of config changes will see it — not in a lint baseline.
+# ---------------------------------------------------------------------------
+COMPAT_ACCEPTED = frozenset({
+    "num_threads",            # OpenMP thread count; XLA manages threading
+    "force_col_wise",         # row/col-wise histogram choice is layout-fixed here
+    "force_row_wise",
+    "histogram_pool_size",    # host histogram pool; histograms live in HBM
+    "is_enable_sparse",       # sparse row format; the packed binned matrix is dense
+    "feature_pre_filter",     # bin-time feature filtering not implemented
+    "save_binary",            # reference binary dataset dump format
+    "precise_float_parser",   # reference text parser option; numpy parses here
+    "parser_config_file",
+    "time_out",               # socket-cluster timeout; TPU meshes have no sockets
+    "gpu_platform_id",        # GPU device selection block: no analog on TPU
+    "gpu_device_id",
+    "gpu_use_dp",
+    "num_gpu",
+})
+
 _OBJECTIVE_ALIASES = {
     "regression": "regression", "regression_l2": "regression", "l2": "regression",
     "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",

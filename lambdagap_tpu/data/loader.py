@@ -447,7 +447,8 @@ def load_data_file(path: str, config: Config,
         return load_binary(path)
     if config.two_round:
         return _load_two_round(path, config, reference)
-    thr = getattr(config, "stream_ingest_threshold_mb", 0)
+    # fallback mirrors the declared Config default (graftlint R11)
+    thr = getattr(config, "stream_ingest_threshold_mb", 256)
     try:
         fsize = os.path.getsize(path)
     except OSError:

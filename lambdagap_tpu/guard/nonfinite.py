@@ -94,7 +94,9 @@ class TrainGuard:
 
     @classmethod
     def from_config(cls, config) -> "TrainGuard":
-        return cls(policy=getattr(config, "guard_nonfinite", "off"),
+        # fallback mirrors the declared Config default (graftlint R11
+        # checks the two stay in agreement)
+        return cls(policy=getattr(config, "guard_nonfinite", "raise"),
                    clip=getattr(config, "guard_clip", 1e30),
                    plan=faults_mod.plan_for(config))
 

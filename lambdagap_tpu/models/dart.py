@@ -249,8 +249,12 @@ class RF(GBDT):
 
     def _renew_tree_output_rf(self, tree: Tree, k: int, mask) -> None:
         init = self.init_scores[k]
+        # graftlint: disable=R1 — RF leaf renewal is a host percentile
+        # refit by design (objective.renew_tree_output); perm + mask are
+        # fetched once per tree, not per split, on the opt-in rf path
         perm = np.asarray(jax.device_get(self.learner.last_perm))
         const_score = np.full(self.num_data, init)
+        # graftlint: disable=R1 — same per-tree RF renew transfer as above
         mask_np = None if mask is None else np.asarray(jax.device_get(mask))
         begins = self.learner.last_leaf_begin
         counts = self.learner.last_leaf_count

@@ -437,6 +437,10 @@ class FusedTreeLearner(SerialTreeLearner):
     def materialize(self, rec: DeviceTree) -> Tree:
         """Fetch a DeviceTree and build the host Tree model (one transfer;
         row_leaf stays on device — it is O(N))."""
+        # graftlint: disable=R1 — THE materialization boundary of the fused
+        # learner: one compact O(leaves) struct transfer per tree builds
+        # the host model; scores already updated on device, so this is the
+        # only per-tree D2H of the sync-free path
         h = jax.device_get({k: v for k, v in rec._asdict().items()
                             if k != "row_leaf"})
         return self._tree_from_host(h)

@@ -625,9 +625,11 @@ class GBDT:
             from ..data.binning import BIN_CATEGORICAL
             if m.bin_type == BIN_CATEGORICAL:
                 numeric[j] = False
-        g = np.asarray(jax.device_get(grad))
-        h = np.asarray(jax.device_get(hess))
-        perm = np.asarray(jax.device_get(self.learner.last_perm))
+        # graftlint: disable=R1 — linear-tree leaf fit is a host lstsq over
+        # the retained RAW matrix by design (opt-in linear_tree path); the
+        # three operands ride ONE batched transfer, once per tree
+        g, h, perm = (np.asarray(a) for a in jax.device_get(
+            (grad, hess, self.learner.last_perm)))
         begins = self.learner.last_leaf_begin
         counts = self.learner.last_leaf_count
 
@@ -726,6 +728,9 @@ class GBDT:
                             "values (metrics will not match predict())",
                             self.valid_sets[vi][0])
             if vraw is not None:
+                # graftlint: disable=R1 — linear-tree valid-set eval must
+                # gather raw feature rows per leaf on the host; one
+                # transfer per tree per valid set, opt-in linear_tree path
                 leaf_idx = np.asarray(jax.device_get(
                     predict_leaf_index_binned(x, arrs, depth)))
                 add = linear_leaf_outputs(tree, vraw, leaf_idx)
@@ -739,10 +744,15 @@ class GBDT:
         """L1-family leaf refit by weighted percentile of residuals
         (reference: RenewTreeOutput path in gbdt.cpp:412 +
         regression_objective.hpp percentiles)."""
-        score = np.asarray(jax.device_get(self.scores[k]))
-        mask_np = None if mask is None else np.asarray(jax.device_get(mask))
+        # graftlint: disable=R1 — the L1-family leaf refit (RenewTreeOutput)
+        # is a host percentile pass over residuals by design, once per tree
+        # on the opt-in renew path; score + mask ride ONE batched transfer
+        score, mask_np = (None if a is None else np.asarray(a)
+                          for a in jax.device_get((self.scores[k], mask)))
         if getattr(self.learner, "last_row_leaf", None) is not None:
             # fused learner: leaf membership from row_leaf
+            # graftlint: disable=R1 — same renew pass: leaf membership is
+            # consumed by the host percentile refit, one transfer per tree
             row_leaf = np.asarray(jax.device_get(self.learner.last_row_leaf))
             for leaf in range(tree.num_leaves):
                 rows = np.nonzero(row_leaf == leaf)[0]
@@ -752,6 +762,8 @@ class GBDT:
                     tree.leaf_value[leaf] = self.objective.renew_tree_output(
                         rows, score)
             return
+        # graftlint: disable=R1 — same renew pass, host-loop learners: the
+        # leaf permutation feeds the host percentile refit, once per tree
         perm = np.asarray(jax.device_get(self.learner.last_perm))
         begins = self.learner.last_leaf_begin
         counts = self.learner.last_leaf_count

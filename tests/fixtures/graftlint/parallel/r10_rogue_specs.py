@@ -1,0 +1,27 @@
+"""R10 fixture (ISSUE 10): every way a module can bypass the registry.
+
+With ``parallel/sharding.py`` (the partition-rule registry) in the scanned
+set, spec literals, private mesh construction, the bare jax ``shard_map``
+import (the seed bug that killed test collection on jax<0.6), and private
+axis constants are all findings — the grep acceptance test promoted into
+a package-wide semantic rule.
+"""
+import numpy as np
+from jax import shard_map  # BAD:R10 — bypasses the registry's compat shim
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROGUE_AXIS = "rows"  # BAD:R10 — private axis constant, not a registry axis
+
+
+def private_mesh(devs):
+    return Mesh(np.asarray(devs), ("rows",))  # BAD:R10 — use make_mesh()
+
+
+def local_spec_literal(mesh, arr):
+    sharding = NamedSharding(mesh, P("data"))  # BAD:R10 — spec literal
+    return sharding
+
+
+def registry_resolved(mesh, spec):
+    # specs resolved through the registry (a variable here) are fine
+    return NamedSharding(mesh, spec)

@@ -1,0 +1,16 @@
+"""R11 fixture (ISSUE 10): a miniature Config with one knob of each kind.
+
+``alpha_rate`` is read by the consumer module (clean); ``beta_window`` is
+declared but read by nobody (R11a); ``legacy_knob`` is unread too but
+listed in COMPAT_ACCEPTED — the declaration file owns its exemption.
+"""
+from dataclasses import dataclass
+
+COMPAT_ACCEPTED = frozenset({"legacy_knob"})
+
+
+@dataclass
+class Config:
+    alpha_rate: float = 0.1
+    beta_window: int = 64  # BAD:R11 — declared but never read anywhere
+    legacy_knob: int = 0   # accepted-but-inert: exempt via COMPAT_ACCEPTED

@@ -1,0 +1,20 @@
+"""R11 fixture (ISSUE 10): the three knob-drift read shapes.
+
+``cfg.alpha_rate`` is a clean declared read. ``cfg.alpha_rte`` is the typo
+class — no such field, method, or dynamically assigned attribute, so the
+read fails at runtime (R11b). The ``getattr`` fallback default disagreeing
+with the declared default (0.5 vs 0.1) is the silent-divergence class
+(R11c) — the no-config code path behaves differently from the documented
+default. The ``params.get`` with the MATCHING default shows the clean
+shape; dynamic attributes assigned onto the config (``cfg.resolved``) are
+declarations by assignment, not typos.
+"""
+
+
+def fit(cfg, params):
+    lr = cfg.alpha_rate
+    bad = cfg.alpha_rte  # BAD:R11 — typo'd knob read
+    fallback = getattr(cfg, "alpha_rate", 0.5)  # BAD:R11 — divergent default
+    ok = params.get("alpha_rate", 0.1)
+    cfg.resolved = True
+    return lr, bad, fallback, ok, cfg.resolved

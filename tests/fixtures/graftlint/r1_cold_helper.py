@@ -1,0 +1,17 @@
+"""R1 fixture (ISSUE 10): a host-sync helper in a COLD file.
+
+Nothing here is hot by name or path — per-file linting scans it clean.
+But ``r1_hot_caller.py``'s ``train_one_iter`` calls ``fetch_row_count``
+directly, so the sync runs once per boosting iteration; the call-graph
+retarget flags it here, naming the hot caller.
+"""
+import jax
+
+
+def fetch_row_count(state):
+    return int(jax.device_get(state.count))  # BAD:R1 — called from a hot fn
+
+
+def cold_and_uncalled(state):
+    # same sync shape, but nothing hot calls this helper: clean
+    return int(jax.device_get(state.count))

@@ -1,0 +1,9 @@
+"""R1 fixture (ISSUE 10): the hot function whose call makes a cold helper
+hot. This file itself has no sync and scans clean — the finding lands in
+r1_cold_helper.py, where the sync lives."""
+from .r1_cold_helper import fetch_row_count
+
+
+def train_one_iter(state):
+    n = fetch_row_count(state)
+    return n + 1

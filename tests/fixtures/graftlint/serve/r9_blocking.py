@@ -1,0 +1,31 @@
+"""R9 fixture (ISSUE 10): blocking work under a lock that R5 cannot see.
+
+Two shapes R5's lexical, name-heuristic scope misses:
+
+- the lock attribute is named ``_mu`` — no "lock" substring, so R5's
+  ``with <lock>:`` detector never engages; the semantic index knows the
+  attribute was initialized to ``threading.Lock()`` and flags the
+  ``Event.wait`` held under it;
+- the blocking ``sendall`` lives one resolved call away (``publish``
+  holds the lock and calls ``self._push``) — invisible to any lexical
+  scan of the ``with`` body.
+"""
+import threading
+
+
+class Publisher:
+    def __init__(self, sock):
+        self.sock = sock
+        self._mu = threading.Lock()
+        self._done = threading.Event()
+
+    def _push(self, payload):
+        self.sock.sendall(payload)
+
+    def publish(self, payload):
+        with self._mu:
+            self._push(payload)  # BAD:R9 — sendall reachable under _mu
+
+    def wait_done(self):
+        with self._mu:
+            self._done.wait()  # BAD:R9 — Event.wait while holding _mu

@@ -1,0 +1,25 @@
+"""R9 fixture (ISSUE 10): one half of a cross-module lock-order cycle.
+
+This module's admission path holds REG_LOCK and flushes stats (which takes
+STATS_LOCK in r9_cycle_b); that module's rollup path holds STATS_LOCK and
+audits the registry (which takes REG_LOCK here). Two threads entering the
+two paths concurrently deadlock — a property NO single-file lint can see:
+each file in isolation is a perfectly ordinary lock-then-call shape.
+"""
+import threading
+
+from .r9_cycle_b import flush_stats
+
+REG_LOCK = threading.Lock()
+_MODELS = {}
+
+
+def admit(name, model):
+    with REG_LOCK:
+        _MODELS[name] = model
+        flush_stats(name)  # BAD:R9 — acquires STATS_LOCK while REG_LOCK held
+
+
+def audit_registry(names):
+    with REG_LOCK:
+        return [n for n in names if n in _MODELS]

@@ -1,0 +1,25 @@
+"""R9 fixture (ISSUE 10): the other half of the cross-module lock cycle.
+
+``rollup`` holds STATS_LOCK and calls back into r9_cycle_a's
+``audit_registry`` (which takes REG_LOCK) — the reverse order of
+r9_cycle_a.admit. Each edge of the cycle is flagged in the module that
+creates it. (The circular import never executes: graftlint parses, it
+does not import.)
+"""
+import threading
+
+from .r9_cycle_a import audit_registry
+
+STATS_LOCK = threading.Lock()
+_COUNTS = {}
+
+
+def flush_stats(name):
+    with STATS_LOCK:
+        _COUNTS[name] = _COUNTS.get(name, 0) + 1
+
+
+def rollup(names):
+    with STATS_LOCK:
+        live = audit_registry(names)  # BAD:R9 — REG_LOCK under STATS_LOCK
+        return {n: _COUNTS.get(n, 0) for n in live}

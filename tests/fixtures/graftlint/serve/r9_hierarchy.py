@@ -1,0 +1,45 @@
+"""R9 fixture (ISSUE 10): a CLEAN hierarchical lock order.
+
+The real registry -> stats shape: the registry's admission path holds its
+own lock and bumps stats (which takes the stats lock) — a one-directional
+edge. No path ever acquires the registry lock while holding the stats
+lock, so the acquisition graph is acyclic and the module must scan clean.
+The condition-variable wait is the canonical pattern (wait RELEASES the
+held lock) and must not flag either.
+"""
+import threading
+
+
+class HierStats:
+    def __init__(self):
+        self.hier_stats_lock = threading.Lock()
+        self.admitted = 0
+
+    def bump(self):
+        with self.hier_stats_lock:
+            self.admitted += 1
+
+
+class HierRegistry:
+    def __init__(self):
+        self.hier_reg_lock = threading.Lock()
+        self._stats = HierStats()
+        self._entries = {}
+        self._cv = threading.Condition()
+
+    def admit(self, name, model):
+        with self.hier_reg_lock:
+            self._entries[name] = model
+            self._stats.bump()           # registry -> stats: one direction
+
+    def wait_for(self, name):
+        with self._cv:
+            while name not in self._entries:
+                self._cv.wait()          # releases _cv: the cond pattern
+            return self._entries[name]
+
+    def announce(self, name, model):
+        with self.hier_reg_lock:
+            self._entries[name] = model
+        with self._cv:
+            self._cv.notify_all()
