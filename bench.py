@@ -596,6 +596,161 @@ def run_layout_ab(rows: int, max_bin: int, iters: int) -> None:
     }))
 
 
+def _wall_metric_curve(booster, iters: int, metric_fn):
+    """Train ``iters`` rounds, recording (cumulative wall seconds, metric)
+    after every round, device-complete at each boundary (graftlint R7)."""
+    import numpy as np
+    walls, metrics = [], []
+    t0 = time.time()
+    for _ in range(iters):
+        booster.update()
+        np.asarray(booster._booster.scores[0][:1])
+        walls.append(time.time() - t0)
+        metrics.append(metric_fn(booster))
+    return walls, metrics
+
+
+def _first_crossing(walls, metrics, target: float, higher_better: bool):
+    """(wall_s, iteration) of the first round meeting ``target``."""
+    for i, m in enumerate(metrics):
+        if (m >= target) if higher_better else (m <= target):
+            return round(walls[i], 4), i + 1
+    return None, None
+
+
+def run_linear_ab(rows: int, max_bin: int, iters: int) -> None:
+    """Child-process entry (ISSUE 11): constant-leaf vs piece-wise LINEAR
+    leaves at HIGGS- and MSLR-shaped configs, scored by
+    WALL-CLOCK-TO-TARGET-METRIC — not per-iteration cost. arXiv:1802.05640's
+    claim is that linear leaves reach equal accuracy in 2-5x fewer
+    iterations; per-iter comparisons would hide exactly that, so each
+    shape's target is the CONSTANT arm's final valid metric after ``iters``
+    rounds and both arms report the wall/iterations to first reach it.
+
+    Env: BENCH_LINEAR_LEAVES overrides num_leaves; BENCH_LINEAR_RANK_Q the
+    MSLR-shaped query count. The CPU container validates the machinery
+    (and the iteration-count ratio, which is hardware-independent); the
+    wall-clock ratio is a bench-chip number."""
+    _configure_jax_cache()
+    import jax
+
+    import lambdagap_tpu as lgb
+
+    leaves = int(os.environ.get("BENCH_LINEAR_LEAVES", 63))
+    out = {"rows": rows, "max_bin": max_bin, "iters": iters,
+           "num_leaves": leaves, "backend": jax.default_backend(),
+           "device": str(jax.devices()[0]),
+           "method": ("per-iteration wall+metric curves, device-complete "
+                      "each boundary; target = constant arm's FINAL valid "
+                      "metric; wall_to_target = first crossing")}
+
+    # -- HIGGS-shaped: binary, dense numeric features -------------------
+    z = np.load(_ensure_data(rows))
+    X, y = z["X"], z["y"]
+    n_tr = int(len(X) * 0.85)
+    higgs = {}
+    for arm, extra in (("constant", {}),
+                       ("linear", {"linear_tree": True,
+                                   "linear_lambda": 0.01})):
+        params = {"objective": "binary", "num_leaves": leaves,
+                  "learning_rate": 0.1, "max_bin": max_bin,
+                  "min_data_in_leaf": 50, "verbose": -1,
+                  "tpu_fused_learner": "1", **extra}
+        t0 = time.time()
+        dtrain = lgb.Dataset(X[:n_tr], label=y[:n_tr], params=params)
+        booster = lgb.Booster(params=params, train_set=dtrain)
+        dvalid = lgb.Dataset(X[n_tr:], label=y[n_tr:], reference=dtrain)
+        booster.add_valid(dvalid, "valid")
+        construct_s = time.time() - t0
+        booster.update()                      # compile outside the clock
+        np.asarray(booster._booster.scores[0][:1])
+        yv = y[n_tr:]
+
+        def val_auc(b, yv=yv):
+            return auc_score(yv, np.asarray(b._booster.valid_scores[0][0]))
+
+        walls, aucs = _wall_metric_curve(booster, iters, val_auc)
+        higgs[arm] = {"construct_s": round(construct_s, 3),
+                      "per_iter_s": round(walls[-1] / iters, 4),
+                      "final_auc": round(aucs[-1], 5),
+                      "auc_curve": [round(a, 5) for a in aucs],
+                      "wall_curve_s": [round(w, 3) for w in walls]}
+    target = higgs["constant"]["final_auc"]
+    for arm in higgs:
+        w, it = _first_crossing(higgs[arm]["wall_curve_s"],
+                                higgs[arm]["auc_curve"], target, True)
+        higgs[arm]["wall_to_target_s"] = w
+        higgs[arm]["iters_to_target"] = it
+    wc, wl = (higgs["constant"]["wall_to_target_s"],
+              higgs["linear"]["wall_to_target_s"])
+    higgs["target_auc"] = target
+    higgs["speedup_wall_to_target"] = (round(wc / wl, 3)
+                                       if wc and wl else None)
+    ic, il = (higgs["constant"]["iters_to_target"],
+              higgs["linear"]["iters_to_target"])
+    higgs["iter_ratio_to_target"] = (round(ic / il, 3)
+                                     if ic and il else None)
+    out["higgs_shaped"] = higgs
+
+    # -- MSLR-shaped: lambdarank over graded-relevance queries ----------
+    rng = np.random.RandomState(11)
+    n_q = int(os.environ.get("BENCH_LINEAR_RANK_Q", 400))
+    F = 136
+    sizes = rng.randint(40, 201, n_q)
+    N = int(sizes.sum())
+    Xr = rng.randn(N, F).astype(np.float32)
+    w = rng.randn(F).astype(np.float32) * (rng.rand(F) < 0.2)
+    latent = Xr @ w * 0.6 + rng.randn(N).astype(np.float32)
+    yr = np.clip(np.floor(latent - latent.mean() + 0.8), 0,
+                 4).astype(np.float32)
+    n_train_q = int(n_q * 0.9)
+    train_docs = int(sizes[:n_train_q].sum())
+    mslr = {}
+    for arm, extra in (("constant", {}),
+                       ("linear", {"linear_tree": True,
+                                   "linear_lambda": 0.01})):
+        params = {"objective": "lambdarank", "metric": "ndcg",
+                  "eval_at": [10], "num_leaves": leaves,
+                  "learning_rate": 0.1, "max_bin": max_bin,
+                  "min_data_in_leaf": 50, "verbose": -1,
+                  "tpu_fused_learner": "1", **extra}
+        dtrain = lgb.Dataset(Xr[:train_docs], label=yr[:train_docs],
+                             group=sizes[:n_train_q], params=params)
+        booster = lgb.Booster(params=params, train_set=dtrain)
+        dvalid = lgb.Dataset(Xr[train_docs:], label=yr[train_docs:],
+                             group=sizes[n_train_q:], reference=dtrain)
+        booster.add_valid(dvalid, "valid")
+        booster.update()
+        np.asarray(booster._booster.scores[0][:1])
+
+        def val_ndcg(b):
+            return next(v for (_, m, v, _) in b._booster.eval_valid()
+                        if "ndcg" in m)
+
+        walls, ndcgs = _wall_metric_curve(booster, iters, val_ndcg)
+        mslr[arm] = {"per_iter_s": round(walls[-1] / iters, 4),
+                     "final_ndcg10": round(ndcgs[-1], 5),
+                     "ndcg_curve": [round(v, 5) for v in ndcgs],
+                     "wall_curve_s": [round(v, 3) for v in walls]}
+    target = mslr["constant"]["final_ndcg10"]
+    for arm in mslr:
+        w, it = _first_crossing(mslr[arm]["wall_curve_s"],
+                                mslr[arm]["ndcg_curve"], target, True)
+        mslr[arm]["wall_to_target_s"] = w
+        mslr[arm]["iters_to_target"] = it
+    wc, wl = (mslr["constant"]["wall_to_target_s"],
+              mslr["linear"]["wall_to_target_s"])
+    mslr["target_ndcg10"] = target
+    mslr["speedup_wall_to_target"] = (round(wc / wl, 3)
+                                      if wc and wl else None)
+    ic, il = (mslr["constant"]["iters_to_target"],
+              mslr["linear"]["iters_to_target"])
+    mslr["iter_ratio_to_target"] = (round(ic / il, 3)
+                                    if ic and il else None)
+    out["mslr_shaped"] = mslr
+    print(json.dumps(out))
+
+
 def run_stream_ab(rows: int, max_bin: int, iters: int) -> None:
     """Child-process entry (ISSUE 7): ABAB same-session A/B of
     ``data_residency=stream`` (host-sharded binned matrix + async
@@ -1455,6 +1610,16 @@ def main() -> None:
              str(ITERS_MEASURED)], ATTEMPT_TIMEOUT,
             "stream A/B (out-of-core vs resident)")
 
+    # constant-vs-linear leaves A/B (ISSUE 11): wall-clock-to-target-metric
+    # at HIGGS- and MSLR-shaped configs — the per-iter cost the linear
+    # solve adds vs the iterations it saves (arXiv:1802.05640)
+    linear_ab = None
+    if os.environ.get("BENCH_LINEAR_AB", "1") != "0":
+        linear_ab = _run_child(
+            ["--linear-ab", str(min(chosen["rows"], 1 << 20)),
+             str(chosen["max_bin"]), str(ITERS_MEASURED * 2)],
+            ATTEMPT_TIMEOUT, "linear-leaf A/B (constant vs linear)")
+
     # multi-chip scaling (ISSUE 8): fused data-parallel at 1/2/4/8
     # devices — real mesh when present, virtual CPU widths elsewhere —
     # with bit-identity across widths and psum traffic vs the ICI bound
@@ -1584,6 +1749,7 @@ def main() -> None:
             "microbench_post": micro_post,
             "layout_ab": layout_ab,
             "stream_ab": stream_ab,
+            "linear_ab": linear_ab,
             "multichip": multichip,
             "roofline": roofline,
             "full_run": full_run,
@@ -1604,6 +1770,8 @@ if __name__ == "__main__":
         run_layout_ab(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     elif len(sys.argv) >= 5 and sys.argv[1] == "--stream-ab":
         run_stream_ab(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+    elif len(sys.argv) >= 5 and sys.argv[1] == "--linear-ab":
+        run_linear_ab(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     elif sys.argv[1:2] == ["--multichip-scaling"]:
         run_multichip_scaling(
             int(sys.argv[2]) if len(sys.argv) > 2

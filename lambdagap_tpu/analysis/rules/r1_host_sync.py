@@ -21,10 +21,13 @@ Heuristic hot contexts:
   (the request path), ``ops/predict_tensor.py`` (the inference hot
   path: its tile loop runs once per ``predict_tree_tile`` trees per
   predict call, so one D2H inside it serializes every tile dispatch),
-  and ``ops/hist_pallas.py`` (the default TPU histogram kernel and its
+  ``ops/hist_pallas.py`` (the default TPU histogram kernel and its
   wrappers: a host read inside the per-feature-block tile loop — or in
   the wrapper that dispatches one pallas_call per leaf chunk — would
-  serialize every histogram chunk of every split of every tree).
+  serialize every histogram chunk of every split of every tree), and
+  ``ops/linear.py`` (the linear-leaf moment accumulation runs once per
+  tree in the boosting loop; a sync inside its chunk loop would stall
+  every chunk of every tree's solve).
 
 Sync calls flagged: ``jax.device_get``, ``.item()``, ``.block_until_ready()``,
 ``float(...)``/``int(...)`` wrapping a jax/jnp call, and
@@ -62,11 +65,18 @@ HOT_FUNCTIONS = frozenset({
     "stream_windows", "wait_ready", "_train_tree_stream",
     "_stream_small_hist", "_root_histogram_stream",
     "_leaf_histogram_stream", "_split_partition_stream",
+    # linear-leaf surfaces (ops/linear.py + models/linear_leaf.py): the
+    # moment accumulation runs once per tree inside the boosting loop and
+    # the shared leaf evaluation runs inside every predict dispatch — a
+    # D2H in either serializes the iteration/dispatch; the ONE deliberate
+    # moments fetch per tree carries a written justification
+    "accumulate_leaf_moments", "fit_linear_leaves_batched",
+    "solve_linear_leaves", "linear_leaf_values",
 })
 
 # files whose loop bodies are hot regardless of function name
 HOT_PATHS = ("/serve/", "/ops/predict_tensor", "/ops/hist_pallas",
-             "/data/stream")
+             "/data/stream", "/ops/linear")
 
 _JAXISH = ("jax.", "jnp.", "lax.")
 

@@ -187,6 +187,20 @@ class Dataset:
             md.check(self._constructed.num_data)
             return self._constructed
         cfg = config or Config.from_params(self.params)
+        if not cfg.linear_tree and self.params:
+            # a Dataset built with its own linear_tree param must retain
+            # the raw matrix even when the booster's config lacks the flag
+            # (continued training of a constant-leaf model FROM a linear
+            # init_model replays coefficients over raw rows; ISSUE 11
+            # satellite — the resume fatal should only fire when raw data
+            # is genuinely absent)
+            own = Config.from_params({
+                k: v for k, v in self.params.items()
+                if Config.canonical_name(k) == "linear_tree"})
+            if own.linear_tree:
+                import copy as _copy
+                cfg = _copy.deepcopy(cfg)
+                cfg.linear_tree = True
         # Arrow metadata vectors normalize once at the boundary (reference:
         # the Arrow field paths of LGBM_DatasetSetField, src/c_api.cpp)
         if _ARROW:

@@ -276,7 +276,7 @@ class Config:
     max_delta_step: float = 0.0
     lambda_l1: float = 0.0
     lambda_l2: float = 0.0
-    linear_lambda: float = 0.0
+    linear_lambda: float = 0.0           # ridge strength of the per-leaf linear solve (docs/linear-trees.md)
     min_gain_to_split: float = 0.0
     drop_rate: float = 0.1
     max_drop: int = 50
@@ -315,7 +315,7 @@ class Config:
     output_model: str = "LightGBM_model.txt"
     saved_feature_importance_type: int = 0
     snapshot_freq: int = -1
-    linear_tree: bool = False
+    linear_tree: bool = False            # piece-wise linear leaves: MXU-batched leaf solve, raw matrix retained (docs/linear-trees.md)
     max_bin: int = 255
     max_bin_by_feature: List[int] = field(default_factory=list)
     min_data_in_bin: int = 3
@@ -587,6 +587,14 @@ class Config:
              f"unknown boosting {self.boosting!r}"),
             (self.data_sample_strategy in ("bagging", "goss"),
              f"unknown data_sample_strategy {self.data_sample_strategy!r}"),
+            # DART replays dropped trees with constant leaf values and RF
+            # averages outputs — both would silently corrupt linear-leaf
+            # scores, so the combo is rejected up front (same shape as the
+            # num_grad_quant_bins bound: the error names both knobs)
+            (not (self.linear_tree and self.boosting != "gbdt"),
+             f"linear_tree requires boosting=gbdt "
+             f"(got boosting={self.boosting!r}); disable linear_tree or "
+             f"use gbdt boosting"),
             (self.monotone_constraints_method in ("basic", "intermediate", "advanced"),
              "unknown monotone_constraints_method"),
             (self.predict_engine in ("tensor", "scan"),

@@ -269,10 +269,8 @@ class RF(GBDT):
 def _warn_unsupported(config: Config) -> None:
     """Loudly flag accepted-but-unimplemented parameters — a silently
     ignored option is worse than a missing one (the reference fails fast
-    on unsupported combinations)."""
-    if config.linear_tree and config.boosting != "gbdt":
-        log.warning("linear_tree is only supported with boosting=gbdt; "
-                    "training constant-leaf trees")
+    on unsupported combinations). linear_tree x boosting!=gbdt is now a
+    config-validation ERROR (config.py _check), not a late warning."""
     if config.deterministic:
         # the reference pins OpenMP reduction order under this flag
         # (include/LightGBM/config.h:268); under XLA every reduction

@@ -84,21 +84,24 @@ def dispatch_forest_predict(cfg, x, forest, tree_class, num_class: int,
                             max_depth: int, binned: bool,
                             early_stop_freq: int = 0,
                             early_stop_margin: float = 0.0,
-                            blocks=None):
+                            blocks=None, has_linear: bool = False):
     """Route a whole-forest score dispatch through the configured traversal
     engine (``predict_engine``): the tensorized [rows x trees] engine
     (ops.predict_tensor) or the sequential per-tree reference scan
     (ops.predict). Both return bit-identical [num_class, N] float32;
     ``blocks`` are pre-sliced tree tiles/blocks from the booster or serve
-    caches (either engine consumes the same layout)."""
+    caches (either engine consumes the same layout). ``has_linear`` turns
+    on the per-leaf dot-product payload in the traversal carry (linear
+    trees; raw rows only — binned linear replay stays host-side)."""
     if cfg.predict_engine == "tensor":
         return predict_forest_tensor(
             x, forest, tree_class, num_class, max_depth, binned,
             early_stop_freq, early_stop_margin,
-            tree_tile=cfg.predict_tree_tile, tiles=blocks)
+            tree_tile=cfg.predict_tree_tile, tiles=blocks,
+            has_linear=has_linear)
     return predict_forest(x, forest, tree_class, num_class, max_depth,
                           binned, early_stop_freq, early_stop_margin,
-                          blocks=blocks)
+                          blocks=blocks, has_linear=has_linear)
 
 
 def dispatch_forest_leaf(cfg, x, forest, max_depth: int, binned: bool,
@@ -273,6 +276,13 @@ class GBDT:
             return FusedDataParallelTreeLearner(ds, self.config)
         if tl == "serial":
             cfg = self.config
+            if cfg.linear_tree:
+                # linear leaves are first-class on the fused learner (the
+                # MXU-batched leaf solve, docs/linear-trees.md); demote the
+                # combos the batched path cannot express LOUDLY before any
+                # program compiles
+                from .linear_leaf import resolve_linear_config
+                resolve_linear_config(cfg)
             mode = cfg.tpu_fused_learner
             use_fused = (jax.default_backend() != "cpu" if mode == "auto"
                          else _fused_mode_enabled(mode))
@@ -287,8 +297,6 @@ class GBDT:
                 # (basic AND intermediate run inside the fused program,
                 # incl. intermediate's cross-leaf propagation + re-scans)
                 host_only.append("monotone_constraints_method=advanced")
-            if cfg.linear_tree:
-                host_only.append("linear_tree")
             if _cegb_requested(cfg):
                 host_only.append("cegb")
             if use_fused and host_only:
@@ -422,27 +430,28 @@ class GBDT:
                                         forest, tree_class, K, depth,
                                         binned=True)
 
-    def _linear_forest_outputs(self, trees, forest, depth, x, raw,
-                               binned: bool) -> np.ndarray:
-        """[K, N] float64 outputs of a linear-tree forest: leaf index per
-        (tree, row) + host-side linear leaf models. The single copy of this
-        loop — resume/valid replay and predict() must agree exactly."""
-        from .tree import linear_leaf_outputs
-        K = self.num_tree_per_iteration
-        leaf_T = np.asarray(jax.device_get(dispatch_forest_leaf(
-            self.config, x, forest, depth, binned=binned)))
-        add = np.zeros((K, raw.shape[0]), dtype=np.float64)
-        for i, t in enumerate(trees):
-            add[i % K] += linear_leaf_outputs(t, raw, leaf_T[i])
-        return add
-
     def _replay_linear_forest(self, trees, forest, depth, binned, raw,
                               scores) -> jax.Array:
         """Add a linear-tree forest's outputs to ``scores`` (constant-leaf
-        replay would silently diverge from predict())."""
-        add = self._linear_forest_outputs(trees, forest, depth, binned, raw,
-                                          binned=True)
-        return scores + jnp.asarray(add.astype(np.float32))
+        replay would silently diverge from predict()).
+
+        The adds run PER TREE in forest order, each tree's float64 host
+        outputs rounded to f32 before its device add — the exact addition
+        sequence training used (`_update_train_score` adds one f32 tree at
+        a time), so snapshot resume replays scores bit-identically. A
+        single summed-in-f64 add would differ by ulps and silently break
+        kill-and-resume byte-identity (the PR 6 drift class)."""
+        from .tree import linear_leaf_outputs
+        K = self.num_tree_per_iteration
+        # graftlint: disable=R1 — one leaf-index fetch for the whole
+        # forest being replayed (resume/valid attach), not per iteration
+        leaf_T = np.asarray(jax.device_get(dispatch_forest_leaf(
+            self.config, binned, forest, depth, binned=True)))
+        for i, t in enumerate(trees):
+            add = linear_leaf_outputs(t, raw, leaf_T[i])
+            scores = scores.at[i % K].add(
+                jnp.asarray(add.astype(np.float32)))
+        return scores
 
     # ------------------------------------------------------------------
     def boosting(self) -> Tuple[jax.Array, jax.Array]:
@@ -503,6 +512,7 @@ class GBDT:
         from .fused_learner import FusedTreeLearner
         fast = (isinstance(self.learner, FusedTreeLearner)
                 and type(self) is GBDT
+                and not cfg.linear_tree
                 and (self.objective is None
                      or not self.objective.is_renew_tree_output))
         if fast:
@@ -541,7 +551,8 @@ class GBDT:
             if tree.num_leaves > 1:
                 should_continue = True
                 if cfg.linear_tree and type(self) is GBDT \
-                        and type(self.learner) is SerialTreeLearner:
+                        and type(self.learner) in (SerialTreeLearner,
+                                                   FusedTreeLearner):
                     self._fit_linear_tree(tree, k, grad[k], hess[k])
                 if self.objective is not None and self.objective.is_renew_tree_output:
                     self._renew_tree_output(tree, k, mask)
@@ -610,41 +621,54 @@ class GBDT:
             leaf_idx[perm[b:b + c]] = leaf
         return leaf_idx
 
+    def _linear_raw_dev(self) -> jax.Array:
+        """Device copy of the linear_tree-retained raw matrix, uploaded
+        once per training run (the moment accumulation reads it every
+        tree)."""
+        raw = self.train_set.raw
+        cache = getattr(self, "_linear_raw_cache", None)
+        if cache is None or cache[0] is not raw:
+            self._linear_raw_cache = (raw, jnp.asarray(raw))
+        return self._linear_raw_cache[1]
+
     def _fit_linear_tree(self, tree: Tree, k: int, grad, hess) -> None:
-        """Fit linear leaf models on the raw features of the leaf paths
-        (reference: LinearTreeLearner::CalculateLinear,
-        src/treelearner/linear_tree_learner.cpp)."""
-        from .tree import fit_linear_leaves
+        """Fit linear leaf models over the raw features of the leaf paths:
+        MXU-batched moment accumulation + ONE stacked solve per tree
+        (models/linear_leaf.py; reference:
+        LinearTreeLearner::CalculateLinear host loop replaced wholesale —
+        both the serial and the fused learner land here, so their linear
+        trees are bit-identical by construction)."""
+        from .linear_leaf import (fit_linear_leaves_batched,
+                                  numeric_feature_mask)
         ds = self.train_set
         if ds.raw is None:
             log.warning("linear_tree needs the retained raw matrix; "
                         "skipping linear fit")
             return
-        numeric = np.ones(ds.num_total_features, dtype=bool)
-        for j, m in enumerate(ds.mappers):
-            from ..data.binning import BIN_CATEGORICAL
-            if m.bin_type == BIN_CATEGORICAL:
-                numeric[j] = False
-        # graftlint: disable=R1 — linear-tree leaf fit is a host lstsq over
-        # the retained RAW matrix by design (opt-in linear_tree path); the
-        # three operands ride ONE batched transfer, once per tree
-        g, h, perm = (np.asarray(a) for a in jax.device_get(
-            (grad, hess, self.learner.last_perm)))
-        begins = self.learner.last_leaf_begin
-        counts = self.learner.last_leaf_count
-
-        def rows_of(leaf):
-            b, c = int(begins[leaf]), int(counts[leaf])
-            return perm[b:b + c]
-
-        fit_linear_leaves(tree, ds.raw, rows_of, g, h,
-                          self.config.linear_lambda, numeric)
+        numeric = numeric_feature_mask(ds)
+        if getattr(self.learner, "last_row_leaf", None) is not None:
+            # fused learner: the device row->leaf map IS the membership
+            leaf_dev = self.learner.last_row_leaf
+            # graftlint: disable=R1 — one O(N) map fetch per tree: the
+            # host mirror drives the linear score update + resume replay
+            # (exact f64 leaf outputs), opt-in linear_tree path
+            leaf_idx = np.asarray(jax.device_get(leaf_dev))
+        else:
+            # graftlint: disable=R1 — serial learner: the leaf permutation
+            # is the membership source; ONE transfer per tree
+            perm = np.asarray(jax.device_get(self.learner.last_perm))
+            begins = self.learner.last_leaf_begin
+            counts = self.learner.last_leaf_count
+            leaf_idx = np.zeros(self.num_data, dtype=np.int32)
+            for leaf in range(tree.num_leaves):
+                b, c = int(begins[leaf]), int(counts[leaf])
+                leaf_idx[perm[b:b + c]] = leaf
+            leaf_dev = jnp.asarray(leaf_idx)
+        fit_linear_leaves_batched(tree, self._linear_raw_dev(), leaf_dev,
+                                  grad, hess, self.config.linear_lambda,
+                                  numeric, self.config.num_leaves)
         # cache the per-row leaf map for the score update (saves a second
         # full-permutation D2H per iteration)
-        leaf_idx = np.zeros(self.num_data, dtype=np.int32)
-        for leaf in range(tree.num_leaves):
-            b, c = int(begins[leaf]), int(counts[leaf])
-            leaf_idx[perm[b:b + c]] = leaf
         self._linear_leaf_idx = leaf_idx
 
     def _tree_add_bias(self, tree: Tree, bias: float, k: int) -> None:
@@ -1072,17 +1096,16 @@ class GBDT:
                     res = res / max(1, len(idx) // max(K, 1))
                 return res[0] if K == 1 else res.T
         forest, depth, tree_class, blocks = self._device_forest(idx, trees)
-        if has_linear:
-            res = self._linear_forest_outputs(
-                trees, forest, depth, jnp.asarray(data), data,
-                binned=False).astype(np.float32)
-        else:
-            out = dispatch_forest_predict(
-                self.config, jnp.asarray(data), forest, tree_class, K,
-                depth, binned=False, early_stop_freq=es_freq,
-                early_stop_margin=float(self.config.pred_early_stop_margin),
-                blocks=blocks)
-            res = np.asarray(jax.device_get(out))
+        # linear forests ride the SAME device dispatch: the traversal carry
+        # accumulates each leaf's dot product from the padded coefficient
+        # tables stacked into the forest arrays (ops/linear.py), so serve's
+        # compiled buckets and this path stay bit-identical
+        out = dispatch_forest_predict(
+            self.config, jnp.asarray(data), forest, tree_class, K,
+            depth, binned=False, early_stop_freq=es_freq,
+            early_stop_margin=float(self.config.pred_early_stop_margin),
+            blocks=blocks, has_linear=has_linear)
+        res = np.asarray(jax.device_get(out))
         if self.average_output:
             n_iters = max(1, len(idx) // max(K, 1))
             res = res / n_iters
@@ -1109,7 +1132,7 @@ class GBDT:
         expected value, rows summing to the raw prediction (reference:
         Tree::PredictContrib / TreeSHAP, src/io/tree.cpp; native kernel in
         native/treeshap.cpp)."""
-        from .shap import tree_shap_accumulate
+        from .shap import tree_shap_accumulate, tree_shap_linear
         data = np.asarray(data, dtype=np.float64)
         data = np.ascontiguousarray(self._check_predict_shape(data))
         N, F_data = data.shape
@@ -1117,11 +1140,6 @@ class GBDT:
         idx = self._model_slice(start_iteration, num_iteration)
         self._materialize_lazy(idx)
         trees = [self._tree(i) for i in idx]
-        if any(getattr(t, "is_linear", False) for t in trees):
-            # TreeSHAP over constant leaf values would break the "rows sum to
-            # the raw prediction" invariant for linear leaves (the reference
-            # rejects pred_contrib for linear trees too)
-            log.fatal("pred_contrib is not supported for linear_tree models")
         max_f = max((f for t in trees
                      for f in t.split_feature[:t.num_internal]), default=-1)
         if max_f >= F_data:
@@ -1129,7 +1147,15 @@ class GBDT:
                       "splits on feature %d", F_data, max_f)
         phi = np.zeros((K, N, F_data + 1), dtype=np.float64)
         for pos, i in enumerate(idx):
-            tree_shap_accumulate(trees[pos], data, phi[i % K])
+            t = trees[pos]
+            if getattr(t, "is_linear", False):
+                # coefficient-attribution split (arXiv:1802.05640): the
+                # structural TreeSHAP runs over leaf CONSTANTS, the linear
+                # terms attribute directly to their features — rows still
+                # sum to the raw prediction (models/shap.py)
+                tree_shap_linear(t, data, phi[i % K])
+            else:
+                tree_shap_accumulate(t, data, phi[i % K])
         if self.average_output:
             phi /= max(1, len(idx) // max(K, 1))
         if K == 1:
@@ -1257,6 +1283,11 @@ class GBDT:
             return
         for k in range(self.num_tree_per_iteration):
             tree = self._tree(len(self.models) - self.num_tree_per_iteration + k)
+            if getattr(tree, "is_linear", False):
+                # subtracting constant leaf values would silently corrupt
+                # the scores a linear tree updated with its dot products
+                log.fatal("rollback_one_iter is not supported for "
+                          "linear_tree models")
             # subtract contribution by re-adding with negated leaf values
             arrs = tree_to_arrays(tree, feature_meta=self._meta,
                                   use_inner_feature=True)

@@ -1,0 +1,134 @@
+"""Piece-wise linear leaves: the trained model class glue.
+
+This module makes ``linear_tree=true`` a first-class TPU model class
+(arXiv:1802.05640; ROADMAP item 1): it owns the per-tree fit orchestration
+— path-feature extraction on the host tree skeleton, the MXU-batched
+moment accumulation + ONE regularized solve per tree (ops/linear.py), and
+the constant-leaf fallback policy — and is the single entry point BOTH
+learners call (``GBDT._fit_linear_tree``), so serial and fused linear
+trees are bit-identical by construction.
+
+The reference's per-leaf host loop (linear_tree_learner.cpp
+CalculateLinear) gathered each leaf's raw rows and solved leaf by leaf;
+here the leaf dimension is batched: one device pass over the raw matrix
+builds every leaf's ``X^T H X`` / ``X^T g`` simultaneously, and one
+``[L, P, P]`` stacked solve produces every coefficient vector. The only
+per-tree host work left is walking the (already host-resident) tree
+skeleton for path features and writing the payload back.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.linear import (accumulate_leaf_moments, leaf_feature_width,
+                          moment_chunk_rows, solve_linear_leaves)
+from ..utils import log
+from .tree import Tree
+
+
+def numeric_feature_mask(ds) -> np.ndarray:
+    """True for features a linear leaf may use (numeric, non-categorical;
+    reference: CalculateLinear skips categorical splits on the path)."""
+    from ..data.binning import BIN_CATEGORICAL
+    numeric = np.ones(ds.num_total_features, dtype=bool)
+    for j, m in enumerate(ds.mappers):
+        if m.bin_type == BIN_CATEGORICAL:
+            numeric[j] = False
+    return numeric
+
+
+def leaf_path_features(tree: Tree, numeric_mask: np.ndarray,
+                       num_leaves_pad: int, width: int) -> np.ndarray:
+    """[L_pad+1, FL] int32 table of each leaf's sorted numeric path
+    features, ``-1`` on padding slots; row L_pad is the all-padding dump
+    row the accumulation routes masked rows to."""
+    tbl = np.full((num_leaves_pad + 1, width), -1, np.int32)
+    if tree.num_internal == 0:
+        return tbl
+    path_feats = [[] for _ in range(tree.num_leaves)]
+
+    def walk(node, feats):
+        if node < 0:
+            path_feats[~node] = feats
+            return
+        f = tree.split_feature[node]
+        nxt = feats if (tree.is_categorical[node]
+                        or not numeric_mask[f]) else feats + [f]
+        walk(tree.left_child[node], nxt)
+        walk(tree.right_child[node], nxt)
+
+    walk(0, [])
+    for leaf in range(tree.num_leaves):
+        feats = sorted(set(path_feats[leaf]))
+        tbl[leaf, :len(feats)] = feats
+    return tbl
+
+
+def fit_linear_leaves_batched(tree: Tree, X_dev: jax.Array,
+                              leaf_idx_dev: jax.Array,
+                              grad: jax.Array, hess: jax.Array,
+                              linear_lambda: float,
+                              numeric_mask: np.ndarray,
+                              num_leaves_cap: int) -> None:
+    """Fit every leaf's linear model in one accumulation + one solve.
+
+    Mutates ``tree`` in place like the host reference did: sets
+    ``is_linear`` and the per-leaf ``leaf_features``/``leaf_coeff``/
+    ``leaf_const`` payload, leaving ineligible leaves (no numeric path
+    features, too few non-NaN rows, singular/non-finite system) on their
+    constant output. ``num_leaves_cap`` (config num_leaves) fixes the
+    compiled accumulation shape so growing trees never retrace it.
+    """
+    L = tree.num_leaves
+    Lc = max(int(num_leaves_cap), L)
+    FL = leaf_feature_width(int(numeric_mask.sum()), Lc)
+    tbl = leaf_path_features(tree, numeric_mask, Lc, FL)
+    nfeat = (tbl[:Lc] >= 0).sum(axis=1).astype(np.int64)
+
+    tree.is_linear = True
+    tree.leaf_features = [[] for _ in range(L)]
+    tree.leaf_coeff = [np.zeros(0, np.float64) for _ in range(L)]
+    tree.leaf_const = np.asarray(tree.leaf_value[:L], np.float64).copy()
+    if not nfeat[:L].any():
+        return
+
+    chunk = moment_chunk_rows(Lc, FL)
+    XtHX_d, Xtg_d, cnt_d = accumulate_leaf_moments(
+        X_dev, leaf_idx_dev, grad, hess, jnp.asarray(tbl),
+        num_leaves=Lc, chunk=chunk)
+    # graftlint: disable=R1 — the one O(leaves * P^2) moment fetch per
+    # tree: the row-dimension work already ran on device; the tiny stacked
+    # solve is float64 host math by payload contract (serialized coeffs),
+    # and all three operands ride ONE batched transfer
+    XtHX, Xtg, cnt = (np.asarray(a) for a in jax.device_get(
+        (XtHX_d, Xtg_d, cnt_d)))
+    sol, ok = solve_linear_leaves(XtHX[:Lc], Xtg[:Lc], cnt[:Lc],
+                                  nfeat, linear_lambda)
+    for leaf in range(L):
+        if not ok[leaf]:
+            continue
+        nf = int(nfeat[leaf])
+        tree.leaf_features[leaf] = [int(f) for f in tbl[leaf, :nf]]
+        tree.leaf_coeff[leaf] = sol[leaf, :nf].copy()
+        tree.leaf_const[leaf] = float(sol[leaf, FL])
+
+
+def resolve_linear_config(cfg, ds=None) -> None:
+    """Demote unsupported combos up front, loudly (called from learner
+    dispatch before any program compiles)."""
+    if not cfg.linear_tree:
+        return
+    if cfg.use_quantized_grad:
+        log.warning("use_quantized_grad is not applied with linear_tree "
+                    "(the leaf solve needs full-precision gradients); "
+                    "training runs in full precision")
+        cfg.use_quantized_grad = False
+    if cfg.data_residency == "stream":
+        log.warning("linear_tree does not support data_residency=stream "
+                    "(the leaf solve reads the resident raw matrix); "
+                    "falling back to hbm residency")
+    # auto must not silently resolve to stream either: the raw matrix the
+    # leaf solve reads is resident by linear_tree's retention contract
+    cfg.data_residency = "hbm"

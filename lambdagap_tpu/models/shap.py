@@ -71,6 +71,51 @@ def tree_shap_accumulate(tree: Tree, X: np.ndarray, phi: np.ndarray) -> None:
     _tree_shap_python(tree, X, phi)
 
 
+def tree_shap_linear(tree: Tree, X: np.ndarray, phi: np.ndarray) -> None:
+    """SHAP for a piece-wise linear tree via the coefficient-attribution
+    split (arXiv:1802.05640): a linear leaf's output decomposes as
+    ``leaf_const + sum_f coeff_f * x_f``, so the STRUCTURAL attribution
+    runs standard TreeSHAP over the leaf constants (path credit for
+    reaching the leaf) and each linear term attributes directly to its own
+    feature. Rows then sum to the raw prediction exactly, the invariant
+    the old ``pred_contrib`` rejection existed to protect.
+
+    A row with NaN in its leaf's features predicts the constant fallback
+    ``leaf_value``; the difference to the structurally-attributed
+    ``leaf_const`` goes to the first NaN feature (the one that caused the
+    fallback), keeping the sum invariant for fallback rows too."""
+    L = tree.num_leaves
+    const = np.asarray(tree.leaf_const[:L], np.float64)
+    lv_save = tree.leaf_value
+    lv = np.asarray(lv_save, np.float64).copy()
+    lv[:L] = const
+    tree.leaf_value = lv
+    try:
+        # structural pass over the constants (native kernel or fallback)
+        tree_shap_accumulate(tree, X, phi)
+    finally:
+        tree.leaf_value = lv_save
+    for r in range(X.shape[0]):
+        row = X[r]
+        node = 0 if tree.num_internal > 0 else ~0
+        while node >= 0:
+            node = (tree.left_child[node] if _decide(tree, node, row)
+                    else tree.right_child[node])
+        leaf = ~node
+        feats = tree.leaf_features[leaf]
+        if not feats:
+            continue
+        xs = row[list(feats)]
+        nan = np.isnan(xs)
+        if nan.any():
+            phi[r, feats[int(np.argmax(nan))]] += \
+                float(lv_save[leaf]) - float(const[leaf])
+            continue
+        coeff = np.asarray(tree.leaf_coeff[leaf], np.float64)
+        for f, c, v in zip(feats, coeff, xs):
+            phi[r, f] += c * v
+
+
 # ---------------------------------------------------------------------------
 # pure-Python fallback (same recursion; slow, for no-compiler environments)
 # ---------------------------------------------------------------------------

@@ -268,66 +268,6 @@ def rebind_to_dataset(tree: Tree, ds) -> None:
                 tree.missing_type[i] = MISSING_NONE_C
 
 
-def fit_linear_leaves(tree: Tree, X_raw: np.ndarray, rows_per_leaf,
-                      grad: np.ndarray, hess: np.ndarray,
-                      linear_lambda: float,
-                      numeric_mask: np.ndarray) -> None:
-    """Fit a ridge-regularized linear model in every leaf over the numeric
-    features used along its path (reference:
-    src/treelearner/linear_tree_learner.cpp CalculateLinear — XTHX/XTg
-    normal equations per leaf; rows with NaN in the leaf's features fall
-    back to the constant output, as does a singular system).
-
-    Mutates the tree in place: sets ``is_linear``, per-leaf
-    ``leaf_features``/``leaf_coeff``/``leaf_const``.
-    """
-    L = tree.num_leaves
-    tree.is_linear = True
-    tree.leaf_features = [[] for _ in range(L)]
-    tree.leaf_coeff = [np.zeros(0, np.float64) for _ in range(L)]
-    tree.leaf_const = np.asarray(tree.leaf_value[:L], np.float64).copy()
-
-    # features on each leaf's path (numeric only)
-    path_feats = [[] for _ in range(L)]
-    if tree.num_internal:
-        def walk(node, feats):
-            if node < 0:
-                path_feats[~node] = feats
-                return
-            f = tree.split_feature[node]
-            nxt = feats if (tree.is_categorical[node]
-                            or not numeric_mask[f]) else feats + [f]
-            walk(tree.left_child[node], nxt)
-            walk(tree.right_child[node], nxt)
-        walk(0, [])
-
-    for leaf in range(L):
-        feats = sorted(set(path_feats[leaf]))
-        rows = rows_per_leaf(leaf)
-        if not feats or len(rows) < len(feats) + 1:
-            continue
-        Xl = X_raw[np.asarray(rows)][:, feats].astype(np.float64)
-        ok = ~np.isnan(Xl).any(axis=1)
-        if ok.sum() < len(feats) + 1:
-            continue
-        Xl = Xl[ok]
-        g = grad[np.asarray(rows)][ok].astype(np.float64)
-        h = hess[np.asarray(rows)][ok].astype(np.float64)
-        A = np.column_stack([Xl, np.ones(len(Xl))])
-        M = A.T @ (A * h[:, None])
-        M[np.arange(len(feats)), np.arange(len(feats))] += linear_lambda
-        b = -A.T @ g
-        try:
-            sol = np.linalg.solve(M, b)
-        except np.linalg.LinAlgError:
-            continue
-        if not np.isfinite(sol).all():
-            continue
-        tree.leaf_features[leaf] = list(feats)
-        tree.leaf_coeff[leaf] = sol[:-1]
-        tree.leaf_const[leaf] = float(sol[-1])
-
-
 def linear_leaf_outputs(tree: Tree, X_raw: np.ndarray,
                         leaf_idx: np.ndarray) -> np.ndarray:
     """Per-row outputs of a linear tree given each row's leaf index

@@ -50,17 +50,25 @@ class TreeArrays(NamedTuple):
     #                              sizes these dynamically via
     #                              Common::ConstructBitset, src/io/tree.cpp)
     leaf_value: jax.Array      # f32 [L]
+    # piece-wise linear leaf payload (docs/linear-trees.md): constant term,
+    # padded per-leaf feature ids (-1 = empty slot) and coefficients. For
+    # constant trees leaf_const == leaf_value and every slot is empty, so
+    # the linear traversal carry degenerates to the constant gather —
+    # engines only read these under has_linear=True (raw rows only).
+    leaf_const: jax.Array      # f32 [L]
+    leaf_feat: jax.Array       # i32 [L, FL]
+    leaf_coeff: jax.Array      # f32 [L, FL]
 
 
 def tree_to_arrays(tree, feature_meta=None, use_inner_feature: bool = False,
                    pad_nodes: int = 0, pad_leaves: int = 0,
-                   pad_cat_words: int = 0) -> TreeArrays:
+                   pad_cat_words: int = 0, pad_leaf_feats: int = 0) -> TreeArrays:
     """Stack a host Tree into TreeArrays.
 
     feature_meta: dict from BinnedDataset.feature_arrays() — required for
     binned traversal (default_bin / num_bin per node's feature).
-    pad_nodes / pad_leaves / pad_cat_words: minimum padded sizes, used to
-    align trees before stacking them into a forest.
+    pad_nodes / pad_leaves / pad_cat_words / pad_leaf_feats: minimum padded
+    sizes, used to align trees before stacking them into a forest.
     """
     n = max(tree.num_internal, 1)
     M = max(n, pad_nodes)
@@ -108,6 +116,24 @@ def tree_to_arrays(tree, feature_meta=None, use_inner_feature: bool = False,
     leaf_value = np.zeros(L, dtype=np.float32)
     leaf_value[:max(tree.num_leaves, 1)] = \
         tree.leaf_value[:max(tree.num_leaves, 1)]
+    # linear payload: constant trees carry leaf_const == leaf_value with
+    # every slot empty, so a mixed (linear + constant) forest evaluates
+    # uniformly under has_linear=True
+    FL = max(1, pad_leaf_feats,
+             max((len(tree.leaf_features[i]) for i in range(tree.num_leaves)),
+                 default=0) if getattr(tree, "is_linear", False) else 0)
+    leaf_const = leaf_value.copy()
+    leaf_feat = np.full((L, FL), -1, dtype=np.int32)
+    leaf_coeff = np.zeros((L, FL), dtype=np.float32)
+    if getattr(tree, "is_linear", False):
+        nl = tree.num_leaves
+        leaf_const[:nl] = np.asarray(tree.leaf_const[:nl], np.float32)
+        for i in range(nl):
+            lfeats = tree.leaf_features[i]
+            if lfeats:
+                leaf_feat[i, :len(lfeats)] = lfeats
+                leaf_coeff[i, :len(lfeats)] = np.asarray(tree.leaf_coeff[i],
+                                                         np.float32)
     return TreeArrays(
         split_feature=pad_i(feats[:max(tree.num_internal, 1)]),
         threshold=pad_f(tree.threshold_real),
@@ -122,6 +148,9 @@ def tree_to_arrays(tree, feature_meta=None, use_inner_feature: bool = False,
         cat_bitset=jnp.asarray(bits),
         cat_bitset_real=jnp.asarray(bits_real),
         leaf_value=jnp.asarray(leaf_value),
+        leaf_const=jnp.asarray(leaf_const),
+        leaf_feat=jnp.asarray(leaf_feat),
+        leaf_coeff=jnp.asarray(leaf_coeff),
     )
 
 
@@ -140,9 +169,16 @@ def forest_to_arrays(trees, feature_meta=None,
     L = _round32(max(max(t.num_leaves, 1) for t in trees))
     W = max([8] + [len(t.cat_bitset_real[i]) for t in trees
                    for i in range(t.num_internal)])
+    # linear leaf slots, rounded up so appended trees rarely change FL
+    # (a new width re-stacks the forest, it never recompiles silently)
+    FLr = max([0] + [len(t.leaf_features[i]) for t in trees
+                     if getattr(t, "is_linear", False)
+                     for i in range(t.num_leaves)])
+    FL = max(1, ((FLr + 3) // 4) * 4) if FLr else 1
     depth = _round_depth(max(t.max_depth for t in trees) + 1)
     per_tree = [tree_to_arrays(t, feature_meta, use_inner_feature,
-                               pad_nodes=M, pad_leaves=L, pad_cat_words=W)
+                               pad_nodes=M, pad_leaves=L, pad_cat_words=W,
+                               pad_leaf_feats=FL)
                 for t in trees]
     stacked = TreeArrays(*(jnp.stack(cols) for cols in zip(*per_tree)))
     return stacked, depth
@@ -225,21 +261,36 @@ def predict_leaf_index_binned(x_binned: jax.Array, t: TreeArrays,
     return _traverse_leaf_id(x_binned, t, max_depth, binned=True)
 
 
+def _tree_leaf_vals(x: jax.Array, t: TreeArrays, max_depth: int,
+                    binned: bool, has_linear: bool) -> jax.Array:
+    """One tree's per-row output [N]: the constant leaf gather, or — for
+    linear forests on raw rows — the shared per-leaf dot-product
+    evaluation (ops/linear.py), identical op-for-op to the tensor
+    engine's so both engines stay ``array_equal``."""
+    leaf = _traverse_leaf_id(x, t, max_depth, binned)
+    if not has_linear:
+        return t.leaf_value[leaf]
+    from .linear import linear_leaf_values
+    return linear_leaf_values(x, leaf[:, None], t.leaf_value, t.leaf_const,
+                              t.leaf_feat, t.leaf_coeff)[:, 0]
+
+
 @functools.partial(jax.jit,
                    static_argnames=("num_class", "max_depth", "binned",
-                                    "early_stop_freq"))
+                                    "early_stop_freq", "has_linear"))
 def _predict_forest_block(x: jax.Array, forest: TreeArrays,
                           tree_class: jax.Array, carry,
                           num_class: int, max_depth: int, binned: bool,
                           early_stop_freq: int = 0,
-                          early_stop_margin: float = 0.0):
+                          early_stop_margin: float = 0.0,
+                          has_linear: bool = False):
     """One bounded block of trees, threading the (out, stopped, i) carry."""
     if early_stop_freq <= 0:
         out, stopped, i = carry
 
         def step(o, tk):
             t, k = tk
-            vals = t.leaf_value[_traverse_leaf_id(x, t, max_depth, binned)]
+            vals = _tree_leaf_vals(x, t, max_depth, binned, has_linear)
             return o.at[k].add(vals), None
 
         out, _ = lax.scan(step, out, (forest, tree_class))
@@ -256,7 +307,7 @@ def _predict_forest_block(x: jax.Array, forest: TreeArrays,
     def step(c, tk):
         out, stopped, i = c
         t, k = tk
-        vals = t.leaf_value[_traverse_leaf_id(x, t, max_depth, binned)]
+        vals = _tree_leaf_vals(x, t, max_depth, binned, has_linear)
         out = out.at[k].add(jnp.where(stopped, 0.0, vals))
         i = i + 1
         check = (i % early_stop_freq) == 0
@@ -299,7 +350,7 @@ def predict_forest(x: jax.Array, forest: TreeArrays, tree_class: jax.Array,
                    early_stop_freq: int = 0,
                    early_stop_margin: float = 0.0,
                    tree_block: Optional[int] = None,
-                   blocks=None) -> jax.Array:
+                   blocks=None, has_linear: bool = False) -> jax.Array:
     """Sum a whole forest's leaf values into per-class scores.
 
     x: [N, D] raw floats (binned=False) or [N, F] binned (binned=True).
@@ -325,7 +376,13 @@ def predict_forest(x: jax.Array, forest: TreeArrays, tree_class: jax.Array,
     one block long compile to the identical single kernel as before.
 
     ``blocks``: pre-sliced device blocks from :func:`build_forest_blocks`;
-    passing them skips the per-call forest re-slice entirely."""
+    passing them skips the per-call forest re-slice entirely.
+
+    ``has_linear``: evaluate the per-leaf linear payload (raw rows only —
+    linear leaves read raw feature values, which binned matrices no longer
+    carry; callers replay binned linear forests host-side)."""
+    assert not (binned and has_linear), \
+        "linear forests traverse raw rows; binned linear replay is host-side"
     N = x.shape[0]
     T = tree_class.shape[0]
     if tree_block is None:
@@ -336,14 +393,14 @@ def predict_forest(x: jax.Array, forest: TreeArrays, tree_class: jax.Array,
         if tree_block <= 0 or T <= tree_block:
             out, _, _ = _predict_forest_block(
                 x, forest, tree_class, init, num_class, max_depth, binned,
-                early_stop_freq, early_stop_margin)
+                early_stop_freq, early_stop_margin, has_linear)
             return out
         blocks = build_forest_blocks(forest, tree_class, tree_block)
     carry = init
     for blk, tc, _ in blocks:
         carry = _predict_forest_block(
             x, blk, tc, carry, num_class, max_depth, binned,
-            early_stop_freq, early_stop_margin)
+            early_stop_freq, early_stop_margin, has_linear)
     return carry[0]
 
 

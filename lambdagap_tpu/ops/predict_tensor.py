@@ -117,31 +117,43 @@ def _traverse_tile(x: jax.Array, t: TreeArrays, max_depth: int,
                          jnp.zeros((R, Tt), jnp.int32))
 
 
-def _tile_leaf_values(node: jax.Array, t: TreeArrays) -> jax.Array:
+def _tile_leaf_values(node: jax.Array, t: TreeArrays, x: jax.Array,
+                      has_linear: bool) -> jax.Array:
     """Leaf-value gather for a traversed tile: [R, Tt] f32. No-op pad trees
     (node >= 0) contribute exactly 0.0, like the sequential engine's padded
-    tail blocks."""
+    tail blocks. Under ``has_linear`` the gather becomes the shared
+    per-leaf dot-product evaluation (ops/linear.py) over the flattened
+    leaf tables — the same elementwise op sequence the scan engine runs,
+    so the engines stay ``array_equal`` on linear forests."""
     Tt = t.split_feature.shape[0]
     L = t.leaf_value.shape[-1]
-    leaf_flat = t.leaf_value.reshape(-1)
     done = node < 0
     leaf = jnp.where(done, ~node, 0)
-    vals = leaf_flat[(jnp.arange(Tt, dtype=jnp.int32) * L)[None, :] + leaf]
+    idx = (jnp.arange(Tt, dtype=jnp.int32) * L)[None, :] + leaf   # [R, Tt]
+    if has_linear:
+        from .linear import linear_leaf_values
+        FL = t.leaf_feat.shape[-1]
+        vals = linear_leaf_values(
+            x, idx, t.leaf_value.reshape(-1), t.leaf_const.reshape(-1),
+            t.leaf_feat.reshape(-1, FL), t.leaf_coeff.reshape(-1, FL))
+    else:
+        vals = t.leaf_value.reshape(-1)[idx]
     return jnp.where(done, vals, jnp.float32(0.0))
 
 
 @functools.partial(jax.jit,
                    static_argnames=("num_class", "max_depth", "binned",
-                                    "early_stop_freq"))
+                                    "early_stop_freq", "has_linear"))
 def _predict_tensor_tile(x: jax.Array, t: TreeArrays, tree_class: jax.Array,
                          carry, num_class: int, max_depth: int, binned: bool,
                          early_stop_freq: int = 0,
-                         early_stop_margin: float = 0.0):
+                         early_stop_margin: float = 0.0,
+                         has_linear: bool = False):
     """One tile: parallel [R, Tt] traversal, then an in-forest-order
     accumulation scan threading the sequential engine's (out, stopped, i)
     carry — identical f32 addition order, identical early-stop points."""
     node = _traverse_tile(x, t, max_depth, binned)
-    vals = _tile_leaf_values(node, t)                         # [R, Tt]
+    vals = _tile_leaf_values(node, t, x, has_linear)          # [R, Tt]
     if early_stop_freq <= 0:
         out, stopped, i = carry
 
@@ -198,14 +210,17 @@ def predict_forest_tensor(x: jax.Array, forest: TreeArrays,
                           early_stop_freq: int = 0,
                           early_stop_margin: float = 0.0,
                           tree_tile: Optional[int] = None,
-                          tiles=None) -> jax.Array:
+                          tiles=None, has_linear: bool = False) -> jax.Array:
     """Tensorized drop-in for :func:`ops.predict.predict_forest`.
 
     Same signature semantics: x is [N, D] raw floats (binned=False) or
     [N, F] binned; returns [num_class, N] float32, bit-identical to the
     sequential engine. ``tiles`` (from :func:`build_tree_tiles`) skips the
     per-call forest re-slice; ``tree_tile`` bounds the [R, Tt] working set
-    per dispatch (default ``predict_tree_tile``)."""
+    per dispatch (default ``predict_tree_tile``). ``has_linear`` switches
+    the leaf gather to the per-leaf dot-product payload (raw rows only)."""
+    assert not (binned and has_linear), \
+        "linear forests traverse raw rows; binned linear replay is host-side"
     N = x.shape[0]
     T = tree_class.shape[0]
     if tree_tile is None:
@@ -216,14 +231,14 @@ def predict_forest_tensor(x: jax.Array, forest: TreeArrays,
         if tree_tile <= 0 or T <= tree_tile:
             out, _, _ = _predict_tensor_tile(
                 x, forest, tree_class, init, num_class, max_depth, binned,
-                early_stop_freq, early_stop_margin)
+                early_stop_freq, early_stop_margin, has_linear)
             return out
         tiles = build_tree_tiles(forest, tree_class, tree_tile)
     carry = init
     for blk, tc, _ in tiles:
         carry = _predict_tensor_tile(
             x, blk, tc, carry, num_class, max_depth, binned,
-            early_stop_freq, early_stop_margin)
+            early_stop_freq, early_stop_margin, has_linear)
     return carry[0]
 
 

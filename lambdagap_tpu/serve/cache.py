@@ -73,10 +73,12 @@ class CompiledForestCache:
         idx = gbdt._model_slice(start_iteration, num_iteration)
         gbdt._materialize_lazy(idx)
         trees = [gbdt._tree(i) for i in idx]
-        if any(getattr(t, "is_linear", False) for t in trees):
-            raise ValueError(
-                "serve does not support linear_tree models: linear leaf "
-                "payloads are evaluated host-side (use Booster.predict)")
+        # linear forests compile like constant ones: the padded per-leaf
+        # coefficient tables ride the stacked TreeArrays and the traversal
+        # carry accumulates each leaf's dot product on device
+        # (docs/linear-trees.md), so every bucket/registry/router/frontend
+        # path serves linear models bit-identically to device predict
+        self.has_linear = any(getattr(t, "is_linear", False) for t in trees)
         self.idx = idx
         self.num_class = gbdt.num_tree_per_iteration
         # matrix width the compiled executables expect: 1 + max split
@@ -182,14 +184,16 @@ class CompiledForestCache:
                 self.num_class, self._depth, binned=False,
                 early_stop_freq=self._es_freq,
                 early_stop_margin=self._es_margin,
-                tree_tile=self._tree_tile, tiles=self._blocks)
+                tree_tile=self._tree_tile, tiles=self._blocks,
+                has_linear=self.has_linear)
         else:
             out = predict_forest(
                 jnp.asarray(xb), self._forest, self._tree_class,
                 self.num_class, self._depth, binned=False,
                 early_stop_freq=self._es_freq,
                 early_stop_margin=self._es_margin,
-                tree_block=self._tree_block, blocks=self._blocks)
+                tree_block=self._tree_block, blocks=self._blocks,
+                has_linear=self.has_linear)
         if self.gbdt.average_output:
             out = out / self._n_iters
         obj = self.gbdt.objective

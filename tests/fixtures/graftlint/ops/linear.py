@@ -1,0 +1,25 @@
+"""R1 fixture (linear-leaf solve path): a D2H read inside the
+moment-accumulation chunk loop of ops/linear.py serializes every chunk of
+every tree's leaf solve — flagged even under an arbitrary function name
+(loop-in-hot-path), and in the named hot functions without a loop."""
+import jax
+import jax.numpy as jnp
+
+
+def chunked_moment_wrapper(X, leaf_idx, nch):
+    acc = jnp.zeros((8, 9, 9), jnp.float32)
+    for c in range(nch):
+        acc = acc + jnp.einsum("wp,wq->pq", X, X)
+        _ = float(jnp.sum(acc))  # BAD:R1
+    return acc
+
+
+def accumulate_leaf_moments(X, leaf_idx, grad, hess, feat_tbl):
+    # hot by function name, no loop needed
+    out = jnp.einsum("wp,wq->pq", X, X)
+    return jax.device_get(out)  # BAD:R1
+
+
+def pick_width_host(shape):
+    # not a hot name, not in a loop: fine (one-time width choice)
+    return jax.device_get(jnp.asarray(shape))

@@ -60,6 +60,7 @@ def fixture_findings():
     "serve/r1_serve_loop.py",
     "ops/predict_tensor.py",
     "ops/hist_pallas.py",
+    "ops/linear.py",
     "r2_recompile.py",
     "r3_clamped_slice.py",
     "r4_dtype_drift.py",

@@ -1,0 +1,264 @@
+"""Piece-wise linear leaves (ISSUE 11): the TPU-native model class.
+
+The acceptance matrix: fused-learner linear trees BIT-IDENTICAL to
+serial-learner linear trees (same batched moment accumulation + stacked
+solve, ops/linear.py); tensor-engine linear predictions ``array_equal`` to
+the scan oracle across ragged buckets, NaN/default-left routing, and
+categorical passthrough; SIGKILL + resume=auto byte-identity under
+fused+linear (the PR 6 f64/f32 drift class); and a linear model serving
+through ModelRegistry + Router + TCP frontend bit-identically to device
+predict (the old serve/cache.py rejection is gone).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASE = {"objective": "regression", "num_leaves": 6, "learning_rate": 0.4,
+        "min_data_in_leaf": 20, "verbose": -1, "linear_tree": True,
+        "linear_lambda": 1e-3}
+
+
+def _data(n=1200, seed=5, nan=False, cat=False):
+    rng = np.random.RandomState(seed)
+    X = (rng.rand(n, 5) * 4).astype(np.float32)
+    if cat:
+        X[:, 4] = rng.randint(0, 6, n)
+    if nan:
+        X[::13, 0] = np.nan
+        X[::29, 2] = np.nan
+    base = np.nan_to_num(X, nan=1.0)
+    y = (2.0 * base[:, 0] - 1.5 * base[:, 1]
+         + np.where(base[:, 2] > 2, 3.0, 0.0)
+         + (base[:, 4] % 3 if cat else 0.0)
+         + 0.05 * rng.randn(n)).astype(np.float32)
+    return X, y
+
+
+def _train(X, y, fused, extra=None):
+    params = {**BASE, "tpu_fused_learner": "1" if fused else "0"}
+    if extra:
+        params.update(extra)
+    cats = [4] if extra and extra.pop("_cat", False) else "auto"
+    ds = lgb.Dataset(X, label=y, categorical_feature=cats, params=params)
+    return lgb.train(params, ds, num_boost_round=6)
+
+
+def _trees(booster) -> str:
+    return booster.model_to_string().split("end of trees")[0]
+
+
+# -- fused == serial ----------------------------------------------------
+@pytest.mark.parametrize("extra", [
+    None,
+    {"bagging_fraction": 0.7, "bagging_freq": 1},
+    {"data_sample_strategy": "goss", "top_rate": 0.3, "other_rate": 0.2},
+    {"_nan": True},
+    {"_cat": True},
+    {"max_depth": 3, "lambda_l2": 1.0},
+])
+def test_fused_serial_linear_bit_identical(extra):
+    ex = dict(extra or {})
+    nan = ex.pop("_nan", False)
+    cat = ex.get("_cat", False)
+    X, y = _data(nan=nan, cat=cat)
+    bs = _train(X, y, fused=False, extra=dict(ex))
+    bf = _train(X, y, fused=True, extra=dict(ex))
+    assert any(getattr(t, "is_linear", False)
+               for t in bs._booster.host_models)
+    assert _trees(bs) == _trees(bf), \
+        "fused linear trees must be byte-identical to serial ones"
+    assert np.array_equal(bs.predict(X), bf.predict(X))
+
+
+# -- tensor == scan on linear forests -----------------------------------
+def test_tensor_scan_engines_array_equal_on_linear_forest():
+    X, y = _data(nan=True, cat=True)
+    b = _train(X, y, fused=True, extra={"_cat": True})
+    text = b.model_to_string()
+    outs = {}
+    for eng in ("tensor", "scan"):
+        bb = lgb.Booster(model_str=text, params={"predict_engine": eng,
+                                                 "verbose": -1})
+        # ragged sizes exercise every padding bucket/tile tail
+        outs[eng] = [bb.predict(X[:n], raw_score=True)
+                     for n in (1, 3, 37, 200, len(X))]
+    for a, c in zip(outs["tensor"], outs["scan"]):
+        assert np.array_equal(a, c), \
+            "tensor engine must match the scan oracle exactly"
+    # NaN rows fell back to constant leaves, not to garbage
+    assert all(np.isfinite(o).all() for o in outs["tensor"])
+
+
+def test_predict_matches_host_linear_replay():
+    """The device engines' linear outputs agree with the host float64
+    leaf-model evaluation (the training/replay path) to f32 rounding."""
+    from lambdagap_tpu.models.tree import linear_leaf_outputs
+    X, y = _data(nan=True)
+    b = _train(X, y, fused=True)
+    got = b.predict(X, raw_score=True)
+    leaf = b.predict(X, pred_leaf=True)
+    host = np.zeros(len(X))
+    for i, t in enumerate(b._booster.host_models):
+        host += linear_leaf_outputs(t, X.astype(np.float64), leaf[:, i])
+    np.testing.assert_allclose(got, host, rtol=1e-5, atol=1e-6)
+
+
+# -- SIGKILL + resume byte-identity under fused + linear ----------------
+def _cli(args, tmp_path, faults=""):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    if faults:
+        env["LAMBDAGAP_FAULTS"] = faults
+    else:
+        env.pop("LAMBDAGAP_FAULTS", None)
+    return subprocess.run([sys.executable, "-m", "lambdagap_tpu", *args],
+                          cwd=str(tmp_path), env=env, capture_output=True,
+                          text=True, timeout=300)
+
+
+def test_sigkill_resume_fused_linear_byte_identical(tmp_path):
+    """ISSUE 11 acceptance: snapshot/resume byte-identity under
+    fused+linear — resume replays each linear tree's float64 outputs
+    rounded to f32 PER TREE, the exact addition order training used (the
+    PR 6 f64-materialization drift class, now guarded for linear)."""
+    X, y = _data(600, seed=9)
+    np.savetxt(str(tmp_path / "train.csv"),
+               np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+    args = ["task=train", "data=train.csv", "label_column=0",
+            "objective=regression", "boost_from_average=false",
+            "num_iterations=6", "snapshot_freq=1", "min_data_in_leaf=20",
+            "num_leaves=6", "linear_tree=true", "linear_lambda=0.001",
+            "verbose=1", "resume=auto", "tpu_fused_learner=1"]
+    r = _cli(args + ["output_model=m_crash.txt"], tmp_path,
+             faults="crash_at_iter=3")
+    assert r.returncode == -9, f"expected SIGKILL, got {r.returncode}: " \
+        f"{r.stdout}\n{r.stderr}"
+    r = _cli(args + ["output_model=m_crash.txt"], tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Resumed from snapshot" in r.stdout + r.stderr
+    r = _cli(args + ["output_model=m_ref.txt"], tmp_path)
+    assert r.returncode == 0, r.stdout + r.stderr
+    resumed = (tmp_path / "m_crash.txt").read_text()
+    ref = (tmp_path / "m_ref.txt").read_text()
+    split = "end of trees"
+    assert "is_linear=1" in ref
+    assert resumed.split(split)[0] == ref.split(split)[0], \
+        "fused+linear resumed model must be byte-identical"
+
+
+# -- serve: registry / router / frontend round trip ---------------------
+def test_linear_serves_bit_identical_through_fleet_paths():
+    from lambdagap_tpu.serve import (FrontendClient, LocalReplica, Router,
+                                     ServeFrontend)
+    X, y = _data(nan=True)
+    b = _train(X, y, fused=True)
+    ref = b.predict(X[:600])
+    with b.as_server(buckets=(1, 8, 64), warmup=True) as s:
+        outs, lo = [], 0
+        for n in (1, 3, 8, 11, 64, 100, 129):
+            outs.append(s.predict(X[lo:lo + n]))
+            lo += n
+        assert np.array_equal(np.concatenate(outs), ref[:lo]), \
+            "served linear outputs must be bit-identical to device predict"
+        got_named = np.concatenate([s.predict(X[i:i + 37], model="default",
+                                              tenant="parity")
+                                    for i in range(0, 111, 37)])
+        assert np.array_equal(got_named, ref[:111])
+        with Router([LocalReplica("a", s)]) as router:
+            got_routed = np.concatenate([router.predict(X[i:i + 29],
+                                                        timeout=30)
+                                         for i in range(0, 87, 29)])
+        assert np.array_equal(got_routed, ref[:87])
+        with ServeFrontend(s) as fe:
+            with FrontendClient("127.0.0.1", fe.port) as client:
+                got_wire = np.concatenate([client.predict(X[i:i + 41])
+                                           for i in range(0, 123, 41)])
+        assert np.array_equal(got_wire, np.asarray(ref[:123], np.float32))
+
+
+def test_linear_model_registry_swap_and_readmission():
+    """A linear model rides the registry like any other: evict, re-admit,
+    swap — parity held throughout (the rejection would have made all of
+    this impossible)."""
+    X, y = _data()
+    b = _train(X, y, fused=True)
+    b2 = _train(X, y, fused=False, extra={"num_leaves": 4})
+    ref, ref2 = b.predict(X[:128]), b2.predict(X[:128])
+    with b.as_server(buckets=(64,)) as s:
+        s.add_model("lin2", b2._booster)
+        assert np.array_equal(s.predict(X[:128], model="lin2"), ref2)
+        assert np.array_equal(s.predict(X[:128]), ref)
+
+
+# -- continued training round-trip (satellite) --------------------------
+def test_linear_resume_refit_roundtrip_with_raw_retaining_dataset():
+    """Satellite: resume_from/refit on a linear model works whenever raw
+    data is retained — including a Dataset that requested linear_tree via
+    its OWN params while the booster config dropped the flag (constant
+    continuation from a linear init model)."""
+    X, y = _data(900, seed=11)
+    b5 = lgb.train(BASE, lgb.Dataset(X, label=y), num_boost_round=5)
+    const_params = {k: v for k, v in BASE.items()
+                    if k not in ("linear_tree", "linear_lambda")}
+    ds = lgb.Dataset(X, label=y, params={"linear_tree": True})
+    resumed = lgb.train(const_params, ds, num_boost_round=3, init_model=b5)
+    assert np.isfinite(resumed.predict(X)).all()
+    assert len(resumed._booster.models) == 8
+    # refit still drops the linear payload loudly
+    b_ref = b5.refit(X, y)
+    assert not any(getattr(t, "is_linear", False)
+                   for t in b_ref._booster.host_models)
+    # genuinely absent raw data still fails fast
+    with pytest.raises(RuntimeError, match="raw"):
+        lgb.train(const_params, lgb.Dataset(X, label=y), num_boost_round=2,
+                  init_model=b5)
+
+
+# -- unsupported combos fall back loudly --------------------------------
+def test_linear_dart_rejected_at_config_time():
+    X, y = _data()
+    with pytest.raises(RuntimeError, match="linear_tree.*boosting"):
+        lgb.train({**BASE, "boosting": "dart"}, lgb.Dataset(X, label=y),
+                  num_boost_round=2)
+
+
+def test_linear_quantized_falls_back_to_full_precision(caplog):
+    X, y = _data()
+    import logging
+    with caplog.at_level(logging.WARNING, logger="lambdagap_tpu"):
+        b = lgb.train({**BASE, "use_quantized_grad": True, "verbose": 0,
+                       "tpu_fused_learner": "1"},
+                      lgb.Dataset(X, label=y), num_boost_round=3)
+    assert any("use_quantized_grad" in r.message for r in caplog.records)
+    assert any(getattr(t, "is_linear", False)
+               for t in b._booster.host_models)
+
+
+def test_linear_stream_falls_back_to_hbm(caplog):
+    X, y = _data()
+    import logging
+    with caplog.at_level(logging.WARNING, logger="lambdagap_tpu"):
+        b = lgb.train({**BASE, "data_residency": "stream", "verbose": 0,
+                       "tpu_fused_learner": "1"},
+                      lgb.Dataset(X, label=y), num_boost_round=3)
+    assert any("data_residency=stream" in r.message
+               for r in caplog.records)
+    assert any(getattr(t, "is_linear", False)
+               for t in b._booster.host_models)
+
+
+# -- SHAP coefficient-attribution split ---------------------------------
+def test_linear_pred_contrib_sum_invariant_with_nans():
+    X, y = _data(nan=True)
+    b = _train(X, y, fused=True)
+    phi = b.predict(X, pred_contrib=True)
+    assert phi.shape == (len(X), X.shape[1] + 1)
+    np.testing.assert_allclose(phi.sum(axis=1),
+                               b.predict(X, raw_score=True),
+                               rtol=1e-4, atol=1e-5)

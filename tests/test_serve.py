@@ -209,18 +209,22 @@ def test_swap_from_in_memory_booster_and_num_iteration():
     assert np.array_equal(got, ref_5[:576])
 
 
-def test_serve_rejects_narrow_rows_and_linear_trees():
+def test_serve_rejects_narrow_rows_and_serves_linear_trees():
     b, X = _train_binary()
     with b.as_server(buckets=(8,)) as s:
         fut = s.submit(X[0, :2])
         with pytest.raises(ValueError, match="features"):
             fut.result(timeout=30)
+    # linear forests serve through the compiled buckets bit-identically to
+    # device predict (ISSUE 11: the old ValueError rejection is gone)
     Xr, yr = make_regression(600, 6, noise=1.0, random_state=1)
     br = lgb.train({"objective": "regression", "linear_tree": True,
                     "verbose": -1}, lgb.Dataset(Xr, label=yr),
                    num_boost_round=3)
-    with pytest.raises(ValueError, match="linear_tree"):
-        br.as_server()
+    ref = br.predict(Xr[:64])
+    with br.as_server(buckets=(64,)) as s:
+        got = s.predict(Xr[:64])
+    assert np.array_equal(got, ref)
 
 
 def test_cli_task_serve_roundtrip(tmp_path):

@@ -206,8 +206,9 @@ def test_linear_tree_with_valid_set():
 
 
 def test_linear_tree_resume_refit_contrib_guards():
-    """ADVICE r2: continued training replays the linear path, refit drops
-    linear payloads, pred_contrib rejects linear trees."""
+    """ADVICE r2 + ISSUE 11: continued training replays the linear path,
+    refit drops linear payloads, pred_contrib attributes linear leaves via
+    the coefficient split (rows sum to the raw prediction)."""
     rng = np.random.RandomState(11)
     X = rng.rand(900, 4) * 4
     y = 2.0 * X[:, 0] - 1.5 * X[:, 1] + 0.05 * rng.randn(900)
@@ -223,9 +224,13 @@ def test_linear_tree_resume_refit_contrib_guards():
     np.testing.assert_allclose(resumed.predict(X), b10.predict(X),
                                rtol=1e-3, atol=1e-4)
 
-    # pred_contrib must refuse linear trees (sum invariant breaks)
-    with pytest.raises(RuntimeError):
-        b10.predict(X, pred_contrib=True)
+    # pred_contrib over linear trees: the coefficient-attribution split
+    # keeps the TreeSHAP sum invariant (ISSUE 11 tentpole)
+    phi = b10.predict(X, pred_contrib=True)
+    assert phi.shape == (len(X), X.shape[1] + 1)
+    np.testing.assert_allclose(phi.sum(axis=1),
+                               b10.predict(X, raw_score=True),
+                               rtol=1e-4, atol=1e-5)
 
     # refit drops the linear payload so refitted constants drive predictions
     b_ref = b10.refit(X, y)

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Linear-leaf gate for tools/run_full_suite.sh (ISSUE 11 CI satellite).
+
+Runs a short ``linear_tree=true`` training on the FUSED learner with
+telemetry, then checks the whole model-class contract end to end:
+
+* zero steady-state recompiles — the batched moment accumulation compiles
+  at ONE fixed shape per config (ops/linear.py leaf_feature_width), so a
+  steady compile means the shape pinning regressed;
+* the trained model really carries linear leaves (is_linear=1 payload);
+* tensor-engine predictions ``array_equal`` to the scan oracle on the
+  result (the engine parity contract over the linear payload);
+* a serve dispatch of the linear model succeeds and is bit-identical to
+  device predict (the serve/cache.py rejection must stay gone).
+
+Exit 0 on success, 1 with a diagnostic on any violation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ROUNDS = 6
+
+
+def main() -> int:
+    import numpy as np
+
+    import lambdagap_tpu as lgb
+
+    out = os.path.join(tempfile.mkdtemp(prefix="lambdagap_gate_"),
+                       "run.jsonl")
+    rng = np.random.RandomState(0)
+    X = (rng.rand(2000, 8) * 4).astype(np.float32)
+    y = (2.0 * X[:, 0] - 1.5 * X[:, 1] + np.where(X[:, 2] > 2, 3.0, 0.0)
+         + 0.05 * rng.randn(2000)).astype(np.float32)
+    booster = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "linear_tree": True, "linear_lambda": 1e-3,
+                         "verbose": -1, "telemetry": True,
+                         "telemetry_out": out, "tpu_fused_learner": "1"},
+                        lgb.Dataset(X, label=y), num_boost_round=ROUNDS)
+
+    text = booster.model_to_string()
+    if "is_linear=1" not in text:
+        print("linear gate: trained model carries no linear leaves",
+              file=sys.stderr)
+        return 1
+
+    records = [json.loads(ln) for ln in open(out) if ln.strip()]
+    iters = [r for r in records if r.get("type") == "iteration"]
+    steady = sum(r["compiles"]["steady"] for r in iters)
+    if steady:
+        print(f"linear gate: {steady} steady-state recompile(s) — the "
+              f"fixed-shape moment accumulation (ops/linear.py) regressed",
+              file=sys.stderr)
+        return 1
+
+    outs = {}
+    for eng in ("tensor", "scan"):
+        bb = lgb.Booster(model_str=text, params={"predict_engine": eng,
+                                                 "verbose": -1})
+        outs[eng] = bb.predict(X[:777], raw_score=True)
+    if not np.array_equal(outs["tensor"], outs["scan"]):
+        print("linear gate: tensor engine diverged from the scan oracle "
+              "on a linear forest", file=sys.stderr)
+        return 1
+
+    ref = booster.predict(X[:128])
+    with booster.as_server(buckets=(64,), warmup=True) as server:
+        got = server.predict(X[:128])
+    if not np.array_equal(got, ref):
+        print("linear gate: serve dispatch of the linear model is not "
+              "bit-identical to device predict", file=sys.stderr)
+        return 1
+
+    rmse = float(np.sqrt(np.mean((booster.predict(X) - y) ** 2)))
+    print(f"linear gate OK: {ROUNDS} fused linear iterations, zero steady "
+          f"recompiles, tensor==scan on 777 rows, serve bit-identical "
+          f"(train rmse {rmse:.4f})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

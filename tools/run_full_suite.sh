@@ -51,6 +51,15 @@ if ! env JAX_PLATFORMS=cpu python tools/multichip_gate.py; then
     echo "sidecar lost its mesh fields; see docs/performance.md)"
     exit 1
 fi
+# linear gate (ISSUE 11): short fused linear_tree training — zero
+# steady-state recompiles (fixed-shape moment accumulation), model text
+# carries linear leaves, tensor/scan engine parity on the result, and a
+# serve dispatch of the linear model succeeds bit-identically
+if ! env JAX_PLATFORMS=cpu python tools/linear_gate.py; then
+    echo "FAIL-FAST: linear gate failed (linear-leaf training/predict/serve"
+    echo "contract regressed; see docs/linear-trees.md)"
+    exit 1
+fi
 # chaos gate (ISSUE 5): short train under injected gradient NaNs must
 # finish with a valid model (guard_nonfinite=skip_tree), and a serve loop
 # under injected dispatch failures must shed, degrade, and recover
