@@ -26,7 +26,11 @@ OPEN-loop generator (serve/loadgen.py):
   each flip pays;
 - ``chaos`` — a replica killed mid-round behind the router: the gate-level
   invariant (every accepted request resolves; goodput holds) measured
-  under the bench workload.
+  under the bench workload;
+- ``trace_breakdown`` — where a request's p95 actually goes (queue vs
+  registry vs dispatch vs transport shares, from sampled spans over a
+  loopback frontend), plus the ABAB-measured latency cost of tracing at
+  ``serve_trace_sample=1.0`` against the 0.0 default (ISSUE 12).
 
 Usage::
 
@@ -264,6 +268,77 @@ def bench_registry(booster, X, flips: int = 10, per_flip: int = 20) -> dict:
         server.close()
 
 
+def bench_trace(booster, X, rate: float = 300.0, duration_s: float = 1.5,
+                deadline_ms: float = 100.0, max_delay_ms: float = 2.0
+                ) -> dict:
+    """trace_breakdown (ISSUE 12): where a request's p95 actually goes —
+    queue vs registry vs dispatch vs transport — derived from sampled
+    spans over a loopback frontend, plus the ABAB cost of sampling
+    itself (sample=0.0, the default, alternated with sample=1.0)."""
+    from lambdagap_tpu.obs import trace
+    from lambdagap_tpu.serve import FrontendClient, ServeFrontend, \
+        run_open_loop
+    n_req = max(100, int(rate * duration_s))
+    server = booster.as_server(max_delay_ms=max_delay_ms)
+    fe = ServeFrontend(server).start()
+    client = FrontendClient("127.0.0.1", fe.port)
+    arms = []
+    agg = {}
+    try:
+        run_open_loop(client.submit, X, rate, n_req // 2,
+                      deadline_ms=deadline_ms, seed=31)   # warm
+        # ABAB: default-off / fully-sampled, interleaved so drift cannot
+        # masquerade as tracing overhead (the BENCH_NOTES discipline);
+        # three pairs + per-arm medians because a single CPU-container
+        # scheduling hiccup in one arm otherwise dominates the ratio
+        for sample in (0.0, 1.0, 0.0, 1.0, 0.0, 1.0):
+            trace.RECORDER.reset()
+            trace.RECORDER.configure(sample=sample)
+            r = run_open_loop(client.submit, X, rate, n_req,
+                              deadline_ms=deadline_ms, seed=37)
+            arms.append({"sample": sample,
+                         "p50_ms": r["latency_ms"]["p50"],
+                         "p95_ms": r["latency_ms"]["p95"],
+                         "goodput_ratio": r["goodput_ratio"],
+                         "spans_recorded": trace.RECORDER.n_spans})
+            if sample > 0:
+                agg = trace.RECORDER.aggregates()
+        trace.RECORDER.configure(sample=0.0)
+    finally:
+        client.close()
+        fe.close()
+        server.close()
+        trace.RECORDER.reset()
+
+    def p95_ms(name):
+        return 1e3 * agg.get(name, {}).get("p95", 0.0)
+
+    root = p95_ms("client_request")
+    frontend = p95_ms("frontend")
+    parts = {"queue_ms": p95_ms("queue_wait"),
+             "readmit_ms": p95_ms("registry_get"),
+             "dispatch_ms": p95_ms("dispatch"),
+             "transport_ms": max(root - frontend, 0.0)}
+    shares = {k.replace("_ms", "_share"): (v / root if root else 0.0)
+              for k, v in parts.items()}
+    off = sorted(a["p50_ms"] for a in arms if a["sample"] == 0.0)
+    on = sorted(a["p50_ms"] for a in arms if a["sample"] > 0.0)
+    med = lambda xs: xs[len(xs) // 2]    # noqa: E731
+    return {
+        "rate_rps": rate,
+        "n_requests_per_arm": n_req,
+        "client_request_p95_ms": root,
+        "breakdown_p95": {**parts, **shares},
+        "span_counts": {k: v.get("count", 0) for k, v in agg.items()},
+        "overhead_abab": {
+            "arms": arms,
+            "p50_off_ms": med(off),
+            "p50_on_ms": med(on),
+            "p50_on_over_off": med(on) / max(med(off), 1e-9),
+        },
+    }
+
+
 def bench_chaos(booster, X, rate: float, deadline_ms: float,
                 duration_s: float, max_delay_ms: float) -> dict:
     """Kill one of two replicas mid-round: the serve-gate invariant under
@@ -374,7 +449,7 @@ def main(argv=None) -> int:
                           args.window, args.max_delay_ms)
     print(f"  {served['throughput_rps']:.0f} req/s", file=sys.stderr)
 
-    open_loop = registry = chaos = None
+    open_loop = registry = chaos = trace_breakdown = None
     if not args.skip_fleet:
         rates = [float(r) for r in args.sweep_rates.split(",") if r]
         widths = [int(n) for n in args.replica_counts.split(",") if n]
@@ -398,6 +473,20 @@ def main(argv=None) -> int:
         print(f"  stranded {chaos['stranded']}, goodput ratio "
               f"{chaos['goodput_ratio']:.2f}, counts {chaos['counts']}",
               file=sys.stderr)
+        trace_rate = rates[min(1, len(rates) - 1)]
+        print(f"trace round (sampled spans @ {trace_rate:g} rps, "
+              "ABAB overhead)...", file=sys.stderr)
+        trace_breakdown = bench_trace(
+            booster, X, rate=trace_rate,
+            duration_s=max(args.sweep_duration, 1.5),
+            deadline_ms=max(args.deadline_ms, 100.0),
+            max_delay_ms=args.max_delay_ms)
+        bd = trace_breakdown["breakdown_p95"]
+        print(f"  p95 shares: queue {bd['queue_share']:.2f}, dispatch "
+              f"{bd['dispatch_share']:.2f}, transport "
+              f"{bd['transport_share']:.2f}; tracing p50 on/off = "
+              f"{trace_breakdown['overhead_abab']['p50_on_over_off']:.3f}",
+              file=sys.stderr)
 
     speedup = served["throughput_rps"] / max(naive["throughput_rps"], 1e-9)
     speedup_dev = (served["throughput_rps"]
@@ -415,6 +504,7 @@ def main(argv=None) -> int:
         "open_loop": open_loop,
         "registry": registry,
         "chaos": chaos,
+        "trace_breakdown": trace_breakdown,
         "speedup": speedup,
         "speedup_vs_device_naive": speedup_dev,
         "serve_engine": served["stats"].get("engine"),

@@ -250,7 +250,7 @@ class FunctionInfo:
     """One indexed top-level function or method: the call-graph node."""
 
     __slots__ = ("relpath", "qualname", "name", "cls", "node", "ctx",
-                 "call_nodes", "resolved_calls", "acquires")
+                 "call_nodes", "with_nodes", "resolved_calls", "acquires")
 
     def __init__(self, relpath: str, qualname: str, name: str,
                  cls: Optional[str], node: ast.AST, ctx: ModuleContext
@@ -262,6 +262,7 @@ class FunctionInfo:
         self.node = node
         self.ctx = ctx
         self.call_nodes: List[ast.Call] = []
+        self.with_nodes: List[ast.With] = []
         # (call node, callee FunctionInfo) — filled by finalize()
         self.resolved_calls: List[Tuple[ast.Call, "FunctionInfo"]] = []
         # lock identities this function acquires anywhere in its body
@@ -426,36 +427,45 @@ class PackageIndex:
             self.functions[fi.key] = fi
             infos.append(fi)
         # lock attributes + constructor-typed attributes (self.X = Cls(...))
-        for fi in infos:
-            if fi.cls is None:
+        # — one pass over the module's by-type Assign index instead of an
+        # ast.walk per method (the per-function re-walks were the scan's
+        # second-largest cost; the G0 budget test times the whole run)
+        for node in ctx.nodes(ast.Assign):
+            if not isinstance(node.value, ast.Call):
                 continue
-            for node in ast.walk(fi.node):
-                if not (isinstance(node, ast.Assign)
-                        and isinstance(node.value, ast.Call)):
-                    continue
-                tail = call_name(node.value).rsplit(".", 1)[-1]
-                for t in node.targets:
-                    if not (isinstance(t, ast.Attribute)
-                            and isinstance(t.value, ast.Name)
-                            and t.value.id == "self"):
-                        continue
-                    if tail in _LOCK_CTORS:
-                        self.class_locks.setdefault(
-                            fi.cls, {})[t.attr] = tail
-                        self._lock_attr_owners.setdefault(
-                            t.attr, set()).add(fi.cls)
-                    elif isinstance(node.value.func, ast.Name):
-                        self._attr_ctor_raw.append(
-                            (fi.relpath, fi.cls, t.attr,
-                             node.value.func.id))
-        # attribute every call site to its innermost indexed function
-        for call in ctx.nodes(ast.Call):
-            for anc in ctx.ancestors(call):
+            fi = None
+            for anc in ctx.ancestors(node):
                 if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     fi = self._info_for_def(ctx, anc)
-                    if fi is not None:
-                        fi.call_nodes.append(call)
                     break
+            if fi is None or fi.cls is None:
+                continue
+            tail = call_name(node.value).rsplit(".", 1)[-1]
+            for t in node.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                if tail in _LOCK_CTORS:
+                    self.class_locks.setdefault(
+                        fi.cls, {})[t.attr] = tail
+                    self._lock_attr_owners.setdefault(
+                        t.attr, set()).add(fi.cls)
+                elif isinstance(node.value.func, ast.Name):
+                    self._attr_ctor_raw.append(
+                        (fi.relpath, fi.cls, t.attr,
+                         node.value.func.id))
+        # attribute every call/with site to its innermost indexed function
+        for kind in (ast.Call, ast.With):
+            for node in ctx.nodes(kind):
+                for anc in ctx.ancestors(node):
+                    if isinstance(anc, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fi = self._info_for_def(ctx, anc)
+                        if fi is not None:
+                            (fi.call_nodes if kind is ast.Call
+                             else fi.with_nodes).append(node)
+                        break
 
     def _info_for_def(self, ctx: ModuleContext, node: ast.AST
                       ) -> Optional[FunctionInfo]:
@@ -669,9 +679,7 @@ class PackageIndex:
     def _function_acquires(self, fi: FunctionInfo
                            ) -> List[Tuple[Tuple[str, str], ast.With]]:
         out = []
-        for node in ast.walk(fi.node):
-            if not isinstance(node, ast.With):
-                continue
+        for node in fi.with_nodes:       # indexed at collect; no re-walk
             for item in node.items:
                 ident = self.lock_identity(fi, item.context_expr)
                 if ident is not None:

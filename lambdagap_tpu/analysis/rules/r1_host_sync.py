@@ -24,10 +24,13 @@ Heuristic hot contexts:
   ``ops/hist_pallas.py`` (the default TPU histogram kernel and its
   wrappers: a host read inside the per-feature-block tile loop — or in
   the wrapper that dispatches one pallas_call per leaf chunk — would
-  serialize every histogram chunk of every split of every tree), and
+  serialize every histogram chunk of every split of every tree),
   ``ops/linear.py`` (the linear-leaf moment accumulation runs once per
   tree in the boosting loop; a sync inside its chunk loop would stall
-  every chunk of every tree's solve).
+  every chunk of every tree's solve), and ``obs/trace.py`` /
+  ``obs/fleet.py`` (span enter/exit runs per sampled request per hop and
+  the fleet merge per scrape tick — observability must never sync the
+  device it observes).
 
 Sync calls flagged: ``jax.device_get``, ``.item()``, ``.block_until_ready()``,
 ``float(...)``/``int(...)`` wrapping a jax/jnp call, and
@@ -72,11 +75,18 @@ HOT_FUNCTIONS = frozenset({
     # moments fetch per tree carries a written justification
     "accumulate_leaf_moments", "fit_linear_leaves_batched",
     "solve_linear_leaves", "linear_leaf_values",
+    # trace/fleet plane (obs/trace.py, obs/fleet.py): span enter/exit
+    # runs on every sampled request at EVERY hop, and the scrape merge
+    # runs on the router's signal-plane cadence — neither may ever force
+    # the device (a D2H in span bookkeeping would charge the latency it
+    # claims to measure; one in the merge would convoy the control loop
+    # behind the data plane)
+    "record", "maybe_trace", "merge_snapshots", "scrape",
 })
 
 # files whose loop bodies are hot regardless of function name
 HOT_PATHS = ("/serve/", "/ops/predict_tensor", "/ops/hist_pallas",
-             "/data/stream", "/ops/linear")
+             "/data/stream", "/ops/linear", "/obs/trace", "/obs/fleet")
 
 _JAXISH = ("jax.", "jnp.", "lax.")
 

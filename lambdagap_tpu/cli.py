@@ -177,12 +177,35 @@ def _parse_serve_models(spec: str):
     return out
 
 
+def _configure_observability(cfg: Config):
+    """Arm the graftscope v2 serve-side observability from the config
+    knobs: the process span recorder (``serve_trace_*``) and, when a dump
+    path is set, the flight recorder (fault/SIGTERM/interval dumps).
+    Returns the armed FlightRecorder (or None) so callers can close it."""
+    import os
+    from .obs import trace as obs_trace
+    obs_trace.configure(sample=cfg.serve_trace_sample,
+                        out=cfg.serve_trace_out,
+                        ring=cfg.serve_trace_ring,
+                        proc=f"serve:{os.getpid()}")
+    if not cfg.serve_flight_dump:
+        return None
+    return obs_trace.FlightRecorder(
+        cfg.serve_flight_dump,
+        interval_s=cfg.serve_flight_interval_s,
+        params={"task": "serve", "pid": os.getpid()}).install()
+
+
 def _build_serve_target(cfg: Config, booster):
     """The CLI's serve target: one ForestServer, or ``serve_replicas``
     shared-nothing replicas behind the health-aware router. Extra
     ``serve_models`` are registered on every replica (each keeps its own
-    compiled copy — replicas share nothing)."""
-    from .serve import ForestServer, LocalReplica, Router
+    compiled copy — replicas share nothing). With
+    ``fleet_scrape_interval_s > 0`` a router target also gets the fleet
+    scraper + signal plane (docs/observability.md), so the frontend's
+    ``signals`` and ``prometheus fleet`` verbs answer from live data."""
+    from .serve import (FleetScraper, ForestServer, LocalReplica, Router,
+                        SignalPlane)
     extra = _parse_serve_models(cfg.serve_models)
     n = max(int(cfg.serve_replicas), 1)
     servers = []
@@ -195,8 +218,16 @@ def _build_serve_target(cfg: Config, booster):
         servers.append(s)
     if n == 1:
         return servers[0]
-    return Router([LocalReplica(f"r{i}", s)
-                   for i, s in enumerate(servers)], own_replicas=True)
+    router = Router([LocalReplica(f"r{i}", s)
+                     for i, s in enumerate(servers)], own_replicas=True)
+    if cfg.fleet_scrape_interval_s > 0:
+        from .obs import trace as obs_trace
+        scraper = FleetScraper(
+            router, interval_s=cfg.fleet_scrape_interval_s,
+            timeout_s=cfg.fleet_scrape_timeout_s,
+            signals=SignalPlane(recorder=obs_trace.RECORDER)).start()
+        router.attach_scraper(scraper)
+    return router
 
 
 def run_serve_frontend(cfg: Config, booster) -> None:
@@ -208,15 +239,18 @@ def run_serve_frontend(cfg: Config, booster) -> None:
     import signal
     import threading
     from .serve import ServeFrontend
-    target = _build_serve_target(cfg, booster)
-    fe = ServeFrontend(target, port=cfg.serve_port).start()
-    print(f"SERVE_PORT={fe.port}", flush=True)
     stop = threading.Event()
     try:
+        # BEFORE the flight recorder arms: its SIGTERM hook chains to the
+        # handler installed here, so a drain still dumps the ring first
         signal.signal(signal.SIGTERM, lambda *a: stop.set())
     except ValueError:                   # not the main thread (tests)
         log.warning("serve frontend: SIGTERM handler unavailable off the "
                     "main thread; close with SIGINT/KeyboardInterrupt")
+    flight = _configure_observability(cfg)
+    target = _build_serve_target(cfg, booster)
+    fe = ServeFrontend(target, port=cfg.serve_port).start()
+    print(f"SERVE_PORT={fe.port}", flush=True)
     log.info("task=serve frontend up on port %d (%d replica(s)); "
              "SIGTERM/SIGINT drains and exits", fe.port,
              max(int(cfg.serve_replicas), 1))
@@ -227,6 +261,11 @@ def run_serve_frontend(cfg: Config, booster) -> None:
     fe.close()
     snap = target.stats_snapshot()
     target.close()
+    if flight is not None:
+        flight.close()
+    if cfg.serve_trace_out:
+        from .obs import trace as obs_trace
+        obs_trace.RECORDER.close()
     if cfg.serve_stats_file:
         import json
         with open(cfg.serve_stats_file, "w") as f:
@@ -257,6 +296,7 @@ def run_serve(cfg: Config) -> None:
     if cfg.serve_port >= 0:
         run_serve_frontend(cfg, booster)
         return
+    flight = _configure_observability(cfg)
     server = ForestServer(booster, raw_score=cfg.predict_raw_score,
                           start_iteration=cfg.start_iteration_predict,
                           num_iteration=cfg.num_iteration_predict)
@@ -281,6 +321,11 @@ def run_serve(cfg: Config) -> None:
         if src is not sys.stdin:
             src.close()
         server.close()
+        if flight is not None:
+            flight.close()
+        if cfg.serve_trace_out:
+            from .obs import trace as obs_trace
+            obs_trace.RECORDER.close()
     snap = server.stats_snapshot()
     if cfg.serve_stats_file:
         import json

@@ -376,6 +376,13 @@ class Config:
     serve_tenant_max_share: float = 0.0  # one tenant's max fraction of the bounded queue; 0 = off
     serve_port: int = -1                 # task=serve TCP frontend port: -1 = line loop, 0 = ephemeral, >0 = fixed
     serve_replicas: int = 1              # task=serve: replica servers behind the health-aware router
+    serve_trace_sample: float = 0.0      # distributed-request-trace sample fraction [0, 1]; 0 = off
+    serve_trace_out: str = ""            # span JSONL path (obs/events schema; per-record durability)
+    serve_trace_ring: int = 4096         # recent spans/events kept per process for the flight recorder
+    serve_flight_dump: str = ""          # flight-recorder dump path; armed on fault/SIGTERM when set
+    serve_flight_interval_s: float = 0.0  # periodic flight dumps (SIGKILL durability); 0 = fault-only
+    fleet_scrape_interval_s: float = 0.0  # router-side fleet scrape + signal-plane period; 0 = on demand
+    fleet_scrape_timeout_s: float = 2.0  # per-replica stats RPC timeout during a scrape
 
     # -- guard (lambdagap_tpu.guard; docs/robustness.md) ------------------
     guard_nonfinite: str = "raise"       # non-finite grad/hess/score policy: raise / skip_tree / clip / off
@@ -615,6 +622,16 @@ class Config:
              "serve_tenant_max_share must be in [0, 1]"),
             (self.serve_port >= -1, "serve_port must be >= -1"),
             (self.serve_replicas >= 1, "serve_replicas must be >= 1"),
+            (0.0 <= self.serve_trace_sample <= 1.0,
+             "serve_trace_sample must be in [0, 1]"),
+            (self.serve_trace_ring >= 16,
+             "serve_trace_ring must be >= 16"),
+            (self.serve_flight_interval_s >= 0,
+             "serve_flight_interval_s must be >= 0"),
+            (self.fleet_scrape_interval_s >= 0,
+             "fleet_scrape_interval_s must be >= 0"),
+            (self.fleet_scrape_timeout_s > 0,
+             "fleet_scrape_timeout_s must be > 0"),
             (self.guard_nonfinite in ("off", "raise", "skip_tree", "clip"),
              f"unknown guard_nonfinite {self.guard_nonfinite!r}"),
             (self.guard_clip > 0, "guard_clip must be > 0"),

@@ -15,18 +15,35 @@ The telemetry subsystem the perf work reports through (docs/observability.md):
   (the graftlint-R2 hazard class, caught at runtime).
 - :mod:`.profile` — ``jax.profiler`` capture windows driven by the
   ``profile_start_iter`` / ``profile_n_iters`` / ``profile_dir`` knobs.
-- :mod:`.prom` — Prometheus text exposition for both ``TrainTelemetry``
-  and the serve layer's ``ServeStats``.
+- :mod:`.prom` — Prometheus text exposition for ``TrainTelemetry``, the
+  serve layer's ``ServeStats``, and the merged fleet plane.
 - :mod:`.reservoir` — the bounded uniform sample shared by training and
-  serving percentiles.
+  serving percentiles, with the lifted-aggregate merge the fleet plane
+  sums distributions with.
+- :mod:`.trace` — distributed request tracing (graftscope v2): trace
+  contexts minted at the frontend, one span per hop of the serve stack,
+  parent-linked trees that tile the client-observed wall, and the
+  per-process flight recorder (bounded span/event ring, atomic dumps on
+  fault/SIGTERM/interval).
+- :mod:`.fleet` — the fleet metric plane: scrape every replica's stats,
+  merge counters exactly and latency reservoirs weight-correctly into
+  one fleet snapshot + one ``prometheus fleet`` exposition.
+- :mod:`.signals` — derived control signals (online goodput-knee,
+  residency/eviction pressure, per-replica health timeline): the inputs
+  ROADMAP item 2's revival/placement/autoscaling loop consumes.
 
 Everything is inert unless enabled (``telemetry=true`` / ``telemetry_out=``
-/ ``LAMBDAGAP_TIMETAG``): the off path records nothing and registers no
-``jax.monitoring`` hooks.
+/ ``LAMBDAGAP_TIMETAG``; ``serve_trace_sample>0`` for tracing): the off
+path records nothing and registers no ``jax.monitoring`` hooks.
 """
 from __future__ import annotations
 
-from .reservoir import Reservoir  # noqa: F401
+from .reservoir import MergedReservoir, Reservoir, merge_states  # noqa: F401
 from .telemetry import NULL_TELEMETRY, TrainTelemetry  # noqa: F401
+from .trace import (RECORDER, FlightRecorder, SpanRecorder,  # noqa: F401
+                    TraceContext, start_trace, validate_tree)
 
-__all__ = ["Reservoir", "TrainTelemetry", "NULL_TELEMETRY"]
+__all__ = ["Reservoir", "MergedReservoir", "merge_states",
+           "TrainTelemetry", "NULL_TELEMETRY", "TraceContext",
+           "SpanRecorder", "FlightRecorder", "RECORDER", "start_trace",
+           "validate_tree"]

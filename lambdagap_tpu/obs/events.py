@@ -18,16 +18,27 @@ Record types (``"type"`` field; full table in docs/observability.md):
   ``guard_nonfinite`` diagnostics (lambdagap_tpu.guard: policy + iteration
   when gradients/hessians/scores went non-finite — the last record a
   ``guard_nonfinite=raise`` run writes before failing).
+- ``span`` — one hop of a distributed request trace (obs/trace.py): trace
+  / span / parent ids, span name, recording process, epoch start ``t0``
+  and duration ``dur`` — the record type trace logs and flight-recorder
+  dumps are made of.
+- ``signals`` — one tick of the derived control-signal plane
+  (obs/signals.py): goodput-knee, residency/eviction-pressure, and
+  per-replica health signals, validated by that module's own schema.
 
-Writes flush per line: a crashed run keeps every completed record (the
-whole point of a flight recorder).
+Writes flush per line (or on a small bounded interval for high-rate span
+logs): a crashed run keeps every completed record — the whole point of a
+flight recorder. Reading tolerates the complement: a process SIGKILLed
+mid-write leaves a final line without its newline, which
+:func:`validate_file` / :func:`read_file` report as truncation, not as a
+corrupt file.
 """
 from __future__ import annotations
 
 import json
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 SCHEMA_VERSION = 1
 
@@ -41,6 +52,15 @@ _ITER_REQUIRED = {
     "compiles": lambda v: isinstance(v, dict) and "total" in v
     and "steady" in v,
     "transfers": lambda v: isinstance(v, dict) and "total" in v,
+}
+
+# span-record required keys (obs/trace.py; docs/observability.md span table)
+_SPAN_REQUIRED = {
+    "trace": lambda v: isinstance(v, str) and v != "",
+    "span": lambda v: isinstance(v, str) and v != "",
+    "name": lambda v: isinstance(v, str) and v != "",
+    "t0": lambda v: isinstance(v, (int, float)) and v >= 0,
+    "dur": lambda v: isinstance(v, (int, float)) and v >= 0,
 }
 
 
@@ -78,12 +98,21 @@ def run_header(params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
 
 
 class RunLog:
-    """Line-per-record JSONL writer with per-line flush."""
+    """Line-per-record JSONL writer. Flushes per record by default;
+    ``flush_every > 1`` batches flushes for high-rate writers (span logs)
+    while a ``flush_interval_s`` clock bounds the worst-case data loss a
+    SIGKILL can cause — the reader side tolerates the torn final line."""
 
     def __init__(self, path: str,
-                 params: Optional[Dict[str, Any]] = None) -> None:
+                 params: Optional[Dict[str, Any]] = None,
+                 flush_every: int = 1,
+                 flush_interval_s: float = 0.25) -> None:
         self.path = path
         self._f = open(path, "w", encoding="utf-8")
+        self._flush_every = max(int(flush_every), 1)
+        self._flush_interval = float(flush_interval_s)
+        self._unflushed = 0
+        self._last_flush = time.perf_counter()
         self.write(run_header(params))
 
     def write(self, record: Dict[str, Any]) -> None:
@@ -91,7 +120,13 @@ class RunLog:
             return
         self._f.write(json.dumps(record, separators=(",", ":"),
                                  default=_json_default) + "\n")
-        self._f.flush()
+        self._unflushed += 1
+        now = time.perf_counter()
+        if (self._unflushed >= self._flush_every
+                or now - self._last_flush >= self._flush_interval):
+            self._f.flush()
+            self._unflushed = 0
+            self._last_flush = now
 
     def event(self, event: str, **fields: Any) -> None:
         self.write({"type": "event", "event": event,
@@ -120,7 +155,7 @@ def validate_record(obj: Any) -> List[str]:
     if not isinstance(obj, dict):
         return [f"record is {type(obj).__name__}, not an object"]
     rtype = obj.get("type")
-    if rtype not in ("run_header", "iteration", "event"):
+    if rtype not in ("run_header", "iteration", "event", "span", "signals"):
         return [f"unknown record type {rtype!r}"]
     if rtype == "run_header":
         if obj.get("schema_version") != SCHEMA_VERSION:
@@ -138,31 +173,75 @@ def validate_record(obj: Any) -> List[str]:
     elif rtype == "event":
         if not isinstance(obj.get("event"), str):
             errs.append("event record missing 'event' name")
+    elif rtype == "span":
+        for key, check in _SPAN_REQUIRED.items():
+            if key not in obj:
+                errs.append(f"span record missing {key!r}")
+            elif not check(obj[key]):
+                errs.append(f"span.{key} failed validation: {obj[key]!r}")
+        parent = obj.get("parent")
+        if parent is not None and not isinstance(parent, str):
+            errs.append(f"span.parent must be a string or null, "
+                        f"got {parent!r}")
+    elif rtype == "signals":
+        if not isinstance(obj.get("time_unix"), (int, float)):
+            errs.append("signals record missing 'time_unix'")
+        if not isinstance(obj.get("goodput"), dict):
+            errs.append("signals record missing 'goodput' block")
     return errs
+
+
+def _scan_file(path: str) -> Tuple[List[Tuple[int, Any]], List[str], bool]:
+    """Shared reader: ((line_no, parsed), errors, truncated). A final
+    line with NO trailing newline that fails to parse is a SIGKILL-torn
+    tail: reported as truncation, never as an error — the flight-recorder
+    / postmortem path reads logs from hard-killed processes."""
+    errs: List[str] = []
+    records: List[Tuple[int, Any]] = []
+    truncated = False
+    with open(path, "r", encoding="utf-8") as f:
+        content = f.read()
+    lines = content.split("\n")
+    last_complete = len(lines) - 1       # split leaves "" after a final \n
+    for i, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            if i > last_complete:        # the newline-less final line
+                truncated = True
+                continue
+            errs.append(f"line {i}: not JSON ({e})")
+            continue
+        records.append((i, obj))
+    return records, errs, truncated
 
 
 def validate_file(path: str) -> List[str]:
     """Validate a whole JSONL run log. Returns a list of
     ``"line N: problem"`` strings; empty means the file conforms (non-empty,
-    parses line-by-line, leads with a run_header, every record valid)."""
-    errs: List[str] = []
-    n_lines = 0
-    with open(path, "r", encoding="utf-8") as f:
-        for i, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
-            n_lines += 1
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError as e:
-                errs.append(f"line {i}: not JSON ({e})")
-                continue
-            if n_lines == 1 and (not isinstance(obj, dict)
-                                 or obj.get("type") != "run_header"):
-                errs.append(f"line {i}: first record must be a run_header")
-            for e in validate_record(obj):
-                errs.append(f"line {i}: {e}")
-    if n_lines == 0:
+    parses line-by-line, leads with a run_header, every record valid). A
+    torn final line — one cut mid-write, without its newline — is
+    tolerated: everything before it still validates (SIGKILLed serve
+    processes leave exactly this shape)."""
+    records, errs, _truncated = _scan_file(path)
+    for n, (i, obj) in enumerate(records):
+        if n == 0 and (not isinstance(obj, dict)
+                       or obj.get("type") != "run_header"):
+            errs.append(f"line {i}: first record must be a run_header")
+        for e in validate_record(obj):
+            errs.append(f"line {i}: {e}")
+    if not records:
         errs.append("empty run log")
     return errs
+
+
+def read_file(path: str) -> Tuple[List[Dict[str, Any]], bool]:
+    """(records, truncated): every parseable record in file order, plus
+    whether a torn final line was dropped. The lenient reader the
+    postmortem tooling uses — unparseable interior lines are skipped, not
+    fatal (a half-recovered disk is still evidence)."""
+    records, _errs, truncated = _scan_file(path)
+    return [obj for _i, obj in records if isinstance(obj, dict)], truncated

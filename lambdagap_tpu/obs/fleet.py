@@ -1,0 +1,283 @@
+"""Fleet metric plane: scrape every replica, merge into ONE snapshot.
+
+PR 9 built the fleet but its metrics stayed per-process: each replica
+answers ``stats json`` / ``prometheus`` for itself, and an operator sizing
+the fleet had to eyeball N expositions. This module is the merge:
+
+- :func:`merge_snapshots` folds N ``ServeStats.snapshot()`` dicts into one
+  fleet-shaped snapshot with the SAME schema — counter sums are exact,
+  latency quantiles are weight-correct reservoir merges
+  (:func:`obs.reservoir.merge_states`: each replica's sample weighted by
+  its true stream size), and the per-model / per-tenant label breakdowns
+  roll up label-preservingly (the per-tenant view an operator bills from
+  survives the merge).
+- :class:`FleetScraper` pulls the per-replica snapshots over the existing
+  surfaces — ``ForestServer.stats_snapshot`` in-process,
+  ``FrontendClient.stats`` over the wire — strictly OUTSIDE any router
+  lock (a blocking scrape under a dispatch lock would convoy the request
+  path; graftlint R5/R9 watch this file for exactly that), optionally on
+  a background interval, feeding every scrape to the signal plane
+  (obs/signals.py) that ROADMAP item 2's autonomics consume.
+
+The router exposes the result as ``Router.fleet_snapshot()`` and the
+``prometheus fleet`` verb (docs/serving.md): one exposition for the whole
+fleet, served from the frontend that fronts it.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils import log
+from .reservoir import merge_states, valid_state
+
+# plain summable counters of the ServeStats snapshot schema
+_SUM_KEYS = ("requests", "rows", "errors", "timeouts", "rejected",
+             "swap_failures", "swaps", "evictions", "readmissions",
+             "throughput_rps", "throughput_rows_per_s")
+_RES_KEYS = ("latency_ms", "queue_wait_ms", "device_ms")
+_GROUP_SUM_KEYS = ("requests", "rows", "shed", "rejected", "evictions",
+                   "readmissions")
+
+
+def _merge_quantiles(snaps: List[Dict], key: str) -> Dict[str, float]:
+    """Reservoir-merge one latency distribution across snapshots. Falls
+    back to a request-weighted mean of the published percentiles when a
+    snapshot carries no reservoir state (an old replica mid-rolling-
+    restart must not break the fleet view) — flagged ``"approx"``."""
+    states = [s.get("reservoirs", {}).get(key) for s in snaps]
+    if any(valid_state(st) for st in states):
+        return merge_states(states).percentiles()
+    out: Dict[str, float] = {}
+    total = sum(s.get("requests", 0) for s in snaps) or 1
+    for s in snaps:
+        w = s.get("requests", 0) / total
+        for q, v in (s.get(key) or {}).items():
+            out[q] = out.get(q, 0.0) + w * float(v)
+    if out:
+        out["approx"] = 1.0
+    return out
+
+
+def _merge_groups(snaps: List[Dict], block_key: str) -> Dict[str, Dict]:
+    """Label-preserving rollup of ``per_model`` / ``per_tenant`` blocks:
+    union of keys, counter sums, reservoir-merged latency per key."""
+    names: List[str] = []
+    for s in snaps:
+        for k in (s.get(block_key) or {}):
+            if k not in names:
+                names.append(k)
+    out: Dict[str, Dict] = {}
+    for name in sorted(names):
+        groups = [s.get(block_key, {}).get(name) for s in snaps]
+        groups = [g for g in groups if g]
+        merged: Dict[str, Any] = {k: sum(g.get(k, 0) for g in groups)
+                                  for k in _GROUP_SUM_KEYS}
+        states = [g.get("latency_state") for g in groups]
+        if any(valid_state(st) for st in states):
+            merged["latency_ms"] = merge_states(states).percentiles()
+        else:
+            lats = [g.get("latency_ms") or {} for g in groups]
+            total = sum(g.get("requests", 0) for g in groups) or 1
+            merged["latency_ms"] = {}
+            for g, lat in zip(groups, lats):
+                w = g.get("requests", 0) / total
+                for q, v in lat.items():
+                    merged["latency_ms"][q] = (
+                        merged["latency_ms"].get(q, 0.0) + w * float(v))
+        out[name] = merged
+    return out
+
+
+def _merge_registry(snaps: List[Dict]) -> Optional[Dict]:
+    regs = [s.get("registry") for s in snaps if s.get("registry")]
+    if not regs:
+        return None
+    names: List[str] = []
+    for r in regs:
+        for k in (r.get("models") or {}):
+            if k not in names:
+                names.append(k)
+    models: Dict[str, Dict] = {}
+    for name in sorted(names):
+        entries = [r.get("models", {}).get(name) for r in regs]
+        entries = [e for e in entries if e]
+        models[name] = {
+            "replicas": len(entries),
+            "resident_replicas": sum(1 for e in entries
+                                     if e.get("resident")),
+            "resident": any(e.get("resident") for e in entries),
+            "builds": sum(e.get("builds", 0) for e in entries),
+            "hbm_bytes": sum(e.get("hbm_bytes", 0) for e in entries),
+        }
+    return {
+        "models": models,
+        "registered_models": len(models),
+        "resident_models": sum(1 for m in models.values()
+                               if m["resident"]),
+        "hbm_bytes_resident": sum(r.get("hbm_bytes_resident", 0)
+                                  for r in regs),
+        "hbm_budget_bytes": sum(r.get("hbm_budget_bytes", 0)
+                                for r in regs),
+    }
+
+
+def merge_snapshots(snaps: List[Dict]) -> Dict:
+    """N per-replica ``ServeStats.snapshot()`` dicts -> ONE snapshot of
+    the same schema, counters summed exactly and quantiles merged
+    weight-correctly. Unreachable-replica placeholders (``{"unreachable":
+    ...}``) are skipped but counted."""
+    live = [s for s in snaps if isinstance(s, dict)
+            and "unreachable" not in s]
+    out: Dict[str, Any] = {k: sum(s.get(k, 0) for s in live)
+                           for k in _SUM_KEYS}
+    out["elapsed_s"] = max([s.get("elapsed_s", 0.0) for s in live],
+                           default=0.0)
+    n_batches = sum(s.get("batches", {}).get("count", 0) for s in live)
+    batch_rows = sum(s.get("batches", {}).get("count", 0)
+                     * s.get("batches", {}).get("mean_rows", 0.0)
+                     for s in live)
+    out["batches"] = {"count": n_batches,
+                      "mean_rows": batch_rows / n_batches
+                      if n_batches else 0.0}
+    rows = sum(s.get("rows", 0) for s in live)
+    out["device_us_per_row"] = (
+        sum(s.get("device_us_per_row", 0.0) * s.get("rows", 0)
+            for s in live) / rows if rows else 0.0)
+    for key in _RES_KEYS:
+        out[key] = _merge_quantiles(live, key)
+    cache: Dict[str, Any] = {}
+    for k in ("hits", "misses", "forest_builds", "bucket_compiles"):
+        cache[k] = sum(s.get("cache", {}).get(k, 0) for s in live)
+    total = cache["hits"] + cache["misses"]
+    cache["hit_rate"] = cache["hits"] / total if total else 0.0
+    per_bucket: Dict[str, Dict[str, int]] = {}
+    for s in live:
+        for b, counts in (s.get("cache", {}).get("per_bucket") or {}).items():
+            dst = per_bucket.setdefault(str(b), {"hits": 0, "misses": 0})
+            dst["hits"] += counts.get("hits", 0)
+            dst["misses"] += counts.get("misses", 0)
+    cache["per_bucket"] = per_bucket
+    out["cache"] = cache
+    out["per_model"] = _merge_groups(live, "per_model")
+    out["per_tenant"] = _merge_groups(live, "per_tenant")
+    registry = _merge_registry(live)
+    if registry is not None:
+        out["registry"] = registry
+    out["replica_count"] = len(live)
+    out["unreachable_replicas"] = len(snaps) - len(live)
+    return out
+
+
+def fleet_snapshot(router_stats: Dict) -> Dict:
+    """``Router.stats_snapshot(reservoirs=True)`` -> the fleet snapshot:
+    the router's own dispatch counters plus the merged per-replica stats
+    (schema: docs/observability.md "Fleet metric plane")."""
+    replicas = router_stats.get("replicas") or {}
+    return {
+        "type": "fleet_snapshot",
+        "time_unix": time.time(),
+        "replicas": sorted(replicas),
+        "router": router_stats.get("router") or {},
+        "merged": merge_snapshots(list(replicas.values())),
+        "per_replica_requests": {name: s.get("requests", 0)
+                                 for name, s in sorted(replicas.items())
+                                 if isinstance(s, dict)},
+    }
+
+
+class FleetScraper:
+    """Periodic (or on-demand) fleet scrape -> merged snapshot -> signal
+    plane.
+
+    ``target`` is anything with ``stats_snapshot(reservoirs=True)``
+    returning the router shape (a :class:`~lambdagap_tpu.serve.router.
+    Router`; a single ForestServer works too via :func:`merge_snapshots`
+    of one). The scrape happens entirely on the scraper's thread and
+    never inside the target's dispatch locks — the router fetches each
+    replica's stats outside its own lock by construction, and this class
+    adds none of its own around the RPC. A failed scrape logs + records a
+    flight-recorder event and keeps the previous snapshot: the signal
+    plane prefers stale signals over a convoyed request path.
+    """
+
+    def __init__(self, target, interval_s: float = 0.0,
+                 timeout_s: float = 2.0,
+                 signals=None, recorder=None,
+                 on_snapshot: Optional[Callable[[Dict], None]] = None
+                 ) -> None:
+        self.target = target
+        self.interval_s = max(float(interval_s), 0.0)
+        self.timeout_s = float(timeout_s)
+        self.signals = signals
+        self.on_snapshot = on_snapshot
+        if recorder is None:
+            from . import trace as _trace
+            recorder = _trace.RECORDER
+        self.recorder = recorder
+        self.scrapes = 0
+        self.scrape_errors = 0
+        self._latest: Optional[Dict] = None
+        self._latest_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def scrape(self) -> Dict:
+        """One scrape: fetch + merge + (when attached) signal update."""
+        t0 = time.perf_counter()
+        stats = self.target.stats_snapshot(reservoirs=True,
+                                           timeout_s=self.timeout_s)
+        if "replicas" not in stats:      # a bare ForestServer snapshot
+            stats = {"router": {}, "replicas": {"local": stats}}
+        snap = fleet_snapshot(stats)
+        snap["scrape_s"] = round(time.perf_counter() - t0, 6)
+        with self._latest_lock:
+            self._latest = snap
+            self.scrapes += 1
+        if self.signals is not None:
+            self.signals.update(snap)
+        if self.on_snapshot is not None:
+            self.on_snapshot(snap)
+        return snap
+
+    def latest(self, max_age_s: float = 0.0) -> Dict:
+        """The latest merged snapshot; scrapes on demand when none exists
+        yet or the cached one is older than ``max_age_s`` (0 = any cached
+        snapshot is fine — the background thread keeps it fresh)."""
+        with self._latest_lock:
+            snap = self._latest
+        if snap is not None and (max_age_s <= 0
+                                 or time.time() - snap["time_unix"]
+                                 <= max_age_s):
+            return snap
+        return self.scrape()
+
+    # -- background loop -------------------------------------------------
+    def start(self) -> "FleetScraper":
+        if self.interval_s <= 0:
+            raise ValueError("FleetScraper.start needs interval_s > 0 "
+                             "(fleet_scrape_interval_s)")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="lambdagap-fleet-scraper")
+        self._thread.start()
+        log.info("fleet scraper up: every %.1fs%s", self.interval_s,
+                 " -> signal plane" if self.signals is not None else "")
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape()
+            except Exception as e:
+                # a dying replica mid-scrape is expected fleet weather:
+                # keep the last snapshot, note the miss, keep going
+                self.scrape_errors += 1
+                self.recorder.event("scrape_error", error=str(e))
+                log.warning("fleet scraper: scrape failed (%s); keeping "
+                            "the previous snapshot", e)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)

@@ -200,6 +200,35 @@ def render_router(snapshot: Dict) -> str:
     return w.text()
 
 
+def render_fleet(merged: Dict, router: Optional[Dict] = None) -> str:
+    """Fleet exposition (the ``prometheus fleet`` verb, docs/serving.md):
+    the MERGED per-replica stats rendered through the same serve metric
+    names (obs/fleet.merge_snapshots keeps the snapshot schema, so one
+    scrape config covers a replica and a fleet), plus fleet-level gauges
+    and — when the router's own snapshot is passed — the per-replica
+    routing/health labels. Label values (model/tenant/replica names are
+    user-supplied strings) go through the same exposition-format escaping
+    as every other sample."""
+    w = _Writer()
+    p = "lambdagap_fleet_"
+    w.metric(p + "replicas", merged.get("replica_count", 0),
+             "Replicas merged into this exposition")
+    w.metric(p + "unreachable_replicas",
+             merged.get("unreachable_replicas", 0),
+             "Replicas that failed the scrape (stats missing from the "
+             "merge)")
+    registry = merged.get("registry") or {}
+    name = p + "model_resident_replicas"
+    w.sample_header(name, "Replicas holding the model's compiled forest "
+                    "resident", "gauge")
+    for k, m in (registry.get("models") or {}).items():
+        w.sample(name, m.get("resident_replicas", 0), {"model": k})
+    parts = [w.text(), render_serve(merged)]
+    if router:
+        parts.append(render_router(router))
+    return "".join(parts)
+
+
 def render_train(telemetry) -> str:
     """:class:`TrainTelemetry` aggregates -> Prometheus text."""
     w = _Writer()

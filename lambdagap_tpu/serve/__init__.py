@@ -21,6 +21,8 @@ and the metrics schema.
 """
 from ..guard.degrade import (ReplicaUnavailable, ServeOverloaded,
                              ServeTimeout, SwapFailed, SwapRejected)
+from ..obs.fleet import FleetScraper, fleet_snapshot, merge_snapshots
+from ..obs.signals import SignalPlane
 from .batcher import FairQueue, MicroBatcher, Request
 from .cache import DEFAULT_BUCKETS, CompiledForestCache
 from .frontend import FrontendClient, ServeFrontend
@@ -39,4 +41,5 @@ __all__ = ["ForestServer", "ServeResult", "serve_loop", "MicroBatcher",
            "FrontendClient", "arrival_times", "run_open_loop", "sweep",
            "parse_tenant_weights", "ServeStats", "SwapController",
            "load_booster", "ServeOverloaded", "ServeTimeout", "SwapFailed",
-           "SwapRejected", "ReplicaUnavailable"]
+           "SwapRejected", "ReplicaUnavailable", "FleetScraper",
+           "fleet_snapshot", "merge_snapshots", "SignalPlane"]

@@ -52,19 +52,24 @@ from ..guard.degrade import ServeOverloaded, ServeTimeout
 
 class Request:
     """One queued predict: rows + the future its caller waits on, plus the
-    registry model it targets and the tenant it bills to."""
+    registry model it targets, the tenant it bills to, and (when sampled)
+    the trace context its spans parent to (obs/trace.py)."""
 
-    __slots__ = ("x", "future", "t_submit", "deadline", "model", "tenant")
+    __slots__ = ("x", "future", "t_submit", "t_wall", "deadline", "model",
+                 "tenant", "trace")
 
     def __init__(self, x: np.ndarray, deadline: Optional[float] = None,
                  model: Optional[str] = None,
-                 tenant: Optional[str] = None) -> None:
+                 tenant: Optional[str] = None,
+                 trace=None) -> None:
         self.x = x
         self.future: Future = Future()
         self.t_submit = time.perf_counter()
+        self.t_wall = time.time()        # epoch twin: span t0s align across processes
         self.deadline = deadline         # absolute perf_counter time, or None
         self.model = model               # registry model name (None = default)
         self.tenant = tenant             # accounting/fairness key (optional)
+        self.trace = trace               # TraceContext or None (untraced)
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -232,7 +237,7 @@ class MicroBatcher:
 
     # ------------------------------------------------------------------
     def submit(self, x: np.ndarray, model: Optional[str] = None,
-               tenant: Optional[str] = None) -> Future:
+               tenant: Optional[str] = None, trace=None) -> Future:
         """Enqueue [n, D] float32 rows; returns the Future the worker will
         resolve. Thread-safe. Raises ``RuntimeError`` after close and
         :class:`ServeOverloaded` when the bounded queue is full — or the
@@ -240,7 +245,8 @@ class MicroBatcher:
         (``block`` waits for space instead)."""
         deadline = (time.perf_counter() + self.timeout
                     if self.timeout > 0 else None)
-        req = Request(x, deadline=deadline, model=model, tenant=tenant)
+        req = Request(x, deadline=deadline, model=model, tenant=tenant,
+                      trace=trace)
         while True:
             with self._submit_lock:
                 if self._closed:

@@ -4,10 +4,21 @@ Until this PR every serve caller lived in-process. ``ServeFrontend``
 binds a TCP socket (loopback by default) and speaks newline-delimited
 JSON — one object per line, matching the ``task=serve`` loop verbs:
 
-    {"op": "predict", "id": 1, "x": [[...]], "model": "m", "tenant": "t"}
+    {"op": "predict", "id": 1, "x": [[...]], "model": "m", "tenant": "t",
+     "trace": {"id": "<trace_id>", "parent": "<span_id>"}}
     {"op": "swap",    "id": 2, "source": "model_v2.txt", "model": "m"}
-    {"op": "stats",   "id": 3}            {"op": "prometheus", "id": 5}
-    {"op": "health",  "id": 4}            {"op": "models",     "id": 6}
+    {"op": "stats",   "id": 3, "reservoirs": true}
+    {"op": "prometheus", "id": 5, "scope": "fleet"}
+    {"op": "health",  "id": 4}            {"op": "models",  "id": 6}
+    {"op": "signals", "id": 7}
+
+The optional ``trace`` field carries the distributed-tracing context
+(obs/trace.py): the server records frontend/serve/dispatch child spans
+under the given parent, so one trace id connects the client's wall to
+every hop inside the fleet. ``stats`` with ``reservoirs=true`` adds the
+raw latency-reservoir states a fleet scraper merges; ``prometheus`` with
+``scope="fleet"`` answers the fleet-merged exposition; ``signals`` is the
+control-signal plane (router targets with a scraper attached).
 
 Responses carry the request ``id`` back (predict responses may arrive out
 of submit order — the id is the correlation key):
@@ -35,6 +46,7 @@ from __future__ import annotations
 import json
 import socket
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, Optional
 
@@ -42,6 +54,7 @@ import numpy as np
 
 from ..guard.degrade import (ReplicaUnavailable, ServeOverloaded,
                              ServeTimeout, SwapFailed, SwapRejected)
+from ..obs import trace as obs_trace
 from ..utils import log
 
 # wire error kinds <-> exception classes (client re-raises the real type,
@@ -110,6 +123,10 @@ class _Conn:
             self.frontend._forget(self)
 
     def handle(self, raw: bytes) -> None:
+        # frame receipt time, BEFORE the decode: the frontend span of a
+        # traced predict starts here, so decode cost is inside it
+        self._t_in_wall = time.time()
+        self._t_in = time.perf_counter()
         try:
             frame = json.loads(raw.decode())
             if not isinstance(frame, dict):
@@ -132,19 +149,42 @@ class _Conn:
 
     # -- ops ------------------------------------------------------------
     def _op_predict(self, req_id, frame) -> None:
+        # wire trace context (docs/serving.md): {"trace": {"id", "parent"}}
+        # — malformed values fall back to untraced, never to an error
+        ctx = obs_trace.TraceContext.from_wire(frame.get("trace"))
+        hop = ctx.child() if ctx is not None else None
+        t_in_wall, t_in = self._t_in_wall, self._t_in
         x = np.asarray(frame["x"], dtype=np.float32)
         fut = self.frontend.target.submit(x, model=frame.get("model"),
-                                          tenant=frame.get("tenant"))
+                                          tenant=frame.get("tenant"),
+                                          trace=hop)
 
         def reply(f: Future) -> None:
             exc = f.exception()
             if exc is not None:
                 self.send(_error_frame(req_id, exc))
+                if hop is not None:
+                    obs_trace.RECORDER.record(
+                        "frontend", ctx, t_in_wall,
+                        time.perf_counter() - t_in,
+                        span_id=hop.span_id, error=type(exc).__name__)
                 return
             res = f.result()
-            self.send({"id": req_id, "ok": True,
-                       "values": np.asarray(res.values).tolist(),
-                       "generation": int(res.generation)})
+            if hop is None:
+                self.send({"id": req_id, "ok": True,
+                           "values": np.asarray(res.values).tolist(),
+                           "generation": int(res.generation)})
+                return
+            with obs_trace.RECORDER.span("encode", hop):
+                self.send({"id": req_id, "ok": True,
+                           "values": np.asarray(res.values).tolist(),
+                           "generation": int(res.generation)})
+            # the frontend span closes only after the reply hit the
+            # socket: decode + serve + encode tile it (span tree
+            # discipline, obs/trace.validate_tree)
+            obs_trace.RECORDER.record(
+                "frontend", ctx, t_in_wall, time.perf_counter() - t_in,
+                span_id=hop.span_id)
 
         fut.add_done_callback(reply)
 
@@ -156,12 +196,23 @@ class _Conn:
         self.send({"id": req_id, "ok": True, "generation": int(gen)})
 
     def _op_stats(self, req_id, frame) -> None:
+        # reservoirs=true adds the raw reservoir states a fleet scraper
+        # merges (bounded; obs/fleet.py)
         self.send({"id": req_id, "ok": True,
-                   "stats": self.frontend.target.stats_snapshot()})
+                   "stats": self.frontend.target.stats_snapshot(
+                       reservoirs=bool(frame.get("reservoirs")))})
 
     def _op_prometheus(self, req_id, frame) -> None:
+        target = self.frontend.target
+        if frame.get("scope") == "fleet":
+            text = target.prometheus_fleet()
+        else:
+            text = target.prometheus()
+        self.send({"id": req_id, "ok": True, "text": text})
+
+    def _op_signals(self, req_id, frame) -> None:
         self.send({"id": req_id, "ok": True,
-                   "text": self.frontend.target.prometheus()})
+                   "signals": self.frontend.target.signals()})
 
     def _op_health(self, req_id, frame) -> None:
         health = self.frontend.target.health
@@ -350,7 +401,14 @@ class FrontendClient:
 
     # -- API ------------------------------------------------------------
     def submit(self, x, model: Optional[str] = None,
-               tenant: Optional[str] = None) -> Future:
+               tenant: Optional[str] = None, trace=None) -> Future:
+        """Async predict over the wire. ``trace`` is an incoming
+        :class:`~lambdagap_tpu.obs.trace.TraceContext`; with none given,
+        one is minted per the process ``serve_trace_sample`` knob — the
+        client is where a fleet trace is born. The sampled context rides
+        the frame's ``trace`` field and a ``client_request`` span records
+        the full client-observed wall (submit -> future resolution), the
+        root the server-side spans must tile."""
         x = np.asarray(x, dtype=np.float32)
         if x.ndim == 1:
             x = x[None, :]
@@ -359,7 +417,26 @@ class FrontendClient:
             frame["model"] = model
         if tenant is not None:
             frame["tenant"] = tenant
-        return self._send(frame)
+        ctx = trace if trace is not None \
+            else obs_trace.RECORDER.maybe_trace()
+        if ctx is None:
+            return self._send(frame)
+        if trace is None:                # minted here: this IS the root
+            span, parent = ctx, ""
+        else:
+            span, parent = ctx.child(), None
+        frame["trace"] = span.to_wire()
+        t0_wall, t0 = time.time(), time.perf_counter()
+        fut = self._send(frame)
+
+        def _record(_f) -> None:
+            obs_trace.RECORDER.record(
+                "client_request", ctx, t0_wall,
+                time.perf_counter() - t0, span_id=span.span_id,
+                parent=parent)
+
+        fut.add_done_callback(_record)
+        return fut
 
     def predict(self, x, timeout: Optional[float] = None,
                 model: Optional[str] = None,
@@ -377,11 +454,20 @@ class FrontendClient:
         return int(self._call("swap", timeout=timeout, source=source,
                               model=model)["generation"])
 
-    def stats(self, timeout: Optional[float] = 30.0) -> dict:
-        return self._call("stats", timeout=timeout)["stats"]
+    def stats(self, timeout: Optional[float] = 30.0,
+              reservoirs: bool = False) -> dict:
+        return self._call("stats", timeout=timeout,
+                          reservoirs=True if reservoirs else None)["stats"]
 
-    def prometheus(self, timeout: Optional[float] = 30.0) -> str:
-        return self._call("prometheus", timeout=timeout)["text"]
+    def prometheus(self, timeout: Optional[float] = 30.0,
+                   scope: Optional[str] = None) -> str:
+        return self._call("prometheus", timeout=timeout,
+                          scope=scope)["text"]
+
+    def signals(self, timeout: Optional[float] = 30.0) -> dict:
+        """The router-side control-signal tick (requires the remote
+        frontend to front a router with a signal plane attached)."""
+        return self._call("signals", timeout=timeout)["signals"]
 
     def health(self, timeout: Optional[float] = 30.0) -> str:
         return self._call("health", timeout=timeout)["state"]

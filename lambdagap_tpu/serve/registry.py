@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 from ..guard.degrade import CircuitBreaker, SwapFailed, SwapRejected
@@ -151,12 +152,19 @@ class ModelRegistry:
                  "%d models registered)", name, e.bytes, len(self._entries))
         return 0
 
-    def get(self, name: str = DEFAULT_MODEL):
+    def get(self, name: str = DEFAULT_MODEL,
+            info: Optional[Dict] = None):
         """The resident compiled forest for ``name`` — touching LRU, and
         re-admitting (ONE recompile, generation preserved) if the model
         was evicted. Concurrent callers of an evicted model single-flight
         the rebuild; the losers park on an event, never on a lock held
-        across the compile."""
+        across the compile.
+
+        ``info`` (optional dict) is filled with what the resolve cost:
+        ``readmitted=True`` + ``build_s`` when THIS call paid the
+        recompile, ``waited=True`` when it parked behind another caller's
+        rebuild — the per-request visibility of the readmission cliff
+        that request tracing records as the ``registry_get`` span."""
         while True:
             with self._lock:
                 e = self._entries.get(name)
@@ -175,10 +183,16 @@ class ModelRegistry:
                     waiter = e.pending
                 gbdt, gen = e.gbdt, e.generation
             if waiter is not None:
+                if info is not None:
+                    info["waited"] = True
                 waiter.wait(60.0)
                 continue
             try:
+                t0 = time.perf_counter()
                 cache = self._build(gbdt, gen)   # outside every lock
+                if info is not None:
+                    info["readmitted"] = True
+                    info["build_s"] = time.perf_counter() - t0
                 admitted = self._admit(e, gbdt, cache, readmission=True,
                                        expect_generation=gen)
             finally:

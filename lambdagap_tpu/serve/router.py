@@ -29,12 +29,14 @@ elsewhere would double latency for a deterministic error.
 from __future__ import annotations
 
 import threading
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional, Sequence
 
 from ..guard.degrade import (DEGRADED, DRAINING, OK, ReplicaUnavailable,
                              ServeOverloaded)
 from ..guard.faults import InjectedFault
+from ..obs import trace as obs_trace
 from ..utils import log
 
 # exceptions that indict the REPLICA, not the request: these trigger
@@ -53,9 +55,10 @@ class LocalReplica:
         self.server = server
 
     def submit(self, x, model: Optional[str] = None,
-               tenant: Optional[str] = None) -> Future:
+               tenant: Optional[str] = None, trace=None) -> Future:
         try:
-            return self.server.submit(x, model=model, tenant=tenant)
+            return self.server.submit(x, model=model, tenant=tenant,
+                                      trace=trace)
         except RuntimeError as e:
             if "closed" in str(e):       # a closed server is a dead replica
                 raise ReplicaUnavailable(
@@ -87,8 +90,9 @@ class RemoteReplica:
         self._health_lock = threading.Lock()
 
     def submit(self, x, model: Optional[str] = None,
-               tenant: Optional[str] = None) -> Future:
-        return self.client.submit(x, model=model, tenant=tenant)
+               tenant: Optional[str] = None, trace=None) -> Future:
+        return self.client.submit(x, model=model, tenant=tenant,
+                                  trace=trace)
 
     def health(self) -> str:
         import time
@@ -135,18 +139,41 @@ class Router:
         self._failovers = 0
         self._rejected_no_replica = 0
         self._closed = False
+        self._scraper = None             # obs.fleet.FleetScraper, attached
 
     # -- dispatch -------------------------------------------------------
     def submit(self, x, model: Optional[str] = None,
-               tenant: Optional[str] = None) -> "Future":
+               tenant: Optional[str] = None, trace=None) -> "Future":
         """Route one request; returns a Future of ``ServeResult``. The
         future ALWAYS terminates: a dead replica's in-flight requests are
         failed over to the remaining live replicas, and only a fleet with
-        no live replica rejects (:class:`ReplicaUnavailable`)."""
+        no live replica rejects (:class:`ReplicaUnavailable`). A sampled
+        ``trace`` context gets a ``route`` span covering pick + failover
+        until the future resolves (attrs: the replica that answered, the
+        failover count paid)."""
         if self._closed:
             raise RuntimeError("router closed")
         outer: Future = Future()
-        self._attempt(outer, x, model, tenant, tried=set())
+        ctx = trace if trace is not None \
+            else obs_trace.RECORDER.maybe_trace()
+        hop = None
+        if ctx is not None:
+            hop = ctx.child()            # the route span's own context
+            t0_wall, t0 = time.time(), time.perf_counter()
+            route_state = {"replica": None, "failovers": 0}
+
+            def _record(_f) -> None:
+                obs_trace.RECORDER.record(
+                    "route", ctx, t0_wall, time.perf_counter() - t0,
+                    span_id=hop.span_id,
+                    replica=route_state["replica"],
+                    failovers=route_state["failovers"])
+
+            outer.add_done_callback(_record)
+            self._attempt(outer, x, model, tenant, tried=set(),
+                          trace=hop, route_state=route_state)
+        else:
+            self._attempt(outer, x, model, tenant, tried=set())
         return outer
 
     def predict(self, x, timeout: Optional[float] = None,
@@ -177,7 +204,8 @@ class Router:
         with self._lock:
             return min(tier, key=lambda r: self._inflight[r.name])
 
-    def _attempt(self, outer: Future, x, model, tenant, tried: set) -> None:
+    def _attempt(self, outer: Future, x, model, tenant, tried: set,
+                 trace=None, route_state: Optional[Dict] = None) -> None:
         while True:
             replica = self._pick(tried)
             if replica is None:
@@ -189,19 +217,24 @@ class Router:
                 return
             tried.add(replica.name)
             try:
-                inner = replica.submit(x, model=model, tenant=tenant)
+                inner = replica.submit(x, model=model, tenant=tenant,
+                                       trace=trace)
             # graftlint: disable=R8 — the continue re-enters the pick
             # loop, every exit of which terminates the future: a
             # successful submit chains resolution to on_done, and an
             # exhausted fleet set_exception()s ReplicaUnavailable above
             except FAILOVER_EXCEPTIONS as e:
                 self._note_failure(replica, e)
+                if route_state is not None:
+                    route_state["failovers"] += 1
                 continue                 # submit-time failover
             # graftlint: disable=R8 — same loop contract as above: spill
             # to a peer, or the empty-pick branch resolves the future
             except ServeOverloaded:
                 with self._lock:
                     self._failovers += 1
+                if route_state is not None:
+                    route_state["failovers"] += 1
                 continue                 # overload spill: try a peer
             except Exception as e:
                 outer.set_exception(e)   # request-level error: no replay
@@ -210,6 +243,8 @@ class Router:
         with self._lock:
             self._inflight[replica.name] += 1
             self._routed[replica.name] += 1
+        if route_state is not None:
+            route_state["replica"] = replica.name
 
         def on_done(f: Future) -> None:
             with self._lock:
@@ -220,7 +255,10 @@ class Router:
             elif isinstance(exc, FAILOVER_EXCEPTIONS):
                 # in-flight failover: the replica died under the request
                 self._note_failure(replica, exc)
-                self._attempt(outer, x, model, tenant, tried)
+                if route_state is not None:
+                    route_state["failovers"] += 1
+                self._attempt(outer, x, model, tenant, tried,
+                              trace=trace, route_state=route_state)
             else:
                 outer.set_exception(exc)
 
@@ -293,10 +331,15 @@ class Router:
     def health(self) -> "_FleetHealth":
         return _FleetHealth(self)
 
-    def stats_snapshot(self) -> dict:
+    def stats_snapshot(self, reservoirs: bool = False,
+                       timeout_s: Optional[float] = None) -> dict:
         """Router snapshot + every live replica's own stats, keyed by
         replica name — the fleet-level analog of
-        ``ForestServer.stats_snapshot``."""
+        ``ForestServer.stats_snapshot``. ``reservoirs=True`` asks each
+        replica for its raw reservoir states so the fleet plane can merge
+        latency distributions, not just counters. Replica fetches happen
+        OUTSIDE the router lock (a blocking stats RPC under the dispatch
+        lock would convoy every request; graftlint R9 enforces this)."""
         out = {"router": self.snapshot(), "replicas": {}}
         for r in self._replicas:
             with self._lock:
@@ -304,12 +347,51 @@ class Router:
                     continue
             try:
                 if hasattr(r, "server"):
-                    out["replicas"][r.name] = r.server.stats_snapshot()
+                    out["replicas"][r.name] = r.server.stats_snapshot(
+                        reservoirs=reservoirs)
                 else:
-                    out["replicas"][r.name] = r.client.stats()
+                    out["replicas"][r.name] = r.client.stats(
+                        timeout=timeout_s if timeout_s else 30.0,
+                        reservoirs=reservoirs)
             except Exception as e:
                 out["replicas"][r.name] = {"unreachable": str(e)}
         return out
+
+    # -- fleet metric plane (obs/fleet.py; docs/observability.md) -------
+    def fleet_snapshot(self) -> dict:
+        """Scrape + merge every live replica's stats into ONE snapshot
+        (counter sums exact, reservoir-merged quantiles); prefers the
+        attached scraper's cached snapshot when one is running so the
+        request path never waits on a scrape."""
+        if self._scraper is not None:
+            return self._scraper.latest()
+        from ..obs import fleet
+        return fleet.fleet_snapshot(self.stats_snapshot(reservoirs=True))
+
+    def prometheus_fleet(self) -> str:
+        """The ``prometheus fleet`` verb: one exposition for the whole
+        fleet — merged serve metrics + fleet gauges + per-replica
+        routing/health labels (docs/serving.md)."""
+        from ..obs import prom
+        snap = self.fleet_snapshot()
+        return prom.render_fleet(snap["merged"], router=self.snapshot())
+
+    def attach_scraper(self, scraper) -> None:
+        """Adopt a running :class:`~lambdagap_tpu.obs.fleet.FleetScraper`
+        (and through it, its signal plane): ``fleet_snapshot`` reads its
+        cache, ``signals`` answers from its plane, ``close`` stops it."""
+        self._scraper = scraper
+
+    def signals(self) -> dict:
+        """The current control-signal tick (obs/signals.py). Requires an
+        attached scraper with a signal plane — the CLI wires one when
+        ``fleet_scrape_interval_s > 0``."""
+        if self._scraper is None or self._scraper.signals is None:
+            raise ValueError(
+                "no signal plane attached (set fleet_scrape_interval_s > 0 "
+                "or Router.attach_scraper(FleetScraper(..., "
+                "signals=SignalPlane())))")
+        return self._scraper.signals.snapshot()
 
     def prometheus(self) -> str:
         from ..obs import prom
@@ -341,6 +423,8 @@ class Router:
 
     def close(self) -> None:
         self._closed = True
+        if self._scraper is not None:
+            self._scraper.close()
         if self._own:
             for r in self._replicas:
                 try:

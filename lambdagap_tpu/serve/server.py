@@ -34,6 +34,7 @@ import numpy as np
 
 from ..guard.degrade import HealthMonitor
 from ..guard.faults import plan_for
+from ..obs import trace as obs_trace
 from ..utils import log
 from .batcher import MicroBatcher, Request
 from .cache import DEFAULT_BUCKETS, CompiledForestCache
@@ -173,11 +174,15 @@ class ForestServer:
 
     # -- request path ---------------------------------------------------
     def submit(self, x, model: Optional[str] = None,
-               tenant: Optional[str] = None) -> "Future[ServeResult]":
+               tenant: Optional[str] = None,
+               trace=None) -> "Future[ServeResult]":
         """Async predict: enqueue rows, return a Future of
         :class:`ServeResult`. ``x`` is one row [D] or a matrix [n, D];
         ``model`` routes to a registry model (default: the initial one);
-        ``tenant`` bills the request to a fairness/accounting lane."""
+        ``tenant`` bills the request to a fairness/accounting lane;
+        ``trace`` is an incoming :class:`~lambdagap_tpu.obs.trace.
+        TraceContext` (None = mint one per ``serve_trace_sample``, which
+        defaults to never)."""
         if self._closed:
             raise RuntimeError("ForestServer is closed")
         name = model if model is not None else DEFAULT_MODEL
@@ -189,7 +194,28 @@ class ForestServer:
             x = x[None, :]
         if x.ndim != 2:
             raise ValueError(f"serve requests are rows [n, D], got {x.shape}")
-        return self._batcher.submit(x, model=name, tenant=tenant)
+        ctx = trace if trace is not None \
+            else obs_trace.RECORDER.maybe_trace()
+        if ctx is None:                  # the untraced fast path
+            return self._batcher.submit(x, model=name, tenant=tenant)
+        # the serve_request span covers submit -> future resolution; its
+        # context rides the Request so queue/registry/dispatch spans nest
+        # under it (recorded after the fact — span ids are pre-minted)
+        child = ctx.child()
+        t0_wall, t0 = time.time(), time.perf_counter()
+        fut = self._batcher.submit(x, model=name, tenant=tenant,
+                                   trace=child)
+        attrs = {"model": name}
+        if tenant is not None:
+            attrs["tenant"] = tenant
+
+        def _record(_f) -> None:
+            obs_trace.RECORDER.record(
+                "serve_request", ctx, t0_wall,
+                time.perf_counter() - t0, span_id=child.span_id, **attrs)
+
+        fut.add_done_callback(_record)
+        return fut
 
     def predict(self, x, timeout: Optional[float] = None,
                 model: Optional[str] = None,
@@ -211,9 +237,14 @@ class ForestServer:
                                   background=background)
 
     # -- metrics / lifecycle -------------------------------------------
-    def stats_snapshot(self) -> dict:
+    def stats_snapshot(self, reservoirs: bool = False,
+                       timeout_s: Optional[float] = None) -> dict:
+        """The serving metrics dict; ``reservoirs=True`` adds the raw
+        reservoir states the fleet scraper merges (obs/fleet.py).
+        ``timeout_s`` exists for scrape-surface uniformity with the
+        router (an in-process snapshot cannot block on a peer)."""
         entry = self.registry.entry(DEFAULT_MODEL)
-        snap = self.stats.snapshot()
+        snap = self.stats.snapshot(reservoirs=reservoirs)
         snap["generation"] = entry.generation
         snap["buckets"] = list(entry.buckets)
         snap["engine"] = entry.engine
@@ -232,6 +263,16 @@ class ForestServer:
         docs/observability.md)."""
         from ..obs import prom
         return prom.render_serve(self.stats_snapshot())
+
+    def prometheus_fleet(self) -> str:
+        """The ``prometheus fleet`` verb on a single server: a fleet of
+        one, rendered through the same merge path the router uses — so
+        scrape configs are identical whether a frontend fronts one
+        replica or a router (docs/serving.md)."""
+        from ..obs import fleet, prom
+        merged = fleet.merge_snapshots(
+            [self.stats_snapshot(reservoirs=True)])
+        return prom.render_fleet(merged)
 
     def close(self, timeout: float = 30.0) -> None:
         """Flush queued requests and stop the batcher thread. Health
@@ -259,19 +300,41 @@ class ForestServer:
         for r in batch:
             groups.setdefault(r.model or DEFAULT_MODEL, []).append(r)
         for name, reqs in sorted(groups.items()):
+            info: Dict = {}
+            t_reg_wall, t_reg = time.time(), time.perf_counter()
             try:
-                slot = self.registry.get(name)   # touches LRU; may readmit
+                slot = self.registry.get(name, info=info)  # LRU; may readmit
             except Exception as e:
                 for r in reqs:
                     if not r.future.done():
                         r.future.set_exception(e)
                 self.stats.record_error()
                 continue
+            reg_dur = time.perf_counter() - t_reg
+            rec = obs_trace.RECORDER
+            for r in reqs:
+                if r.trace is None:
+                    continue
+                # queue_wait ends where the registry resolve begins, so
+                # the three children (queue_wait, registry_get, dispatch)
+                # TILE the serve_request span instead of double-counting
+                rec.record("queue_wait", r.trace, r.t_wall,
+                           t_reg - r.t_submit)
+                # the registry resolve, per sampled request: a readmitted
+                # group makes the 174x cliff visible on every trace that
+                # paid it (registry_readmit nests the compile share)
+                sid = rec.record("registry_get", r.trace, t_reg_wall,
+                                 reg_dur, model=name, **info)
+                if info.get("readmitted"):
+                    rec.record("registry_readmit", r.trace, t_reg_wall,
+                               info.get("build_s", reg_dur), parent=sid,
+                               model=name)
             self._dispatch_group(name, slot, reqs)
 
     def _dispatch_group(self, name: str, slot, reqs: List[Request]) -> None:
         """One model's share of a batch through one padded dispatch."""
         t0 = time.perf_counter()
+        t0_wall = time.time()
         W = slot.width
         disable_check = slot.gbdt.config.predict_disable_shape_check
         rows: List[np.ndarray] = []
@@ -299,8 +362,15 @@ class ForestServer:
         t1 = time.perf_counter()
         self.stats.record_dispatch(rows=X.shape[0], device_s=t1 - t0)
         lo = 0
+        rec = obs_trace.RECORDER
         for r, x in zip(good, rows):
             n = x.shape[0]
+            if r.trace is not None:
+                # queue_wait + registry_get were recorded by _run_batch;
+                # the dispatch span reuses the timestamps the stats
+                # already take, so tracing adds no clock reads here
+                rec.record("dispatch", r.trace, t0_wall, t1 - t0,
+                           rows=n, batch_rows=X.shape[0], model=name)
             r.future.set_result(ServeResult(out[lo:lo + n],
                                             slot.generation))
             lo += n
@@ -324,6 +394,8 @@ def serve_loop(server: ForestServer, lines, out_stream,
     - ``stats`` — print the Prometheus exposition of the live serving
       metrics to ``stats_stream`` (default: stderr);
     - ``stats json`` — the ``ServeStats.snapshot()`` JSON instead;
+    - ``prometheus fleet`` — the fleet-merged exposition (a single
+      server renders as a fleet of one, same metric names as a router);
     - ``health`` — one-line health state to ``stats_stream``;
     - ``#``-prefixed lines and blanks are ignored.
 
@@ -339,6 +411,10 @@ def serve_loop(server: ForestServer, lines, out_stream,
             continue
         if line == "stats" or line == "stats prometheus":
             stats_stream.write(server.prometheus())
+            stats_stream.flush()
+            continue
+        if line == "prometheus fleet":
+            stats_stream.write(server.prometheus_fleet())
             stats_stream.flush()
             continue
         if line == "stats json":

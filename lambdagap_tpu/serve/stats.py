@@ -173,7 +173,8 @@ class ServeStats:
         return {k: v * 1e3 for k, v in d.items()}
 
     @staticmethod
-    def _group_block(table: Dict[str, Dict]) -> Dict[str, Dict]:
+    def _group_block(table: Dict[str, Dict],
+                     reservoirs: bool = False) -> Dict[str, Dict]:
         out = {}
         for key, g in sorted(table.items()):
             out[key] = {
@@ -184,13 +185,20 @@ class ServeStats:
                 "latency_ms": {k: v * 1e3
                                for k, v in g["lat"].percentiles().items()},
             }
+            if reservoirs:
+                out[key]["latency_state"] = g["lat"].state(scale=1e3)
         return out
 
-    def snapshot(self) -> Dict:
+    def snapshot(self, reservoirs: bool = False) -> Dict:
+        """The metrics dict of docs/serving.md. ``reservoirs=True`` adds
+        the raw reservoir states (``obs.reservoir.Reservoir.state``, ms
+        units, bounded) that the fleet plane merges — the lifted
+        aggregate a scraper needs to sum distributions, not just
+        counters."""
         with self._lock:
             elapsed = max(time.perf_counter() - self.t_start, 1e-9)
             total = self.cache_hits + self.cache_misses
-            return {
+            out = {
                 "requests": self.n_requests,
                 "rows": self.n_rows,
                 "errors": self.n_errors,
@@ -223,9 +231,16 @@ class ServeStats:
                 "swaps": self.swaps,
                 "evictions": self.evictions,
                 "readmissions": self.readmissions,
-                "per_model": self._group_block(self._models),
-                "per_tenant": self._group_block(self._tenants),
+                "per_model": self._group_block(self._models, reservoirs),
+                "per_tenant": self._group_block(self._tenants, reservoirs),
             }
+            if reservoirs:
+                out["reservoirs"] = {
+                    "latency_ms": self._lat.state(scale=1e3),
+                    "queue_wait_ms": self._queue_wait.state(scale=1e3),
+                    "device_ms": self._device.state(scale=1e3),
+                }
+            return out
 
     def to_json(self, **kwargs) -> str:
         kwargs.setdefault("indent", 2)

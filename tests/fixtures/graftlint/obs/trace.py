@@ -1,0 +1,30 @@
+"""R1 fixture (trace/fleet plane, ISSUE 12): a D2H sync inside span
+bookkeeping or the scrape merge. Span enter/exit runs on every sampled
+request at every hop — a device sync there charges the request the very
+latency the span claims to observe; one inside the scrape-merge loop
+convoys the signal plane behind the data plane. Flagged via the hot
+function names (``record``/``merge_snapshots``) AND via loop-in-hot-path
+(any function in an ``/obs/trace`` file)."""
+import jax
+import jax.numpy as jnp
+
+
+class SpanRecorder:
+    def record(self, name, value, t0, dur):
+        # hot by function name: span exit must be pure host bookkeeping
+        payload = jnp.asarray(value)
+        return float(jnp.sum(payload))  # BAD:R1
+
+    def flush_ring(self, ring):
+        # arbitrary name, but a loop body inside an /obs/trace file: a
+        # sync per ring record stalls every flight-recorder flush
+        out = []
+        for rec in ring:
+            dev = jnp.asarray(rec)
+            out.append(jax.device_get(dev))  # BAD:R1
+        return out
+
+
+def span_duration_host(t0, t1):
+    # host-only arithmetic: no device involved, never flagged
+    return max(t1 - t0, 0.0)

@@ -1,0 +1,31 @@
+"""R9 fixture (ISSUE 12): a blocking fleet scrape under a router-side
+lock. The scrape RPC (``Future.result`` on a stats call) lives one
+resolved call away (``scrape`` holds ``_lock`` and calls ``_fetch``), so
+R5's lexical scan of the ``with`` body never sees it — the semantic
+index's call graph does. A scraper that blocks the dispatch lock on a
+slow replica's stats RPC convoys EVERY request behind the control plane;
+the fix (and the shape the real ``obs/fleet.FleetScraper`` uses) is to
+snapshot the replica list under the lock and fetch outside it."""
+import threading
+
+
+class LockedScraper:
+    def __init__(self, replicas):
+        self._replicas = replicas
+        self._lock = threading.Lock()
+
+    def _fetch(self, replica):
+        return replica.stats_future.result(2.0)
+
+    def scrape(self):
+        out = []
+        with self._lock:
+            for r in self._replicas:
+                out.append(self._fetch(r))  # BAD:R9
+        return out
+
+    def scrape_outside(self):
+        # the correct shape: the lock guards only the list snapshot
+        with self._lock:
+            replicas = list(self._replicas)
+        return [self._fetch(r) for r in replicas]

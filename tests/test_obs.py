@@ -179,6 +179,43 @@ def test_prometheus_output_parses_line_by_line():
     assert "lambdagap_serve_registry_hbm_budget_bytes 8192" in text
 
 
+_PROM_LABELS_ESCAPED = re.compile(
+    r'\{([a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*")'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\\\|\\"|\\n)*")*\}')
+
+
+def test_prometheus_hostile_label_values_escaped():
+    """Model/tenant names are user-supplied strings; the exposition must
+    escape backslash/quote/newline per the format spec, so a hostile name
+    can neither break a sample line nor inject one (ISSUE 12)."""
+    from lambdagap_tpu.serve.stats import ServeStats
+    stats = ServeStats()
+    evil_model = 'm"x\\y\nz'
+    evil_tenant = '\\"end\n# HELP fake_metric injected'
+    stats.record_request(0.001, 0.002, 0.003, rows=1, model=evil_model,
+                         tenant=evil_tenant)
+    stats.record_timeout(model=evil_model, tenant=evil_tenant)
+    snapshot = stats.snapshot()
+    snapshot["registry"] = {"registered_models": 1, "resident_models": 1,
+                            "hbm_bytes_resident": 1, "hbm_budget_bytes": 0,
+                            "models": {evil_model: {"resident": True}}}
+    text = prom.render_serve(snapshot)
+    for ln in [ln for ln in text.splitlines() if ln]:
+        if ln.startswith("#"):
+            assert _PROM_HEADER.match(ln), f"bad header line: {ln!r}"
+            continue
+        m = _PROM_SAMPLE.match(ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        float(m.group(3))
+        if m.group(2):
+            assert _PROM_LABELS_ESCAPED.fullmatch(m.group(2)), \
+                f"label values not exposition-escaped: {ln!r}"
+    # escaped forms present; the injection attempt never starts a line
+    assert '\\"end\\n# HELP' in text
+    assert not any(ln.startswith("# HELP fake_metric")
+                   for ln in text.splitlines())
+
+
 def test_prometheus_router_exposition_parses_and_labels():
     snap = {"failovers": 3, "rejected_no_replica": 1,
             "replicas": {"r0": {"routed": 10, "inflight": 2,

@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Postmortem: one merged cross-process timeline from N observability
+files.
+
+A fleet incident leaves evidence scattered across processes: each
+replica's flight-recorder dump (``serve_flight_dump``, written atomically
+on fault/SIGTERM/interval — a SIGKILLed replica leaves its last periodic
+dump), each process's span JSONL (``serve_trace_out``, per-record flushed,
+torn final line tolerated), and the training run logs. This tool reads any
+number of them through the lenient ``obs.events.read_file`` reader and
+renders ONE wall-clock-ordered timeline — spans and events from every
+process interleaved on the shared epoch clock — plus, per source process,
+its LAST recorded span: the thing a dead replica was doing when it died.
+
+Usage::
+
+    python tools/postmortem.py r0.flight r1.flight trace.jsonl
+    python tools/postmortem.py --trace <trace_id> dumps/*.flight
+    python tools/postmortem.py --json merged.json r*.flight
+
+Exit 0 when every input parsed (truncation is reported, not fatal);
+exit 2 when an input was unreadable.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def load(paths):
+    """[(path, records, truncated)] via the lenient reader; unreadable
+    files abort (a postmortem with silently missing evidence is worse
+    than none)."""
+    from lambdagap_tpu.obs.events import read_file
+    out = []
+    for path in paths:
+        try:
+            records, truncated = read_file(path)
+        except OSError as e:
+            print(f"postmortem: cannot read {path}: {e}", file=sys.stderr)
+            raise SystemExit(2)
+        out.append((path, records, truncated))
+    return out
+
+
+def merge(sources, trace_id=None):
+    """One time-ordered record list; each record annotated with its
+    source file (``_src``) and the recording process when the record
+    carries one."""
+    merged = []
+    for path, records, _trunc in sources:
+        src = os.path.basename(path)
+        for rec in records:
+            if not isinstance(rec, dict):
+                continue
+            rtype = rec.get("type")
+            if rtype == "span":
+                if trace_id and rec.get("trace") != trace_id:
+                    continue
+                t = rec.get("t0", 0.0)
+            elif rtype in ("event", "signals"):
+                if trace_id:
+                    continue
+                t = rec.get("time_unix", 0.0)
+            else:
+                continue                 # run_header/iteration: context only
+            merged.append((float(t), src, rec))
+    merged.sort(key=lambda item: item[0])
+    return merged
+
+
+def last_spans(sources):
+    """source file -> (proc, last span record) — the dead replica's last
+    recorded act."""
+    out = {}
+    for path, records, _trunc in sources:
+        spans = [r for r in records
+                 if isinstance(r, dict) and r.get("type") == "span"]
+        if spans:
+            last = max(spans, key=lambda s: s.get("t0", 0.0)
+                       + s.get("dur", 0.0))
+            out[os.path.basename(path)] = (last.get("proc", "?"), last)
+    return out
+
+
+def render(sources, merged, width=72):
+    lines = []
+    lines.append("postmortem: merged timeline over "
+                 f"{len(sources)} file(s), {len(merged)} record(s)")
+    for path, records, trunc in sources:
+        n_spans = sum(1 for r in records if r.get("type") == "span")
+        n_events = sum(1 for r in records if r.get("type") == "event")
+        header = next((r for r in records
+                       if r.get("type") == "run_header"), {})
+        reason = header.get("params", {}).get("reason", "")
+        lines.append(
+            f"  {os.path.basename(path)}: {n_spans} spans, "
+            f"{n_events} events"
+            + (f", dump reason={reason}" if reason else "")
+            + (" [TRUNCATED final line — writer was killed mid-record]"
+               if trunc else ""))
+    if not merged:
+        lines.append("  (no timeline records)")
+        return "\n".join(lines)
+    t_base = merged[0][0]
+    lines.append(f"  t=0 at epoch {t_base:.6f}")
+    lines.append("")
+    lines.append(f"{'t (ms)':>10}  {'dur (ms)':>9}  "
+                 f"{'proc':<16} {'src':<18} record")
+    for t, src, rec in merged:
+        off = (t - t_base) * 1e3
+        proc = str(rec.get("proc", ""))[:16]
+        if rec["type"] == "span":
+            what = rec["name"]
+            attrs = rec.get("attrs") or {}
+            if attrs:
+                short = ",".join(f"{k}={v}" for k, v in
+                                 sorted(attrs.items()))[:width - len(what)]
+                what = f"{what}({short})"
+            tid = rec.get("trace", "")[:8]
+            lines.append(f"{off:10.2f}  {rec['dur'] * 1e3:9.2f}  "
+                         f"{proc:<16} {src:<18} {what} "
+                         f"[trace {tid}]")
+        else:
+            what = rec.get("event", rec["type"])
+            lines.append(f"{off:10.2f}  {'-':>9}  {proc:<16} {src:<18} "
+                         f"!{what}")
+    lines.append("")
+    for src, (proc, span) in sorted(last_spans(sources).items()):
+        off = (span.get("t0", t_base) - t_base) * 1e3
+        lines.append(f"last span of {src} (proc {proc}): "
+                     f"{span['name']} at t={off:.2f}ms "
+                     f"dur={span.get('dur', 0.0) * 1e3:.2f}ms "
+                     f"[trace {span.get('trace', '')[:8]}]")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("files", nargs="+",
+                    help="flight dumps / span JSONLs / run logs")
+    ap.add_argument("--trace", default=None,
+                    help="restrict the timeline to one trace id")
+    ap.add_argument("--json", default=None,
+                    help="also write the merged records as JSON here")
+    args = ap.parse_args(argv)
+    sources = load(args.files)
+    merged = merge(sources, trace_id=args.trace)
+    print(render(sources, merged))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump([{"t": t, "src": src, **rec}
+                       for t, src, rec in merged], f, indent=2,
+                      default=str)
+        print(f"\nmerged records written to {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -76,6 +76,17 @@ if ! env JAX_PLATFORMS=cpu python tools/serve_gate.py; then
     echo "or goodput never recovered; see docs/serving.md)"
     exit 1
 fi
+# trace gate (ISSUE 12): one traced request through a 2-replica loopback
+# fleet must yield a schema-valid parent-linked span tree tiling the
+# client-observed wall, and a SIGKILLed replica must leave a valid
+# flight-recorder dump that tools/postmortem.py renders naming its last
+# span — with zero stranded futures under tracing
+if ! env JAX_PLATFORMS=cpu python tools/trace_gate.py; then
+    echo "FAIL-FAST: trace gate failed (the distributed span tree broke,"
+    echo "the flight recorder lost the dead replica's history, or tracing"
+    echo "stranded a future; see docs/observability.md)"
+    exit 1
+fi
 echo "=== G1 $(date)"
 python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_predict_tensor.py tests/test_misc_api.py tests/test_graftlint.py -q 2>&1 | tail -1
 echo "=== G2 $(date)"

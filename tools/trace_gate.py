@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""Trace gate (ISSUE 12): one request, one connected cross-process record.
+
+Run by tools/run_full_suite.sh G0. Two invariants of graftscope v2, on a
+REAL 2-replica loopback fleet (``task=serve`` subprocesses behind their
+socket frontends, routed exactly as a production caller would):
+
+1. **The span tree tiles the wall.** One traced request through
+   router -> frontend -> replica -> batcher -> dispatch must yield a
+   schema-valid (``obs.events.validate_file``) parent-linked span tree
+   whose spans tile the client-observed latency within tolerance
+   (``obs.trace.validate_tree`` — the PR 4 span-sum≈wall discipline,
+   across processes).
+
+2. **The dead replica leaves evidence.** SIGKILL a replica mid-open-loop
+   load: its periodic flight-recorder dump must be a valid JSONL ring on
+   disk (atomic writes mean the last completed dump survives a kill at
+   ANY point), ``tools/postmortem.py`` must render the merged timeline
+   naming the dead replica's last span — and zero futures may strand
+   (the serve_gate invariant preserved under tracing).
+
+Exit 0 on pass; nonzero with a reason on any violation.
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RATE_RPS = 100.0
+N_REQUESTS = 200                  # ~2 s of chaos-round load
+DEADLINE_MS = 250.0
+FLIGHT_INTERVAL_S = 0.3
+TREE_TOLERANCE = 0.35             # CPU-container scheduling jitter headroom
+TREE_MIN_COVER = 0.5
+
+
+def fail(msg: str) -> int:
+    print(f"TRACE GATE FAIL: {msg}")
+    return 1
+
+
+def train_model(path: str):
+    import numpy as np
+    import lambdagap_tpu as lgb
+    rng = np.random.RandomState(0)
+    X = rng.randn(1200, 10).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float32)
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                   "tpu_fast_predict_rows": 0},
+                  lgb.Dataset(X, label=y), num_boost_round=8)
+    b.save_model(path)
+    return X
+
+
+def spawn_replica(model_path: str, tmp: str, i: int):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lambdagap_tpu", "task=serve",
+         f"input_model={model_path}", "serve_port=0", "verbose=-1",
+         "serve_max_delay_ms=1",
+         f"serve_trace_out={tmp}/r{i}.trace.jsonl",
+         f"serve_flight_dump={tmp}/r{i}.flight",
+         f"serve_flight_interval_s={FLIGHT_INTERVAL_S}"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, cwd=REPO, env=env)
+    return proc
+
+
+def await_port(proc, timeout_s: float = 120.0) -> int:
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("SERVE_PORT="):
+            return int(line.split("=", 1)[1])
+    raise RuntimeError("replica never printed SERVE_PORT")
+
+
+def traced_request(router, X) -> str:
+    """One traced request through the fleet; records the client root span
+    and returns the trace id."""
+    from lambdagap_tpu.obs import trace
+    ctx = trace.start_trace()
+    t0_wall, t0 = time.time(), time.perf_counter()
+    fut = router.submit(X[0][None, :], trace=ctx)
+    fut.result(30)
+    trace.RECORDER.record("client_request", ctx, t0_wall,
+                          time.perf_counter() - t0,
+                          span_id=ctx.span_id, parent="")
+    return ctx.trace_id
+
+
+def main() -> int:
+    import tempfile
+    from lambdagap_tpu.obs import trace
+    from lambdagap_tpu.obs.events import read_file, validate_file
+    from lambdagap_tpu.serve import RemoteReplica, Router, run_open_loop
+
+    with tempfile.TemporaryDirectory() as tmp:
+        model = os.path.join(tmp, "model.txt")
+        X = train_model(model)
+        print("trace gate: spawning 2 traced task=serve replicas...")
+        procs = [spawn_replica(model, tmp, i) for i in range(2)]
+        trace.RECORDER.configure(ring=65536, proc="gate-client")
+        try:
+            ports = [await_port(p) for p in procs]
+            print(f"trace gate: fleet up on ports {ports}")
+            replicas = [RemoteReplica(f"r{i}", "127.0.0.1", port)
+                        for i, port in enumerate(ports)]
+            router = Router(replicas)
+
+            # warm both replicas' serve paths (and seed both flight
+            # rings with spans) with one traced request each
+            for r in replicas:
+                ctx = trace.start_trace()
+                t0w, t0 = time.time(), time.perf_counter()
+                r.submit(X[1][None, :], trace=ctx).result(60)
+                trace.RECORDER.record("client_request", ctx, t0w,
+                                      time.perf_counter() - t0,
+                                      span_id=ctx.span_id, parent="")
+
+            # -- invariant 1: the span tree tiles the client wall -------
+            tid = traced_request(router, X)
+            time.sleep(0.3)              # replicas flush per record; settle
+            spans = trace.RECORDER.spans()
+            for i in range(2):
+                path = os.path.join(tmp, f"r{i}.trace.jsonl")
+                errs = validate_file(path)
+                if errs:
+                    return fail(f"replica {i} span JSONL invalid: "
+                                f"{errs[:3]}")
+                recs, _trunc = read_file(path)
+                spans += [r for r in recs if r.get("type") == "span"]
+            mine = [s for s in spans if s.get("trace") == tid]
+            names = sorted({s["name"] for s in mine})
+            print(f"trace gate: {len(mine)} spans for trace {tid[:8]}: "
+                  f"{names}")
+            for need in ("client_request", "route", "frontend",
+                         "serve_request", "queue_wait", "dispatch"):
+                if need not in names:
+                    return fail(f"span {need!r} missing from the trace "
+                                f"(got {names})")
+            errs = trace.validate_tree(mine, tid,
+                                       tolerance=TREE_TOLERANCE,
+                                       min_cover=TREE_MIN_COVER)
+            if errs:
+                return fail("span tree does not tile the client wall: "
+                            + "; ".join(errs))
+            print("trace gate: span tree parent-linked + tiles the "
+                  "client-observed wall")
+
+            # -- invariant 2: SIGKILL leaves a valid flight dump --------
+            time.sleep(2 * FLIGHT_INTERVAL_S)   # ensure a periodic dump
+            dead_pid = procs[0].pid
+
+            def killer():
+                time.sleep(N_REQUESTS / RATE_RPS * 0.4)
+                print("trace gate: SIGKILL replica r0 mid-load")
+                procs[0].send_signal(signal.SIGKILL)
+
+            k = threading.Thread(target=killer)
+            k.start()
+            chaos = run_open_loop(router.submit, X, RATE_RPS, N_REQUESTS,
+                                  deadline_ms=DEADLINE_MS, seed=2)
+            k.join()
+            c = chaos["counts"]
+            resolved = (c["ok"] + c["rejected"] + c["timeout"]
+                        + c["transport"] + c["error"])
+            if resolved != N_REQUESTS:
+                return fail(f"{N_REQUESTS - resolved} of {N_REQUESTS} "
+                            "requests never resolved under tracing — a "
+                            "stranded future")
+            if c["error"]:
+                return fail(f"{c['error']} unexplained request errors")
+            print(f"trace gate: chaos round resolved {resolved}/"
+                  f"{N_REQUESTS} (counts {c})")
+
+            dump0 = os.path.join(tmp, "r0.flight")
+            if not os.path.exists(dump0):
+                return fail("killed replica left no flight-recorder dump "
+                            f"({dump0}); periodic dumps did not run")
+            errs = validate_file(dump0)
+            if errs:
+                return fail(f"flight dump of the killed replica is not "
+                            f"schema-valid: {errs[:3]}")
+            recs, _trunc = read_file(dump0)
+            if not any(r.get("type") == "span" for r in recs):
+                return fail("killed replica's flight dump holds no spans")
+
+            # postmortem renders the merged timeline naming the dead
+            # replica's last span
+            pm = subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools",
+                                              "postmortem.py"),
+                 dump0, os.path.join(tmp, "r1.flight"),
+                 os.path.join(tmp, "r0.trace.jsonl")],
+                capture_output=True, text=True, cwd=REPO,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            if pm.returncode != 0:
+                return fail(f"postmortem exited {pm.returncode}: "
+                            f"{pm.stderr[-300:]}")
+            out = pm.stdout
+            if f"serve:{dead_pid}" not in out:
+                return fail("postmortem timeline never names the dead "
+                            f"replica's process serve:{dead_pid}")
+            if "last span of r0.flight" not in out:
+                return fail("postmortem did not render the dead "
+                            "replica's last span")
+            last_line = next(ln for ln in out.splitlines()
+                             if ln.startswith("last span of r0.flight"))
+            print(f"trace gate: postmortem renders the merged timeline — "
+                  f"{last_line}")
+            router.close()
+            print("trace gate: PASS — connected trace tiles the wall, "
+                  "SIGKILLed replica left a valid flight dump, zero "
+                  "stranded futures")
+            return 0
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
