@@ -203,30 +203,71 @@ def _build_serve_target(cfg: Config, booster):
     compiled copy — replicas share nothing). With
     ``fleet_scrape_interval_s > 0`` a router target also gets the fleet
     scraper + signal plane (docs/observability.md), so the frontend's
-    ``signals`` and ``prometheus fleet`` verbs answer from live data."""
-    from .serve import (FleetScraper, ForestServer, LocalReplica, Router,
-                        SignalPlane)
-    extra = _parse_serve_models(cfg.serve_models)
-    n = max(int(cfg.serve_replicas), 1)
-    servers = []
-    for i in range(n):
+    ``signals`` and ``prometheus fleet`` verbs answer from live data.
+    ``serve_autonomics=true`` additionally starts the fleet control loop
+    (docs/robustness.md "Fleet autonomics"): the target is then always a
+    router (a fleet of one is still self-healing and can scale out), a
+    scraper/signal plane is forced on (at the controller's own interval
+    when ``fleet_scrape_interval_s`` is 0), and local scale-out replicas
+    are built from the SAME booster. Off by default: with the knob off,
+    nothing here changes — no controller, no extra thread, byte-identical
+    snapshots."""
+    from .serve import (Autonomics, FleetScraper, ForestServer,
+                        LocalReplica, Router, SignalPlane)
+
+    def make_server():
         s = ForestServer(booster, raw_score=cfg.predict_raw_score,
                          start_iteration=cfg.start_iteration_predict,
                          num_iteration=cfg.num_iteration_predict)
-        for name, path in extra:
+        for name, path in _parse_serve_models(cfg.serve_models):
             s.add_model(name, path)
-        servers.append(s)
-    if n == 1:
+        return s
+
+    n = max(int(cfg.serve_replicas), 1)
+    servers = [make_server() for _ in range(n)]
+    if n == 1 and not cfg.serve_autonomics:
         return servers[0]
     router = Router([LocalReplica(f"r{i}", s)
                      for i, s in enumerate(servers)], own_replicas=True)
-    if cfg.fleet_scrape_interval_s > 0:
+    scrape_interval = cfg.fleet_scrape_interval_s
+    if scrape_interval <= 0 and cfg.serve_autonomics:
+        # the control loop senses through the scraper: force one on at
+        # the controller's cadence rather than running blind
+        scrape_interval = cfg.serve_autonomics_interval_s
+    scraper = None
+    if scrape_interval > 0:
         from .obs import trace as obs_trace
         scraper = FleetScraper(
-            router, interval_s=cfg.fleet_scrape_interval_s,
+            router, interval_s=scrape_interval,
             timeout_s=cfg.fleet_scrape_timeout_s,
             signals=SignalPlane(recorder=obs_trace.RECORDER)).start()
         router.attach_scraper(scraper)
+    if cfg.serve_autonomics:
+        from .guard.faults import plan_for
+
+        def scale(index: int):
+            # scale-out replicas continue the rN numbering past the
+            # configured fleet; compile happens here, outside any lock
+            return LocalReplica(f"r{n + index}", make_server())
+
+        auto = Autonomics(
+            router, signals=scraper.signals if scraper else None,
+            scraper=scraper,
+            interval_s=cfg.serve_autonomics_interval_s,
+            scale=scale,
+            revive_backoff_s=cfg.serve_autonomics_revive_backoff_s,
+            revive_backoff_max_s=cfg.serve_autonomics_revive_backoff_max_s,
+            probe_window=cfg.serve_autonomics_probe_window,
+            scale_out_margin=cfg.serve_autonomics_scale_out_margin,
+            scale_in_margin=cfg.serve_autonomics_scale_in_margin,
+            min_replicas=cfg.serve_autonomics_min_replicas,
+            max_replicas=cfg.serve_autonomics_max_replicas,
+            cooldown_s=cfg.serve_autonomics_cooldown_s,
+            hysteresis_ticks=cfg.serve_autonomics_hysteresis_ticks,
+            placement=cfg.serve_autonomics_placement,
+            placement_budget_bytes=int(cfg.serve_hbm_budget_mb * (1 << 20)),
+            faults=plan_for(cfg)).start()
+        router.attach_autonomics(auto)
     return router
 
 

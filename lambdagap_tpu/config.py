@@ -383,6 +383,18 @@ class Config:
     serve_flight_interval_s: float = 0.0  # periodic flight dumps (SIGKILL durability); 0 = fault-only
     fleet_scrape_interval_s: float = 0.0  # router-side fleet scrape + signal-plane period; 0 = on demand
     fleet_scrape_timeout_s: float = 2.0  # per-replica stats RPC timeout during a scrape
+    serve_autonomics: bool = False       # fleet control loop: revival + placement + delta rollout + autoscaling (off = byte-identical pre-autonomics behavior)
+    serve_autonomics_interval_s: float = 1.0  # controller tick period
+    serve_autonomics_revive_backoff_s: float = 0.5   # first revival retry delay (bounded exponential, deterministic jitter)
+    serve_autonomics_revive_backoff_max_s: float = 30.0  # revival backoff hard cap
+    serve_autonomics_probe_window: int = 3   # consecutive healthy ticks clearing a revived replica's probation
+    serve_autonomics_scale_out_margin: float = 0.1   # scale OUT when knee_margin <= this (saturation approaching)
+    serve_autonomics_scale_in_margin: float = 0.5    # scale IN when knee_margin >= this (demonstrated headroom)
+    serve_autonomics_min_replicas: int = 1   # autoscaler floor (scale-in never goes below)
+    serve_autonomics_max_replicas: int = 0   # autoscaler ceiling; 0 = autoscaling off (revival/placement still run)
+    serve_autonomics_cooldown_s: float = 10.0  # minimum seconds between scale actions (rate limit)
+    serve_autonomics_hysteresis_ticks: int = 3  # consecutive ticks a margin condition must hold before acting
+    serve_autonomics_placement: bool = True  # HBM-aware model placement + residency-preferring routing (needs serve_hbm_budget_mb > 0 to bind)
 
     # -- guard (lambdagap_tpu.guard; docs/robustness.md) ------------------
     guard_nonfinite: str = "raise"       # non-finite grad/hess/score policy: raise / skip_tree / clip / off
@@ -632,6 +644,31 @@ class Config:
              "fleet_scrape_interval_s must be >= 0"),
             (self.fleet_scrape_timeout_s > 0,
              "fleet_scrape_timeout_s must be > 0"),
+            (self.serve_autonomics_interval_s > 0,
+             "serve_autonomics_interval_s must be > 0"),
+            (self.serve_autonomics_revive_backoff_s > 0,
+             "serve_autonomics_revive_backoff_s must be > 0"),
+            (self.serve_autonomics_revive_backoff_max_s
+             >= self.serve_autonomics_revive_backoff_s,
+             "serve_autonomics_revive_backoff_max_s must be >= "
+             "serve_autonomics_revive_backoff_s"),
+            (self.serve_autonomics_probe_window >= 1,
+             "serve_autonomics_probe_window must be >= 1"),
+            (self.serve_autonomics_scale_out_margin
+             < self.serve_autonomics_scale_in_margin,
+             "serve_autonomics_scale_out_margin must be < "
+             "serve_autonomics_scale_in_margin (the hysteresis band)"),
+            (self.serve_autonomics_min_replicas >= 1,
+             "serve_autonomics_min_replicas must be >= 1"),
+            (self.serve_autonomics_max_replicas == 0
+             or self.serve_autonomics_max_replicas
+             >= self.serve_autonomics_min_replicas,
+             "serve_autonomics_max_replicas must be 0 (off) or >= "
+             "serve_autonomics_min_replicas"),
+            (self.serve_autonomics_cooldown_s >= 0,
+             "serve_autonomics_cooldown_s must be >= 0"),
+            (self.serve_autonomics_hysteresis_ticks >= 1,
+             "serve_autonomics_hysteresis_ticks must be >= 1"),
             (self.guard_nonfinite in ("off", "raise", "skip_tree", "clip"),
              f"unknown guard_nonfinite {self.guard_nonfinite!r}"),
             (self.guard_clip > 0, "guard_clip must be > 0"),

@@ -14,12 +14,17 @@ Production posture for the whole framework (docs/robustness.md):
   (``ServeTimeout``), bounded-queue backpressure (``ServeOverloaded``),
   a swap circuit breaker (``SwapFailed``/``SwapRejected``) and the
   OK/DEGRADED/DRAINING health state machine.
+- :mod:`.backoff` — the one bounded-exponential-backoff-with-
+  deterministic-jitter policy shared by the swap breaker's cooldown, the
+  fleet scraper's re-scrape-after-error cadence, and replica revival
+  (serve/autonomics.py).
 - :mod:`.faults` — config/env-driven fault injection (crash-at-iteration,
   non-finite gradients, failing/slow serve dispatch, torn snapshot
   writes) powering tests/test_guard*.py and tools/chaos_gate.py.
 """
 from __future__ import annotations
 
+from .backoff import Backoff  # noqa: F401
 from .degrade import (CircuitBreaker, HealthMonitor,  # noqa: F401
                       ReplicaUnavailable, ServeOverloaded, ServeTimeout,
                       SwapFailed, SwapRejected)
@@ -30,7 +35,7 @@ from .snapshot import (SnapshotError, atomic_write_text,  # noqa: F401
                        restore_state, snapshot_path, write_training_snapshot)
 
 __all__ = [
-    "CircuitBreaker", "HealthMonitor", "ReplicaUnavailable",
+    "Backoff", "CircuitBreaker", "HealthMonitor", "ReplicaUnavailable",
     "ServeOverloaded", "ServeTimeout",
     "SwapFailed", "SwapRejected", "FaultPlan", "InjectedFault", "plan_for",
     "NonFiniteError", "TrainGuard", "SnapshotError", "atomic_write_text",

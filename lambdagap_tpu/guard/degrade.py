@@ -14,7 +14,11 @@ of hanging callers on an unbounded queue:
   hot-swaps: after ``threshold`` consecutive failed swaps the circuit
   opens and further swaps are rejected fast (:class:`SwapRejected`) until
   ``cooldown_s`` passes (then one probe swap is allowed through —
-  half-open). The active forest keeps serving throughout.
+  half-open). The active forest keeps serving throughout. The cooldown
+  clock is a :class:`~lambdagap_tpu.guard.backoff.Backoff` policy — the
+  default (factor 1, zero jitter) reproduces the classic fixed cooldown
+  exactly, while a growing policy makes every failed probe widen the
+  next window (the shape replica revival uses).
 - :class:`HealthMonitor` — the OK / DEGRADED / DRAINING state machine
   exposed via ``ServeStats``/Prometheus and the serve CLI. DEGRADED means
   "alive but shedding or failing" (dispatch failures not yet followed by a
@@ -26,6 +30,9 @@ from __future__ import annotations
 
 import threading
 import time
+from typing import Optional
+
+from .backoff import Backoff
 
 
 class ServeTimeout(TimeoutError):
@@ -60,29 +67,57 @@ class CircuitBreaker:
     """Consecutive-failure circuit breaker (closed -> open -> half_open).
 
     ``threshold=0`` disables the breaker (always allows). ``clock`` is
-    injectable for tests. Thread-safe.
+    injectable for tests. Thread-safe. The cooldown window comes from a
+    :class:`~lambdagap_tpu.guard.backoff.Backoff` policy: the default is
+    a fixed ``cooldown_s`` (factor 1, no jitter — byte-compatible with
+    the pre-backoff breaker); pass ``backoff=`` for escalating windows.
     """
 
     def __init__(self, threshold: int = 3, cooldown_s: float = 30.0,
-                 clock=time.monotonic) -> None:
+                 clock=time.monotonic,
+                 backoff: Optional[Backoff] = None) -> None:
         self.threshold = int(threshold)
-        self.cooldown_s = float(cooldown_s)
         self._clock = clock
+        if backoff is None:
+            cd = max(float(cooldown_s), 0.0)
+            backoff = Backoff(base_s=cd, factor=1.0, max_s=cd,
+                              jitter=0.0, clock=clock)
+        self.backoff = backoff
         self._lock = threading.Lock()
         self._failures = 0
         self._opened_at = None           # clock() when the circuit opened
 
+    @property
+    def cooldown_s(self) -> float:
+        return self.backoff.base_s
+
+    @cooldown_s.setter
+    def cooldown_s(self, value: float) -> None:
+        v = max(float(value), 0.0)
+        self.backoff.base_s = v
+        if self.backoff.max_s < v:
+            self.backoff.max_s = v
+
+    def _window_s(self) -> float:
+        """The current open-window length: the backoff delay of the last
+        recorded failure (constant under the default fixed policy)."""
+        return self.backoff.delay_for(max(self.backoff.attempts - 1, 0))
+
     def record_failure(self) -> None:
         with self._lock:
             self._failures += 1
-            if self.threshold > 0 and self._failures >= self.threshold \
-                    and self._opened_at is None:
-                self._opened_at = self._clock()
+            if self.threshold > 0 and self._failures >= self.threshold:
+                if self._opened_at is None:
+                    self._opened_at = self._clock()
+                # escalating policies widen the NEXT window per failed
+                # probe; the fixed default keeps every window == cooldown
+                self.backoff.note_failure()
 
     def record_success(self) -> None:
         with self._lock:
             self._failures = 0
             self._opened_at = None
+            self.backoff.note_success()
 
     def state(self) -> str:
         with self._lock:
@@ -91,7 +126,7 @@ class CircuitBreaker:
     def _state_locked(self) -> str:
         if self._opened_at is None:
             return "closed"
-        if self._clock() - self._opened_at >= self.cooldown_s:
+        if self._clock() - self._opened_at >= self._window_s():
             return "half_open"
         return "open"
 

@@ -22,6 +22,14 @@ arms these points:
 - ``torn_snapshot=K`` — the K-th snapshot write of the process bypasses
   the atomic tmp+rename protocol and writes a truncated file in place:
   the torn-write crash window, materialized.
+- ``revive_fail=K`` — the next K replica-revival attempts of the
+  autonomics controller (serve/autonomics.py) raise
+  :class:`InjectedFault` before touching the replica: the
+  flapping-replica case the revival backoff must absorb.
+- ``delta_swap_fail=K`` — the next K delta hot-swap applications raise
+  :class:`InjectedFault` before reconstructing the model text: one armed
+  replica turns a fleet delta rollout into the partial-failure case the
+  rollback path must clean up (tools/autonomics_gate.py).
 
 All points are inert unless armed; parsing happens once per plan. Plans
 are per-booster / per-server (``plan_for(config)``), so two servers in one
@@ -78,11 +86,14 @@ class FaultPlan:
         self.serve_dispatch_slow_ms: float = float(
             kv.get("serve_dispatch_slow_ms", 0.0))
         self.torn_snapshot: int = int(kv.get("torn_snapshot", 0))
+        self.revive_fail: int = int(kv.get("revive_fail", 0))
+        self.delta_swap_fail: int = int(kv.get("delta_swap_fail", 0))
         self._fired_nonfinite: set = set()
         self._snapshot_writes = 0
         unknown = set(kv) - {"crash_at_iter", "nonfinite_grad",
                              "serve_dispatch_fail", "serve_dispatch_slow_ms",
-                             "torn_snapshot"}
+                             "torn_snapshot", "revive_fail",
+                             "delta_swap_fail"}
         if unknown:
             log.warning("unknown fault point(s) ignored: %s",
                         ", ".join(sorted(unknown)))
@@ -93,7 +104,9 @@ class FaultPlan:
                 or self.nonfinite_grad is not None
                 or self.serve_dispatch_fail > 0
                 or self.serve_dispatch_slow_ms > 0
-                or self.torn_snapshot > 0)
+                or self.torn_snapshot > 0
+                or self.revive_fail > 0
+                or self.delta_swap_fail > 0)
 
     # -- training points ------------------------------------------------
     def crash_point(self, iteration: int) -> None:
@@ -131,6 +144,21 @@ class FaultPlan:
             self.serve_dispatch_fail -= 1
             raise InjectedFault("injected serve dispatch failure "
                                 f"({self.serve_dispatch_fail} left)")
+
+    # -- autonomics points ----------------------------------------------
+    def revive_fault(self) -> None:
+        """Called at the top of every replica-revival attempt."""
+        if self.revive_fail > 0:
+            self.revive_fail -= 1
+            raise InjectedFault("injected replica revival failure "
+                                f"({self.revive_fail} left)")
+
+    def delta_swap_fault(self) -> None:
+        """Called before a delta hot-swap reconstructs the model text."""
+        if self.delta_swap_fail > 0:
+            self.delta_swap_fail -= 1
+            raise InjectedFault("injected delta swap failure "
+                                f"({self.delta_swap_fail} left)")
 
     # -- snapshot point -------------------------------------------------
     def tear_snapshot(self, path: str, data: str) -> bool:

@@ -199,7 +199,11 @@ def _scan_file(path: str) -> Tuple[List[Tuple[int, Any]], List[str], bool]:
     errs: List[str] = []
     records: List[Tuple[int, Any]] = []
     truncated = False
-    with open(path, "r", encoding="utf-8") as f:
+    # errors="replace": a dump torn mid-byte-sequence (SIGKILL during a
+    # non-atomic copy, a half-recovered disk) must degrade to a torn/
+    # garbage LINE — which the per-line parse below already tolerates —
+    # not to a UnicodeDecodeError that loses the whole file's evidence
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
         content = f.read()
     lines = content.split("\n")
     last_complete = len(lines) - 1       # split leaves "" after a final \n

@@ -207,6 +207,7 @@ class FleetScraper:
                  signals=None, recorder=None,
                  on_snapshot: Optional[Callable[[Dict], None]] = None
                  ) -> None:
+        from ..guard.backoff import Backoff
         self.target = target
         self.interval_s = max(float(interval_s), 0.0)
         self.timeout_s = float(timeout_s)
@@ -218,6 +219,12 @@ class FleetScraper:
         self.recorder = recorder
         self.scrapes = 0
         self.scrape_errors = 0
+        # re-scrape-after-error cadence: bounded exponential (guard/
+        # backoff.py) so a fleet that is DOWN is probed gently instead of
+        # hammered every interval; one good scrape resets to full rate
+        base = max(self.interval_s, 0.1)
+        self._err_backoff = Backoff(base_s=base, factor=2.0,
+                                    max_s=max(30.0, base), jitter=0.0)
         self._latest: Optional[Dict] = None
         self._latest_lock = threading.Lock()
         self._stop = threading.Event()
@@ -267,15 +274,22 @@ class FleetScraper:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
+            if not self._err_backoff.ready():
+                continue                 # backing off after failed scrapes
             try:
                 self.scrape()
+                self._err_backoff.note_success()
             except Exception as e:
                 # a dying replica mid-scrape is expected fleet weather:
-                # keep the last snapshot, note the miss, keep going
+                # keep the last snapshot, note the miss, keep going —
+                # at the backoff's pace, not the full scrape rate
                 self.scrape_errors += 1
-                self.recorder.event("scrape_error", error=str(e))
+                delay = self._err_backoff.note_failure()
+                self.recorder.event("scrape_error", error=str(e),
+                                    retry_in_s=round(delay, 3))
                 log.warning("fleet scraper: scrape failed (%s); keeping "
-                            "the previous snapshot", e)
+                            "the previous snapshot, next attempt in "
+                            "%.1fs", e, delay)
 
     def close(self) -> None:
         self._stop.set()

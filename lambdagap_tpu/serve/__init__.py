@@ -6,9 +6,13 @@ eviction + re-admission), a micro-batching request queue with weighted
 tenant fairness (batcher.py), per-model atomic generation-pointer hot-swap
 (registry.py; swap.py keeps the PR 1 single-model controller), a serving
 metrics layer (stats.py), a health-aware replica router with failover
-(router.py), a newline-JSON socket front end (frontend.py), and an
-open-loop load generator (loadgen.py) — fronted by :class:`ForestServer`
-(server.py). Entry points::
+(router.py), a newline-JSON socket front end (frontend.py), an
+open-loop load generator (loadgen.py), and — behind
+``serve_autonomics=true`` — a self-healing control loop (autonomics.py:
+replica revival with backoff + probation, HBM-aware model placement
+(placement.py), fleet-atomic delta hot-swap rollouts (delta.py), and a
+goodput-knee autoscaler) — fronted by :class:`ForestServer` (server.py).
+Entry points::
 
     server = booster.as_server()                  # Python API
     python -m lambdagap_tpu task=serve \
@@ -23,10 +27,13 @@ from ..guard.degrade import (ReplicaUnavailable, ServeOverloaded,
                              ServeTimeout, SwapFailed, SwapRejected)
 from ..obs.fleet import FleetScraper, fleet_snapshot, merge_snapshots
 from ..obs.signals import SignalPlane
+from .autonomics import Autonomics, default_revive
 from .batcher import FairQueue, MicroBatcher, Request
 from .cache import DEFAULT_BUCKETS, CompiledForestCache
+from .delta import DeltaMismatch, apply_delta, make_delta
 from .frontend import FrontendClient, ServeFrontend
 from .loadgen import arrival_times, run_open_loop, sweep
+from .placement import plan_from_fleet, plan_placement
 from .registry import DEFAULT_MODEL, ModelEntry, ModelRegistry
 from .router import LocalReplica, RemoteReplica, Router
 from .server import (ForestServer, ServeResult, parse_tenant_weights,
@@ -42,4 +49,6 @@ __all__ = ["ForestServer", "ServeResult", "serve_loop", "MicroBatcher",
            "parse_tenant_weights", "ServeStats", "SwapController",
            "load_booster", "ServeOverloaded", "ServeTimeout", "SwapFailed",
            "SwapRejected", "ReplicaUnavailable", "FleetScraper",
-           "fleet_snapshot", "merge_snapshots", "SignalPlane"]
+           "fleet_snapshot", "merge_snapshots", "SignalPlane",
+           "Autonomics", "default_revive", "DeltaMismatch", "make_delta",
+           "apply_delta", "plan_placement", "plan_from_fleet"]

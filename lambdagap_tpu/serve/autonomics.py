@@ -1,0 +1,512 @@
+"""Fleet autonomics: the control loop that closes PR 12's signal plane.
+
+The fleet was observable but static: a dead replica stayed dead until
+the router was rebuilt, residency was decided by LRU accidents priced at
+174-214x, and nothing reacted to the measured goodput knee (12.7k rps
+"throughput" at 0.12 goodput). This module is the ACTUATION half of
+ROADMAP item 2 — a background controller that consumes
+:class:`~lambdagap_tpu.obs.signals.SignalPlane` ticks and the fleet
+metric plane, and acts on the router with four behaviors:
+
+- **replica revival + probation** (``_revive_tick``): a replica the
+  router marked dead is reconnected (``RemoteReplica.reconnect``) or
+  respawned (``LocalReplica.respawn`` — a fresh server warmed from the
+  registry's host-retained models), under a per-replica bounded
+  exponential backoff with deterministic jitter
+  (:class:`~lambdagap_tpu.guard.backoff.Backoff`). A revived replica
+  re-enters rotation at PROBATION — the router demotes it to the
+  degraded tier — until ``probe_window`` consecutive healthy ticks at
+  fleet goodput clear it (``_probation_tick``); a replica that dies
+  again during probation pays the grown backoff, so a flapping host
+  cannot convert the controller into a crash loop.
+- **HBM-aware placement** (``_placement_tick``): the
+  :mod:`~lambdagap_tpu.serve.placement` bin-pack over per-model traffic
+  and bytes, actuated as ``prefetch`` (the readmission compile paid off
+  the request path) THEN ``Router.set_placement`` (traffic follows the
+  resident forest) — the cliff is paid by design, not by LRU accident.
+- **delta hot-swap rollout** (:meth:`rollout_delta`): ship only the
+  appended trees (serve/delta.py) to every live replica; on ANY
+  per-replica failure, the already-committed replicas are swapped back
+  to the base text — the fleet lands the new generation everywhere or
+  nowhere (each per-replica failure still feeds that model's swap
+  breaker, exactly like a full swap).
+- **goodput-knee autoscaling** (``_autoscale_tick``): scale the local
+  fleet out when ``knee_margin`` shrinks past ``scale_out_margin`` and
+  in above ``scale_in_margin`` — hysteresis-guarded (the condition must
+  hold ``hysteresis_ticks`` consecutive ticks) and rate-limited
+  (``cooldown_s`` between scale actions), acting only on a demonstrated
+  knee (``knee_rps > 0``): a cold fleet with no evidence is left alone.
+
+Lock discipline (graftlint R9, the ``r9_scrape``/``r9_autonomics``
+hazard class): the controller's own lock guards counters and plan maps
+ONLY. Every reconnect, respawn, prefetch, compile, and swap happens with
+NO lock held — router mutations go through router methods that lock
+around pointer flips, never around the work. The controller thread is a
+daemon; ``tick()`` is public and deterministic so tests and gates drive
+the loop without wall-clock sleeps.
+
+Everything here is off unless ``serve_autonomics=true``: with the knob
+off no controller exists, no thread starts, and router/ServeStats
+snapshots are byte-identical to the pre-autonomics schema
+(docs/robustness.md "Fleet autonomics").
+"""
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, Optional
+
+from ..guard.backoff import Backoff
+from ..guard.degrade import SwapFailed
+from ..utils import log
+from .placement import plan_changes, plan_from_fleet
+from .registry import DEFAULT_MODEL
+
+
+def _text_of_source(source) -> str:
+    """Resolve a rollout source (path / model text / Booster / GBDT)
+    into full model text — the delta publisher's input."""
+    from ..models.model_text import read_model_source
+    from .delta import model_text_of
+    from .swap import load_booster
+    if isinstance(source, str):
+        return read_model_source(source)
+    return model_text_of(load_booster(source))
+
+
+def default_revive(name: str, replica):
+    """The built-in revival primitive: reconnect a RemoteReplica's
+    address, respawn a LocalReplica's server from its host-retained
+    models. Raises while the endpoint is still down (the backoff's
+    job to absorb)."""
+    if hasattr(replica, "reconnect"):
+        return replica.reconnect()
+    if hasattr(replica, "respawn"):
+        return replica.respawn()
+    raise TypeError(f"replica {name!r} ({type(replica).__name__}) has no "
+                    "reconnect/respawn primitive; pass revive= to "
+                    "Autonomics")
+
+
+class Autonomics:
+    """The fleet controller. ``router`` is the actuation surface;
+    ``signals`` (a SignalPlane) and ``scraper`` (a FleetScraper) are the
+    sensing surfaces — either may be None, disabling the behaviors that
+    need it (revival works from the router snapshot alone).
+
+    ``revive(name, old_replica) -> replica`` overrides the revival
+    primitive (the autonomics gate respawns task=serve subprocesses
+    here); ``scale(index) -> replica`` supplies scale-out replicas (None
+    disables the autoscaler's out direction).
+    """
+
+    def __init__(self, router, signals=None, scraper=None, *,
+                 interval_s: float = 1.0,
+                 revive: Optional[Callable] = None,
+                 scale: Optional[Callable] = None,
+                 revive_backoff_s: float = 0.5,
+                 revive_backoff_max_s: float = 30.0,
+                 probe_window: int = 3,
+                 scale_out_margin: float = 0.1,
+                 scale_in_margin: float = 0.5,
+                 min_replicas: int = 1,
+                 max_replicas: int = 0,
+                 cooldown_s: float = 10.0,
+                 hysteresis_ticks: int = 3,
+                 placement: bool = True,
+                 placement_budget_bytes: int = 0,
+                 placement_spread: int = 1,
+                 faults=None, recorder=None, seed: int = 0,
+                 clock=time.monotonic) -> None:
+        self.router = router
+        self.signals = signals
+        self.scraper = scraper
+        self.interval_s = max(float(interval_s), 0.05)
+        self._revive_fn = revive if revive is not None else default_revive
+        self._scale_fn = scale
+        self._backoff_base = float(revive_backoff_s)
+        self._backoff_max = float(revive_backoff_max_s)
+        self.probe_window = max(int(probe_window), 1)
+        self.scale_out_margin = float(scale_out_margin)
+        self.scale_in_margin = float(scale_in_margin)
+        self.min_replicas = max(int(min_replicas), 1)
+        self.max_replicas = int(max_replicas)
+        self.cooldown_s = max(float(cooldown_s), 0.0)
+        self.hysteresis_ticks = max(int(hysteresis_ticks), 1)
+        self.placement_enabled = bool(placement)
+        self.placement_budget_bytes = int(placement_budget_bytes)
+        self.placement_spread = max(int(placement_spread), 1)
+        self._faults = faults
+        if recorder is None:
+            from ..obs import trace as obs_trace
+            recorder = obs_trace.RECORDER
+        self._recorder = recorder
+        self.seed = int(seed)
+        self._clock = clock
+        self._lock = threading.Lock()    # counters/maps ONLY — never held
+        self._backoffs: Dict[str, Backoff] = {}   # across actuation work
+        self._probes: Dict[str, int] = {}
+        self._plan: Dict[str, List[str]] = {}
+        self._base_texts: Dict[str, str] = {}
+        self._scaled: List[str] = []     # replicas this controller added
+        self._scale_seq = 0
+        self._out_streak = 0
+        self._in_streak = 0
+        self._last_scale_at: Optional[float] = None
+        self.counters = {"ticks": 0, "revivals": 0, "revival_failures": 0,
+                         "promotions": 0, "demotions": 0,
+                         "placement_updates": 0, "prefetches": 0,
+                         "scale_outs": 0, "scale_ins": 0,
+                         "delta_rollouts": 0, "delta_rollbacks": 0,
+                         "full_rollouts": 0}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- sensing helpers -------------------------------------------------
+    def _backoff_for(self, name: str) -> Backoff:
+        with self._lock:
+            b = self._backoffs.get(name)
+            if b is None:
+                b = self._backoffs[name] = Backoff(
+                    base_s=self._backoff_base, factor=2.0,
+                    max_s=self._backoff_max, jitter=0.1,
+                    seed=self.seed ^ zlib.crc32(name.encode()),
+                    clock=self._clock)
+            return b
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[key] += n
+
+    # -- the control loop ------------------------------------------------
+    def tick(self) -> Dict:
+        """One deterministic control step: sense (signal plane + router
+        snapshot), then actuate each behavior. Public so tests and the
+        autonomics gate drive the loop without wall-clock coupling;
+        the background thread calls exactly this."""
+        sig = self.signals.snapshot() if self.signals is not None else None
+        rsnap = self.router.snapshot()
+        self._revive_tick(rsnap)
+        self._probation_tick(rsnap, sig)
+        if self.placement_enabled:
+            self._placement_tick()
+        self._autoscale_tick(sig)
+        self._bump("ticks")
+        return rsnap
+
+    def _revive_tick(self, rsnap: Dict) -> None:
+        for name, info in sorted(rsnap.get("replicas", {}).items()):
+            if not info.get("dead"):
+                continue
+            b = self._backoff_for(name)
+            if not b.ready():
+                continue
+            try:
+                if self._faults is not None:
+                    self._faults.revive_fault()
+                old = self.router.replica(name)
+                fresh = self._revive_fn(name, old)
+                state = fresh.health()
+                if state == "dead":
+                    raise ConnectionError(
+                        f"revived replica {name!r} reports dead health")
+                # pointer flip only; the reconnect/respawn above ran with
+                # no lock held (R9 discipline)
+                self.router.replace_replica(name, fresh, probation=True)
+            except Exception as e:
+                delay = b.note_failure()
+                self._bump("revival_failures")
+                self._recorder.event("autonomics_revive_failed",
+                                     replica=name, error=str(e),
+                                     retry_in_s=round(delay, 3))
+                log.warning("autonomics: revival of replica %r failed "
+                            "(%s); retrying in %.2fs (attempt %d)",
+                            name, e, delay, b.attempts)
+                continue
+            with self._lock:
+                self._probes[name] = 0
+            self._bump("revivals")
+            self._recorder.event("autonomics_revived", replica=name,
+                                 attempts=b.attempts)
+            log.info("autonomics: replica %r revived; probation until "
+                     "%d healthy ticks at fleet goodput", name,
+                     self.probe_window)
+
+    def _probation_tick(self, rsnap: Dict, sig: Optional[Dict]) -> None:
+        good_ratio = (self.signals.knee.good_ratio
+                      if self.signals is not None else 0.9)
+        interval_good = 1.0
+        if sig is not None:
+            interval_good = float(
+                sig.get("interval", {}).get("good_fraction", 1.0))
+        for name, info in sorted(rsnap.get("replicas", {}).items()):
+            if not info.get("probation"):
+                continue
+            healthy = (not info.get("dead")
+                       and info.get("health") == "ok"
+                       and interval_good >= good_ratio)
+            with self._lock:
+                streak = self._probes.get(name, 0)
+                streak = streak + 1 if healthy else 0
+                self._probes[name] = streak
+            if streak < self.probe_window:
+                continue
+            self.router.set_probation(name, False)
+            self._backoff_for(name).note_success()
+            with self._lock:
+                self._probes.pop(name, None)
+            self._bump("promotions")
+            self._recorder.event("autonomics_promoted", replica=name)
+            log.info("autonomics: replica %r cleared probation after %d "
+                     "healthy ticks; back in the ok tier", name,
+                     self.probe_window)
+
+    def _placement_tick(self) -> None:
+        if self.scraper is None:
+            return
+        try:
+            fleet = self.scraper.latest()
+        except Exception as e:           # a scrape may race a dying replica
+            log.warning("autonomics: placement skipped — no fleet "
+                        "snapshot (%s)", e)
+            return
+        live = self.router.replica_names(live_only=True)
+        n_models = ((fleet.get("merged") or {}).get("registry") or {}) \
+            .get("registered_models", 0)
+        if len(live) < 2 or n_models < 2:
+            return                       # nothing to place
+        plan = plan_from_fleet(fleet, live,
+                               budget_bytes=self.placement_budget_bytes,
+                               spread=self.placement_spread)
+        with self._lock:
+            if plan == self._plan:
+                return
+            changes = plan_changes(self._plan, plan)
+            self._plan = plan
+        # prefetch BEFORE routing flips: the readmission compile lands on
+        # the replica while its traffic still flows elsewhere
+        for model, names in sorted(changes.items()):
+            for rname in names:
+                try:
+                    self.router.prefetch(model, rname)
+                    self._bump("prefetches")
+                except Exception as e:
+                    log.warning("autonomics: prefetch of model %r on "
+                                "replica %r failed: %s", model, rname, e)
+        self.router.set_placement(plan)
+        self._bump("placement_updates")
+        self._recorder.event("autonomics_placement",
+                             models=len(plan),
+                             moves=sum(len(v) for v in changes.values()))
+
+    def _autoscale_tick(self, sig: Optional[Dict]) -> None:
+        if sig is None or self.max_replicas <= 0:
+            return
+        good = sig.get("goodput") or {}
+        knee = float(good.get("knee_rps", 0.0))
+        margin = float(good.get("knee_margin", 0.0))
+        with self._lock:
+            if knee <= 0.0:
+                # no demonstrated knee: no evidence, no action
+                self._out_streak = self._in_streak = 0
+                return
+            if margin <= self.scale_out_margin:
+                self._out_streak += 1
+                self._in_streak = 0
+            elif margin >= self.scale_in_margin:
+                self._in_streak += 1
+                self._out_streak = 0
+            else:
+                self._out_streak = self._in_streak = 0
+            out_due = self._out_streak >= self.hysteresis_ticks
+            in_due = self._in_streak >= self.hysteresis_ticks
+            cooled = (self._last_scale_at is None
+                      or self._clock() - self._last_scale_at
+                      >= self.cooldown_s)
+        if not cooled:
+            return
+        live = self.router.replica_names(live_only=True)
+        if out_due and self._scale_fn is not None \
+                and len(live) < self.max_replicas:
+            with self._lock:
+                idx = self._scale_seq
+                self._scale_seq += 1
+            try:
+                replica = self._scale_fn(idx)   # build/compile: no lock
+            except Exception as e:
+                log.warning("autonomics: scale-out replica build failed: "
+                            "%s", e)
+                return
+            if replica is None:
+                return
+            self.router.add_replica(replica, probation=False)
+            with self._lock:
+                self._scaled.append(replica.name)
+                self._last_scale_at = self._clock()
+                self._out_streak = 0
+            self._bump("scale_outs")
+            self._recorder.event("autonomics_scale_out",
+                                 replica=replica.name,
+                                 knee_margin=round(margin, 4))
+            log.info("autonomics: scaled OUT (+%r) at knee_margin %.3f "
+                     "<= %.3f", replica.name, margin,
+                     self.scale_out_margin)
+        elif in_due and len(live) > self.min_replicas:
+            with self._lock:
+                name = self._scaled.pop() if self._scaled else None
+            if name is None or name not in live:
+                # only retire replicas this controller added: the
+                # operator's configured fleet is a floor, not a pool
+                return
+            self.router.remove_replica(name, close=True)
+            with self._lock:
+                self._last_scale_at = self._clock()
+                self._in_streak = 0
+            self._bump("scale_ins")
+            self._recorder.event("autonomics_scale_in", replica=name,
+                                 knee_margin=round(margin, 4))
+            log.info("autonomics: scaled IN (-%r) at knee_margin %.3f "
+                     ">= %.3f", name, margin, self.scale_in_margin)
+
+    # -- delta rollout ---------------------------------------------------
+    def rollout_delta(self, source, model: Optional[str] = None,
+                      base_source=None) -> Dict:
+        """Fleet-atomic model rollout, appended trees only.
+
+        Computes the delta from the deployed base text (cached from the
+        previous rollout, or ``base_source``, or a live local replica's
+        registry) to ``source``; applies it to every live replica IN
+        ORDER; on any per-replica failure, the replicas that already
+        committed are swapped BACK to the base text before the failure
+        propagates — the fleet is never left mixed-generation. A source
+        that does not extend the base falls back to a full fleet swap
+        (same atomicity protocol). Returns a summary dict
+        (mode/replicas/bytes)."""
+        from .delta import delta_bytes, make_delta
+        mname = model if model is not None else DEFAULT_MODEL
+        new_text = _text_of_source(source)
+        base_text = self._resolve_base(mname, base_source)
+        delta = make_delta(base_text, new_text)
+        names = self.router.replica_names(live_only=True)
+        if not names:
+            raise SwapFailed("delta rollout: no live replica")
+        mode = "delta" if delta is not None else "full"
+        applied: List[str] = []
+        failure: Optional[Exception] = None
+        failed_on = None
+        for name in names:
+            try:
+                if delta is not None:
+                    self.router.swap_delta_on(name, delta, model=model)
+                else:
+                    self.router.swap_on(name, new_text, model=model)
+                applied.append(name)
+            except Exception as e:
+                failure, failed_on = e, name
+                break
+        if failure is not None:
+            rolled = []
+            for name in applied:         # un-commit: back to the base
+                try:
+                    self.router.swap_on(name, base_text, model=model)
+                    rolled.append(name)
+                except Exception as e:
+                    log.warning("autonomics: rollback of replica %r "
+                                "failed too (%s); it is now degraded "
+                                "until the next successful rollout",
+                                name, e)
+            self._bump("delta_rollbacks")
+            self._recorder.event("autonomics_rollout_rolled_back",
+                                 model=mname, failed_on=failed_on,
+                                 rolled_back=rolled)
+            raise SwapFailed(
+                f"{mode} rollout of model {mname!r} failed on replica "
+                f"{failed_on!r} ({failure}); rolled back "
+                f"{rolled or 'nothing'} — the fleet stays on the base "
+                "generation") from failure
+        with self._lock:
+            self._base_texts[mname] = new_text
+        self._bump("delta_rollouts" if delta is not None
+                   else "full_rollouts")
+        out = {"mode": mode, "model": mname, "replicas": list(names),
+               "full_bytes": len(new_text.encode("utf-8"))}
+        if delta is not None:
+            out["delta_bytes"] = delta_bytes(delta)
+            out["appended_trees_bytes"] = len(
+                str(delta["append"]).encode("utf-8"))
+        self._recorder.event("autonomics_rollout", **{
+            k: v for k, v in out.items() if k != "replicas"})
+        log.info("autonomics: %s rollout of model %r landed on %d "
+                 "replica(s)%s", mode, mname, len(names),
+                 f" ({out.get('delta_bytes', 0)} delta bytes vs "
+                 f"{out['full_bytes']} full)" if delta is not None else "")
+        return out
+
+    def _resolve_base(self, mname: str, base_source) -> str:
+        with self._lock:
+            cached = self._base_texts.get(mname)
+        if cached is not None:
+            return cached
+        if base_source is not None:
+            text = _text_of_source(base_source)
+        else:
+            text = None
+            for name in self.router.replica_names(live_only=True):
+                r = self.router.replica(name)
+                if hasattr(r, "server"):
+                    text = r.server.model_text(mname)
+                    break
+            if text is None:
+                raise SwapFailed(
+                    f"delta rollout of model {mname!r} needs a base: no "
+                    "prior rollout cached, no base_source given, and no "
+                    "local replica to read the resident text from")
+        with self._lock:
+            self._base_texts.setdefault(mname, text)
+        return text
+
+    # -- lifecycle / reporting ------------------------------------------
+    def start(self) -> "Autonomics":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="lambdagap-autonomics")
+        self._thread.start()
+        log.info("autonomics controller up: every %.2fs (probe window "
+                 "%d, scale margins out<=%.2f in>=%.2f, replicas "
+                 "[%d, %s])", self.interval_s, self.probe_window,
+                 self.scale_out_margin, self.scale_in_margin,
+                 self.min_replicas, self.max_replicas or "fixed")
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:       # the loop must outlive one bad tick
+                log.warning("autonomics: tick failed (%s); continuing", e)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "probation": dict(self._probes),
+                "backoffs": {n: b.snapshot()
+                             for n, b in sorted(self._backoffs.items())
+                             if b.attempts or not b.ready()},
+                "placement_models": len(self._plan),
+                "scaled_replicas": list(self._scaled),
+                "streaks": {"out": self._out_streak,
+                            "in": self._in_streak},
+            }

@@ -7,10 +7,12 @@ JSON — one object per line, matching the ``task=serve`` loop verbs:
     {"op": "predict", "id": 1, "x": [[...]], "model": "m", "tenant": "t",
      "trace": {"id": "<trace_id>", "parent": "<span_id>"}}
     {"op": "swap",    "id": 2, "source": "model_v2.txt", "model": "m"}
+    {"op": "swap_delta", "id": 8, "model": "m", "delta": {...}}
     {"op": "stats",   "id": 3, "reservoirs": true}
     {"op": "prometheus", "id": 5, "scope": "fleet"}
     {"op": "health",  "id": 4}            {"op": "models",  "id": 6}
-    {"op": "signals", "id": 7}
+    {"op": "signals", "id": 7}            {"op": "prefetch", "id": 9,
+                                           "model": "m"}
 
 The optional ``trace`` field carries the distributed-tracing context
 (obs/trace.py): the server records frontend/serve/dispatch child spans
@@ -195,6 +197,24 @@ class _Conn:
         gen = self.frontend.target.swap(frame["source"], **kwargs)
         self.send({"id": req_id, "ok": True, "generation": int(gen)})
 
+    def _op_swap_delta(self, req_id, frame) -> None:
+        # appended-trees rollout frame (serve/delta.py); a non-applying
+        # delta answers SwapFailed and the old generation keeps serving
+        kwargs = {}
+        if frame.get("model") is not None:
+            kwargs["model"] = frame["model"]
+        gen = self.frontend.target.swap_delta(frame["delta"], **kwargs)
+        self.send({"id": req_id, "ok": True, "generation": int(gen)})
+
+    def _op_prefetch(self, req_id, frame) -> None:
+        # placement actuation: make the model resident off the request
+        # path (pays any readmission compile HERE, not on a request)
+        kwargs = {}
+        if frame.get("model") is not None:
+            kwargs["model"] = frame["model"]
+        info = self.frontend.target.prefetch(**kwargs)
+        self.send({"id": req_id, "ok": True, "info": info})
+
     def _op_stats(self, req_id, frame) -> None:
         # reservoirs=true adds the raw reservoir states a fleet scraper
         # merges (bounded; obs/fleet.py)
@@ -230,11 +250,12 @@ class ServeFrontend:
     ephemeral port, exposed as :attr:`port` after :meth:`start`."""
 
     def __init__(self, target, host: str = "127.0.0.1", port: int = 0,
-                 backlog: int = 64) -> None:
+                 backlog: int = 64, bind_retry_s: float = 5.0) -> None:
         self.target = target
         self.host = host
         self._port = int(port)
         self._backlog = int(backlog)
+        self._bind_retry_s = max(float(bind_retry_s), 0.0)
         self._sock: Optional[socket.socket] = None
         self._conns: set = set()
         self._conn_lock = threading.Lock()
@@ -247,8 +268,23 @@ class ServeFrontend:
 
     def start(self) -> "ServeFrontend":
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        # SO_REUSEADDR + a bounded EADDRINUSE retry window: a revived
+        # replica re-binding its OLD fixed port must win against the dead
+        # process's lingering socket (TIME_WAIT, or a SIGKILLed peer the
+        # kernel has not fully reaped) instead of failing the respawn —
+        # the rapid kill/revive cycle the autonomics controller drives
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind((self.host, self._port))
+        deadline = time.perf_counter() + self._bind_retry_s
+        while True:
+            try:
+                sock.bind((self.host, self._port))
+                break
+            except OSError as e:
+                import errno
+                if (e.errno != errno.EADDRINUSE or self._port == 0
+                        or time.perf_counter() >= deadline):
+                    raise
+                time.sleep(0.05)
         sock.listen(self._backlog)
         self._port = sock.getsockname()[1]
         self._sock = sock
@@ -257,8 +293,8 @@ class ServeFrontend:
             name=f"lambdagap-serve-frontend-{self._port}")
         self._accept_thread.start()
         log.info("serve frontend listening on %s:%d (newline-JSON "
-                 "protocol; ops: predict/swap/stats/prometheus/health/"
-                 "models)", self.host, self._port)
+                 "protocol; ops: predict/swap/swap_delta/prefetch/stats/"
+                 "prometheus/signals/health/models)", self.host, self._port)
         return self
 
     def _accept_loop(self) -> None:
@@ -453,6 +489,20 @@ class FrontendClient:
              timeout: Optional[float] = 120.0) -> int:
         return int(self._call("swap", timeout=timeout, source=source,
                               model=model)["generation"])
+
+    def swap_delta(self, delta, model: Optional[str] = None,
+                   timeout: Optional[float] = 120.0) -> int:
+        """Delta hot-swap over the wire: only the appended trees (plus
+        header/tail) cross the socket (serve/delta.py)."""
+        return int(self._call("swap_delta", timeout=timeout, delta=delta,
+                              model=model)["generation"])
+
+    def prefetch(self, model: Optional[str] = None,
+                 timeout: Optional[float] = 120.0) -> dict:
+        """Make a registry model resident on the remote replica now
+        (placement actuation; pays any readmission off the request
+        path)."""
+        return self._call("prefetch", timeout=timeout, model=model)["info"]
 
     def stats(self, timeout: Optional[float] = 30.0,
               reservoirs: bool = False) -> dict:

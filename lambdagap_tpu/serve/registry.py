@@ -263,6 +263,41 @@ class ModelRegistry:
             return t
         return work()
 
+    def swap_delta(self, name: str, delta, faults=None):
+        """Delta hot-swap (serve/delta.py): reconstruct the new model
+        text from this entry's RESIDENT host model + the appended-trees
+        frame, then take the normal :meth:`swap` path — compile,
+        pre-warm, pointer flip, circuit breaker. A delta that does not
+        apply (stale base, wrong hash, torn frame) raises
+        :class:`SwapFailed` through the same breaker-fed rollback the
+        full swap uses: the active generation keeps serving."""
+        from .delta import apply_delta, model_text_of
+        e = self.entry(name)
+        try:
+            if faults is not None:
+                faults.delta_swap_fault()
+            base_text = model_text_of(e.gbdt)
+            new_text = apply_delta(base_text, delta)
+        except Exception as exc:
+            e.breaker.record_failure()
+            if self._stats is not None:
+                self._stats.record_swap_failure()
+            log.warning("serve registry: delta swap of model %r failed to "
+                        "apply (%s); generation %d keeps serving "
+                        "(breaker: %s)", name, exc, e.generation,
+                        e.breaker.state())
+            raise SwapFailed(
+                f"delta swap of model {name!r} failed to apply ({exc}); "
+                f"serving continues on generation {e.generation}") from exc
+        return self.swap(name, new_text)
+
+    def model_text(self, name: str = DEFAULT_MODEL) -> str:
+        """The resident host model's full text — the base a delta
+        publisher diffs against (host models survive eviction, so this
+        never recompiles anything)."""
+        from .delta import model_text_of
+        return model_text_of(self.entry(name).gbdt)
+
     def remove(self, name: str) -> None:
         """Forget a model entirely (device AND host side). In-flight
         batches that already hold its compiled forest finish normally."""

@@ -54,6 +54,25 @@ class LocalReplica:
         self.name = name
         self.server = server
 
+    def respawn(self) -> "LocalReplica":
+        """A fresh in-process server under the same name, warmed from
+        the dead server's registry-retained HOST models (eviction and
+        close never drop those) — the local revival primitive
+        (serve/autonomics.py). Generations restart at 0 on the new
+        server; model identity is the host model text, not the counter."""
+        from .registry import DEFAULT_MODEL
+        from .server import ForestServer
+        reg = self.server.registry
+        server = ForestServer(reg.entry(DEFAULT_MODEL).gbdt,
+                              buckets=self.server._buckets,
+                              raw_score=self.server.raw_score,
+                              start_iteration=self.server._si,
+                              num_iteration=self.server._ni)
+        for name in reg.names():
+            if name != DEFAULT_MODEL:
+                server.add_model(name, reg.entry(name).gbdt)
+        return LocalReplica(self.name, server)
+
     def submit(self, x, model: Optional[str] = None,
                tenant: Optional[str] = None, trace=None) -> Future:
         try:
@@ -83,11 +102,24 @@ class RemoteReplica:
                  ) -> None:
         from .frontend import FrontendClient
         self.name = name
+        # the address survives on the replica object so a revival can
+        # reconnect the SAME endpoint (serve/autonomics.py)
+        self.host = host
+        self.port = int(port)
+        self._connect_timeout = float(connect_timeout)
         self.client = FrontendClient(host, port, timeout=connect_timeout)
         self._ttl = float(health_ttl_s)
         self._health = OK
         self._health_at = 0.0
         self._health_lock = threading.Lock()
+
+    def reconnect(self) -> "RemoteReplica":
+        """A FRESH replica object for the same name/address — the remote
+        revival primitive. Raises (ConnectionError/OSError) while the
+        endpoint is still down; the revival backoff absorbs that."""
+        return RemoteReplica(self.name, self.host, self.port,
+                             health_ttl_s=self._ttl,
+                             connect_timeout=self._connect_timeout)
 
     def submit(self, x, model: Optional[str] = None,
                tenant: Optional[str] = None, trace=None) -> Future:
@@ -136,10 +168,18 @@ class Router:
         self._inflight: Dict[str, int] = {r.name: 0 for r in replicas}
         self._routed: Dict[str, int] = {r.name: 0 for r in replicas}
         self._dead: Dict[str, bool] = {r.name: False for r in replicas}
+        # probation: a revived replica serves in the DEGRADED tier until
+        # the autonomics controller promotes it (docs/robustness.md);
+        # placement: model -> preferred replica names holding it resident
+        # (serve/placement.py). Both empty unless a controller is active,
+        # so knob-off router snapshots stay byte-identical to pre-PR.
+        self._probation: Dict[str, bool] = {}
+        self._placement: Dict[str, tuple] = {}
         self._failovers = 0
         self._rejected_no_replica = 0
         self._closed = False
         self._scraper = None             # obs.fleet.FleetScraper, attached
+        self._autonomics = None          # serve.autonomics.Autonomics
 
     # -- dispatch -------------------------------------------------------
     def submit(self, x, model: Optional[str] = None,
@@ -182,11 +222,19 @@ class Router:
         return self.submit(x, model=model, tenant=tenant).result(
             timeout).values
 
-    def _pick(self, tried: set):
-        """Least-loaded replica among the healthiest available tier."""
+    def _pick(self, tried: set, model: Optional[str] = None):
+        """Least-loaded replica among the healthiest available tier.
+        Probation replicas (freshly revived) are demoted to the DEGRADED
+        tier regardless of reported health; when a placement plan names
+        replicas holding ``model`` resident, those are preferred within
+        the winning tier — model traffic stays where the forest already
+        lives, so readmission cliffs are paid by placement decisions,
+        never by routing accidents."""
         with self._lock:
             candidates = [r for r in self._replicas
                           if r.name not in tried and not self._dead[r.name]]
+            resident = self._placement.get(model, ()) if model else ()
+            probation = dict(self._probation)
         by_state: Dict[str, List] = {}
         for r in candidates:
             try:
@@ -197,17 +245,23 @@ class Router:
                 if state == "dead":
                     self._mark_dead(r)
                 continue
+            if state == OK and probation.get(r.name):
+                state = DEGRADED         # revived: serves, never preferred
             by_state.setdefault(state, []).append(r)
         tier = by_state.get(OK) or by_state.get(DEGRADED) or []
         if not tier:
             return None
+        if resident:
+            preferred = [r for r in tier if r.name in resident]
+            if preferred:
+                tier = preferred
         with self._lock:
             return min(tier, key=lambda r: self._inflight[r.name])
 
     def _attempt(self, outer: Future, x, model, tenant, tried: set,
                  trace=None, route_state: Optional[Dict] = None) -> None:
         while True:
-            replica = self._pick(tried)
+            replica = self._pick(tried, model=model)
             if replica is None:
                 with self._lock:
                     self._rejected_no_replica += 1
@@ -282,6 +336,134 @@ class Router:
                     " and marking it dead"
                     if isinstance(exc, _DEAD_MARKING) else "")
 
+    # -- replica lifecycle (the autonomics actuation surface; every
+    # -- method takes the lock only around pointer/metadata flips — the
+    # -- reconnect/respawn/compile work happens in the CALLER, outside
+    # -- any router lock, which graftlint R9 enforces) ------------------
+    def add_replica(self, replica, probation: bool = False) -> None:
+        """Join a new replica to the rotation (scale-out). Name must be
+        fresh; ``probation=True`` starts it in the degraded tier."""
+        with self._lock:
+            if any(r.name == replica.name for r in self._replicas):
+                raise ValueError(f"replica name {replica.name!r} is "
+                                 "already registered; use replace_replica")
+            self._replicas.append(replica)
+            self._inflight[replica.name] = 0
+            self._routed.setdefault(replica.name, 0)
+            self._dead[replica.name] = False
+            if probation:
+                self._probation[replica.name] = True
+        log.info("router: replica %r joined the rotation%s", replica.name,
+                 " (probation)" if probation else "")
+
+    def replace_replica(self, name: str, replica,
+                        probation: bool = True) -> None:
+        """Swap a (typically dead) replica object for a freshly
+        reconnected/respawned one under the SAME name — the revival
+        flip. The new replica re-enters at probation (degraded tier)
+        until the controller's probe window clears it. The old replica
+        object is closed best-effort outside the lock."""
+        if replica.name != name:
+            raise ValueError(f"replacement replica is named "
+                             f"{replica.name!r}, not {name!r}")
+        with self._lock:
+            idx = next((i for i, r in enumerate(self._replicas)
+                        if r.name == name), None)
+            if idx is None:
+                raise KeyError(f"unknown replica {name!r}")
+            old = self._replicas[idx]
+            self._replicas[idx] = replica
+            self._inflight[name] = 0
+            self._dead[name] = False
+            if probation:
+                self._probation[name] = True
+        if old is not replica:
+            try:
+                old.close()
+            except Exception as e:       # a dead replica may fail to close
+                log.debug("router: closing replaced replica %r failed: %s",
+                          name, e)
+        log.info("router: replica %r revived and re-entered rotation%s",
+                 name, " at probation (degraded tier)" if probation else "")
+
+    def remove_replica(self, name: str, close: bool = True) -> None:
+        """Retire a replica from the rotation (scale-in). The replica is
+        removed from dispatch first, then — outside the router lock —
+        closed, which drains its queued requests (``ForestServer.close``
+        flushes before stopping; a remote close resolves its pending
+        futures)."""
+        with self._lock:
+            idx = next((i for i, r in enumerate(self._replicas)
+                        if r.name == name), None)
+            if idx is None:
+                raise KeyError(f"unknown replica {name!r}")
+            if len(self._replicas) == 1:
+                raise ValueError("cannot remove the last replica")
+            replica = self._replicas.pop(idx)
+            self._inflight.pop(name, None)
+            self._routed.pop(name, None)
+            self._dead.pop(name, None)
+            self._probation.pop(name, None)
+            for model, names in list(self._placement.items()):
+                if name in names:
+                    self._placement[model] = tuple(n for n in names
+                                                   if n != name)
+        if close:
+            try:
+                replica.close()
+            except Exception as e:
+                log.warning("router: closing retired replica %r failed: %s",
+                            name, e)
+        log.info("router: replica %r retired from the rotation", name)
+
+    def set_probation(self, name: str, probation: bool) -> None:
+        """Enter/clear the probation (degraded-tier) state of a replica."""
+        with self._lock:
+            if not any(r.name == name for r in self._replicas):
+                raise KeyError(f"unknown replica {name!r}")
+            if probation:
+                self._probation[name] = True
+            else:
+                self._probation.pop(name, None)
+
+    def set_placement(self, plan: Dict[str, Sequence]) -> None:
+        """Install a model -> preferred-replica-names plan
+        (serve/placement.py); ``{}`` clears placement-aware routing."""
+        with self._lock:
+            self._placement = {str(m): tuple(names)
+                               for m, names in (plan or {}).items()}
+
+    def replica_names(self, live_only: bool = True) -> List[str]:
+        with self._lock:
+            return [r.name for r in self._replicas
+                    if not (live_only and self._dead[r.name])]
+
+    def replica(self, name: str):
+        with self._lock:
+            for r in self._replicas:
+                if r.name == name:
+                    return r
+        raise KeyError(f"unknown replica {name!r}")
+
+    def prefetch(self, model: Optional[str] = None,
+                 replica: Optional[str] = None) -> dict:
+        """Make a model resident (placement actuation; the compile
+        happens on the replica, no router lock held). ``replica=None``
+        prefetches on EVERY live replica — the ForestServer-compatible
+        shape the frontend's ``prefetch`` op uses on a router target —
+        and returns per-replica info keyed by name."""
+        names = [replica] if replica is not None \
+            else self.replica_names(live_only=True)
+        out = {}
+        for name in names:
+            r = self.replica(name)
+            if hasattr(r, "server"):
+                out[name] = r.server.prefetch(**(
+                    {} if model is None else {"model": model}))
+            else:
+                out[name] = r.client.prefetch(model=model)
+        return out[replica] if replica is not None else out
+
     # -- fleet-wide operations (ForestServer-compatible surface, so a
     # -- ServeFrontend can front a whole replica group) -----------------
     def swap(self, source, params=None, model: Optional[str] = None,
@@ -311,6 +493,55 @@ class Router:
         if first_exc is not None:
             raise first_exc
         return last
+
+    def swap_delta(self, delta, model: Optional[str] = None):
+        """Fleet-wide delta swap with :meth:`swap` semantics: attempt
+        every live replica in name order, per-replica rollback on
+        failure, first exception propagates AFTER the rest were
+        attempted (a partial rollout is visible, not silent). The
+        all-or-nothing rollout protocol — roll committed replicas back —
+        is ``Autonomics.rollout_delta``, which holds the base text this
+        method does not."""
+        last = None
+        first_exc = None
+        for r in sorted(self._replicas, key=lambda r: r.name):
+            with self._lock:
+                if self._dead[r.name]:
+                    continue
+            kwargs = {} if model is None else {"model": model}
+            try:
+                if hasattr(r, "server"):
+                    last = r.server.swap_delta(delta, **kwargs)
+                else:
+                    last = r.client.swap_delta(delta, **kwargs)
+            except Exception as e:
+                if first_exc is None:
+                    first_exc = e
+                log.warning("router: delta swap on replica %r failed: %s",
+                            r.name, e)
+        if first_exc is not None:
+            raise first_exc
+        return last
+
+    def swap_on(self, name: str, source, model: Optional[str] = None):
+        """Full swap on ONE replica (the rollback half of a delta
+        rollout; serve/autonomics.py)."""
+        r = self.replica(name)
+        kwargs = {} if model is None else {"model": model}
+        if hasattr(r, "server"):
+            return r.server.swap(source, **kwargs)
+        return r.client.swap(source, **kwargs)
+
+    def swap_delta_on(self, name: str, delta,
+                      model: Optional[str] = None):
+        """Delta swap on ONE replica; the fleet-atomic rollout protocol
+        (apply everywhere or roll back everywhere) lives in
+        ``Autonomics.rollout_delta``."""
+        r = self.replica(name)
+        kwargs = {} if model is None else {"model": model}
+        if hasattr(r, "server"):
+            return r.server.swap_delta(delta, **kwargs)
+        return r.client.swap_delta(delta, **kwargs)
 
     def models(self) -> List[str]:
         """The first live replica's registry listing (replicas of one
@@ -382,6 +613,14 @@ class Router:
         cache, ``signals`` answers from its plane, ``close`` stops it."""
         self._scraper = scraper
 
+    def attach_autonomics(self, controller) -> None:
+        """Adopt a running :class:`~lambdagap_tpu.serve.autonomics.
+        Autonomics` controller: ``close`` stops its loop, and the
+        ``autonomics`` block joins :meth:`snapshot` (only then — with
+        the knob off, snapshots stay byte-identical to pre-autonomics
+        behavior)."""
+        self._autonomics = controller
+
     def signals(self) -> dict:
         """The current control-signal tick (obs/signals.py). Requires an
         attached scraper with a signal plane — the CLI wires one when
@@ -407,11 +646,25 @@ class Router:
                     "dead": self._dead[r.name],
                 } for r in self._replicas
             }
+            # probation/placement/autonomics keys appear ONLY when the
+            # control loop put them there: knob-off snapshots must stay
+            # byte-identical to the pre-autonomics schema (acceptance
+            # criterion of ISSUE 13)
+            for name in self._probation:
+                if name in replicas:
+                    replicas[name]["probation"] = True
             out = {
                 "replicas": replicas,
                 "failovers": self._failovers,
                 "rejected_no_replica": self._rejected_no_replica,
             }
+            if self._placement:
+                out["placement"] = {m: list(names)
+                                    for m, names in
+                                    sorted(self._placement.items())}
+            autonomics = self._autonomics
+        if autonomics is not None:
+            out["autonomics"] = autonomics.snapshot()
         for r in self._replicas:         # health probes outside the lock
             try:
                 replicas[r.name]["health"] = (
@@ -423,6 +676,8 @@ class Router:
 
     def close(self) -> None:
         self._closed = True
+        if self._autonomics is not None:
+            self._autonomics.close()
         if self._scraper is not None:
             self._scraper.close()
         if self._own:

@@ -236,6 +236,29 @@ class ForestServer:
         return self.registry.swap(model, source, params=params,
                                   background=background)
 
+    def swap_delta(self, delta, model: str = DEFAULT_MODEL) -> int:
+        """Delta hot-swap: apply an appended-trees frame
+        (serve/delta.py) against the resident host model, then compile /
+        pre-warm / flip exactly like :meth:`swap`. Returns the new
+        generation; a non-applying delta raises ``SwapFailed`` with the
+        old generation untouched."""
+        return self.registry.swap_delta(model, delta, faults=self._faults)
+
+    def model_text(self, model: str = DEFAULT_MODEL) -> str:
+        """The resident host model's full text (delta-swap base)."""
+        return self.registry.model_text(model)
+
+    def prefetch(self, model: str = DEFAULT_MODEL) -> Dict:
+        """Make ``model`` resident NOW (re-admitting it if evicted) and
+        report what that cost — the placement loop's actuation verb, so
+        the readmission cliff is paid off the request path, by design
+        (docs/serving.md "Model placement")."""
+        info: Dict = {}
+        self.registry.get(model, info=info)
+        info.setdefault("readmitted", False)
+        info["resident"] = True
+        return info
+
     # -- metrics / lifecycle -------------------------------------------
     def stats_snapshot(self, reservoirs: bool = False,
                        timeout_s: Optional[float] = None) -> dict:

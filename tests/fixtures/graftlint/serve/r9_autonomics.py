@@ -1,0 +1,52 @@
+"""R1/R9 fixture pair (ISSUE 13): the autonomics-controller hazard
+class. The control loop's actuation — reconnect, respawn, warm/compile —
+is long-running by nature; holding ANY dispatch-adjacent lock across it
+convoys the request path behind the control plane (the r9_scrape class,
+now with the controller's own lock identities: ``self._mu`` defeats
+R5's name heuristic, the semantic index resolves it to a real
+``threading.Lock``). And the controller lives in serve/, an R1 hot
+path: a device sync inside its per-replica loop would charge every
+tick with a host-device round trip. The clean shapes at the bottom are
+what the real ``serve/autonomics.py`` does: snapshot under the lock,
+actuate outside it."""
+import threading
+
+import jax.numpy as jnp
+
+
+class LockedController:
+    def __init__(self, replicas):
+        self._replicas = replicas
+        self._mu = threading.Lock()      # identity-resolved, name-opaque
+
+    def _respawn(self, replica):
+        # the blocking respawn wait lives one resolved call away: R5's
+        # lexical scan of the caller's with-body never sees it
+        return replica.proc_future.result(30.0)
+
+    def revive_all_locked(self):
+        out = []
+        with self._mu:
+            for r in self._replicas:
+                out.append(self._respawn(r))  # BAD:R9
+        return out
+
+    def probe_locked(self, sock):
+        with self._mu:
+            sock.sendall(b"probe\n")     # BAD:R9
+
+    def warm_scores(self, batches):
+        out = []
+        for x in batches:
+            out.append(float(jnp.sum(x)))  # BAD:R1
+        return out
+
+    # -- the clean shapes (the real controller's discipline) -----------
+    def revive_all(self):
+        with self._mu:
+            replicas = list(self._replicas)
+        return [self._respawn(r) for r in replicas]
+
+    def warm_scores_device(self, batches):
+        # keep the accumulation on device; one terminal fetch, no loop
+        return jnp.stack([jnp.sum(x) for x in batches])

@@ -1,0 +1,469 @@
+"""Fleet autonomics (ISSUE 13): revival + probation, placement +
+residency routing, delta hot-swap atomicity, the goodput-knee
+autoscaler, and — the acceptance criterion — off-by-default behavior:
+no knob, no controller, no thread, byte-identical snapshots.
+
+Controller behaviors are driven through the public ``tick()`` with fake
+replicas and injected clocks — deterministic, no wall-clock sleeps; the
+end-to-end version under real load/SIGKILL lives in
+tools/autonomics_gate.py.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.guard.degrade import SwapFailed
+from lambdagap_tpu.guard.faults import FaultPlan
+from lambdagap_tpu.obs.signals import SignalPlane
+from lambdagap_tpu.serve import (Autonomics, ForestServer, LocalReplica,
+                                 Router, apply_delta, make_delta,
+                                 plan_placement)
+from lambdagap_tpu.serve.delta import DeltaMismatch, delta_bytes
+from lambdagap_tpu.serve.placement import plan_changes
+
+
+# ---------------------------------------------------------------------------
+# fakes
+# ---------------------------------------------------------------------------
+class FakeReplica:
+    """A routable replica with scriptable health and counted submits."""
+
+    def __init__(self, name, health="ok"):
+        self.name = name
+        self._health = health
+        self.submits = 0
+        self.closed = False
+
+    def submit(self, x, model=None, tenant=None, trace=None):
+        from concurrent.futures import Future
+        from lambdagap_tpu.guard.degrade import ReplicaUnavailable
+        if self._health == "dead":
+            raise ReplicaUnavailable(f"{self.name} is dead")
+        self.submits += 1
+        f = Future()
+        f.set_result(("served-by", self.name))
+        return f
+
+    def health(self):
+        return self._health
+
+    def close(self):
+        self.closed = True
+
+
+def _signals_with_margin(knee_rps, offered_rps):
+    """A SignalPlane whose latest tick carries the given knee state."""
+    plane = SignalPlane(alpha=1.0)
+    plane.knee.knee_rps = knee_rps
+    plane.knee.offered_rps = offered_rps
+    plane.knee.ticks = 5
+    plane.update({"merged": {}, "time_unix": 1.0})
+    # update() re-observed 0 rps; force the fields we are scripting
+    plane.knee.knee_rps = knee_rps
+    plane.knee.offered_rps = offered_rps
+    plane._latest["goodput"] = plane.knee.snapshot()
+    plane._latest["interval"]["good_fraction"] = 1.0
+    return plane
+
+
+# ---------------------------------------------------------------------------
+# off by default (acceptance criterion)
+# ---------------------------------------------------------------------------
+def test_router_snapshot_byte_identical_without_autonomics():
+    """With no controller attached, the router snapshot carries exactly
+    the pre-autonomics schema — no probation/placement/autonomics keys
+    anywhere."""
+    r = Router([FakeReplica("r0"), FakeReplica("r1")])
+    snap = r.snapshot()
+    assert sorted(snap) == ["failovers", "rejected_no_replica", "replicas"]
+    for info in snap["replicas"].values():
+        assert sorted(info) == ["dead", "health", "inflight", "routed"]
+    # and the snapshot is json-stable (the byte-identity the gate diffs)
+    json.dumps(snap, sort_keys=True)
+
+
+def test_cli_target_off_by_default_no_controller_thread():
+    from lambdagap_tpu.cli import _build_serve_target
+    from lambdagap_tpu.config import Config
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    b = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+                  lgb.Dataset(X, label=y), num_boost_round=3)
+    cfg = Config.from_params({"verbose": -1})
+    assert cfg.serve_autonomics is False          # the default
+    before = {t.name for t in threading.enumerate()}
+    target = _build_serve_target(cfg, b._booster)
+    after = {t.name for t in threading.enumerate()}
+    try:
+        assert isinstance(target, ForestServer)   # no router wrapping
+        assert not any("autonomics" in t for t in after - before)
+    finally:
+        target.close()
+
+
+def test_config_knob_validation():
+    from lambdagap_tpu.config import Config
+    with pytest.raises(Exception):
+        Config.from_params({"serve_autonomics_probe_window": 0})
+    with pytest.raises(Exception):
+        Config.from_params({"serve_autonomics_scale_out_margin": 0.9,
+                            "serve_autonomics_scale_in_margin": 0.2})
+    cfg = Config.from_params({"serve_autonomics": "true",
+                              "serve_autonomics_max_replicas": 4})
+    assert cfg.serve_autonomics is True
+    assert cfg.serve_autonomics_max_replicas == 4
+
+
+# ---------------------------------------------------------------------------
+# revival + probation
+# ---------------------------------------------------------------------------
+def test_dead_replica_revived_with_backoff_and_probation():
+    t = [0.0]
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    router = Router([r0, r1])
+    revived = FakeReplica("r0")
+    attempts = []
+
+    def revive(name, old):
+        attempts.append(t[0])
+        if len(attempts) < 3:
+            raise ConnectionError("still down")
+        return revived
+
+    auto = Autonomics(router, revive=revive, revive_backoff_s=1.0,
+                      probe_window=2, clock=lambda: t[0])
+    auto._backoff_for("r0").jitter = 0.0          # exact schedule
+    r0._health = "dead"
+    router._mark_dead(r0)
+
+    auto.tick()                                   # attempt 1: fails
+    assert attempts == [0.0]
+    auto.tick()                                   # backoff: not due yet
+    assert attempts == [0.0]
+    t[0] = 1.0
+    auto.tick()                                   # attempt 2 at +1s: fails
+    assert attempts == [0.0, 1.0]
+    t[0] = 2.5
+    auto.tick()                                   # not due (next at +3.0)
+    assert attempts == [0.0, 1.0]
+    t[0] = 3.0
+    auto.tick()                                   # attempt 3: succeeds
+    assert attempts == [0.0, 1.0, 3.0]
+    snap = router.snapshot()
+    assert snap["replicas"]["r0"]["dead"] is False
+    assert snap["replicas"]["r0"]["probation"] is True
+    # probation: the revived replica serves only as the DEGRADED tier
+    picked = router._pick(set())
+    assert picked is r1                           # ok tier wins
+    # two healthy ticks clear the probe window
+    auto.tick()
+    assert router.snapshot()["replicas"]["r0"].get("probation") is True
+    auto.tick()
+    assert "probation" not in router.snapshot()["replicas"]["r0"]
+    assert auto.counters["revivals"] == 1
+    assert auto.counters["revival_failures"] == 2
+    assert auto.counters["promotions"] == 1
+
+
+def test_unhealthy_probation_resets_probe_streak():
+    t = [0.0]
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    router = Router([r0, r1])
+    auto = Autonomics(router, probe_window=2, clock=lambda: t[0])
+    router.set_probation("r0", True)
+    r0._health = "degraded"
+    auto.tick()                                   # unhealthy: streak 0
+    r0._health = "ok"
+    auto.tick()                                   # streak 1
+    assert "probation" in router.snapshot()["replicas"]["r0"]
+    auto.tick()                                   # streak 2: promoted
+    assert "probation" not in router.snapshot()["replicas"]["r0"]
+
+
+def test_injected_revive_fault_counts_as_failure():
+    t = [0.0]
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    router = Router([r0, r1])
+    router._mark_dead(r0)
+    auto = Autonomics(router, revive=lambda n, o: FakeReplica(n),
+                      faults=FaultPlan("revive_fail=1"),
+                      clock=lambda: t[0])
+    auto.tick()
+    assert auto.counters["revival_failures"] == 1
+    t[0] = 100.0
+    auto.tick()                                   # fault exhausted: revives
+    assert auto.counters["revivals"] == 1
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+def test_plan_placement_bin_packs_under_budget():
+    models = {"hot": {"bytes": 60, "traffic": 100},
+              "warm": {"bytes": 60, "traffic": 10},
+              "cold": {"bytes": 60, "traffic": 1}}
+    plan = plan_placement(models, ["r0", "r1"], budget_bytes=120)
+    assert sorted(plan) == ["cold", "hot", "warm"]
+    assert all(len(v) == 1 for v in plan.values())
+    # hot gets first pick; the three models spread 2+1 across budgets
+    per_replica = {}
+    for m, (r,) in plan.items():
+        per_replica.setdefault(r, []).append(m)
+    assert all(len(ms) <= 2 for ms in per_replica.values())
+
+
+def test_plan_placement_over_budget_model_still_placed():
+    plan = plan_placement({"huge": {"bytes": 1000, "traffic": 1}},
+                          ["r0", "r1"], budget_bytes=10)
+    assert plan == {"huge": ["r0"]}
+
+
+def test_plan_placement_deterministic_and_spread():
+    models = {"a": {"bytes": 10, "traffic": 5},
+              "b": {"bytes": 10, "traffic": 5}}
+    p1 = plan_placement(models, ["r0", "r1", "r2"], budget_bytes=100,
+                        spread=2)
+    p2 = plan_placement(models, ["r0", "r1", "r2"], budget_bytes=100,
+                        spread=2)
+    assert p1 == p2
+    assert all(len(v) == 2 for v in p1.values())
+
+
+def test_plan_changes_lists_only_new_assignments():
+    old = {"a": ["r0"], "b": ["r1"]}
+    new = {"a": ["r0", "r2"], "b": ["r0"], "c": ["r1"]}
+    assert plan_changes(old, new) == {"a": ["r2"], "b": ["r0"],
+                                      "c": ["r1"]}
+
+
+def test_router_routes_model_traffic_to_resident_replica():
+    r0, r1 = FakeReplica("r0"), FakeReplica("r1")
+    router = Router([r0, r1])
+    router.set_placement({"m": ("r1",)})
+    for _ in range(6):
+        router.submit(np.zeros((1, 2), np.float32), model="m").result(5)
+    assert r1.submits == 6 and r0.submits == 0
+    # un-placed models still balance by least-inflight
+    for _ in range(4):
+        router.submit(np.zeros((1, 2), np.float32)).result(5)
+    assert r0.submits > 0
+    # placement is a preference, not a partition: dead preferred replica
+    # fails over to the other
+    r1._health = "dead"
+    router._mark_dead(r1)
+    router.submit(np.zeros((1, 2), np.float32), model="m").result(5)
+    assert r0.submits > 4
+
+
+# ---------------------------------------------------------------------------
+# delta hot-swap
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def trained_pair(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("autonomics_models")
+    rng = np.random.RandomState(7)
+    X = rng.randn(500, 6).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    b_v1 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+    p_v1 = os.path.join(str(tmp), "v1.txt")
+    b_v1.save_model(p_v1)
+    b_v2 = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3,
+                     init_model=p_v1)
+    p_v2 = os.path.join(str(tmp), "v2.txt")
+    b_v2.save_model(p_v2)
+    return X, p_v1, p_v2
+
+
+def test_delta_roundtrip_and_mismatch(trained_pair):
+    _X, p_v1, p_v2 = trained_pair
+    t1, t2 = open(p_v1).read(), open(p_v2).read()
+    delta = make_delta(t1, t2)
+    assert delta is not None and delta["base_trees"] == 5
+    assert apply_delta(t1, delta) == t2           # byte-exact reconstruction
+    assert len(delta["append"].encode()) < len(t2.encode()) / 2
+    assert delta_bytes(delta) < len(t2.encode())
+    # shrinking forests are not deltas
+    assert make_delta(t2, t1) is None
+    # wrong base: refused, never spliced
+    with pytest.raises(DeltaMismatch):
+        apply_delta(t2, delta)
+    with pytest.raises(DeltaMismatch):
+        apply_delta(t1, {"format": 99})
+
+
+def test_registry_swap_delta_end_to_end(trained_pair):
+    X, p_v1, p_v2 = trained_pair
+    t1, t2 = open(p_v1).read(), open(p_v2).read()
+    server = ForestServer(lgb.Booster(model_file=p_v1),
+                          max_delay_ms=1.0)
+    try:
+        gen = server.swap_delta(make_delta(t1, t2))
+        assert gen == 1
+        expect = lgb.Booster(model_file=p_v2).predict(X[:8])
+        got = server.predict(X[:8])
+        assert np.array_equal(np.asarray(expect, np.float32).reshape(-1),
+                              np.asarray(got).reshape(-1))
+        # a stale delta now fails against the NEW resident base and the
+        # active generation keeps serving (breaker-fed rollback path)
+        with pytest.raises(SwapFailed):
+            server.swap_delta(make_delta(t1, t2))
+        assert server.generation == 1
+    finally:
+        server.close()
+
+
+def test_rollout_delta_atomic_or_rolled_back(trained_pair):
+    X, p_v1, p_v2 = trained_pair
+    mk = lambda: ForestServer(lgb.Booster(model_file=p_v1),  # noqa: E731
+                              max_delay_ms=1.0)
+    s0, s1, s2 = mk(), mk(), mk()
+    router = Router([LocalReplica("r0", s0), LocalReplica("r1", s1),
+                     LocalReplica("r2", s2)], own_replicas=True)
+    auto = Autonomics(router)
+    try:
+        out = auto.rollout_delta(p_v2, base_source=p_v1)
+        assert out["mode"] == "delta"
+        assert out["delta_bytes"] < out["full_bytes"]
+        texts = {s.model_text() for s in (s0, s1, s2)}
+        assert len(texts) == 1                    # whole fleet on v2
+        assert auto.counters["delta_rollouts"] == 1
+
+        # next rollout: r1 armed to fail -> the fleet must roll back
+        b_v3 = lgb.train({"objective": "binary", "num_leaves": 7,
+                          "verbose": -1},
+                         lgb.Dataset(X, label=(X[:, 0] > 0).astype(
+                             np.float32)), num_boost_round=2,
+                         init_model=p_v2)
+        s1._faults = FaultPlan("delta_swap_fail=1")
+        with pytest.raises(SwapFailed):
+            auto.rollout_delta(b_v3)
+        from lambdagap_tpu.serve.delta import split_model_text
+        forests = {tuple(split_model_text(s.model_text())[1])
+                   for s in (s0, s1, s2)}
+        assert len(forests) == 1                  # no mixed generations
+        # and it is the BASE forest (v2's trees), not v3's: the tail
+        # (re-serialized parameters) may differ from the file, the
+        # forest may not
+        assert forests == {tuple(split_model_text(open(p_v2).read())[1])}
+        assert auto.counters["delta_rollbacks"] == 1
+    finally:
+        router.close()
+
+
+def test_swap_delta_and_prefetch_over_the_wire(trained_pair):
+    from lambdagap_tpu.serve import FrontendClient, ServeFrontend
+    X, p_v1, p_v2 = trained_pair
+    t1, t2 = open(p_v1).read(), open(p_v2).read()
+    server = ForestServer(lgb.Booster(model_file=p_v1), max_delay_ms=1.0)
+    fe = ServeFrontend(server).start()
+    client = FrontendClient("127.0.0.1", fe.port)
+    try:
+        info = client.prefetch()                  # resident already
+        assert info["resident"] is True and not info["readmitted"]
+        gen = client.swap_delta(make_delta(t1, t2))
+        assert gen == 1
+        expect = lgb.Booster(model_file=p_v2).predict(X[:4])
+        got = client.predict(X[:4])
+        assert np.array_equal(np.asarray(expect, np.float32).reshape(-1),
+                              np.asarray(got).reshape(-1))
+        # a stale delta answers SwapFailed as the REAL class client-side
+        with pytest.raises(SwapFailed):
+            client.swap_delta(make_delta(t1, t2))
+    finally:
+        client.close()
+        fe.close()
+        server.close()
+
+
+def test_router_fleet_swap_delta_surface(trained_pair):
+    """The ForestServer-compatible fleet surface: a frontend fronting a
+    ROUTER serves the same swap_delta/prefetch verbs."""
+    _X, p_v1, p_v2 = trained_pair
+    t1, t2 = open(p_v1).read(), open(p_v2).read()
+    mk = lambda: ForestServer(lgb.Booster(model_file=p_v1),  # noqa: E731
+                              max_delay_ms=1.0)
+    s0, s1 = mk(), mk()
+    router = Router([LocalReplica("r0", s0), LocalReplica("r1", s1)],
+                    own_replicas=True)
+    try:
+        info = router.prefetch()                  # all live replicas
+        assert sorted(info) == ["r0", "r1"]
+        gen = router.swap_delta(make_delta(t1, t2))
+        assert gen == 1
+        assert s0.generation == s1.generation == 1
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler
+# ---------------------------------------------------------------------------
+def test_autoscaler_out_in_with_hysteresis_and_cooldown():
+    t = [0.0]
+    r0 = FakeReplica("r0")
+    router = Router([r0])
+    built = []
+
+    def scale(index):
+        rep = FakeReplica(f"s{index}")
+        built.append(rep.name)
+        return rep
+
+    plane = _signals_with_margin(knee_rps=100.0, offered_rps=98.0)
+    auto = Autonomics(router, signals=plane, scale=scale,
+                      scale_out_margin=0.1, scale_in_margin=0.5,
+                      min_replicas=1, max_replicas=2,
+                      hysteresis_ticks=2, cooldown_s=10.0,
+                      clock=lambda: t[0])
+    auto.tick()                                   # streak 1: no action
+    assert built == []
+    auto.tick()                                   # streak 2: scale OUT
+    assert built == ["s0"]
+    assert set(router.replica_names()) == {"r0", "s0"}
+    auto.tick()                                   # cooldown: no repeat
+    auto.tick()
+    assert len(built) == 1
+    # recover: wide margin -> scale back IN (after cooldown + hysteresis)
+    plane2 = _signals_with_margin(knee_rps=100.0, offered_rps=10.0)
+    auto.signals = plane2
+    t[0] = 11.0
+    auto.tick()
+    auto.tick()
+    assert set(router.replica_names()) == {"r0"}
+    assert auto.counters["scale_outs"] == 1
+    assert auto.counters["scale_ins"] == 1
+    # only controller-added replicas are retired; the floor holds
+    auto.tick()
+    assert set(router.replica_names()) == {"r0"}
+
+
+def test_autoscaler_inert_without_knee_evidence():
+    t = [0.0]
+    router = Router([FakeReplica("r0")])
+    plane = _signals_with_margin(knee_rps=0.0, offered_rps=0.0)
+    auto = Autonomics(router, signals=plane,
+                      scale=lambda i: FakeReplica(f"s{i}"),
+                      max_replicas=3, hysteresis_ticks=1,
+                      clock=lambda: t[0])
+    for _ in range(5):
+        auto.tick()
+    assert router.replica_names() == ["r0"]       # cold fleet untouched
+
+
+def test_controller_thread_starts_and_stops():
+    router = Router([FakeReplica("r0")])
+    auto = Autonomics(router, interval_s=0.05).start()
+    assert auto.running
+    names = {th.name for th in threading.enumerate()}
+    assert "lambdagap-autonomics" in names
+    router.attach_autonomics(auto)
+    snap = router.snapshot()
+    assert "autonomics" in snap and "counters" in snap["autonomics"]
+    router.close()                                # closes the controller
+    assert not auto.running

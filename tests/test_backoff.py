@@ -1,0 +1,155 @@
+"""guard/backoff.py: the one bounded-exponential-backoff policy (ISSUE
+13 satellite) — cap, reset-on-success, deterministic jitter under a
+seed — plus its three consumers' contracts: the swap breaker's cooldown
+is unchanged by the refactor, the fleet scraper backs off after failed
+scrapes, and escalating breaker windows work when asked for.
+"""
+import pytest
+
+from lambdagap_tpu.guard.backoff import Backoff
+from lambdagap_tpu.guard.degrade import CircuitBreaker
+
+
+def test_exponential_growth_and_hard_cap():
+    b = Backoff(base_s=1.0, factor=2.0, max_s=5.0, jitter=0.0)
+    assert [b.delay_for(k) for k in range(5)] == [1.0, 2.0, 4.0, 5.0, 5.0]
+
+
+def test_cap_applies_after_jitter():
+    b = Backoff(base_s=4.0, factor=2.0, max_s=8.0, jitter=0.5, seed=3)
+    # every delay, jittered or not, respects the bound
+    assert all(b.delay_for(k) <= 8.0 for k in range(20))
+
+
+def test_jitter_deterministic_under_seed():
+    a = Backoff(base_s=1.0, factor=2.0, max_s=60.0, jitter=0.25, seed=42)
+    b = Backoff(base_s=1.0, factor=2.0, max_s=60.0, jitter=0.25, seed=42)
+    seq_a = [a.delay_for(k) for k in range(8)]
+    assert seq_a == [b.delay_for(k) for k in range(8)]
+    # call order/count must not matter: re-query out of order
+    assert a.delay_for(3) == seq_a[3]
+    # a different seed desynchronizes (the anti-thundering-herd point)
+    c = Backoff(base_s=1.0, factor=2.0, max_s=60.0, jitter=0.25, seed=43)
+    assert [c.delay_for(k) for k in range(8)] != seq_a
+    # jitter stays within the configured fraction
+    for k in range(6):
+        raw = 1.0 * 2.0 ** k
+        assert abs(seq_a[k] - raw) <= 0.25 * raw + 1e-9
+
+
+def test_schedule_reset_on_success():
+    t = [0.0]
+    b = Backoff(base_s=1.0, factor=2.0, max_s=8.0, jitter=0.0,
+                clock=lambda: t[0])
+    assert b.ready()                     # nothing armed yet
+    assert b.note_failure() == 1.0
+    assert not b.ready()
+    t[0] = 0.5
+    assert not b.ready()
+    t[0] = 1.0
+    assert b.ready()                     # delay elapsed
+    assert b.note_failure() == 2.0       # second failure: grown
+    assert b.attempts == 2
+    b.note_success()
+    assert b.attempts == 0 and b.ready()
+    assert b.note_failure() == 1.0       # back to the base delay
+
+
+def test_rearm_keeps_current_window():
+    t = [0.0]
+    b = Backoff(base_s=1.0, factor=2.0, max_s=8.0, jitter=0.0,
+                clock=lambda: t[0])
+    b.note_failure()
+    t[0] = 1.0
+    assert b.ready()
+    b.rearm()                            # probe consumed: same window
+    assert not b.ready() and b.attempts == 1
+    t[0] = 2.0
+    assert b.ready()
+
+
+def test_invalid_parameters_rejected():
+    with pytest.raises(ValueError):
+        Backoff(base_s=-1.0)
+    with pytest.raises(ValueError):
+        Backoff(factor=0.5)
+    with pytest.raises(ValueError):
+        Backoff(base_s=2.0, max_s=1.0)
+    with pytest.raises(ValueError):
+        Backoff(jitter=1.0)
+
+
+# -- consumers -----------------------------------------------------------
+def test_breaker_semantics_unchanged_by_backoff_refactor():
+    """The PR 5 breaker contract, post-refactor: threshold opens, fixed
+    cooldown half-opens, one probe per window, success closes."""
+    t = [0.0]
+    br = CircuitBreaker(threshold=2, cooldown_s=10.0, clock=lambda: t[0])
+    assert br.state() == "closed" and br.allow()
+    br.record_failure()
+    assert br.state() == "closed"
+    br.record_failure()
+    assert br.state() == "open" and not br.allow()
+    t[0] = 9.9
+    assert br.state() == "open"
+    t[0] = 10.0
+    assert br.state() == "half_open"
+    assert br.allow()                    # the probe
+    assert not br.allow()                # only one probe per cooldown
+    t[0] = 20.0
+    assert br.allow()
+    br.record_success()
+    assert br.state() == "closed" and br.consecutive_failures == 0
+
+
+def test_breaker_cooldown_mutable_after_construction():
+    # tests/test_guard_serve.py sets breaker.cooldown_s = 0.0 on a live
+    # server; the property must keep honoring that idiom
+    t = [0.0]
+    br = CircuitBreaker(threshold=1, cooldown_s=30.0, clock=lambda: t[0])
+    br.record_failure()
+    assert br.state() == "open"
+    br.cooldown_s = 0.0
+    assert br.state() == "half_open"
+
+
+def test_breaker_escalating_windows_via_custom_backoff():
+    t = [0.0]
+    br = CircuitBreaker(
+        threshold=1, clock=lambda: t[0],
+        backoff=Backoff(base_s=1.0, factor=2.0, max_s=8.0, jitter=0.0,
+                        clock=lambda: t[0]))
+    br.record_failure()                  # opens: window 1s
+    t[0] = 1.0
+    assert br.state() == "half_open" and br.allow()
+    br.record_failure()                  # failed probe: window grows to 2s
+    t[0] = 2.0
+    assert br.state() == "open"
+    t[0] = 3.0
+    assert br.state() == "half_open"
+
+
+def test_fleet_scraper_backs_off_after_scrape_errors():
+    from lambdagap_tpu.obs.fleet import FleetScraper
+
+    class Flaky:
+        def __init__(self):
+            self.calls = 0
+
+        def stats_snapshot(self, reservoirs=False, timeout_s=None):
+            self.calls += 1
+            raise ConnectionError("replica down")
+
+    target = Flaky()
+    sc = FleetScraper(target, interval_s=0.5)
+    # drive the loop body by hand (no wall clock): each failed scrape
+    # must arm a growing retry window
+    with pytest.raises(ConnectionError):
+        sc.scrape()
+    sc._err_backoff.note_failure()
+    assert not sc._err_backoff.ready()
+    first = sc._err_backoff.delay_for(0)
+    second = sc._err_backoff.delay_for(1)
+    assert second == 2 * first
+    sc._err_backoff.note_success()
+    assert sc._err_backoff.ready()

@@ -76,6 +76,7 @@ def fixture_findings():
     "serve/r9_cycle_b.py",
     "serve/r9_blocking.py",
     "serve/r9_scrape.py",
+    "serve/r9_autonomics.py",
     "obs/trace.py",
     "parallel/r10_rogue_specs.py",
     "r11_drift/config.py",

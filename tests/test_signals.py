@@ -1,0 +1,138 @@
+"""obs/signals.py edge cases the autonomics controller hits (ISSUE 13
+satellite): empty scrape window, zero-offered-rate knee updates,
+HealthTimeline ring wraparound, and a replica flapping ok->dead->ok
+inside one scrape interval. The controller codes against
+validate_signals' schema, so every edge tick must stay schema-valid.
+"""
+from lambdagap_tpu.obs.signals import (HealthTimeline, KneeEstimator,
+                                       SignalPlane, validate_signals)
+
+
+def _snap(now, requests=0, timeouts=0, rejected=0, errors=0,
+          replicas=None, registry=None):
+    merged = {"requests": requests, "timeouts": timeouts,
+              "rejected": rejected, "errors": errors}
+    if registry is not None:
+        merged["registry"] = registry
+    return {"merged": merged, "time_unix": now,
+            "router": {"replicas": replicas or {}}}
+
+
+def test_empty_scrape_window_first_tick_is_schema_valid():
+    plane = SignalPlane()
+    sig = plane.update({})               # an empty scrape: no merged block
+    assert validate_signals(sig) == []
+    assert sig["interval"] == {"dt_s": 0.0, "offered_rps": 0.0,
+                               "good_fraction": 1.0}
+    assert sig["goodput"]["knee_rps"] == 0.0
+    assert sig["goodput"]["knee_margin"] == 0.0   # no evidence, no margin
+
+
+def test_snapshot_before_first_tick_is_schema_valid():
+    plane = SignalPlane()
+    assert validate_signals(plane.snapshot()) == []
+
+
+def test_zero_offered_rate_tick_never_divides_by_zero():
+    plane = SignalPlane()
+    plane.update(_snap(100.0, requests=50))
+    sig = plane.update(_snap(101.0, requests=50))   # no new offers: 0 rps
+    assert validate_signals(sig) == []
+    assert sig["interval"]["offered_rps"] == 0.0
+    assert sig["interval"]["good_fraction"] == 1.0  # 0/0 reads as good
+    # and the knee estimator itself takes a (0, good) observation calmly
+    knee = KneeEstimator()
+    knee.observe(100.0, 1.0)
+    assert knee.knee_rps > 0
+    knee.observe(0.0, 1.0)
+    # headroom grows as offered falls (EWMA-smoothed), never past 1
+    assert 0.0 < knee.knee_margin <= 1.0
+    for _ in range(50):
+        knee.observe(0.0, 1.0)           # long idle: margin -> all headroom
+    assert knee.knee_margin > 0.9
+
+
+def test_counter_reset_reads_as_zero_interval_not_negative():
+    """A replica death resets its counters; the merged sums can go
+    BACKWARD across one scrape. The interval must clamp at zero, not
+    report negative rates that would whipsaw the autoscaler."""
+    plane = SignalPlane()
+    plane.update(_snap(10.0, requests=1000, timeouts=50))
+    sig = plane.update(_snap(11.0, requests=400, timeouts=10))
+    assert sig["interval"]["offered_rps"] == 0.0
+    assert sig["interval"]["good_fraction"] == 1.0
+    assert validate_signals(sig) == []
+
+
+def test_same_timestamp_tick_is_inert():
+    plane = SignalPlane()
+    plane.update(_snap(5.0, requests=10))
+    before = plane.knee.ticks
+    sig = plane.update(_snap(5.0, requests=20))     # dt == 0
+    assert plane.knee.ticks == before    # no knee observation from dt=0
+    assert validate_signals(sig) == []
+
+
+def test_health_timeline_ring_wraparound():
+    tl = HealthTimeline(ring=8)
+    states = ["ok", "dead"]
+    for i in range(25):                  # 25 TRANSITIONS through 1 replica
+        tl.note("r0", states[i % 2], t=float(i))
+    snap = tl.snapshot()
+    assert len(snap["transitions"]) == 8             # bounded
+    assert snap["transitions"][0]["t"] == 17.0       # oldest dropped
+    assert snap["transitions"][-1]["t"] == 24.0
+    assert snap["current"] == {"r0": states[24 % 2]}
+
+
+def test_health_timeline_collapses_repeats_not_flaps():
+    tl = HealthTimeline(ring=16)
+    assert tl.note("r0", "ok") is True
+    assert tl.note("r0", "ok") is False  # repeat: no transition
+    # ok -> dead -> ok inside one scrape interval: every change recorded
+    assert tl.note("r0", "dead", t=1.0) is True
+    assert tl.note("r0", "ok", t=1.0) is True
+    snap = tl.snapshot()
+    assert [tr["state"] for tr in snap["transitions"]] == \
+        ["ok", "dead", "ok"]
+    assert snap["current"]["r0"] == "ok"
+
+
+def test_flap_within_one_scrape_interval_through_the_plane():
+    """The plane only sees scrape-edge states: a replica that died and
+    revived BETWEEN scrapes looks steady-ok at the plane, while direct
+    timeline notes (the revival path writes these) still record the
+    flap. Both views must coexist in one schema-valid tick."""
+    plane = SignalPlane()
+    plane.update(_snap(1.0, replicas={"r0": {"health": "ok"}}))
+    # mid-interval: the controller's revival path records the flap
+    plane.health.note("r0", "dead", t=1.4)
+    plane.health.note("r0", "ok", t=1.6)
+    sig = plane.update(_snap(2.0, replicas={"r0": {"health": "ok"}}))
+    assert validate_signals(sig) == []
+    states = [tr["state"] for tr in sig["health"]["transitions"]]
+    assert states == ["ok", "dead", "ok"]            # flap preserved
+    assert sig["health"]["current"] == {"r0": "ok"}
+
+
+def test_dead_replica_reaches_the_timeline_via_router_snapshot():
+    plane = SignalPlane()
+    plane.update(_snap(1.0, replicas={"r0": {"health": "ok"},
+                                      "r1": {"health": "ok"}}))
+    sig = plane.update(_snap(2.0, replicas={"r0": {"health": "dead"},
+                                            "r1": {"health": "ok"}}))
+    assert sig["health"]["current"]["r0"] == "dead"
+    assert validate_signals(sig) == []
+
+
+def test_knee_margin_bounded_and_decaying():
+    knee = KneeEstimator(alpha=1.0, good_ratio=0.9, knee_decay=0.5)
+    knee.observe(1000.0, 1.0)
+    assert knee.knee_rps >= 1000.0
+    m_at_peak = knee.knee_margin
+    knee.observe(100.0, 1.0)             # traffic fell away
+    assert knee.knee_margin <= 1.0       # schema bound
+    knee.observe(100.0, 1.0)
+    # the knee decays toward current offered: stale peaks stop vouching
+    assert knee.knee_rps < 1000.0
+    assert m_at_peak <= 1.0

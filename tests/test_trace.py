@@ -380,6 +380,76 @@ def test_flight_recorder_dump_and_postmortem(tmp_path, booster):
     assert trace.RECORDER.proc in text
 
 
+def test_postmortem_tolerates_torn_flight_dump(tmp_path, booster):
+    """ISSUE 13 satellite: one SIGKILL-torn dump (truncated final JSON,
+    even mid-byte-sequence garbage) must NOT abort the merged timeline —
+    the intact sources still render, the torn one reports truncation,
+    exactly like obs/events.read_file's torn-final-line contract."""
+    import importlib.util
+    b, X = booster
+    good = str(tmp_path / "r1.flight")
+    server = b.as_server(max_delay_ms=0.5)
+    fr = trace.FlightRecorder(good, params={"who": "survivor"})
+    try:
+        _traced_submit(server, X[0])
+        fr.dump(reason="test")
+    finally:
+        server.close()
+    # tear a copy of the good dump mid-record, then corrupt the tail
+    # with bytes that are not valid UTF-8 (a half-recovered disk)
+    raw = open(good, "rb").read()
+    torn = str(tmp_path / "r0.flight")
+    with open(torn, "wb") as f:
+        f.write(raw[: int(len(raw) * 0.6)] + b"\xe2\x82")
+    spec = importlib.util.spec_from_file_location(
+        "postmortem", os.path.join(os.path.dirname(__file__), "..",
+                                   "tools", "postmortem.py"))
+    pm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pm)
+    sources = pm.load([torn, good])      # must not raise
+    by_path = {os.path.basename(p): (r, t) for p, r, t in sources}
+    assert by_path["r0.flight"][1] is True         # truncation reported
+    assert by_path["r1.flight"][1] is False
+    merged = pm.merge(sources)
+    assert merged                         # the intact source's records
+    text = pm.render(sources, merged)
+    assert "TRUNCATED" in text
+    assert "last span of r1.flight" in text
+    # the torn dump's parseable prefix still contributes evidence
+    assert any(src == "r0.flight" for _t, src, _r in merged)
+    # and main() exits 0 on the same inputs (truncation != failure)
+    assert pm.main([torn, good]) == 0
+
+
+def test_postmortem_skips_structurally_torn_records(tmp_path):
+    """Records that parse but lost fields (interior corruption) degrade
+    to best-effort rendering, never a KeyError abort."""
+    import importlib.util
+    import json as _json
+    path = str(tmp_path / "weird.flight")
+    with open(path, "w") as f:
+        f.write(_json.dumps({"type": "run_header", "schema_version": 1,
+                             "time_unix": 1.0, "params": "torn"}) + "\n")
+        f.write(_json.dumps({"type": "span", "t0": 2.0}) + "\n")
+        f.write(_json.dumps({"type": "span", "trace": "t", "span": "s",
+                             "name": "dispatch", "t0": "garbage"}) + "\n")
+        f.write(_json.dumps({"type": "event", "event": "x",
+                             "time_unix": 3.0}) + "\n")
+    spec = importlib.util.spec_from_file_location(
+        "postmortem", os.path.join(os.path.dirname(__file__), "..",
+                                   "tools", "postmortem.py"))
+    pm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pm)
+    sources = pm.load([path])
+    merged = pm.merge(sources)
+    # the numeric-t0 span and the event survive; the garbage-t0 one is
+    # skipped rather than crashing the sort
+    assert [r.get("type") for _t, _s, r in merged] == ["span", "event"]
+    text = pm.render(sources, merged)
+    assert "dispatch" not in text         # the torn span was dropped
+    assert "!x" in text
+
+
 def test_flight_recorder_periodic_dump(tmp_path):
     dump = str(tmp_path / "tick.flight")
     rec = trace.SpanRecorder(ring=64, proc="ticker")

@@ -1,0 +1,405 @@
+#!/usr/bin/env python
+"""Autonomics gate (ISSUE 13): the control loop proven under faults.
+
+Run by tools/run_full_suite.sh G0. Three scenarios, one per actuation
+behavior the controller ships:
+
+A. **kill-and-revive under open-loop load** — a 2-replica loopback fleet
+   of REAL ``task=serve`` subprocesses; replica r0 is SIGKILLed mid-load.
+   The controller must respawn it (same fixed port — the
+   SO_REUSEADDR/rebind path), re-admit it at probation, and promote it;
+   every accepted request resolves (zero stranded futures) and fleet
+   goodput re-converges to >= 90% of the pre-kill baseline.
+B. **placement under induced eviction pressure** — 3 models on 2
+   replicas under an HBM budget that fits ~1 model per replica. The
+   placement loop must pin the hot model to a resident replica and route
+   its traffic there: during the measured window the cold models churn
+   (evictions > 0) while the hot model pays ~zero readmissions.
+C. **delta hot-swap during scale-out** — the autoscaler grows the fleet
+   (scripted knee signals), then a delta rollout must land atomically on
+   EVERY live replica (including the fresh one); with a delta fault
+   armed on one replica, the rollout must roll back on all of them — no
+   mixed-generation fleet. Delta frames must be smaller than the full
+   model text.
+
+Exit 0 on pass; nonzero with a reason on any violation.
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+RATE_RPS = 120.0
+N_REQUESTS = 240
+DEADLINE_MS = 250.0
+RECOVERY_FRACTION = 0.90
+
+
+def fail(msg: str) -> int:
+    print(f"AUTONOMICS GATE FAIL: {msg}")
+    return 1
+
+
+def train_model(path: str, seed: int = 0, rounds: int = 10):
+    import numpy as np
+    import lambdagap_tpu as lgb
+    rng = np.random.RandomState(seed)
+    X = rng.randn(1500, 10).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] + np.sin(X[:, 2]) > 0).astype(np.float32)
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                   "tpu_fast_predict_rows": 0},
+                  lgb.Dataset(X, label=y), num_boost_round=rounds)
+    b.save_model(path)
+    return X
+
+
+def spawn_replica(model_path: str, port: int = 0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "lambdagap_tpu", "task=serve",
+         f"input_model={model_path}", f"serve_port={port}", "verbose=-1",
+         "serve_max_delay_ms=1"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, cwd=REPO, env=env)
+
+
+def await_port(proc, timeout_s: float = 120.0) -> int:
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("SERVE_PORT="):
+            return int(line.split("=", 1)[1])
+    raise RuntimeError("replica never printed SERVE_PORT")
+
+
+# ---------------------------------------------------------------------------
+def scenario_a_kill_and_revive(tmp: str) -> int:
+    from lambdagap_tpu.obs.fleet import FleetScraper
+    from lambdagap_tpu.obs.signals import SignalPlane
+    from lambdagap_tpu.serve import (Autonomics, RemoteReplica, Router,
+                                     run_open_loop)
+
+    model = os.path.join(tmp, "model_a.txt")
+    X = train_model(model)
+    print("autonomics gate [A]: spawning 2 task=serve replicas...")
+    procs = {}
+    procs["r0"] = spawn_replica(model)
+    procs["r1"] = spawn_replica(model)
+    ports = {name: await_port(p) for name, p in procs.items()}
+    print(f"autonomics gate [A]: fleet up on ports {ports}")
+    router = Router([RemoteReplica(name, "127.0.0.1", port)
+                     for name, port in sorted(ports.items())])
+    plane = SignalPlane()
+    scraper = FleetScraper(router, interval_s=0.25, signals=plane).start()
+    router.attach_scraper(scraper)
+
+    def revive(name, old):
+        # respawn the dead subprocess on its OLD fixed port (the
+        # SO_REUSEADDR + bind-retry path), then reconnect the client
+        proc = procs[name]
+        if proc.poll() is None:
+            raise ConnectionError(f"{name} process still running")
+        fresh = spawn_replica(model, port=old.port)
+        procs[name] = fresh
+        port = await_port(fresh)
+        if port != old.port:
+            raise RuntimeError(
+                f"respawned replica re-announced port {port}, expected "
+                f"to rebind {old.port}")
+        return RemoteReplica(name, "127.0.0.1", port)
+
+    auto = Autonomics(router, signals=plane, scraper=scraper,
+                      interval_s=0.25, revive=revive,
+                      revive_backoff_s=0.25, probe_window=2).start()
+    router.attach_autonomics(auto)
+    try:
+        pre = run_open_loop(router.submit, X, RATE_RPS, N_REQUESTS,
+                            deadline_ms=DEADLINE_MS, seed=1)
+        print(f"autonomics gate [A]: pre-fault goodput ratio "
+              f"{pre['goodput_ratio']:.2f}, counts {pre['counts']}")
+        if pre["counts"]["error"]:
+            return fail("[A] pre-fault round had unexplained errors")
+        if pre["goodput_ratio"] < 0.5:
+            return fail("[A] fleet cannot carry the gate load; baseline "
+                        "meaningless")
+
+        def killer():
+            time.sleep(N_REQUESTS / RATE_RPS * 0.4)
+            print("autonomics gate [A]: SIGKILL replica r0 mid-load")
+            procs["r0"].send_signal(signal.SIGKILL)
+
+        k = threading.Thread(target=killer)
+        k.start()
+        chaos = run_open_loop(router.submit, X, RATE_RPS, N_REQUESTS,
+                              deadline_ms=DEADLINE_MS, seed=2)
+        k.join()
+        c = chaos["counts"]
+        resolved = (c["ok"] + c["rejected"] + c["timeout"]
+                    + c["transport"] + c["error"])
+        print(f"autonomics gate [A]: chaos counts {c}")
+        if resolved != N_REQUESTS:
+            return fail(f"[A] {N_REQUESTS - resolved} requests never "
+                        "resolved — a stranded future")
+        if c["error"]:
+            return fail(f"[A] {c['error']} unexplained errors in the "
+                        "chaos round")
+
+        # the controller must revive r0: same name, same port, probation
+        # then promotion — wait for the full cycle, not just the respawn
+        deadline = time.time() + 150.0
+        while time.time() < deadline:
+            snap = router.snapshot()
+            info = snap["replicas"]["r0"]
+            if not info["dead"] and "probation" not in info \
+                    and auto.counters["revivals"] >= 1:
+                break
+            time.sleep(0.25)
+        else:
+            return fail(f"[A] r0 never revived+promoted: {snap['replicas']}"
+                        f" autonomics={auto.snapshot()}")
+        print(f"autonomics gate [A]: r0 revived on port {ports['r0']} "
+              f"after {auto.counters['revival_failures']} failed "
+              f"attempt(s); promoted from probation")
+
+        post = run_open_loop(router.submit, X, RATE_RPS, N_REQUESTS,
+                             deadline_ms=DEADLINE_MS, seed=3)
+        print(f"autonomics gate [A]: post-revival goodput ratio "
+              f"{post['goodput_ratio']:.2f} vs pre "
+              f"{pre['goodput_ratio']:.2f}")
+        if post["counts"]["error"]:
+            return fail("[A] post-revival round had unexplained errors")
+        if post["goodput_ratio"] < RECOVERY_FRACTION * pre["goodput_ratio"]:
+            return fail(f"[A] goodput did not re-converge: "
+                        f"{post['goodput_ratio']:.2f} < "
+                        f"{RECOVERY_FRACTION:.0%} of "
+                        f"{pre['goodput_ratio']:.2f}")
+        # the revived replica must actually be BACK IN ROTATION
+        if router.snapshot()["replicas"]["r0"]["routed"] == 0:
+            return fail("[A] revived r0 never took a request")
+        print("autonomics gate [A]: PASS")
+        return 0
+    finally:
+        router.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+# ---------------------------------------------------------------------------
+def scenario_b_placement(tmp: str) -> int:
+    import numpy as np
+    import lambdagap_tpu as lgb
+    from lambdagap_tpu.obs.fleet import FleetScraper
+    from lambdagap_tpu.obs.signals import SignalPlane
+    from lambdagap_tpu.serve import (Autonomics, ForestServer,
+                                     LocalReplica, Router)
+
+    paths = {}
+    for i, name in enumerate(("hot", "cold1", "cold2")):
+        paths[name] = os.path.join(tmp, f"model_{name}.txt")
+        X = train_model(paths[name], seed=i, rounds=8)
+
+    def make_server(budget):
+        s = ForestServer(lgb.Booster(model_file=paths["hot"]),
+                         max_delay_ms=1.0, hbm_budget_bytes=budget)
+        # the default entry rides along but sees no traffic
+        for name in ("hot", "cold1", "cold2"):
+            s.add_model(name, paths[name])
+        return s
+
+    probe = ForestServer(lgb.Booster(model_file=paths["hot"]),
+                         max_delay_ms=1.0)
+    one_model = probe.registry.entry("default").bytes
+    probe.close()
+    budget = int(one_model * 1.5)        # fits ONE model (+ slack), not two
+    s0, s1 = make_server(budget), make_server(budget)
+    router = Router([LocalReplica("r0", s0), LocalReplica("r1", s1)],
+                    own_replicas=True)
+    plane = SignalPlane()
+    scraper = FleetScraper(router, signals=plane)   # on-demand scrapes
+    auto = Autonomics(router, signals=plane, scraper=scraper,
+                      placement=True, placement_budget_bytes=budget)
+    router.attach_autonomics(auto)
+    try:
+        rng = np.random.RandomState(3)
+        row = X[:1]
+
+        def drive(n, models):
+            futs = [router.submit(row, model=models[i % len(models)])
+                    for i in range(n)]
+            for f in futs:
+                f.result(30)
+
+        # traffic history: hot dominates -> the plan pins it
+        drive(60, ["hot"])
+        drive(12, ["cold1", "cold2"])
+        scraper.scrape()
+        auto.tick()
+        plan = router.snapshot().get("placement")
+        if not plan or "hot" not in plan or len(plan["hot"]) != 1:
+            return fail(f"[B] no placement plan for the hot model: {plan}")
+        hot_home = plan["hot"][0]
+        print(f"autonomics gate [B]: plan {plan} (hot -> {hot_home}, "
+              f"budget {budget} bytes ~ 1 model/replica)")
+
+        def hot_readmissions():
+            stats = router.stats_snapshot()
+            return sum((s.get("per_model", {}).get("hot", {})
+                        .get("readmissions", 0))
+                       for s in stats["replicas"].values()
+                       if isinstance(s, dict))
+
+        def total_evictions():
+            stats = router.stats_snapshot()
+            return sum(s.get("evictions", 0)
+                       for s in stats["replicas"].values()
+                       if isinstance(s, dict))
+
+        base_readmit = hot_readmissions()
+        base_evict = total_evictions()
+        # measured window: hot traffic + cold churn (the two cold models
+        # alternate on the other replica, evicting each other under the
+        # one-model budget — real, measured eviction pressure)
+        for _ in range(6):
+            drive(20, ["hot"])
+            drive(8, ["cold1", "cold2"])
+            scraper.scrape()
+            auto.tick()
+        d_readmit = hot_readmissions() - base_readmit
+        d_evict = total_evictions() - base_evict
+        print(f"autonomics gate [B]: measured window: hot readmissions "
+              f"+{d_readmit}, fleet evictions +{d_evict}")
+        if d_evict == 0:
+            return fail("[B] no eviction pressure induced — the budget "
+                        "did not bind; the scenario proves nothing")
+        if d_readmit > 1:
+            return fail(f"[B] hot model paid {d_readmit} readmissions "
+                        "under placement — requests are not staying on "
+                        "the resident replica")
+        print("autonomics gate [B]: PASS")
+        return 0
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+def scenario_c_delta_during_scaleout(tmp: str) -> int:
+    import lambdagap_tpu as lgb
+    from lambdagap_tpu.guard.degrade import SwapFailed
+    from lambdagap_tpu.guard.faults import FaultPlan
+    from lambdagap_tpu.obs.signals import SignalPlane
+    from lambdagap_tpu.serve import (Autonomics, ForestServer,
+                                     LocalReplica, Router)
+    from lambdagap_tpu.serve.delta import split_model_text
+
+    v1 = os.path.join(tmp, "model_c1.txt")
+    X = train_model(v1, seed=9, rounds=8)
+    import numpy as np
+    y = (X[:, 0] - 0.5 * X[:, 1] + np.sin(X[:, 2]) > 0).astype(np.float32)
+    b2 = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                   lgb.Dataset(X, label=y), num_boost_round=4,
+                   init_model=v1)
+    v2 = os.path.join(tmp, "model_c2.txt")
+    b2.save_model(v2)
+    b3 = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+                   lgb.Dataset(X, label=y), num_boost_round=2,
+                   init_model=v2)
+    v3 = os.path.join(tmp, "model_c3.txt")
+    b3.save_model(v3)
+
+    def mk(name):
+        return LocalReplica(name, ForestServer(
+            lgb.Booster(model_file=v1), max_delay_ms=1.0))
+
+    router = Router([mk("r0"), mk("r1")], own_replicas=True)
+    plane = SignalPlane(alpha=1.0)
+    # scripted saturation: offered hugs the knee -> margin ~0
+    plane.knee.knee_rps = 100.0
+    plane.knee.offered_rps = 99.0
+    plane.knee.ticks = 5
+    plane.update({"merged": {}, "time_unix": 1.0})
+    plane.knee.knee_rps = 100.0
+    plane.knee.offered_rps = 99.0
+    plane._latest["goodput"] = plane.knee.snapshot()
+
+    auto = Autonomics(router, signals=plane, scale=lambda i: mk(f"s{i}"),
+                      scale_out_margin=0.1, scale_in_margin=0.5,
+                      max_replicas=3, hysteresis_ticks=1, cooldown_s=0.0)
+    router.attach_autonomics(auto)
+    try:
+        auto.tick()
+        live = sorted(router.replica_names())
+        if live != ["r0", "r1", "s0"]:
+            return fail(f"[C] autoscaler did not scale out: {live}")
+        print(f"autonomics gate [C]: scaled out to {live} at "
+              "knee_margin ~0.01")
+
+        out = auto.rollout_delta(v2, base_source=v1)
+        if out["mode"] != "delta":
+            return fail(f"[C] rollout fell back to {out['mode']}")
+        if out["delta_bytes"] >= out["full_bytes"]:
+            return fail(f"[C] delta frame ({out['delta_bytes']}B) is not "
+                        f"smaller than the full text "
+                        f"({out['full_bytes']}B)")
+        forests = {tuple(split_model_text(
+            router.replica(n).server.model_text())[1]) for n in live}
+        want = {tuple(split_model_text(open(v2).read())[1])}
+        if forests != want:
+            return fail("[C] delta rollout did not land the SAME forest "
+                        "on every live replica (fresh scale-out replica "
+                        "included)")
+        print(f"autonomics gate [C]: delta rollout landed on all 3 "
+              f"replicas ({out['delta_bytes']}B delta vs "
+              f"{out['full_bytes']}B full)")
+
+        # rollout with one replica armed to fail: all-or-nothing
+        router.replica("r1").server._faults = FaultPlan("delta_swap_fail=1")
+        try:
+            auto.rollout_delta(v3)
+            return fail("[C] rollout with an armed fault did not raise")
+        except SwapFailed as e:
+            print(f"autonomics gate [C]: faulted rollout rolled back "
+                  f"({e})")
+        forests = {tuple(split_model_text(
+            router.replica(n).server.model_text())[1])
+            for n in sorted(router.replica_names())}
+        if len(forests) != 1:
+            return fail("[C] MIXED-GENERATION FLEET after failed rollout")
+        if forests != want:
+            return fail("[C] fleet is uniform but not on the base "
+                        "generation after rollback")
+        if auto.counters["delta_rollbacks"] != 1:
+            return fail("[C] rollback not recorded")
+        print("autonomics gate [C]: PASS")
+        return 0
+    finally:
+        router.close()
+
+
+def main() -> int:
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        for scenario in (scenario_a_kill_and_revive, scenario_b_placement,
+                         scenario_c_delta_during_scaleout):
+            rc = scenario(tmp)
+            if rc:
+                return rc
+    print("autonomics gate: PASS — revival under load, placement under "
+          "eviction pressure, atomic delta rollout during scale-out")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -31,9 +31,13 @@ sys.path.insert(0, REPO)
 
 
 def load(paths):
-    """[(path, records, truncated)] via the lenient reader; unreadable
-    files abort (a postmortem with silently missing evidence is worse
-    than none)."""
+    """[(path, records, truncated)] via the lenient reader. A MISSING or
+    unreadable file aborts (a postmortem with silently absent evidence
+    is worse than none); a file that exists but is SIGKILL-torn —
+    truncated final JSON, garbage bytes, even an empty dump — is
+    evidence of the crash itself: its parseable prefix joins the
+    timeline and the truncation is reported, never fatal (the
+    obs.events.read_file contract, applied to every input)."""
     from lambdagap_tpu.obs.events import read_file
     out = []
     for path in paths:
@@ -42,6 +46,13 @@ def load(paths):
         except OSError as e:
             print(f"postmortem: cannot read {path}: {e}", file=sys.stderr)
             raise SystemExit(2)
+        except ValueError as e:
+            # defensively non-fatal: whatever mangling the reader could
+            # not absorb still must not take down the merged timeline
+            print(f"postmortem: {path} is corrupt beyond recovery ({e}); "
+                  "keeping it as an empty, truncated source",
+                  file=sys.stderr)
+            records, truncated = [], True
         out.append((path, records, truncated))
     return out
 
@@ -67,6 +78,8 @@ def merge(sources, trace_id=None):
                 t = rec.get("time_unix", 0.0)
             else:
                 continue                 # run_header/iteration: context only
+            if not isinstance(t, (int, float)):
+                continue                 # structurally torn record: skip it
             merged.append((float(t), src, rec))
     merged.sort(key=lambda item: item[0])
     return merged
@@ -76,12 +89,16 @@ def last_spans(sources):
     """source file -> (proc, last span record) — the dead replica's last
     recorded act."""
     out = {}
+
+    def _num(v):
+        return float(v) if isinstance(v, (int, float)) else 0.0
+
     for path, records, _trunc in sources:
         spans = [r for r in records
                  if isinstance(r, dict) and r.get("type") == "span"]
         if spans:
-            last = max(spans, key=lambda s: s.get("t0", 0.0)
-                       + s.get("dur", 0.0))
+            last = max(spans, key=lambda s: _num(s.get("t0"))
+                       + _num(s.get("dur")))
             out[os.path.basename(path)] = (last.get("proc", "?"), last)
     return out
 
@@ -95,7 +112,8 @@ def render(sources, merged, width=72):
         n_events = sum(1 for r in records if r.get("type") == "event")
         header = next((r for r in records
                        if r.get("type") == "run_header"), {})
-        reason = header.get("params", {}).get("reason", "")
+        params = header.get("params")
+        reason = params.get("reason", "") if isinstance(params, dict) else ""
         lines.append(
             f"  {os.path.basename(path)}: {n_spans} spans, "
             f"{n_events} events"
@@ -114,14 +132,19 @@ def render(sources, merged, width=72):
         off = (t - t_base) * 1e3
         proc = str(rec.get("proc", ""))[:16]
         if rec["type"] == "span":
-            what = rec["name"]
+            # .get defaults throughout: a span that parsed but lost
+            # fields to a torn write still renders instead of KeyError-
+            # aborting every OTHER process's evidence
+            what = str(rec.get("name", "?"))
             attrs = rec.get("attrs") or {}
-            if attrs:
+            if isinstance(attrs, dict) and attrs:
                 short = ",".join(f"{k}={v}" for k, v in
                                  sorted(attrs.items()))[:width - len(what)]
                 what = f"{what}({short})"
-            tid = rec.get("trace", "")[:8]
-            lines.append(f"{off:10.2f}  {rec['dur'] * 1e3:9.2f}  "
+            dur = rec.get("dur", 0.0)
+            dur = float(dur) if isinstance(dur, (int, float)) else 0.0
+            tid = str(rec.get("trace", ""))[:8]
+            lines.append(f"{off:10.2f}  {dur * 1e3:9.2f}  "
                          f"{proc:<16} {src:<18} {what} "
                          f"[trace {tid}]")
         else:
@@ -130,11 +153,14 @@ def render(sources, merged, width=72):
                          f"!{what}")
     lines.append("")
     for src, (proc, span) in sorted(last_spans(sources).items()):
-        off = (span.get("t0", t_base) - t_base) * 1e3
+        t0 = span.get("t0", t_base)
+        t0 = float(t0) if isinstance(t0, (int, float)) else t_base
+        dur = span.get("dur", 0.0)
+        dur = float(dur) if isinstance(dur, (int, float)) else 0.0
         lines.append(f"last span of {src} (proc {proc}): "
-                     f"{span['name']} at t={off:.2f}ms "
-                     f"dur={span.get('dur', 0.0) * 1e3:.2f}ms "
-                     f"[trace {span.get('trace', '')[:8]}]")
+                     f"{span.get('name', '?')} at t={(t0 - t_base) * 1e3:.2f}ms "
+                     f"dur={dur * 1e3:.2f}ms "
+                     f"[trace {str(span.get('trace', ''))[:8]}]")
     return "\n".join(lines)
 
 

@@ -87,6 +87,17 @@ if ! env JAX_PLATFORMS=cpu python tools/trace_gate.py; then
     echo "stranded a future; see docs/observability.md)"
     exit 1
 fi
+# autonomics gate (ISSUE 13): the fleet control loop under faults — a
+# SIGKILLed replica is respawned on its old port and goodput re-converges
+# with zero stranded futures; placement pins the hot model (readmissions
+# ~0 under induced eviction pressure); a delta rollout during scale-out
+# lands atomically on every live replica or rolls back on all of them
+if ! env JAX_PLATFORMS=cpu python tools/autonomics_gate.py; then
+    echo "FAIL-FAST: autonomics gate failed (revival, placement, or the"
+    echo "atomic delta rollout contract regressed; see docs/robustness.md"
+    echo "'Fleet autonomics')"
+    exit 1
+fi
 echo "=== G1 $(date)"
 python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_predict_tensor.py tests/test_misc_api.py tests/test_graftlint.py -q 2>&1 | tail -1
 echo "=== G2 $(date)"
