@@ -16,11 +16,14 @@ Rules (see docs/static-analysis.md for the full rationale). Pass 1 builds
 a package-wide semantic index (module/class/function tables, an
 intra-package call graph with method resolution through ``self``,
 per-function lock-acquisition sets, config-knob declarations and read
-sites, the sharding-registry axis universe); pass 2 runs the rules over
-index + AST:
+sites, the sharding-registry axis universe); pass 2 infers transitive
+effect sets (``d2h_sync``/``blocking``/``acquires``/``collective``/
+``jit_compile``, propagated to fixpoint over the call graph with
+provenance chains — ``analysis/effects.py``); pass 3 runs the rules over
+index + effects + AST:
 
 - R1 host-device sync in hot paths (incl. helpers REACHED from hot
-  functions via the call graph)
+  functions through the call graph at ANY depth, with the full chain)
 - R2 jit recompile hazards
 - R3 clamped dynamic_slice starts without a guarding invariant
 - R4 dtype drift (array creation without an explicit dtype)
@@ -29,11 +32,19 @@ index + AST:
 - R7 unsynced timing (perf_counter deltas over async device dispatch)
 - R8 future/exception discipline
 - R9 lock-order deadlock cycles + blocking work reachable under a lock
+  at any call depth
 - R10 sharding-registry enforcement (spec/mesh construction sites)
 - R11 config-knob drift (unused/typo'd/divergent-default knobs)
+- R12 composition-matrix enforcement (silent/half-named axis demotions;
+  feeds docs/capability-matrix.md)
+- R13 wire-protocol drift (frontend/client/kind-map/serve_loop/docs
+  bijection)
+- R14 dead suppressions + stale baseline entries
 
 Intentionally import-light: no jax import happens here, so the linter runs
 in well under the 2 s G0 budget and can scan trees that do not import.
+The content-hash cache (``analysis/cache.py``) makes an unchanged-tree
+re-scan a hash walk that replays byte-identical findings.
 """
 from __future__ import annotations
 
@@ -41,11 +52,13 @@ from .core import (Finding, FunctionInfo, ModuleContext,  # noqa: F401
                    PackageIndex, Rule, all_rules, apply_baseline,
                    build_index, load_baseline, register_rule, scan,
                    write_baseline)
-from . import rules  # noqa: F401  (registers R1..R11)
+from . import rules  # noqa: F401  (registers R1..R14)
+from .effects import EffectAnalysis, get_effects  # noqa: F401
 from .cli import main  # noqa: F401
 
 __all__ = [
-    "Finding", "FunctionInfo", "ModuleContext", "PackageIndex", "Rule",
-    "all_rules", "apply_baseline", "build_index", "load_baseline",
-    "register_rule", "scan", "write_baseline", "main",
+    "EffectAnalysis", "Finding", "FunctionInfo", "ModuleContext",
+    "PackageIndex", "Rule", "all_rules", "apply_baseline", "build_index",
+    "get_effects", "load_baseline", "register_rule", "scan",
+    "write_baseline", "main",
 ]

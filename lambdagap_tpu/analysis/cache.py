@@ -1,0 +1,161 @@
+"""Content-hash incremental scan cache (ISSUE 14).
+
+The G0 gate enforces a 2 s wall budget on the full three-pass scan; as
+the package grows, the budget holds because a scan of an UNCHANGED tree
+is a hash walk, not a re-analysis. The cache is deliberately
+whole-result: graftlint's value is its cross-module rules (call-graph
+reach, lock graphs, knob tables, wire bijections), so per-file reuse
+would be unsound — any changed file can change any other file's findings.
+Correct granularity: one entry keyed by
+
+- the content hash of EVERY scanned file (path + sha256),
+- the content hash of the analyzer itself (``analysis/*.py`` +
+  ``rules/*.py`` — editing a rule invalidates every cached result), and
+- the effective rule selection (``--select``/``--disable``).
+
+A hit replays the stored findings verbatim — cold and warm scans are
+byte-identical by construction, and ``tests/test_graftlint.py`` asserts
+it end to end (same ``Finding`` tuples, same serialized output). A miss
+on ANY key component falls through to a full scan and rewrites the
+entry. The cache file lives next to the baseline by default
+(``.graftlint_cache.json``, gitignored) and is a pure accelerator:
+deleting it is always safe.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+from .core import Finding, iter_py_files
+
+CACHE_VERSION = 1
+DEFAULT_CACHE = ".graftlint_cache.json"
+
+_analyzer_hash_memo: Optional[str] = None
+
+
+def analyzer_hash() -> str:
+    """sha256 over the analyzer's own sources: a rule edit must invalidate
+    every cached scan result."""
+    global _analyzer_hash_memo
+    if _analyzer_hash_memo is not None:
+        return _analyzer_hash_memo
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for fp, rel in sorted(iter_py_files([here])):
+        h.update(rel.encode())
+        with open(fp, "rb") as f:
+            h.update(hashlib.sha256(f.read()).digest())
+    _analyzer_hash_memo = h.hexdigest()
+    return _analyzer_hash_memo
+
+
+def scan_key(paths: Sequence[str], select, disable) -> str:
+    """The cache key: every scanned file's content hash + analyzer hash +
+    rule selection."""
+    h = hashlib.sha256()
+    h.update(analyzer_hash().encode())
+    h.update(json.dumps([sorted(select) if select else None,
+                         sorted(disable) if disable else None]).encode())
+    for fp, rel in iter_py_files(paths):
+        h.update(rel.replace(os.sep, "/").encode())
+        try:
+            with open(fp, "rb") as f:
+                h.update(hashlib.sha256(f.read()).digest())
+        except OSError:
+            h.update(b"<unreadable>")
+    # R13 reads docs/serving.md (located by walking up from the scanned
+    # frontend) — a docs-only edit must invalidate the cache too
+    from .rules.r13_wire_drift import _find_doc
+    for p in paths:
+        anchor = p if os.path.isfile(p) else os.path.join(p, "x")
+        doc = _find_doc(anchor)
+        if doc:
+            try:
+                with open(doc, "rb") as f:
+                    h.update(hashlib.sha256(f.read()).digest())
+            except OSError:
+                h.update(b"<unreadable-doc>")
+            break
+    return h.hexdigest()
+
+
+def load(cache_path: str, key: str) -> Optional[List[Finding]]:
+    """The cached findings for ``key``, or None on any mismatch/damage."""
+    try:
+        with open(cache_path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if (data.get("version") != CACHE_VERSION
+            or data.get("key") != key):
+        return None
+    try:
+        return [Finding(**e) for e in data["findings"]]
+    except (KeyError, TypeError):
+        return None
+
+
+def store(cache_path: str, key: str, findings: Sequence[Finding]) -> None:
+    """Best-effort write (atomic: tmp + rename); a read-only tree just
+    runs cold every time."""
+    payload = {"version": CACHE_VERSION, "key": key,
+               "findings": [dataclasses.asdict(f) for f in findings]}
+    tmp = f"{cache_path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, cache_path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        # graftlint: disable=R8 — best-effort cleanup of a tmp file that
+        # may never have been created (the write above failed first); the
+        # cache is a pure accelerator and a stranded tmp is harmless
+        except OSError:
+            pass
+
+
+def changed_files(paths: Sequence[str], base: Optional[str] = None
+                  ) -> Optional[List[str]]:
+    """The scanned .py files that differ from the git working baseline —
+    uncommitted changes (staged, unstaged, untracked), plus the diff
+    against ``base`` (a ref; e.g. a merge-base) when given. None when git
+    is unavailable (callers fall back to a full scan).
+
+    This is the ``--changed-only`` pre-commit fast path: cross-module
+    rules see a PARTIAL universe, so whole-package finding classes stand
+    down (``PackageIndex.partial_scan``); the full scan remains the G0
+    gate of record.
+    """
+    import subprocess
+    changed: set = set()
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], capture_output=True,
+            text=True, check=True).stdout.strip()
+        status = subprocess.run(
+            ["git", "status", "--porcelain=v1", "-uall"],
+            capture_output=True, text=True, check=True).stdout
+        for line in status.splitlines():
+            if len(line) > 3:
+                name = line[3:].split(" -> ")[-1].strip().strip('"')
+                changed.add(os.path.abspath(os.path.join(top, name)))
+        if base:
+            diff = subprocess.run(
+                ["git", "diff", "--name-only", base, "HEAD"],
+                capture_output=True, text=True, check=True).stdout
+            for name in diff.splitlines():
+                if name.strip():
+                    changed.add(os.path.abspath(
+                        os.path.join(top, name.strip())))
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    out = []
+    for fp, _rel in iter_py_files(paths):
+        if os.path.abspath(fp) in changed:
+            out.append(fp)
+    return out

@@ -1,10 +1,19 @@
 """graftlint CLI: ``python -m lambdagap_tpu.analysis [paths...]``.
 
-Exit codes: 0 — clean (every finding baselined or none); 1 — new findings
-(or the ``--max-seconds`` budget blown); 2 — usage error.
+Exit codes: 0 — clean (every finding baselined or none); 1 — new findings,
+stale baseline entries (R14), or the ``--max-seconds`` budget blown;
+2 — usage error.
 ``--write-baseline`` regenerates the baseline file from the current
 findings (preserving per-entry ``why`` justifications whose keys still
-match; output deterministic — sorted by rule, path, line) and exits 0.
+match; output deterministic — sorted by rule, path, line; dead entries
+pruned and counted) and exits 0.
+
+ISSUE 14 surfaces: the content-hash scan cache is ON by default
+(``--cache PATH`` / ``--no-cache``; a warm hit replays byte-identical
+findings in milliseconds — the G0 gate asserts identity), and
+``--changed-only`` (+ ``--changed-base REF``) is the pre-commit fast
+path: scan only git-changed files with whole-package finding classes
+standing down (docs/static-analysis.md has the hook recipe).
 
 Output formats (``--format``):
 
@@ -30,7 +39,8 @@ import sys
 import time
 from typing import List, Optional, Sequence
 
-from . import rules  # noqa: F401  (registers R1..R11)
+from . import cache as scan_cache
+from . import rules  # noqa: F401  (registers R1..R14)
 from .core import (Finding, all_rules, apply_baseline, load_baseline, scan,
                    write_baseline)
 
@@ -61,6 +71,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-seconds", type=float, default=None,
                    help="fail (exit 1) when the scan exceeds this "
                         "wall-clock budget — the G0 gate passes 2")
+    p.add_argument("--cache", default=scan_cache.DEFAULT_CACHE,
+                   help="content-hash scan cache file (default: "
+                        f"{scan_cache.DEFAULT_CACHE}; a warm hit replays "
+                        "byte-identical findings without re-analyzing)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="force a cold scan (never read or write the "
+                        "cache)")
+    p.add_argument("--changed-only", action="store_true",
+                   help="pre-commit fast path: scan only files git "
+                        "reports changed (uncommitted, plus "
+                        "--changed-base ref); whole-package finding "
+                        "classes stand down — the full scan stays the "
+                        "gate of record")
+    p.add_argument("--changed-base", default=None,
+                   help="with --changed-only: also include files "
+                        "differing from this git ref (e.g. a merge-base)")
     p.add_argument("--list-rules", action="store_true")
     return p
 
@@ -138,8 +164,49 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     select = args.select.split(",") if args.select else None
     disable = args.disable.split(",") if args.disable else None
+    partial = False
+    if args.changed_only and args.write_baseline:
+        # a partial scan sees a partial finding set; regenerating the
+        # baseline from it would prune every entry outside the changed
+        # files as "dead"
+        print("graftlint: --write-baseline needs a full scan; drop "
+              "--changed-only", file=sys.stderr)
+        return 2
+    if args.changed_only:
+        changed = scan_cache.changed_files(paths, base=args.changed_base)
+        if changed is None:
+            print("graftlint: --changed-only needs git; falling back to "
+                  "a full scan", file=sys.stderr)
+        elif not changed:
+            print("graftlint: --changed-only: no scanned files changed; "
+                  "nothing to do")
+            return 0
+        else:
+            # anchor files the cross-module rules need for context, when
+            # they exist under the requested roots
+            anchors = set()
+            from .core import iter_py_files
+            for fp, rel in iter_py_files(paths):
+                base = rel.replace(os.sep, "/").rsplit("/", 1)[-1]
+                if base in ("config.py", "sharding.py"):
+                    anchors.add(fp)
+            paths = sorted(set(changed) | anchors)
+            partial = True
     t0 = time.perf_counter()
-    findings = scan(paths, select=select, disable=disable)
+    cache_hit = False
+    use_cache = not args.no_cache and not partial
+    cache_key = None
+    if use_cache:
+        cache_key = scan_cache.scan_key(paths, select, disable)
+        cached = scan_cache.load(args.cache, cache_key)
+        if cached is not None:
+            findings = cached
+            cache_hit = True
+    if not cache_hit:
+        findings = scan(paths, select=select, disable=disable,
+                        partial=partial)
+        if use_cache:
+            scan_cache.store(args.cache, cache_key, findings)
     elapsed = time.perf_counter() - t0
 
     baseline_path = args.baseline
@@ -149,8 +216,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.write_baseline:
         out = baseline_path or DEFAULT_BASELINE
         os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        pruned = 0
+        if os.path.exists(out):
+            try:
+                _new, stale_old = apply_baseline(findings,
+                                                 load_baseline(out))
+                pruned = len(stale_old)
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"graftlint: old baseline unreadable ({e}); "
+                      f"rebuilding from scratch", file=sys.stderr)
         write_baseline(findings, out)
-        print(f"graftlint: wrote {len(findings)} finding(s) to {out}")
+        tail = (f" (pruned {pruned} dead entr"
+                f"{'y' if pruned == 1 else 'ies'})") if pruned else ""
+        print(f"graftlint: wrote {len(findings)} finding(s) to {out}"
+              f"{tail}")
         return 0
 
     entries = []
@@ -162,37 +241,45 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
     new, stale = apply_baseline(findings, entries)
+    # R14b: a stale baseline entry is a finding, not a warning — the
+    # grandfathered hazard no longer exists, so the entry is inert and
+    # would silently absorb the NEXT finding with the same key; the scan
+    # fails until --write-baseline prunes it
+    for e in stale:
+        new.append(Finding(
+            rule="R14", path=e["path"], line=1, col=0,
+            message=(f"stale baseline entry: the grandfathered {e['rule']}"
+                     f" finding ({e['snippet'][:60]!r}) no longer exists "
+                     f"— the code was fixed or changed; regenerate with "
+                     f"--write-baseline (prunes dead entries) so the "
+                     f"baseline cannot silently absorb a future "
+                     f"{e['rule']} finding"),
+            snippet=e["snippet"]))
+    new.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
 
     if args.format == "json":
         print(json.dumps({
             "findings": [f.__dict__ for f in new],
-            "baselined": len(findings) - len(new),
+            "baselined": len(findings) - (len(new) - len(stale)),
             "stale_baseline_entries": stale,
             "elapsed_s": elapsed,
+            "cache_hit": cache_hit,
         }, indent=2))
     elif args.format == "github":
         out = render_github(new)
         if out:
             print(out)
-        for e in stale:
-            print(f"::warning title=graftlint stale baseline::"
-                  f"{e['rule']} {e['path']}: entry no longer matches — "
-                  f"regenerate with --write-baseline")
     elif args.format == "sarif":
         print(render_sarif(new))
     else:
         for f in new:
             print(f.format())
-        for e in stale:
-            print(f"graftlint: stale baseline entry (code changed or "
-                  f"fixed — regenerate with --write-baseline): "
-                  f"{e['rule']} {e['path']}: {e['snippet'][:60]}",
-                  file=sys.stderr)
-        n_base = len(findings) - len(new)
+        n_base = len(findings) - (len(new) - len(stale))
         tail = f" ({n_base} baselined)" if n_base else ""
+        warm = ", warm cache" if cache_hit else ""
         print(f"graftlint: {len(new)} finding(s){tail} in "
               f"{len(set(f.path for f in findings)) if findings else 0} "
-              f"file(s) [{elapsed:.2f}s]")
+              f"file(s) [{elapsed:.2f}s{warm}]")
     if args.max_seconds is not None and elapsed > args.max_seconds:
         print(f"graftlint: scan took {elapsed:.2f}s, over the "
               f"--max-seconds {args.max_seconds:g} budget (the two-pass "

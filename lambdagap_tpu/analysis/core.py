@@ -21,8 +21,12 @@ Design notes — the two-pass architecture:
 - **Pass 2** runs rules as pure functions of ``(ModuleContext,
   PackageIndex)``. No scanned code is ever imported, so the whole run takes
   well under the 2 s G0 budget and can lint broken trees.
-- Findings are suppressible inline (``# graftlint: disable=R1,R5``, on the
-  offending line or alone on the line above) and grandfatherable in a
+- Findings are suppressible inline (a ``graftlint disable`` comment naming
+  the rules, on the offending line or alone on the line above; the exact
+  spelling is in docs/static-analysis.md — not spelled out here because
+  the suppression scanner is line-based and would treat a literal example
+  in this docstring as a real, inert suppression: the R14 class) and
+  grandfatherable in a
   checked-in JSON baseline keyed by (rule, path, normalized source line) —
   line-number drift does not invalidate baseline entries, editing the
   offending line does. ``write_baseline`` output is deterministic (entries
@@ -102,8 +106,15 @@ class ModuleContext:
                 parents[child] = node
                 order.append(child)
         self._order = order
-        self._suppress: Dict[int, set] = {}
-        self._suppress_file: set = set()
+        # line -> rule -> {origin comment line}: the origin back-pointer is
+        # what lets R14 decide which suppression COMMENT absorbed a finding
+        self._suppress: Dict[int, Dict[str, set]] = {}
+        self._suppress_file: Dict[str, int] = {}
+        # every suppression comment in the file: (comment line, rules,
+        # is_file_level) — R14's universe of suppressions to audit
+        self.suppression_sites: List[Tuple[int, frozenset, bool]] = []
+        # (rule, origin comment line) pairs that absorbed >= 1 finding
+        self.used_suppressions: set = set()
         self._scan_suppressions()
 
     # -- node index -----------------------------------------------------
@@ -117,19 +128,28 @@ class ModuleContext:
         return out
 
     # -- suppressions ---------------------------------------------------
+    def _add_suppression(self, line: int, rule: str, origin: int) -> None:
+        self._suppress.setdefault(line, {}).setdefault(rule, set()).add(
+            origin)
+
     def _scan_suppressions(self) -> None:
         for i, line in enumerate(self.lines, 1):
             if "graftlint" not in line:
                 continue
             m = SUPPRESS_FILE_RE.search(line)
             if m:
-                self._suppress_file |= _rule_list(m.group(1))
+                rules = _rule_list(m.group(1))
+                for r in rules:
+                    self._suppress_file.setdefault(r, i)
+                self.suppression_sites.append((i, frozenset(rules), True))
                 continue
             m = SUPPRESS_RE.search(line)
             if not m:
                 continue
             rules = _rule_list(m.group(1))
-            self._suppress.setdefault(i, set()).update(rules)
+            self.suppression_sites.append((i, frozenset(rules), False))
+            for r in rules:
+                self._add_suppression(i, r, i)
             # a comment alone on its line suppresses the next code line
             # (walking past any continuation comment lines of the
             # justification)
@@ -138,7 +158,8 @@ class ModuleContext:
                 while (j <= len(self.lines)
                        and self.lines[j - 1].lstrip().startswith("#")):
                     j += 1
-                self._suppress.setdefault(j, set()).update(rules)
+                for r in rules:
+                    self._add_suppression(j, r, i)
         if not self._suppress:
             return
         # a suppressed line covers the whole statement that starts there
@@ -151,13 +172,25 @@ class ModuleContext:
                 continue
             end = getattr(node, "end_lineno", node.lineno) or node.lineno
             for ln in range(node.lineno + 1, end + 1):
-                self._suppress.setdefault(ln, set()).update(rules)
+                for r, origins in rules.items():
+                    for o in origins:
+                        self._add_suppression(ln, r, o)
 
     def suppressed(self, rule: str, line: int) -> bool:
-        if rule in self._suppress_file or "ALL" in self._suppress_file:
-            return True
-        rules = self._suppress.get(line, ())
-        return rule in rules or "ALL" in rules
+        """True when a finding of ``rule`` at ``line`` is suppressed.
+        Records which suppression comment absorbed it (R14's usage
+        signal)."""
+        hit = False
+        for r in (rule, "ALL"):
+            if r in self._suppress_file:
+                self.used_suppressions.add((r, self._suppress_file[r]))
+                hit = True
+        rules = self._suppress.get(line, {})
+        for r in (rule, "ALL"):
+            for origin in rules.get(r, ()):
+                self.used_suppressions.add((r, origin))
+                hit = True
+        return hit
 
     # -- AST helpers ----------------------------------------------------
     def parent(self, node: ast.AST) -> Optional[ast.AST]:
@@ -347,6 +380,9 @@ class PackageIndex:
         self.knob_reads: List[KnobRead] = []
         self.knob_writes: Set[str] = set()
         self.loose_reads: Set[str] = set()
+        # True for an intentionally incomplete (--changed-only) scan set:
+        # whole-package finding classes stand down (see build_index)
+        self.partial_scan = False
         self._finalized = False
 
     # ------------------------------------------------------------------
@@ -732,6 +768,13 @@ class Rule:
               ) -> Iterator[Finding]:
         raise NotImplementedError
 
+    def post_check(self, ctx: ModuleContext, index: PackageIndex,
+                   executed_rules: Set[str]) -> Iterator[Finding]:
+        """Second-phase hook, run after every ordinary rule has finished
+        over every module — the hook R14 uses to audit which suppressions
+        actually absorbed a finding. Default: nothing."""
+        return iter(())
+
 
 # -- registry -----------------------------------------------------------
 _RULES: Dict[str, Rule] = {}
@@ -764,12 +807,17 @@ def iter_py_files(paths: Sequence[str]) -> Iterator[Tuple[str, str]]:
                     yield fp, os.path.relpath(fp, p)
 
 
-def build_index(paths: Sequence[str]
+def build_index(paths: Sequence[str], partial: bool = False
                 ) -> Tuple[List[ModuleContext], PackageIndex, List[Finding]]:
     """Pass 1: parse every file and build the finalized semantic index.
-    Returns (contexts, index, parse_failures-as-R0-findings)."""
+    Returns (contexts, index, parse_failures-as-R0-findings). ``partial``
+    marks an intentionally incomplete scan set (``--changed-only``): rules
+    whose finding classes need the WHOLE package in view (R11's
+    unused-knob class) stand down instead of reporting the missing files
+    as drift."""
     contexts: List[ModuleContext] = []
     index = PackageIndex()
+    index.partial_scan = partial
     failures: List[Finding] = []
     for fp, rel in iter_py_files(paths):
         try:
@@ -789,18 +837,33 @@ def build_index(paths: Sequence[str]
 
 
 def scan(paths: Sequence[str], select: Optional[Iterable[str]] = None,
-         disable: Optional[Iterable[str]] = None) -> List[Finding]:
-    """Run the rule set over ``paths`` (files or directory roots)."""
+         disable: Optional[Iterable[str]] = None,
+         partial: bool = False) -> List[Finding]:
+    """Run the rule set over ``paths`` (files or directory roots).
+
+    Two phases: every ordinary rule runs over every module first, THEN
+    post-check rules (R14's dead-suppression audit) run — they need the
+    complete picture of which suppression comments absorbed a finding,
+    which only exists once every other rule has fired.
+    """
     sel = {r.upper() for r in select} if select else None
     dis = {r.upper() for r in disable} if disable else set()
     rules = [r for r in all_rules()
              if (sel is None or r.id in sel) and r.id not in dis]
-    contexts, index, findings = build_index(paths)
+    executed = {r.id for r in rules}
+    contexts, index, findings = build_index(paths, partial=partial)
     for ctx in contexts:
         for rule in rules:
             if not rule.applies_to(ctx.relpath):
                 continue
             for f in rule.check(ctx, index):
+                if not ctx.suppressed(f.rule, f.line):
+                    findings.append(f)
+    for ctx in contexts:
+        for rule in rules:
+            if not rule.applies_to(ctx.relpath):
+                continue
+            for f in rule.post_check(ctx, index, executed):
                 if not ctx.suppressed(f.rule, f.line):
                     findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -1,0 +1,317 @@
+"""Transitive effect inference over the package call graph (pass 3).
+
+Pass 1 (core.py) indexes *syntax*: who defines what, who calls whom, which
+attributes are locks. Pass 2's original rules consumed those facts at most
+ONE call-graph hop deep (R1's hot-caller reach, R9's callee-acquires
+edges). This module closes the gap: every indexed function gets an
+inferred **effect set**, propagated to fixpoint over the whole intra-
+package call graph, with a provenance witness per inherited effect so a
+finding can print the exact call chain from the flagged frame to the
+primitive operation that carries the effect.
+
+Effects are ``(kind, detail)`` pairs:
+
+- ``("d2h_sync", op)`` — a host-device synchronization primitive
+  (``jax.device_get``, ``.item()``, ``.block_until_ready()``,
+  ``float``/``int``/``np.asarray`` over a device computation). R1's raw
+  material.
+- ``("blocking", op)`` — a call that parks the calling thread
+  (``Future.result``, ``join``, ``sendall``, queue get/put, ``sleep``,
+  forest builds/warms ...). R5/R9's raw material; the classifier lives
+  HERE so the three rules can never disagree about what "blocking" means.
+  A ``Condition.wait``/``notify`` on a lock the *owning* function itself
+  acquires is NOT recorded — that is the condition-variable pattern, not
+  a hazard, and exempting it at extraction time keeps the exemption
+  correct at every propagation depth.
+- ``("acquires", "Owner.attr")`` — the function body acquires that lock
+  identity somewhere (from ``FunctionInfo.acquires``). R9a's edges are
+  now read off the transitive closure of this effect.
+- ``("collective", axis)`` — a named-axis collective (``psum`` family);
+  detail is the resolved axis string or ``"<dynamic>"``.
+- ``("jit_compile", op)`` — a ``jax.jit``/``pallas_call`` executable is
+  constructed here (compilation can take seconds; reaching one under a
+  lock or per request is its own hazard class).
+
+The fixpoint is a standard worklist union: ``effects(f) = direct(f) ∪
+U_{f->g} effects(g)``, with the FIRST callee to contribute an effect kept
+as the provenance witness (deterministic: callees are visited in resolved
+order, the index is deterministic, so cold and warm scans print identical
+chains). Cycles in the call graph converge because effect sets only grow
+and are bounded by the package's finite effect universe.
+
+``EffectAnalysis.reach_from(roots)`` answers the dual question R1 asks —
+which functions are reachable FROM a named set (the hot surfaces), with a
+shortest provenance chain per reached function — via one BFS, cached per
+root-set.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from .core import FunctionInfo, PackageIndex, call_name
+
+Effect = Tuple[str, str]
+FnKey = Tuple[str, str]
+
+# ---------------------------------------------------------------------------
+# call classifiers (shared by R1, R5, R9 and the direct-effect extraction)
+# ---------------------------------------------------------------------------
+# method names that block the calling thread. "sendall" joined when the
+# socket frontend landed: a frame write under the connection's tx mutex
+# convoys every batcher callback replying on that connection exactly like
+# "send" does.
+BLOCKING_METHODS = frozenset({
+    "result", "join", "wait", "sleep", "block_until_ready",
+    "device_get", "device_put", "warm", "_build", "recv", "send",
+    "sendall", "acquire",
+})
+# .get()/.put() only block on queue-ish receivers
+QUEUEISH = ("q", "queue", "_q", "_queue")
+
+_JAXISH = ("jax.", "jnp.", "lax.")
+
+# the psum family: named-axis collectives whose axis strings R6 checks
+COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "axis_index", "psum_scatter", "ppermute",
+})
+
+# condition-variable verbs: wait RELEASES the held lock, notify never
+# blocks — the canonical pattern, not a hazard, when performed on a lock
+# the function itself holds
+COND_VERBS = frozenset({"wait", "notify", "notify_all"})
+
+
+def _is_jaxish_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and (call_name(node).startswith(_JAXISH)
+                 or call_name(node) in ("device_get",)))
+
+
+def sync_kind(call: ast.Call) -> str:
+    """Classify a call as a host-device sync; '' when it is not one."""
+    name = call_name(call)
+    tail = name.rsplit(".", 1)[-1]
+    if tail == "device_get":
+        return "jax.device_get"
+    if tail in ("item", "block_until_ready") and not call.args:
+        return f".{tail}()"
+    if name in ("float", "int") and len(call.args) == 1:
+        arg = call.args[0]
+        if _is_jaxish_call(arg) and sync_kind(arg) == "":
+            return f"{name}() over a device value"
+    if tail in ("asarray", "array") and name.startswith("np.") and call.args:
+        arg = call.args[0]
+        if _is_jaxish_call(arg) and sync_kind(arg) == "":
+            return f"{name}() over a device value"
+    return ""
+
+
+def blocking_kind(call: ast.Call) -> str:
+    """Classify a call as thread-blocking; '' when it is not one."""
+    name = call_name(call)
+    tail = name.rsplit(".", 1)[-1]
+    if tail in BLOCKING_METHODS:
+        return name
+    if tail in ("get", "put"):
+        recv = name.rsplit(".", 2)
+        if len(recv) >= 2 and any(recv[-2].lower().endswith(q)
+                                  for q in QUEUEISH):
+            return name
+    return ""
+
+
+def jit_kind(call: ast.Call) -> str:
+    """Classify a call as constructing a compiled executable."""
+    name = call_name(call)
+    tail = name.rsplit(".", 1)[-1]
+    if tail in ("jit", "pallas_call"):
+        return name
+    return ""
+
+
+def collective_axis(fi: FunctionInfo, index: PackageIndex,
+                    call: ast.Call) -> Optional[str]:
+    """The resolved axis of a collective call, "<dynamic>" when the axis
+    expression is not statically known, None when not a collective."""
+    tail = call_name(call).rsplit(".", 1)[-1]
+    if tail not in COLLECTIVES:
+        return None
+    axis_expr: Optional[ast.AST] = None
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            axis_expr = kw.value
+    if axis_expr is None and len(call.args) >= 2:
+        axis_expr = call.args[1]
+    elif axis_expr is None and call.args and tail == "axis_index":
+        axis_expr = call.args[0]
+    if axis_expr is None:
+        return "<dynamic>"
+    resolved = index.resolve_string(fi.ctx, axis_expr)
+    return resolved if resolved is not None else "<dynamic>"
+
+
+# ---------------------------------------------------------------------------
+# the analysis
+# ---------------------------------------------------------------------------
+class EffectAnalysis:
+    """Whole-package effect sets + provenance, computed once per index."""
+
+    def __init__(self, index: PackageIndex) -> None:
+        self.index = index
+        # direct effects: fkey -> {effect: witness call/with node}
+        self.direct: Dict[FnKey, Dict[Effect, ast.AST]] = {}
+        # transitive effects: fkey -> {effect: via-callee key (None=direct)}
+        self.effects: Dict[FnKey, Dict[Effect, Optional[FnKey]]] = {}
+        self._reach_cache: Dict[Tuple[FrozenSet[str], FrozenSet[str]],
+                                Dict[FnKey, Optional[FnKey]]] = {}
+        for fi in index.functions.values():
+            self.direct[fi.key] = self._direct_effects(fi)
+            self.effects[fi.key] = {
+                e: None for e in self.direct[fi.key]}
+        self._fixpoint()
+
+    # -- direct extraction ----------------------------------------------
+    def _direct_effects(self, fi: FunctionInfo
+                        ) -> Dict[Effect, ast.AST]:
+        out: Dict[Effect, ast.AST] = {}
+        own_locks = {ident for ident, _n in fi.acquires}
+        for (ident, node) in fi.acquires:
+            out.setdefault(("acquires", f"{ident[0]}.{ident[1]}"), node)
+        for call in fi.call_nodes:
+            k = sync_kind(call)
+            if k:
+                out.setdefault(("d2h_sync", k), call)
+            b = blocking_kind(call)
+            if b:
+                # exempt cond.wait()/notify() on a lock this function
+                # itself acquires — its own legitimate pattern at every
+                # depth of propagation
+                tail = b.rsplit(".", 1)[-1]
+                exempt = False
+                if tail in COND_VERBS and isinstance(call.func,
+                                                    ast.Attribute):
+                    cid = self.index.lock_identity(fi, call.func.value)
+                    if cid is not None and cid in own_locks:
+                        exempt = True
+                if not exempt:
+                    out.setdefault(("blocking", b), call)
+            j = jit_kind(call)
+            if j:
+                out.setdefault(("jit_compile", j), call)
+            ax = collective_axis(fi, self.index, call)
+            if ax is not None:
+                out.setdefault(("collective", ax), call)
+        return out
+
+    # -- fixpoint ---------------------------------------------------------
+    def _fixpoint(self) -> None:
+        # reverse edges: callee -> callers, over the resolved call graph
+        callers: Dict[FnKey, List[FnKey]] = {}
+        for fi in self.index.functions.values():
+            for _call, callee in fi.resolved_calls:
+                callers.setdefault(callee.key, []).append(fi.key)
+        work = list(self.index.functions.keys())
+        in_work = set(work)
+        while work:
+            key = work.pop()
+            in_work.discard(key)
+            eff = self.effects.get(key)
+            if not eff:
+                continue
+            for caller_key in callers.get(key, ()):
+                ceff = self.effects[caller_key]
+                grew = False
+                for e in eff:
+                    if e not in ceff:
+                        ceff[e] = key
+                        grew = True
+                if grew and caller_key not in in_work:
+                    work.append(caller_key)
+                    in_work.add(caller_key)
+
+    # -- queries ----------------------------------------------------------
+    def has(self, key: FnKey, kind: str) -> bool:
+        return any(k == kind for (k, _d) in self.effects.get(key, ()))
+
+    def effects_of(self, key: FnKey, kind: str) -> List[Effect]:
+        return sorted(e for e in self.effects.get(key, ())
+                      if e[0] == kind)
+
+    def chain(self, key: FnKey, effect: Effect) -> List[FnKey]:
+        """Provenance: the call chain from ``key`` (inclusive) to the
+        function whose body performs ``effect`` directly."""
+        out = [key]
+        seen = {key}
+        cur = key
+        while True:
+            via = self.effects.get(cur, {}).get(effect, None)
+            if via is None or via in seen:
+                return out
+            out.append(via)
+            seen.add(via)
+            cur = via
+
+    def witness(self, key: FnKey, effect: Effect) -> Optional[ast.AST]:
+        """The AST node of the direct site at the end of ``chain``."""
+        owner = self.chain(key, effect)[-1]
+        return self.direct.get(owner, {}).get(effect)
+
+    def chain_str(self, key: FnKey, effect: Effect) -> str:
+        qn = self.index.functions
+        return " -> ".join(qn[k].qualname if k in qn else k[1]
+                           for k in self.chain(key, effect))
+
+    # -- forward reachability (R1's hot surfaces) -------------------------
+    def reach_from(self, root_names: FrozenSet[str],
+                   block: FrozenSet[str] = frozenset()
+                   ) -> Dict[FnKey, Optional[FnKey]]:
+        """BFS parent map over the call graph from every function whose
+        NAME is in ``root_names``: reached key -> predecessor key (None
+        for the roots themselves). Functions named in ``block`` are never
+        entered (boundary functions that run off the per-iteration path).
+        Deterministic order; cached per (roots, block)."""
+        cache_key = (root_names, block)
+        cached = self._reach_cache.get(cache_key)
+        if cached is not None:
+            return cached
+        parent: Dict[FnKey, Optional[FnKey]] = {}
+        frontier: List[FnKey] = []
+        for key in sorted(self.index.functions):
+            if self.index.functions[key].name in root_names:
+                parent[key] = None
+                frontier.append(key)
+        while frontier:
+            nxt: List[FnKey] = []
+            for key in frontier:
+                fi = self.index.functions[key]
+                for _call, callee in fi.resolved_calls:
+                    if callee.key not in parent \
+                            and callee.name not in block:
+                        parent[callee.key] = key
+                        nxt.append(callee.key)
+            frontier = nxt
+        self._reach_cache[cache_key] = parent
+        return parent
+
+    def path_from_root(self, parent: Dict[FnKey, Optional[FnKey]],
+                       key: FnKey) -> List[str]:
+        """Qualnames from the root that reaches ``key`` down to ``key``."""
+        chain: List[FnKey] = []
+        cur: Optional[FnKey] = key
+        while cur is not None:
+            chain.append(cur)
+            cur = parent.get(cur)
+        chain.reverse()
+        fns = self.index.functions
+        return [fns[k].qualname if k in fns else k[1] for k in chain]
+
+
+def get_effects(index: PackageIndex) -> EffectAnalysis:
+    """The per-index cached analysis (rules share one computation)."""
+    cached = getattr(index, "_effect_analysis", None)
+    if cached is None:
+        cached = EffectAnalysis(index)
+        index._effect_analysis = cached
+    return cached

@@ -10,6 +10,7 @@ from __future__ import annotations
 from . import (r1_host_sync, r2_recompile, r3_clamped_slice,  # noqa: F401
                r4_dtype_drift, r5_lock_discipline, r6_collective_axis,
                r7_unsynced_timing, r8_future_discipline, r9_lock_order,
-               r10_sharding_registry, r11_config_drift)
+               r10_sharding_registry, r11_config_drift, r12_composition,
+               r13_wire_drift, r14_dead_suppression)
 
 from ..core import all_rules  # noqa: F401  (re-export for convenience)

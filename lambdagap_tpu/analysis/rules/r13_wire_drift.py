@@ -1,0 +1,236 @@
+"""R13: wire-protocol drift — the serve wire surfaces must stay in
+bijection.
+
+The newline-JSON wire protocol now spans four code surfaces and one doc:
+``_Conn._op_<verb>`` handlers (server side), ``FrontendClient`` ops
+(caller side), the exception kind-map ``_KINDS`` (error fidelity across
+the wire), the ``serve_loop`` text verbs (the ``task=serve`` CLI), and
+the ``docs/serving.md`` wire/line-protocol tables. PRs 9/12/13 each grew
+the protocol (stats reservoirs, prometheus fleet, signals, swap_delta,
+prefetch) and every addition had to remember every surface by hand — the
+divergent-surface bug class PR 10 caught by luck. R13 makes the bijection
+a scan invariant:
+
+- **R13a — handler/client bijection** (any module defining BOTH
+  surfaces): an ``_op_X`` handler with no client method sending op
+  ``"X"`` is unreachable from the shipped caller; a client op with no
+  handler answers ``unknown op`` at runtime. Both directions are
+  findings, anchored at the orphan.
+- **R13b — docs drift** (the real ``serve/frontend.py`` only): every
+  handler verb must appear as a ``{"op": "<verb>"}`` frame in
+  ``docs/serving.md``, and every documented frame must have a handler.
+  The doc is located by walking up from the scanned file (works from any
+  scan root; silently skipped when absent, e.g. fixture trees copied
+  elsewhere).
+- **R13c — kind-map coverage** (the real ``serve/frontend.py``, when
+  ``guard/degrade.py`` is in the scanned set): every exception class the
+  degradation layer defines must have a row in ``_KINDS`` — an unmapped
+  class degrades to ``RuntimeError`` client-side, and the router's
+  class-dispatched failover logic silently stops matching it.
+- **R13d — serve_loop doc coverage** (the real ``serve/server.py``):
+  every text verb ``serve_loop`` dispatches on must appear in the
+  ``docs/serving.md`` line-protocol table.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set
+
+from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
+                    register_rule)
+
+_DOC_OP_RE = re.compile(r'\{\s*"op"\s*:\s*"(\w+)"')
+_DOC_VERB_ROW_RE = re.compile(r"^\|\s*`([a-z][a-z_ ]*?)[=`]")
+
+# builtin/exception bases that mark a class as an exception type
+_EXC_BASES = frozenset({
+    "Exception", "RuntimeError", "ValueError", "KeyError", "OSError",
+    "TimeoutError", "ConnectionError", "IOError", "BaseException",
+})
+
+
+def _find_doc(start_path: str, name: str = "serving.md"
+              ) -> Optional[str]:
+    """Walk up from a scanned file looking for docs/<name>."""
+    cur = os.path.dirname(os.path.abspath(start_path))
+    for _ in range(8):
+        cand = os.path.join(cur, "docs", name)
+        if os.path.isfile(cand):
+            return cand
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            break
+        cur = nxt
+    return None
+
+
+def _handler_ops(ctx: ModuleContext) -> Dict[str, ast.AST]:
+    """verb -> def node for every ``_op_<verb>`` method in the module."""
+    out: Dict[str, ast.AST] = {}
+    for node in ctx.nodes(ast.FunctionDef, ast.AsyncFunctionDef):
+        if node.name.startswith("_op_") and ctx.enclosing_class(node):
+            out.setdefault(node.name[len("_op_"):], node)
+    return out
+
+
+def _client_ops(ctx: ModuleContext) -> Dict[str, ast.AST]:
+    """verb -> node for every op a client in this module sends: literal
+    ``{"op": "<verb>"}`` frames and ``self._call("<verb>", ...)``."""
+    out: Dict[str, ast.AST] = {}
+    for node in ctx.nodes(ast.Dict):
+        for k, v in zip(node.keys, node.values):
+            if (isinstance(k, ast.Constant) and k.value == "op"
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                out.setdefault(v.value, node)
+    for node in ctx.nodes(ast.Call):
+        if call_name(node).rsplit(".", 1)[-1] == "_call" and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            out.setdefault(node.args[0].value, node)
+    return out
+
+
+def _kind_map_keys(ctx: ModuleContext) -> Optional[Set[str]]:
+    """Keys of the module-level ``_KINDS`` wire kind-map, if present."""
+    for node in ctx.nodes(ast.Assign):
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_KINDS"
+                and isinstance(node.value, ast.Dict)):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return None
+
+
+def _degrade_exceptions(index: PackageIndex) -> List[str]:
+    """Exception classes declared by the degradation layer (classes in
+    guard/degrade.py with an exception base)."""
+    out = []
+    for name, decls in index.classes.items():
+        for rel, node in decls:
+            if not rel.endswith("guard/degrade.py"):
+                continue
+            bases = {b.id for b in node.bases if isinstance(b, ast.Name)}
+            if bases & _EXC_BASES or any(b.endswith("Error")
+                                         for b in bases):
+                out.append(name)
+    return sorted(out)
+
+
+def _serve_loop_verbs(ctx: ModuleContext) -> Dict[str, ast.AST]:
+    """Text verbs serve_loop dispatches on: ``line == "<verb>"`` compares
+    and ``line.startswith("<verb>=")`` guards, keyed by first token."""
+    loop = None
+    for node in ctx.nodes(ast.FunctionDef):
+        if node.name == "serve_loop":
+            loop = node
+            break
+    if loop is None:
+        return {}
+    out: Dict[str, ast.AST] = {}
+
+    def token(s: str) -> str:
+        return s.split("=", 1)[0].split(" ", 1)[0]
+
+    for sub in ast.walk(loop):
+        if isinstance(sub, ast.Compare):
+            for comp in sub.comparators:
+                if (isinstance(comp, ast.Constant)
+                        and isinstance(comp.value, str) and comp.value
+                        and comp.value[0].isalpha()):
+                    out.setdefault(token(comp.value), sub)
+        elif (isinstance(sub, ast.Call)
+                and call_name(sub).endswith(".startswith") and sub.args
+                and isinstance(sub.args[0], ast.Constant)
+                and isinstance(sub.args[0].value, str)
+                and sub.args[0].value[:1].isalpha()):
+            out.setdefault(token(sub.args[0].value), sub)
+    return out
+
+
+@register_rule
+class WireDriftRule(Rule):
+    id = "R13"
+    severity = "error"
+    description = ("wire-protocol drift: frontend handlers, client ops, "
+                   "the exception kind-map, serve_loop verbs, and the "
+                   "docs/serving.md tables must stay in bijection")
+    path_filter = ("/serve/",)
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        handlers = _handler_ops(ctx)
+        clients = _client_ops(ctx)
+        # R13a: handler <-> client bijection inside one module
+        if handlers and clients:
+            for verb in sorted(set(handlers) - set(clients)):
+                yield ctx.finding(
+                    self, handlers[verb],
+                    f"wire op '{verb}' has a server handler (_op_{verb}) "
+                    f"but no client method sends it — the shipped caller "
+                    f"cannot reach it; add the FrontendClient method or "
+                    f"delete the dead verb")
+            for verb in sorted(set(clients) - set(handlers)):
+                yield ctx.finding(
+                    self, clients[verb],
+                    f"client sends wire op '{verb}' but no _op_{verb} "
+                    f"handler exists — the frame answers 'unknown op' at "
+                    f"runtime; add the handler or drop the call")
+        is_frontend = ctx.relpath.endswith("frontend.py") and handlers
+        if is_frontend:
+            doc = _find_doc(ctx.path)
+            if doc is not None:
+                with open(doc, "r", encoding="utf-8") as f:
+                    doc_text = f.read()
+                doc_ops = set(_DOC_OP_RE.findall(doc_text))
+                for verb in sorted(set(handlers) - doc_ops):
+                    yield ctx.finding(
+                        self, handlers[verb],
+                        f"wire op '{verb}' is not documented: no "
+                        f'{{"op": "{verb}"}} frame appears in '
+                        f"docs/serving.md's wire-protocol section — add "
+                        f"the frame example (every verb a client can "
+                        f"send must be in the wire table)")
+                for verb in sorted(doc_ops - set(handlers)):
+                    yield ctx.finding(
+                        self, ctx.tree,
+                        f"docs/serving.md documents wire op '{verb}' but "
+                        f"the frontend has no _op_{verb} handler — stale "
+                        f"docs or a dropped verb; reconcile the table")
+            kinds = _kind_map_keys(ctx)
+            if kinds is not None:
+                for cls in _degrade_exceptions(index):
+                    if cls not in kinds:
+                        yield ctx.finding(
+                            self, ctx.tree,
+                            f"exception class '{cls}' "
+                            f"(guard/degrade.py) is absent from the wire "
+                            f"kind-map _KINDS: a remote {cls} degrades "
+                            f"to RuntimeError client-side and "
+                            f"class-dispatched handling (router "
+                            f"failover, loadgen accounting) silently "
+                            f"stops matching it")
+        # R13d: serve_loop text verbs documented in the line-protocol table
+        if ctx.relpath.endswith("serve/server.py"):
+            verbs = _serve_loop_verbs(ctx)
+            if verbs:
+                doc = _find_doc(ctx.path)
+                if doc is not None:
+                    with open(doc, "r", encoding="utf-8") as f:
+                        doc_rows = {
+                            m.group(1).split("=", 1)[0].split(" ", 1)[0]
+                            for m in (_DOC_VERB_ROW_RE.match(l)
+                                      for l in f.read().splitlines())
+                            if m}
+                    for verb in sorted(set(verbs) - doc_rows):
+                        yield ctx.finding(
+                            self, verbs[verb],
+                            f"serve_loop dispatches on text verb "
+                            f"'{verb}' but docs/serving.md's "
+                            f"line-protocol table has no `{verb}` row — "
+                            f"document it (the CLI surface and the doc "
+                            f"table must not diverge)")

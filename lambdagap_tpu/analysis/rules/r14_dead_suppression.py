@@ -1,0 +1,74 @@
+"""R14: dead suppressions and stale grandfathered findings.
+
+The suppression and baseline surfaces exist so a human can say "this
+finding is understood, here is why" — but both rot silently:
+
+- **R14a — inert inline suppressions.** A ``# graftlint: disable=RULE``
+  comment whose rule list suppresses NOTHING (the rule never fires on the
+  covered statement) is worse than dead weight: it documents a hazard
+  that is not there, and it will silently absorb a FUTURE finding of that
+  rule at that site — the one place a new hazard is guaranteed to go
+  unreported. PR 10 found exactly this class by hand: the frontend's
+  ``disable=R5`` comments were inert (R5's name heuristic never saw the
+  ``_tx`` lock), so the justification text was attached to a rule that
+  was not looking. Every suppression comment now proves its keep on every
+  scan.
+- **R14b — stale baseline entries** (CLI layer, ``cli.py``): a baseline
+  entry whose finding no longer exists used to print a stderr warning and
+  exit 0 — inert by the same logic. Stale entries are now R14 findings:
+  the scan fails until ``--write-baseline`` prunes them, so the
+  checked-in baseline can never drift away from the tree it grandfathers.
+
+R14a runs as a **post-check**: the engine records, for every finding any
+rule produced, which suppression comment absorbed it
+(``ModuleContext.used_suppressions``); only after every ordinary rule has
+run over every module does R14 know which comments never fired. A
+suppression naming a rule that was NOT run this scan (``--select``/
+``--disable``) is never reported — absence of evidence only counts when
+the rule actually looked.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Set
+
+from ..core import (Finding, ModuleContext, PackageIndex, Rule,
+                    register_rule)
+
+
+@register_rule
+class DeadSuppressionRule(Rule):
+    id = "R14"
+    severity = "error"
+    description = ("dead suppression surface: an inline 'graftlint: "
+                   "disable' comment that suppresses nothing, or (CLI) a "
+                   "baseline entry whose finding no longer exists")
+
+    def check(self, ctx: ModuleContext, index: PackageIndex
+              ) -> Iterator[Finding]:
+        return iter(())                  # all work happens post-check
+
+    def post_check(self, ctx: ModuleContext, index: PackageIndex,
+                   executed_rules: Set[str]) -> Iterator[Finding]:
+        for (line, rules, file_level) in ctx.suppression_sites:
+            for rule_id in sorted(rules):
+                if rule_id == "ALL":
+                    used = any(o == line for (_r, o)
+                               in ctx.used_suppressions)
+                    if used:
+                        continue
+                elif rule_id not in executed_rules:
+                    continue             # the rule never looked this scan
+                elif (rule_id, line) in ctx.used_suppressions:
+                    continue
+                scope = "file-wide" if file_level else "next statement"
+                finding = Finding(
+                    rule=self.id, path=ctx.relpath, line=line, col=0,
+                    message=(f"inert suppression: 'graftlint: "
+                             f"disable{'-file' if file_level else ''}="
+                             f"{rule_id}' ({scope}) suppresses nothing — "
+                             f"{rule_id} does not fire here; delete the "
+                             f"comment (or fix the rule id) so it cannot "
+                             f"silently absorb a future {rule_id} finding "
+                             f"at this site"),
+                    severity=self.severity, snippet=ctx.line_at(line))
+                yield finding

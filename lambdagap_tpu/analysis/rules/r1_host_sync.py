@@ -12,11 +12,15 @@ Heuristic hot contexts:
 - any function whose name is in :data:`HOT_FUNCTIONS` (the boosting loop,
   gradient computation, score update, serve dispatch, and tensorized
   predict surfaces), at any nesting depth;
-- any function a HOT function *directly calls* (resolved through the
-  semantic index's call graph: ``self`` methods, same-module functions,
-  imported names) — a host-sync helper extracted into a cold file is
-  still one sync per iteration when ``train_one_iter`` calls it, which
-  per-file linting could never see;
+- any function a HOT function *reaches through the call graph at ANY
+  depth* (ISSUE 14: resolved through the semantic index — ``self``
+  methods, constructor-typed attributes, same-module functions, imported
+  names — and propagated transitively by ``analysis/effects.py``) — a
+  host-sync helper extracted into a cold file is still one sync per
+  iteration when ``train_one_iter`` calls it through two intermediate
+  frames, which one-hop resolution could never see. The finding carries
+  the full provenance chain (``train_one_iter -> _stage -> helper``), so
+  the reader never has to reconstruct the reach by hand;
 - any for/while loop body inside a :data:`HOT_PATHS` file — ``serve/``
   (the request path), ``ops/predict_tensor.py`` (the inference hot
   path: its tile loop runs once per ``predict_tree_tile`` trees per
@@ -43,8 +47,9 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
+from ..core import (Finding, ModuleContext, PackageIndex, Rule,
                     register_rule)
+from ..effects import get_effects, sync_kind
 
 # the per-iteration / per-dispatch surfaces of this codebase
 HOT_FUNCTIONS = frozenset({
@@ -88,32 +93,18 @@ HOT_FUNCTIONS = frozenset({
 HOT_PATHS = ("/serve/", "/ops/predict_tensor", "/ops/hist_pallas",
              "/data/stream", "/ops/linear", "/obs/trace", "/obs/fleet")
 
-_JAXISH = ("jax.", "jnp.", "lax.")
+# the sync classifier moved to analysis/effects.py (shared with the
+# transitive effect inference); this alias keeps the historical name
+_sync_kind = sync_kind
 
-
-def _is_jaxish_call(node: ast.AST) -> bool:
-    return (isinstance(node, ast.Call)
-            and (call_name(node).startswith(_JAXISH)
-                 or call_name(node) in ("device_get",)))
-
-
-def _sync_kind(call: ast.Call) -> str:
-    """Classify a call as a host-sync; '' when it is not one."""
-    name = call_name(call)
-    tail = name.rsplit(".", 1)[-1]
-    if tail == "device_get":
-        return "jax.device_get"
-    if tail in ("item", "block_until_ready") and not call.args:
-        return f".{tail}()"
-    if name in ("float", "int") and len(call.args) == 1:
-        arg = call.args[0]
-        if _is_jaxish_call(arg) and _sync_kind(arg) == "":
-            return f"{name}() over a device value"
-    if tail in ("asarray", "array") and name.startswith("np.") and call.args:
-        arg = call.args[0]
-        if _is_jaxish_call(arg) and _sync_kind(arg) == "":
-            return f"{name}() over a device value"
-    return ""
+# functions chains may NOT pass through when propagating hotness: these
+# run once per train()/save call at the boundary, not once per iteration
+# — routing hotness through them would charge the whole cold half of the
+# package to the boosting loop (model text IO, plotting, repr)
+_BOUNDARY_FUNCTIONS = frozenset({
+    "save_model", "model_to_string", "dump_model", "model_from_string",
+    "load_model", "__repr__", "__str__", "__del__", "close",
+})
 
 
 @register_rule
@@ -127,31 +118,39 @@ class HostSyncRule(Rule):
     def check(self, ctx: ModuleContext, index: PackageIndex
               ) -> Iterator[Finding]:
         in_hot_path = any(p in ("/" + ctx.relpath) for p in HOT_PATHS)
+        ana = get_effects(index)
+        reach = ana.reach_from(HOT_FUNCTIONS, block=_BOUNDARY_FUNCTIONS)
         for node in ctx.nodes(ast.Call):
             kind = _sync_kind(node)
             if not kind:
                 continue
             funcs = ctx.enclosing_functions(node)
             hot = any(f.name in HOT_FUNCTIONS for f in funcs)
-            hot_caller = None
+            chain = None
             if not hot and in_hot_path and funcs:
                 hot = ctx.in_loop(node)
             if not hot and funcs:
-                hot_caller = self._hot_caller(ctx, index, node)
-                hot = hot_caller is not None
+                fi = index.function_of(ctx, node)
+                if fi is not None and fi.name not in HOT_FUNCTIONS \
+                        and fi.key in reach:
+                    chain = ana.path_from_root(reach, fi.key)
+                    hot = True
             if not hot:
                 continue
             where = funcs[0].name if funcs else "<module>"
-            if hot_caller is not None:
+            if chain is not None:
+                hops = len(chain) - 1
                 yield ctx.finding(
                     self, node,
                     f"{kind} blocks the host on the device stream inside "
-                    f"'{where}', which hot function '{hot_caller}' calls "
-                    f"(call-graph reach: the helper lives in a cold file "
-                    f"but runs once per iteration/dispatch); hoist the "
-                    f"sync out of the per-iteration path, keep the value "
-                    f"on device, or suppress with a justification if the "
-                    f"sync is inherent")
+                    f"'{where}', which hot function '{chain[0]}' calls "
+                    f"(transitive call-graph reach, {hops} "
+                    f"hop{'s' if hops != 1 else ''}: "
+                    f"{' -> '.join(chain)} — the helper lives in a cold "
+                    f"file but runs once per iteration/dispatch); hoist "
+                    f"the sync out of the per-iteration path, keep the "
+                    f"value on device, or suppress with a justification "
+                    f"if the sync is inherent")
             else:
                 yield ctx.finding(
                     self, node,
@@ -160,19 +159,3 @@ class HostSyncRule(Rule):
                     f"per-iteration path, keep the value on device, or "
                     f"suppress with a justification if the sync is "
                     f"inherent")
-
-    @staticmethod
-    def _hot_caller(ctx: ModuleContext, index: PackageIndex,
-                    node: ast.AST):
-        """The name of a HOT_FUNCTIONS function that directly calls the
-        indexed function enclosing ``node``, or None. One level through
-        the call graph — the ISSUE-10 retarget: a host-sync helper called
-        from a hot path is hot even when it lives in a cold file."""
-        fi = index.function_of(ctx, node)
-        if fi is None or fi.name in HOT_FUNCTIONS:
-            return None
-        for caller_key in index.callers.get(fi.key, ()):
-            caller = index.functions.get(caller_key)
-            if caller is not None and caller.name in HOT_FUNCTIONS:
-                return caller.qualname
-        return None

@@ -26,37 +26,20 @@ from typing import Dict, Iterator, List, Set, Tuple
 
 from ..core import (Finding, ModuleContext, PackageIndex, Rule, call_name,
                     dotted_name, register_rule)
+from ..effects import BLOCKING_METHODS, QUEUEISH, blocking_kind
 
-# method names that block the calling thread. "sendall" joined when the
-# socket frontend landed: a frame write under the connection's tx mutex
-# convoys every batcher callback replying on that connection exactly like
-# "send" does, and the frontend's two deliberate sites carry written
-# justifications
-_BLOCKING_METHODS = frozenset({
-    "result", "join", "wait", "sleep", "block_until_ready",
-    "device_get", "device_put", "warm", "_build", "recv", "send",
-    "sendall", "acquire",
-})
-# .get()/.put() only block on queue-ish receivers
-_QUEUEISH = ("q", "queue", "_q", "_queue")
+# the blocking-call classifier lives in analysis/effects.py since ISSUE 14
+# (shared with the transitive effect inference, so R5, R9 and the effect
+# sets can never disagree about what "blocking" means); these aliases keep
+# the historical names importable
+_BLOCKING_METHODS = BLOCKING_METHODS
+_QUEUEISH = QUEUEISH
+_blocking_kind = blocking_kind
 
 
 def _is_lock_expr(node: ast.AST) -> bool:
     name = dotted_name(node).lower()
     return "lock" in name
-
-
-def _blocking_kind(call: ast.Call) -> str:
-    name = call_name(call)
-    tail = name.rsplit(".", 1)[-1]
-    if tail in _BLOCKING_METHODS:
-        return name
-    if tail in ("get", "put"):
-        recv = name.rsplit(".", 2)
-        if len(recv) >= 2 and any(recv[-2].lower().endswith(q)
-                                  for q in _QUEUEISH):
-            return name
-    return ""
 
 
 def _lock_attrs(cls: ast.ClassDef) -> Set[str]:

@@ -38,32 +38,28 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 from ..core import (Finding, FunctionInfo, ModuleContext, PackageIndex,
                     Rule, call_name, dotted_name, register_rule)
-from .r5_lock_discipline import _BLOCKING_METHODS, _QUEUEISH, _is_lock_expr
+from ..effects import COND_VERBS, blocking_kind, get_effects
+from .r5_lock_discipline import _is_lock_expr
 
 LockId = Tuple[str, str]
 
 # condition-variable verbs on the held lock itself: wait RELEASES the lock,
-# notify never blocks — the canonical pattern, not a hazard
-_COND_VERBS = frozenset({"wait", "notify", "notify_all"})
+# notify never blocks — the canonical pattern, not a hazard (classifier
+# shared with analysis/effects.py, which applies the same exemption at
+# direct-effect extraction so it stays correct at every propagation depth)
+_COND_VERBS = COND_VERBS
+_blocking_kind = blocking_kind
 
 
 def _fmt_lock(lock: LockId) -> str:
     return f"{lock[0]}.{lock[1]}"
 
 
-def _blocking_kind(call: ast.Call) -> str:
-    """R5's blocking-call classifier (shared so the two rules never
-    disagree about what 'blocking' means)."""
-    name = call_name(call)
-    tail = name.rsplit(".", 1)[-1]
-    if tail in _BLOCKING_METHODS:
-        return name
-    if tail in ("get", "put"):
-        recv = name.rsplit(".", 2)
-        if len(recv) >= 2 and any(recv[-2].lower().endswith(q)
-                                  for q in _QUEUEISH):
-            return name
-    return ""
+def _parse_lock_detail(detail: str) -> LockId:
+    """Inverse of the ("acquires", "Owner.attr") effect detail encoding
+    (owner may itself contain dots — module-path lock owners)."""
+    owner, _, attr = detail.rpartition(".")
+    return (owner, attr)
 
 
 class _Edge:
@@ -85,10 +81,11 @@ class _Analysis:
     def __init__(self, index: PackageIndex) -> None:
         self.edges: List[_Edge] = []
         self.blocking: List[Tuple[str, ast.AST, str]] = []  # rel, node, msg
+        self._effects = get_effects(index)
         for fi in index.functions.values():
             # the graph spans serve/ (the issue's concurrency surface);
             # callees OUTSIDE serve/ still contribute when called from it,
-            # via FunctionInfo.acquires in _check_call
+            # via the transitive effect sets in _check_call
             if "/serve/" in "/" + fi.relpath:
                 self._analyze(index, fi)
         graph: Dict[LockId, Set[LockId]] = {}
@@ -155,37 +152,41 @@ class _Analysis:
         recv = name.rsplit(".", 1)[0] if "." in name else ""
         callee = callee_of.get(id(call))
         if callee is not None:
-            # one level through the call graph: locks the callee acquires
-            for (inner, _w) in callee.acquires:
+            ana = self._effects
+            # arbitrary depth through the call graph (ISSUE 14): every
+            # lock identity in the callee's TRANSITIVE effect set is
+            # acquired somewhere downstream of this call while `held` is
+            # held — each contributes an acquisition-graph edge with its
+            # provenance chain
+            for eff in ana.effects_of(callee.key, "acquires"):
+                inner = _parse_lock_detail(eff[1])
                 if inner != held:
+                    chain = [fi.qualname] + [
+                        index.functions[k].qualname
+                        for k in ana.chain(callee.key, eff)
+                        if k in index.functions]
                     self.edges.append(_Edge(
                         held, inner, fi.relpath, call,
-                        f"{fi.qualname} -> {callee.qualname}"))
-            # ... and blocking work it performs
-            for sub in ast.walk(callee.node):
-                if not isinstance(sub, ast.Call):
-                    continue
-                kind = _blocking_kind(sub)
-                if not kind:
-                    continue
-                sub_name = call_name(sub)
-                sub_recv = sub_name.rsplit(".", 1)[0] \
-                    if "." in sub_name else ""
-                # the callee's own condition-wait on a lock it holds is
-                # its own (legitimate) pattern, not this caller's hazard
-                if (sub_name.rsplit(".", 1)[-1] in _COND_VERBS
-                        and isinstance(sub.func, ast.Attribute)):
-                    cid = index.lock_identity(callee, sub.func.value)
-                    if cid is not None and any(
-                            cid == a for a, _ in callee.acquires):
-                        continue
+                        " -> ".join(chain)))
+            # ... and blocking work reachable at any depth (the direct
+            # extraction already exempted each owner's own cond-wait)
+            for eff in ana.effects_of(callee.key, "blocking"):
+                chain_keys = ana.chain(callee.key, eff)
+                owner = index.functions.get(chain_keys[-1])
+                owner_name = owner.qualname if owner else chain_keys[-1][1]
+                hops = len(chain_keys)
+                chain = " -> ".join(
+                    [fi.qualname]
+                    + [index.functions[k].qualname
+                       for k in chain_keys if k in index.functions])
                 self.blocking.append((
                     fi.relpath, call,
-                    f"blocking call {kind}(...) inside "
-                    f"{callee.qualname}() is reachable while "
-                    f"'{fi.qualname}' holds {_fmt_lock(held)} (one call "
-                    f"away — outside R5's lexical scope); move the "
-                    f"blocking work out of the critical section"))
+                    f"blocking call {eff[1]}(...) inside {owner_name}() "
+                    f"is reachable while '{fi.qualname}' holds "
+                    f"{_fmt_lock(held)} ({hops} call"
+                    f"{'s' if hops != 1 else ''} away — outside R5's "
+                    f"lexical scope; reach: {chain}); move the blocking "
+                    f"work out of the critical section"))
                 break                    # one finding per call site
         elif not r5_covers:
             # lexical blocking call under an identity-resolved lock whose
@@ -229,7 +230,8 @@ class LockOrderRule(Rule):
             cycle = ana.cyclic_edges.get(id(e))
             if cycle is None:
                 continue
-            loop = " -> ".join(_fmt_lock(l) for l in cycle + [cycle[0]])
+            # cycle already closes on its first lock ([A, ..., A])
+            loop = " -> ".join(_fmt_lock(l) for l in cycle)
             yield ctx.finding(
                 self, e.node,
                 f"lock-order cycle: acquiring {_fmt_lock(e.dst)} while "

@@ -237,8 +237,8 @@ class GBDT:
                     "with the fused data-parallel learner (full-histogram "
                     "psum per split) so forced splits apply", tl)
         if _cegb_requested(self.config):
-            log.warning("cegb is not applied by the fused data-parallel "
-                        "learner")
+            log.warning("cegb (cegb_tradeoff) is not applied by the fused "
+                        "tree_learner=data learner")
         from ..parallel.fused_parallel import FusedDataParallelTreeLearner
         return FusedDataParallelTreeLearner(ds, self.config)
 
@@ -261,7 +261,8 @@ class GBDT:
                           "tree_learner=data (got %r)", tl)
             if cfg.linear_tree:
                 log.warning("linear_tree is not supported with "
-                            "pre-partitioned training; training "
+                            "pre_partition=true (pre-partitioned "
+                            "multi-process training); training "
                             "constant-leaf trees")
                 cfg.linear_tree = False
             _demote_advanced_monotone(
@@ -270,8 +271,8 @@ class GBDT:
             if _cegb_requested(cfg):
                 not_applied.append("cegb")
             if not_applied:
-                log.warning("%s are not applied by pre-partitioned training",
-                            ", ".join(not_applied))
+                log.warning("%s are not applied by pre_partition=true "
+                            "training", ", ".join(not_applied))
             from ..parallel.fused_parallel import FusedDataParallelTreeLearner
             return FusedDataParallelTreeLearner(ds, self.config)
         if tl == "serial":
@@ -386,7 +387,8 @@ class GBDT:
             if cfg.forcedsplits_filename:
                 return self._forced_splits_data_parallel(ds, tl)
             if _cegb_requested(cfg):
-                log.warning("cegb is not applied by tree_learner=feature")
+                log.warning("cegb (cegb_tradeoff) is not applied by "
+                            "tree_learner=feature")
             from ..parallel.fused_parallel import \
                 FusedFeatureParallelTreeLearner
             return FusedFeatureParallelTreeLearner(ds, self.config)
@@ -443,8 +445,10 @@ class GBDT:
         kill-and-resume byte-identity (the PR 6 drift class)."""
         from .tree import linear_leaf_outputs
         K = self.num_tree_per_iteration
-        # graftlint: disable=R1 — one leaf-index fetch for the whole
-        # forest being replayed (resume/valid attach), not per iteration
+        # one leaf-index fetch for the whole forest being replayed
+        # (resume/valid attach — no hot function reaches this path, so R1
+        # never fired here; the suppression this comment used to carry was
+        # inert from birth and R14 flagged it)
         leaf_T = np.asarray(jax.device_get(dispatch_forest_leaf(
             self.config, binned, forest, depth, binned=True)))
         for i, t in enumerate(trees):

@@ -274,18 +274,21 @@ class SerialTreeLearner:
             if mode == "stream" or sharded:
                 # LOUD fallback (warning, not info): silently training a
                 # requested-stream distributed run device-resident would
-                # hide an OOM footprint the caller sized for streaming
-                log.warning("data_residency=stream is not supported by %s "
-                            "(distributed learners keep their device "
+                # hide an OOM footprint the caller sized for streaming.
+                # Both axes named (R12b): the demoted knob AND the
+                # tree_learner value that forced the demotion
+                log.warning("data_residency=stream is not supported with "
+                            "tree_learner=%s (%s keeps its device "
                             "matrices resident); falling back to "
-                            "data_residency=hbm", type(self).__name__)
+                            "data_residency=hbm", config.tree_learner,
+                            type(self).__name__)
             return "hbm"
-        blockers = self._stream_blockers(config)
-        if blockers:
+        blocker_knobs = self._stream_blockers(config)
+        if blocker_knobs:
             if mode == "stream" or sharded:
                 log.warning("data_residency=stream does not support %s; "
                             "training device-resident",
-                            ", ".join(blockers))
+                            ", ".join(blocker_knobs))
             return "hbm"
         if mode == "stream" or sharded:
             return "stream"
@@ -328,8 +331,9 @@ class SerialTreeLearner:
         layout = config.tree_layout
         if not self.supports_sorted_layout:
             if layout == "sorted":
-                log.info("tree_layout=sorted is not supported by %s; using "
-                         "the gather layout", type(self).__name__)
+                log.info("tree_layout=sorted is not supported with "
+                         "tree_learner=%s (%s); using the gather layout",
+                         config.tree_learner, type(self).__name__)
             return "gather"
         if layout == "auto":
             return "sorted" if self.num_data >= (1 << 20) else "gather"

@@ -211,8 +211,9 @@ class DataParallelTreeLearner(SerialTreeLearner):
         if self.forced_json is not None:
             from ..utils import log
             log.warning("forcedsplits_filename is not supported by the "
-                        "host-loop data/voting-parallel learners (use the "
-                        "fused data-parallel learner); forced splits ignored")
+                        "host-loop tree_learner=data/voting learners (use "
+                        "the fused data-parallel learner); forced splits "
+                        "ignored")
             self.forced_json = None
         num_leaves = cfg.num_leaves
         max_depth = cfg.max_depth

@@ -60,8 +60,14 @@ from ..obs import trace as obs_trace
 from ..utils import log
 
 # wire error kinds <-> exception classes (client re-raises the real type,
-# so router/loadgen accounting is identical for local and remote replicas)
+# so router/loadgen accounting is identical for local and remote replicas).
+# graftlint R13 enforces that every guard/degrade.py exception class has a
+# row here: an unmapped class would degrade to RuntimeError client-side
+# and the router's class-dispatched failover would silently stop matching
+# it (ReplicaUnavailable was exactly that gap — a replica fronting an
+# all-dead fleet answered RuntimeError instead of the failover trigger)
 _KINDS = {
+    "ReplicaUnavailable": ReplicaUnavailable,
     "ServeOverloaded": ServeOverloaded,
     "ServeTimeout": ServeTimeout,
     "SwapFailed": SwapFailed,
@@ -94,12 +100,13 @@ class _Conn:
         try:
             with self._tx:
                 if self._open:
-                    # graftlint: disable=R5,R9 — deliberate: frames must not
+                    # graftlint: disable=R9 — deliberate: frames must not
                     # interleave, so mutual exclusion must span the whole
                     # write; frames are small, the socket is loopback-class,
-                    # and the only contenders are this conn's reply callbacks
-                    # (R9 resolves _tx to a real threading.Lock identity
-                    # that R5's name heuristic never saw)
+                    # and the only contenders are this conn's reply callbacks.
+                    # (R9 resolves _tx to a real threading.Lock identity that
+                    # R5's name heuristic never sees — the old disable=R5
+                    # here was inert, the R14 dead-suppression class)
                     self.sock.sendall(data)
         except OSError:
             # client went away mid-response; its futures already resolved
@@ -372,10 +379,11 @@ class FrontendClient:
         data = (json.dumps(frame) + "\n").encode()
         try:
             with self._tx:
-                # graftlint: disable=R5,R9 — deliberate, mirror of
+                # graftlint: disable=R9 — deliberate, mirror of
                 # _Conn.send: whole-frame writes must not interleave, and
                 # the submit path is the only contender on this mutex
-                # (R9 sees the _tx lock identity R5's name heuristic missed)
+                # (R9 sees the _tx lock identity; R5's name heuristic never
+                # does, so the old disable=R5 here was inert — R14 class)
                 self.sock.sendall(data)
         except OSError as e:
             self._die(e)

@@ -14,3 +14,10 @@ class Config:
     alpha_rate: float = 0.1
     beta_window: int = 64  # BAD:R11 — declared but never read anywhere
     legacy_knob: int = 0   # accepted-but-inert: exempt via COMPAT_ACCEPTED
+    # composition axes read by the r12_combos fixture (this file is the
+    # fixture tree's one Config, so axis knobs must be declared here or
+    # R11b would flag the R12 fixture's reads as typos)
+    linear_tree: bool = False
+    use_quantized_grad: bool = False
+    data_residency: str = "auto"
+    tree_layout: str = "auto"

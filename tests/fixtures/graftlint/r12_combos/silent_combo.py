@@ -1,0 +1,33 @@
+"""R12 fixture (ISSUE 14): the silent-combo module.
+
+Two demotion shapes the composition-matrix rule must catch:
+
+- a feature-axis knob rewritten inside a branch with NO warning/raise —
+  the caller asked for ``tree_layout=sorted`` under quantized gradients
+  and silently got the gather layout (the exact shape that hid the
+  stream x quantized and linear x quantized degradations before PRs 7/11
+  made them loud);
+- a demotion warning that names only ONE of the two axes — the reader of
+  the log line cannot tell which combination forced the fallback.
+
+The compliant shape at the bottom (warning naming both knobs, then the
+write) must scan clean.
+"""
+
+
+def resolve_combo(cfg):
+    if cfg.use_quantized_grad and cfg.tree_layout == "sorted":
+        cfg.tree_layout = "gather"  # BAD:R12 — silent demotion, no warning
+    if cfg.linear_tree and cfg.data_residency == "stream":
+        log.warning("linear_tree does not "  # BAD:R12 — one knob named
+                    "support streaming input; falling back")
+        cfg.data_residency = "hbm"
+    return cfg
+
+
+def resolve_loudly(cfg):
+    if cfg.linear_tree and cfg.use_quantized_grad:
+        log.warning("use_quantized_grad is not applied with linear_tree; "
+                    "training runs in full precision")
+        cfg.use_quantized_grad = False
+    return cfg

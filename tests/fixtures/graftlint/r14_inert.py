@@ -1,0 +1,22 @@
+"""R14 fixture (ISSUE 14): inert suppressions.
+
+The first comment suppresses R1 on a statement where R1 never fires —
+dead weight that would silently absorb a FUTURE R1 finding at that site
+(the PR-10 frontend ``disable=R5`` class, now a finding). The second is a
+live suppression (R1 really fires under it) and must NOT be flagged.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def helper(n):
+    # graftlint: disable=R1 — inert: nothing below syncs  # BAD:R14
+    return jnp.zeros(n, dtype=jnp.float32)
+
+
+def train(xs):
+    total = 0.0
+    for x in xs:
+        # graftlint: disable=R1 — live: this sync is real and justified
+        total += float(jax.device_get(x))
+    return total

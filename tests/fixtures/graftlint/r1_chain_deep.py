@@ -1,0 +1,15 @@
+"""R1 fixture (ISSUE 14): a host sync THREE call-graph hops from the hot
+function (train_one_iter -> stage_partition -> _gather_stats -> here).
+Per-file linting and one-hop caller resolution both scan this clean; the
+transitive effect inference flags it, and the finding's provenance chain
+names every frame between the hot root and the sync."""
+import jax
+
+
+def fetch_partition_count(state):
+    return int(jax.device_get(state.count))  # BAD:R1 — 3 hops from hot
+
+
+def deep_and_uncalled(state):
+    # same shape, but no hot function reaches it at any depth: clean
+    return int(jax.device_get(state.count))

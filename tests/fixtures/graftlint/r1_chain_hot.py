@@ -1,0 +1,14 @@
+"""R1 fixture (ISSUE 14): the hot ROOT of a three-hop sync chain.
+
+This file scans clean — the sync lives two modules away
+(``r1_chain_deep.py``), reached through ``r1_chain_mid.py``. One-hop
+resolution (the ISSUE-10 retarget) never saw past ``stage_partition``;
+the transitive effect inference walks the whole chain and the finding in
+the deep module names the full provenance path
+(``train_one_iter -> stage_partition -> fetch_partition_count``).
+"""
+from .r1_chain_mid import stage_partition
+
+
+def train_one_iter(state):
+    return stage_partition(state) + 1

@@ -1,0 +1,14 @@
+"""R1 fixture (ISSUE 14): the middle frames of the three-hop sync chain.
+Nothing here is hot by name or path, and nothing here syncs — this file
+scans clean. It only FORWARDS hotness: ``train_one_iter`` (r1_chain_hot)
+calls ``stage_partition``, which calls ``_gather_stats``, which calls the
+deep helper that syncs (r1_chain_deep)."""
+from .r1_chain_deep import fetch_partition_count
+
+
+def _gather_stats(state):
+    return fetch_partition_count(state)
+
+
+def stage_partition(state):
+    return _gather_stats(state)

@@ -1,0 +1,24 @@
+"""R13 fixture (ISSUE 14): a rogue wire verb on each side of the socket.
+
+A mini frontend module carrying BOTH wire surfaces: ``_op_<verb>``
+handlers and a client that sends ops. ``flush`` has a handler no shipped
+client can reach; ``drain`` is sent by the client and answers
+``unknown op`` at runtime. Both directions are findings — the bijection
+is the invariant, not either surface alone.
+"""
+
+
+class _Conn:
+    def _op_predict(self, req_id, frame):
+        self.send({"id": req_id, "ok": True, "values": []})
+
+    def _op_flush(self, req_id, frame):  # BAD:R13 — no client sends flush
+        self.send({"id": req_id, "ok": True})
+
+
+class MiniClient:
+    def predict(self, x):
+        return self._send({"op": "predict", "x": x})
+
+    def drain(self):
+        return self._call("drain")  # BAD:R13 — no _op_drain handler

@@ -1,0 +1,32 @@
+"""R9 fixture (ISSUE 14): blocking work TWO resolved calls below a lock.
+
+The ISSUE-10 rule walked exactly ONE call away from the ``with`` block,
+so a trivial extract-method refactor (``_encode_and_write`` between the
+lock and the ``sendall``) silently un-flagged the hazard. The transitive
+effect inference propagates ``blocking`` through any depth, and the
+finding's provenance chain names every intermediate frame. The
+snapshot-then-write shape at the bottom (blocking call AFTER the lock is
+released) must scan clean at every depth.
+"""
+import threading
+
+
+class DeepPublisher:
+    def __init__(self, sock):
+        self.sock = sock
+        self._mu = threading.Lock()
+
+    def _write_frame(self, payload):
+        self.sock.sendall(payload)
+
+    def _encode_and_write(self, payload):
+        return self._write_frame(payload)
+
+    def publish(self, payload):
+        with self._mu:
+            self._encode_and_write(payload)  # BAD:R9 — sendall 2 calls down
+
+    def publish_outside(self, payload):
+        with self._mu:
+            frame = payload
+        self._encode_and_write(frame)

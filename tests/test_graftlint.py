@@ -57,6 +57,7 @@ def fixture_findings():
 @pytest.mark.parametrize("relpath", [
     "r1_host_sync.py",
     "r1_cold_helper.py",
+    "r1_chain_deep.py",
     "serve/r1_serve_loop.py",
     "ops/predict_tensor.py",
     "ops/hist_pallas.py",
@@ -75,12 +76,16 @@ def fixture_findings():
     "serve/r9_cycle_a.py",
     "serve/r9_cycle_b.py",
     "serve/r9_blocking.py",
+    "serve/r9_deep.py",
     "serve/r9_scrape.py",
     "serve/r9_autonomics.py",
     "obs/trace.py",
     "parallel/r10_rogue_specs.py",
     "r11_drift/config.py",
     "r11_drift/consumer.py",
+    "r12_combos/silent_combo.py",
+    "serve/r13_wire.py",
+    "r14_inert.py",
     "data/stream.py",
 ])
 def test_rule_fixture_exact_findings(fixture_findings, relpath):
@@ -93,6 +98,7 @@ def test_rule_fixture_exact_findings(fixture_findings, relpath):
 @pytest.mark.parametrize("relpath", [
     "suppressed.py", "file_suppressed.py", "clean.py",
     "serve/r9_hierarchy.py", "r1_hot_caller.py",
+    "r1_chain_hot.py", "r1_chain_mid.py",
 ])
 def test_suppressions_and_clean_files(fixture_findings, relpath):
     assert fixture_findings.get(relpath, set()) == set()
@@ -412,6 +418,20 @@ def test_cli_sarif_format():
     assert rule_ids == {"R4"}
 
 
+def test_cli_sarif_carries_new_rule_metadata():
+    """ISSUE-14 satellite: SARIF output carries R12/R13/R14 rule metadata
+    (descriptions + fingerprints) for findings of the new rules."""
+    r = _run_cli(FIXTURES, "--no-baseline", "--format", "sarif")
+    assert r.returncode == 1
+    run = json.loads(r.stdout)["runs"][0]
+    rules = {ru["id"]: ru for ru in run["tool"]["driver"]["rules"]}
+    assert {"R12", "R13", "R14"} <= set(rules)
+    for rid in ("R12", "R13", "R14"):
+        assert rules[rid]["shortDescription"]["text"]
+    assert all(res["fingerprints"]["graftlint/v1"]
+               for res in run["results"])
+
+
 def test_cli_max_seconds_budget():
     """--max-seconds enforces the G0 wall budget: an absurdly small budget
     fails even a clean scan; a generous one passes."""
@@ -421,6 +441,245 @@ def test_cli_max_seconds_budget():
     slow = _run_cli(target, "--no-baseline", "--max-seconds", "0.0000001")
     assert slow.returncode == 1
     assert "budget" in slow.stderr
+
+
+# -- transitive effect inference (pass 2, ISSUE 14) ---------------------
+def test_r1_provenance_chain_three_hops_names_full_path():
+    """A sync three call-graph hops from the hot function is flagged in
+    its own (cold) module, and the finding prints the complete provenance
+    chain — the reader never reconstructs the reach by hand."""
+    found = [f for f in scan([FIXTURES], select=["R1"])
+             if f.path == "r1_chain_deep.py"]
+    assert len(found) == 1
+    msg = found[0].message
+    assert ("train_one_iter -> stage_partition -> _gather_stats -> "
+            "fetch_partition_count") in msg
+    assert "3 hops" in msg
+
+
+def test_r9_transitive_blocking_names_depth_and_chain():
+    """Blocking work TWO resolved calls below a lock (invisible to the
+    ISSUE-10 one-hop walk) is flagged with its call chain."""
+    found = [f for f in scan([FIXTURES], select=["R9"])
+             if f.path == "serve/r9_deep.py"]
+    assert len(found) == 1
+    msg = found[0].message
+    assert "2 calls away" in msg
+    assert ("DeepPublisher.publish -> DeepPublisher._encode_and_write "
+            "-> DeepPublisher._write_frame") in msg
+
+
+def test_effect_analysis_fixpoint_and_witness():
+    """EffectAnalysis unit semantics: direct effects, transitive
+    propagation through the call graph, and provenance chains."""
+    from lambdagap_tpu.analysis import build_index, get_effects
+    _ctxs, index, _fail = build_index([FIXTURES])
+    ana = get_effects(index)
+    deep = ("serve/r9_deep.py", "DeepPublisher._write_frame")
+    mid = ("serve/r9_deep.py", "DeepPublisher._encode_and_write")
+    top = ("serve/r9_deep.py", "DeepPublisher.publish")
+    eff = ("blocking", "self.sock.sendall")
+    assert eff in ana.direct[deep]
+    assert eff in ana.effects[mid] and eff in ana.effects[top]
+    assert ana.chain(top, eff) == [top, mid, deep]
+    # the lock acquisition is an effect too
+    assert ana.has(top, "acquires")
+    # and hot-reachability: the chain fixtures
+    assert ana.has(("r1_chain_hot.py", "train_one_iter"), "d2h_sync")
+
+
+# -- R12/R13 over the real tree (ISSUE 14) ------------------------------
+def test_r12_full_package_scan_clean():
+    """Every axis-knob demotion in the package is loud and names both
+    knobs (the learner/gbdt/data_parallel messages this PR fixed stay
+    fixed)."""
+    findings = scan([PKG], select=["R12"])
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_r12_extracted_matrix_covers_known_demotion_sites():
+    """ISSUE-14 acceptance: the extracted capability matrix carries the
+    known lattice cells — linear x {quantized, stream, dart/rf} and
+    stream x distributed — with the right behavior kind."""
+    from lambdagap_tpu.analysis import build_index
+    from lambdagap_tpu.analysis.rules.r12_composition import extract_matrix
+    contexts, index, _fail = build_index([PKG])
+    cells = {(c.knob_a, c.knob_b, c.kind)
+             for c in extract_matrix(contexts, index)}
+    assert ("linear_tree", "use_quantized_grad", "demote") in cells
+    assert ("data_residency", "linear_tree", "demote") in cells
+    assert ("boosting", "linear_tree", "error") in cells      # dart/rf
+    assert ("data_residency", "tree_learner", "demote") in cells
+    assert ("tree_layout", "tree_learner", "demote") in cells
+
+
+def test_capability_matrix_doc_in_sync():
+    """docs/capability-matrix.md matches what the tree generates (the
+    same contract gen_params_doc --check enforces for Parameters.md)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "gen_capability_matrix",
+        os.path.join(REPO, "tools", "gen_capability_matrix.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    with open(os.path.join(REPO, "docs", "capability-matrix.md")) as f:
+        assert f.read() == mod.generate()
+
+
+def test_r13_full_package_scan_clean():
+    """The wire surfaces are in bijection on the merged tree (handlers ==
+    client ops == docs frames; kind-map covers every degrade exception;
+    serve_loop verbs documented)."""
+    findings = scan([PKG], select=["R13"])
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_wire_kind_map_covers_degrade_exceptions():
+    """Runtime counterpart of R13c: every exception class guard/degrade
+    defines maps to itself through the wire kind-map."""
+    import inspect
+    from lambdagap_tpu.guard import degrade
+    from lambdagap_tpu.serve.frontend import _KINDS
+    for name, obj in vars(degrade).items():
+        if inspect.isclass(obj) and issubclass(obj, BaseException) \
+                and obj.__module__ == degrade.__name__:
+            assert _KINDS.get(name) is obj, name
+
+
+def test_r14_full_package_scan_clean():
+    """No inert suppressions in the merged tree (the frontend disable=R5
+    class this PR removed stays removed)."""
+    findings = scan([PKG], select=None)
+    r14 = [f for f in findings if f.rule == "R14"]
+    assert r14 == [], [f.format() for f in r14]
+
+
+def test_r14_not_reported_for_rules_that_did_not_run():
+    """A suppression naming a rule excluded from the scan is never called
+    inert — absence of evidence only counts when the rule looked."""
+    target = os.path.join(FIXTURES, "r14_inert.py")
+    assert scan([target], select=["R14"]) == []
+    assert scan([target], disable=["R1"]) == []
+    assert [f.rule for f in scan([target])] == ["R14"]
+
+
+def test_stale_baseline_entry_is_r14_finding(tmp_path):
+    """CLI: a baseline entry whose finding no longer exists fails the
+    scan as an R14 finding (was: a stderr warning and exit 0)."""
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps({"version": 1, "findings": [{
+        "rule": "R4", "path": "clean.py",
+        "snippet": "x = jnp.zeros(3)", "count": 1, "why": "gone"}]}))
+    r = _run_cli(os.path.join(FIXTURES, "clean.py"),
+                 "--baseline", str(bl))
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "R14" in r.stdout and "stale baseline entry" in r.stdout
+
+
+def test_write_baseline_prunes_dead_entries(tmp_path):
+    """--write-baseline regenerates from current findings only: entries
+    whose finding no longer exists are pruned (and reported)."""
+    target = os.path.join(FIXTURES, "r4_dtype_drift.py")
+    bl = tmp_path / "baseline.json"
+    dead = {"rule": "R4", "path": "elsewhere.py",
+            "snippet": "y = jnp.ones(2)", "count": 1, "why": "dead"}
+    findings = scan([target])
+    write_baseline(findings, str(bl))
+    data = json.loads(bl.read_text())
+    data["findings"].append(dead)
+    bl.write_text(json.dumps(data))
+    r = _run_cli(target, "--write-baseline", "--baseline", str(bl))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "pruned 1 dead entr" in r.stdout
+    kept = {(e["rule"], e["path"]) for e in load_baseline(str(bl))}
+    assert ("R4", "elsewhere.py") not in kept
+
+
+# -- incremental scan cache (ISSUE 14) ----------------------------------
+def test_cache_cold_warm_byte_identical(tmp_path):
+    """Cold and warm scans produce byte-identical findings, and the warm
+    scan actually hits the cache (the G0 assertion, at the API level)."""
+    from lambdagap_tpu.analysis import cache as scan_cache
+    target = os.path.join(FIXTURES, "r4_dtype_drift.py")
+    cache_file = str(tmp_path / "cache.json")
+    cold = scan([target])
+    key = scan_cache.scan_key([target], None, None)
+    assert scan_cache.load(cache_file, key) is None       # cold: no entry
+    scan_cache.store(cache_file, key, cold)
+    warm = scan_cache.load(cache_file, key)
+    assert warm == cold                                    # byte-identical
+    # any content change invalidates the key
+    assert scan_cache.scan_key(
+        [os.path.join(FIXTURES, "clean.py")], None, None) != key
+
+
+def test_cache_cli_warm_hit_and_identity(tmp_path):
+    """CLI: second run with the same tree hits the cache and reports the
+    exact same findings JSON."""
+    target = os.path.join(FIXTURES, "r4_dtype_drift.py")
+    cache_file = str(tmp_path / "cache.json")
+    args = (target, "--no-baseline", "--format", "json",
+            "--cache", cache_file)
+    r1 = _run_cli(*args)
+    r2 = _run_cli(*args)
+    cold, warm = json.loads(r1.stdout), json.loads(r2.stdout)
+    assert cold["cache_hit"] is False and warm["cache_hit"] is True
+    assert cold["findings"] == warm["findings"]
+    assert r1.returncode == r2.returncode == 1
+    # --no-cache forces a cold scan
+    r3 = _run_cli(*args, "--no-cache")
+    assert json.loads(r3.stdout)["cache_hit"] is False
+
+
+def test_cache_invalidated_by_analyzer_options():
+    """Different --select/--disable selections never share a cache
+    entry."""
+    from lambdagap_tpu.analysis import cache as scan_cache
+    target = os.path.join(FIXTURES, "r4_dtype_drift.py")
+    assert scan_cache.scan_key([target], ["R4"], None) != \
+        scan_cache.scan_key([target], None, None)
+
+
+# -- --changed-only (pre-commit fast path, ISSUE 14) --------------------
+def test_changed_only_scans_only_git_changed_files(tmp_path):
+    """In a git repo, --changed-only scans exactly the changed files (a
+    dirty hazard file is found; with a clean tree there is nothing to
+    do), and whole-package finding classes stand down."""
+    import shutil
+    repo = tmp_path / "mini"
+    repo.mkdir()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+
+    def git(*a):
+        subprocess.run(["git", *a], cwd=repo, check=True, env=env,
+                       capture_output=True)
+
+    (repo / "good.py").write_text("import jax.numpy as jnp\n"
+                                  "X = jnp.zeros(3)\n")   # R4, committed
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    cli = [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+           ".", "--changed-only", "--no-baseline", "--format", "json"]
+    clean = subprocess.run(cli[:-2], cwd=repo, env=env,
+                           capture_output=True, text=True)
+    assert clean.returncode == 0
+    assert "no scanned files changed" in clean.stdout
+    (repo / "bad.py").write_text("import jax.numpy as jnp\n"
+                                 "Y = jnp.ones(4)\n")     # R4, uncommitted
+    dirty = subprocess.run(cli, cwd=repo, env=env,
+                           capture_output=True, text=True)
+    assert dirty.returncode == 1, dirty.stdout + dirty.stderr
+    found = json.loads(dirty.stdout)["findings"]
+    assert {f["path"] for f in found} == {"bad.py"}        # good.py skipped
+    # a partial scan must never regenerate the baseline (it would prune
+    # every entry outside the changed files)
+    refuse = subprocess.run(cli[:5] + ["--write-baseline"], cwd=repo,
+                            env=env, capture_output=True, text=True)
+    assert refuse.returncode == 2
+    assert "needs a full scan" in refuse.stderr
 
 
 # -- the acceptance gate ------------------------------------------------

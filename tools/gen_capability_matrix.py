@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Generate (or --check) docs/capability-matrix.md from the graftlint
+semantic index — the gen_params_doc pattern applied to COMPOSITION.
+
+The matrix is the statically extracted capability lattice of the feature
+axes (residency x layout x learner x parallelism x linear x quantized x
+boosting): every axis pair with an explicit config-validation **error**
+cell or loud-demotion **demote** cell, each naming its source of truth
+(graftlint rule R12, lambdagap_tpu/analysis/rules/r12_composition.py).
+Pairs not listed compose freely — and R12 makes sure a NEW non-composing
+pair cannot land without either a cell (which regenerates this doc) or a
+finding (silent demotion / half-named demotion).
+
+Usage: python tools/gen_capability_matrix.py [--check]
+
+--check exits 1 when docs/capability-matrix.md differs from what the
+current tree generates; tools/run_full_suite.sh G0 runs it right after
+gen_params_doc --check, so the documented lattice can never drift from
+the code.
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DOC = os.path.join(REPO, "docs", "capability-matrix.md")
+
+
+def generate() -> str:
+    from lambdagap_tpu.analysis import build_index
+    from lambdagap_tpu.analysis.rules.r12_composition import (
+        extract_matrix, render_matrix)
+    contexts, index, _failures = build_index(
+        [os.path.join(REPO, "lambdagap_tpu")])
+    return render_matrix(extract_matrix(contexts, index))
+
+
+def main() -> int:
+    text = generate()
+    if "--check" in sys.argv:
+        try:
+            with open(DOC, "r", encoding="utf-8") as f:
+                current = f.read()
+        except OSError:
+            print("capability-matrix check FAILED: docs/capability-"
+                  "matrix.md is missing; run python "
+                  "tools/gen_capability_matrix.py", file=sys.stderr)
+            return 1
+        if current != text:
+            print("capability-matrix check FAILED: docs/capability-"
+                  "matrix.md is stale (the extracted lattice changed); "
+                  "run python tools/gen_capability_matrix.py",
+                  file=sys.stderr)
+            return 1
+        print("capability-matrix check OK")
+        return 0
+    with open(DOC, "w", encoding="utf-8") as f:
+        f.write(text)
+    print(f"wrote {DOC}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
