@@ -859,19 +859,28 @@ def run_stream_ab(rows: int, max_bin: int, iters: int) -> None:
     }))
 
 
-def run_multichip_attempt(n_devices: int, rows: int, max_bin: int,
-                          iters: int) -> None:
-    """Child-process entry (ISSUE 8): one fused data-parallel training run
-    at a fixed device count. The parent (``--multichip-scaling``) launches
-    one child per width with the device topology in the environment
+def run_multichip_attempt(grid: str, rows: int, max_bin: int,
+                          iters: int, residency: str = "hbm") -> None:
+    """Child-process entry (ISSUE 8, grid-swept in ISSUE 15): one fused
+    training run at a fixed ``dd x ff`` grid. The parent
+    (``--multichip-scaling``) launches one child per grid with the device
+    topology in the environment
     (``--xla_force_host_platform_device_count=D`` on CPU; the real mesh
-    as-is on TPU), so every width gets a cold, honest program.
+    as-is on TPU), so every grid gets a cold, honest program.
+
+    ``grid`` is a ``mesh_shape`` string ("2x4"); a bare integer is the
+    legacy width form ("8" == "8x1"). Both route through the fused 2-D
+    data x feature learner — ONE program for every grid, which is what
+    makes the sweep comparable. ``residency=stream`` runs the composed
+    out-of-core path (ISSUE 15) instead of the resident one.
 
     Emits per-iter steady wall (device-complete via telemetry iteration
-    boundaries), the sha of the built trees (widths must be BIT-identical
-    — the histogram psum reduces shard partials in a width-stable order),
-    steady-state compile count, and the analytic per-iteration histogram
-    psum traffic (payload + ring-allreduce wire bytes).
+    boundaries), the sha of the built trees (grids must be BIT-identical
+    on the quantized path — integer data-psum + feature-blocked argmax
+    are grid-invariant), steady-state compile count, and the analytic
+    per-iteration wire traffic of all three collectives: the histogram
+    psum over ``data``, the best-tuple all_gather over ``feature``, and
+    the winning-column psum broadcast over ``feature``.
     """
     import hashlib
 
@@ -879,14 +888,22 @@ def run_multichip_attempt(n_devices: int, rows: int, max_bin: int,
     import jax
 
     import lambdagap_tpu as lgb
+    from lambdagap_tpu.parallel.sharding import resolve_mesh_shape
 
+    shape = resolve_mesh_shape(grid if "x" in grid else f"{grid}x1",
+                               len(jax.devices()))
+    dd, ff = shape
+    n_devices = dd * ff
     assert len(jax.devices()) >= n_devices, (
-        f"need {n_devices} devices, have {len(jax.devices())}")
+        f"grid {grid} needs {n_devices} devices, have {len(jax.devices())}")
     leaves = int(os.environ.get("BENCH_MULTICHIP_LEAVES", "15"))
-    # default QUANTIZED: integer histogram reduction is width-invariant,
-    # which is what makes the cross-width bit-identity check meaningful
-    # (f32 is reduction-order-equal only; near-ties may flip per width)
-    quant = os.environ.get("BENCH_MULTICHIP_QUANT", "1") == "1"
+    # default QUANTIZED: integer histogram reduction is grid-invariant,
+    # which is what makes the cross-grid bit-identity check meaningful
+    # (f32 is reduction-order-equal only; near-ties may flip per grid).
+    # The stream arm is f32 by construction (quant is a stream blocker)
+    # and its contract is same-grid stream==hbm instead.
+    quant = (os.environ.get("BENCH_MULTICHIP_QUANT", "1") == "1"
+             and residency != "stream")
     higgs = os.environ.get("BENCH_DATA_HIGGS", "")
     if higgs:
         X, y, _, _ = _load_higgs_real(higgs)
@@ -895,19 +912,24 @@ def run_multichip_attempt(n_devices: int, rows: int, max_bin: int,
         with np.load(_ensure_data(rows)) as d:
             X, y = d["X"][:rows], d["y"][:rows]
     params = {"objective": "binary", "tree_learner": "data",
-              "tpu_fused_learner": "1", "tpu_num_devices": n_devices,
+              "tpu_fused_learner": "1", "mesh_shape": f"{dd}x{ff}",
               "num_leaves": leaves, "max_bin": max_bin,
               "min_data_in_leaf": 20, "verbose": -1,
               "use_quantized_grad": quant, "stochastic_rounding": False,
+              "data_residency": residency, "enable_bundle": False,
               "telemetry": True, "telemetry_warmup": 2}
+    if residency == "stream":
+        params["stream_shard_rows"] = int(os.environ.get(
+            "BENCH_MULTICHIP_SHARD_ROWS", str(max(rows // 7, 1 << 10))))
     t0 = time.perf_counter()
     ds = lgb.Dataset(X, label=y, params=params)
     booster = lgb.Booster(params=params, train_set=ds)
     t_construct = time.perf_counter() - t0
-    from lambdagap_tpu.parallel.fused_parallel import \
-        FusedDataParallelTreeLearner
+    from lambdagap_tpu.parallel.fused_parallel import Fused2DTreeLearner
     lr = booster._booster.learner
-    assert isinstance(lr, FusedDataParallelTreeLearner), type(lr)
+    assert isinstance(lr, Fused2DTreeLearner), type(lr)
+    assert (lr.dd, lr.ff) == (dd, ff)
+    assert lr.residency == residency, (lr.residency, residency)
     warmup = 2
     for _ in range(warmup + iters):
         booster.update()
@@ -922,15 +944,44 @@ def run_multichip_attempt(n_devices: int, rows: int, max_bin: int,
         booster.model_to_string().split("end of trees")[0]
         .encode()).hexdigest()
 
-    # analytic histogram-psum traffic: one [C, Bb, 3] reduction per split
-    C = int(lr.num_features)
+    # analytic per-split wire traffic of the 2-D program's collectives
+    # (ring-allreduce: 2(D-1)/D of the payload crosses each link;
+    # ring-allgather: (D-1)/D)
+    C_loc = int(lr.num_features) // ff
     Bb = int(lr.Bb)
     item = 4                              # f32 (quant_exact int32: same)
-    payload = C * Bb * 3 * item
     splits = leaves - 1
-    ring = 2 * (n_devices - 1) / max(n_devices, 1)
+    hist_payload = C_loc * Bb * 3 * item
+    ring_d = 2 * (dd - 1) / max(dd, 1)
+    # best-split tuple: 11 gathered fields, the 8-word cat bitset widest
+    tuple_bytes = 10 * 4 + 8 * 4
+    gather_f = (ff - 1) / max(ff, 1)
+    n_loc = int(lr.n_loc)
+    col_item = 1 if max_bin <= 255 else 2
+    ring_f = 2 * (ff - 1) / max(ff, 1)
+    wire_per_split = int(hist_payload * ring_d
+                         + tuple_bytes * ff * gather_f
+                         + n_loc * col_item * ring_f)
+    extra = {}
+    if residency == "stream":
+        phases = {}
+        for r in steady:
+            for k, v in (r.get("phases") or {}).items():
+                phases[k] = phases.get(k, 0.0) + v
+        n = max(len(steady), 1)
+        pre = phases.get("h2d_prefetch", 0.0) / n
+        wait = phases.get("chunk_wait", 0.0) / n
+        extra = {
+            "h2d_prefetch_s_per_iter": round(pre, 5),
+            "chunk_wait_s_per_iter": round(wait, 5),
+            "prefetch_overlap_fraction": round(
+                1.0 - wait / max(pre + wait, 1e-12), 4),
+            "num_host_shards": int(lr.sdata.num_shards),
+        }
     print(json.dumps({
+        "grid": f"{dd}x{ff}",
         "n_devices": n_devices,
+        "residency": residency,
         "rows": rows,
         "max_bin": max_bin,
         "num_leaves": leaves,
@@ -939,112 +990,185 @@ def run_multichip_attempt(n_devices: int, rows: int, max_bin: int,
         "construct_s": round(t_construct, 3),
         "compiles_steady": compiles_steady,
         "trees_sha": trees_sha,
-        "psum_payload_bytes_per_split": payload,
-        "psum_payload_bytes_per_iter": payload * splits,
-        "psum_wire_bytes_per_iter": int(payload * splits * ring),
-        "mesh": {"axes": ["data", "feature"],
-                 "shape": [n_devices, 1],
+        "hist_psum_payload_bytes_per_split": hist_payload,
+        "wire_bytes_per_split": wire_per_split,
+        "wire_bytes_per_iter": wire_per_split * splits,
+        "wire_split": {
+            "hist_psum_data": int(hist_payload * ring_d),
+            "best_tuple_allgather_feature": int(tuple_bytes * ff
+                                                * gather_f),
+            "column_bcast_feature": int(n_loc * col_item * ring_f),
+        },
+        "mesh": {"axes": ["data", "feature"], "shape": [dd, ff],
                  "platform": jax.devices()[0].platform},
+        **extra,
     }))
 
 
 def run_multichip_scaling(rows: int, max_bin: int, iters: int) -> None:
-    """Parent entry (ISSUE 8 acceptance): measured multi-chip scaling of
-    the fused data-parallel learner at 1/2/4/8 devices.
+    """Parent entry (ISSUE 15 acceptance): measured dd x ff GRID sweep of
+    the fused 2-D data x feature program — 1x8 / 2x4 / 4x2 / 8x1 by
+    default (BENCH_MULTICHIP_GRIDS overrides), plus a serial 1-device
+    anchor and one composed stream x distributed arm on the middle grid.
 
     Uses the real mesh when this host exposes enough accelerator devices;
-    elsewhere each width runs on a virtual
+    elsewhere each grid runs on a virtual
     ``--xla_force_host_platform_device_count=D`` CPU mesh — which measures
     the *distribution overhead* (padding, collective emulation, per-shard
     program shape), not parallel speedup, since every virtual device
     shares the same cores. Efficiency is therefore defined per mode:
 
-    - real mesh:    efficiency(D) = t1 / (D * tD)   (ideal 1.0)
-    - virtual mesh: efficiency(D) = t1 / tD         (ideal 1.0 — total
-      work is constant, so any slowdown is pure distribution overhead)
+    - real mesh:    efficiency = t_serial / (D * t_grid)   (ideal 1.0)
+    - virtual mesh: efficiency = t_serial / t_grid         (ideal 1.0 —
+      total work is constant, so any slowdown is pure distribution
+      overhead)
 
-    Also emits the analytic histogram-psum wire traffic against the ICI
-    bound (v5e ~45 GB/s/link, BENCH_MULTICHIP_ICI_GBPS) and asserts trees
-    are bit-identical across widths. Result JSON lands on stdout AND in
-    MULTICHIP_r06.json (BENCH_MULTICHIP_OUT overrides).
+    Emits the analytic per-grid wire traffic of all three collectives
+    (hist psum over data, best-tuple all_gather over feature, column
+    psum broadcast over feature) against the ICI bound (v5e ~45 GB/s,
+    BENCH_MULTICHIP_ICI_GBPS), asserts trees are bit-identical across
+    grids on the quantized path, asserts the stream arm is bit-identical
+    to its same-grid resident arm, and sizes the TARGET out-of-core
+    shape (BENCH_MULTICHIP_TARGET_ROWS, default 2^27) against a nominal
+    16 GB chip to document where neither pure axis fits. Result JSON
+    lands on stdout AND in MULTICHIP_r07.json (BENCH_MULTICHIP_OUT
+    overrides).
     """
-    widths = [int(w) for w in os.environ.get(
-        "BENCH_MULTICHIP_WIDTHS", "1,2,4,8").split(",")]
+    grids = [g.strip() for g in os.environ.get(
+        "BENCH_MULTICHIP_GRIDS", "1x1,1x8,2x4,4x2,8x1").split(",")]
+    stream_grid = os.environ.get("BENCH_MULTICHIP_STREAM_GRID", "2x4")
     import jax
+    need = max(int(g.split("x")[0]) * int(g.split("x")[1]) for g in grids)
     real = (jax.default_backend() not in ("cpu",)
-            and len(jax.devices()) >= max(widths))
+            and len(jax.devices()) >= need)
     env = {k: v for k, v in os.environ.items() if "AXON" not in k}
-    results = {}
-    for d in widths:
-        child_env = dict(env)
+
+    def attempt(grid, residency, extra_env=None):
+        dd, ff = (int(v) for v in grid.split("x"))
+        child_env = dict(env, **(extra_env or {}))
         if not real:
             child_env["JAX_PLATFORMS"] = "cpu"
             flags = " ".join(
                 f for f in child_env.get("XLA_FLAGS", "").split()
                 if not f.startswith("--xla_force_host_platform"))
             child_env["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count={d}"
+                f"{flags} --xla_force_host_platform_device_count={dd * ff}"
             ).strip()
         cmd = [sys.executable, os.path.abspath(__file__),
-               "--multichip-attempt", str(d), str(rows), str(max_bin),
-               str(iters)]
-        print(f"[bench] multichip width {d} "
-              f"({'real mesh' if real else 'virtual CPU'})",
+               "--multichip-attempt", grid, str(rows), str(max_bin),
+               str(iters), residency]
+        print(f"[bench] multichip grid {grid} ({residency}, "
+              f"{'real mesh' if real else 'virtual CPU'})",
               file=sys.stderr, flush=True)
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=3600, env=child_env)
             if proc.returncode == 0 and proc.stdout.strip():
-                results[d] = json.loads(
-                    proc.stdout.strip().splitlines()[-1])
-            else:
-                results[d] = {"error": f"rc={proc.returncode}: "
-                                       f"{(proc.stderr or '')[-400:]}"}
+                return json.loads(proc.stdout.strip().splitlines()[-1])
+            return {"error": f"rc={proc.returncode}: "
+                             f"{(proc.stderr or '')[-400:]}"}
         except (subprocess.TimeoutExpired, json.JSONDecodeError) as e:
-            results[d] = {"error": str(e)[:200]}
+            return {"error": str(e)[:200]}
 
-    ok = [d for d in widths if "error" not in results.get(d, {})]
-    t1 = results[1]["s_per_iter"] if 1 in ok else None
+    results = {g: attempt(g, "hbm") for g in grids}
+    stream_res = attempt(stream_grid, "stream")
+
+    ok = [g for g in grids if "error" not in results.get(g, {})]
+    t1 = results["1x1"]["s_per_iter"] if "1x1" in ok else None
     scaling = {}
-    for d in ok:
-        td = results[d]["s_per_iter"]
-        if t1 is None or not td:
+    for g in ok:
+        tg = results[g]["s_per_iter"]
+        if t1 is None or not tg or g == "1x1":
             continue
-        speedup = t1 / td
-        scaling[str(d)] = {
-            "s_per_iter": td,
-            "speedup_vs_1dev": round(speedup, 4),
+        d = results[g]["n_devices"]
+        speedup = t1 / tg
+        scaling[g] = {
+            "s_per_iter": tg,
+            "speedup_vs_serial": round(speedup, 4),
             "efficiency": round(speedup / d if real else speedup, 4),
         }
-    shas = {d: results[d].get("trees_sha") for d in ok}
+    shas = {g: results[g].get("trees_sha") for g in ok}
     bit_identical = len(set(shas.values())) == 1 if shas else False
+    # the stream arm is f32 (quant is a stream blocker); its identity
+    # peer is a same-grid f32 RESIDENT run — the same-grid mirror
+    # contract (f32 cross-grid identity is shape-lucky, ISSUE-8 finding)
+    stream_bit_identical = False
+    if "error" not in stream_res:
+        stream_ref = attempt(stream_grid, "hbm",
+                             {"BENCH_MULTICHIP_QUANT": "0"})
+        stream_bit_identical = (
+            "error" not in stream_ref
+            and stream_res.get("trees_sha") == stream_ref.get("trees_sha"))
     ici_gbps = float(os.environ.get("BENCH_MULTICHIP_ICI_GBPS", "45"))
-    wire8 = (results.get(8, {}) or {}).get("psum_wire_bytes_per_iter")
+    wire_bounds = {
+        g: round(results[g]["wire_bytes_per_iter"] / (ici_gbps * 1e9), 6)
+        for g in ok if results[g].get("wire_bytes_per_iter")}
+
+    # "neither pure axis fits": size the TARGET shape against a nominal
+    # chip. The fused hbm path pins ~2x the packed matrix (packed rows +
+    # column copy); the histogram state adds (L+1)*C*Bb*3*4 per device.
+    # Default target: the pod-scale out-of-core corner — 2^31 rows x 136
+    # MSLR-shaped columns, where (1,D) blows the replicated row block,
+    # (D,1) blows the per-chip packed rows, and only stream x dd>=2
+    # grids fit (O(rows/dd) scalar state + column-sharded histograms).
+    target_rows = int(os.environ.get("BENCH_MULTICHIP_TARGET_ROWS",
+                                     str(1 << 31)))
+    target_cols = int(os.environ.get("BENCH_MULTICHIP_TARGET_COLS", "136"))
+    hbm_bytes = 16 << 30
+    leaves = int(os.environ.get("BENCH_MULTICHIP_LEAVES", "15"))
+    Bb = max(1 << max_bin.bit_length(), 8)   # next_pow2(max_bin+1)
+    item = 1 if max_bin <= 255 else 2
+    fits = {}
+    for g in grids:
+        dd, ff = (int(v) for v in g.split("x"))
+        rows_dev = -(-target_rows // dd)
+        cols_dev = -(-target_cols // ff)
+        resident = 2 * rows_dev * (cols_dev * item + 9)
+        hist = (leaves + 1) * cols_dev * Bb * 3 * 4
+        fits[g] = {
+            "resident_bytes_per_dev": resident,
+            "hist_state_bytes_per_dev": hist,
+            "fits_16gb_hbm": bool(resident + hist < hbm_bytes),
+            "fits_16gb_stream": bool(
+                # stream keeps only O(rows) scalar state + hist on device
+                rows_dev * 24 + hist < hbm_bytes),
+        }
     out = {
         "bench": "multichip_scaling",
         "mode": "real_mesh" if real else "virtual_cpu",
-        "efficiency_definition": ("t1/(D*tD) on a real mesh; t1/tD on a "
-                                  "virtual single-host mesh (constant "
-                                  "total work -> measures distribution "
-                                  "overhead)"),
+        "efficiency_definition": ("t_serial/(D*t_grid) on a real mesh; "
+                                  "t_serial/t_grid on a virtual "
+                                  "single-host mesh (constant total work "
+                                  "-> measures distribution overhead)"),
         "rows": rows,
         "max_bin": max_bin,
         "iters": iters,
-        "widths": widths,
-        "per_width": {str(d): results[d] for d in widths},
+        "grids": grids,
+        "per_grid": {g: results[g] for g in grids},
         "scaling": scaling,
-        "trees_bit_identical_across_widths": bit_identical,
+        "trees_bit_identical_across_grids": bit_identical,
+        "stream_arm": stream_res,
+        "stream_grid": stream_grid,
+        "stream_bit_identical_to_resident_same_grid":
+            bool(stream_bit_identical),
         "ici_bound_gbps": ici_gbps,
-        "psum_wire_s_lower_bound_8dev": (
-            round(wire8 / (ici_gbps * 1e9), 6) if wire8 else None),
+        "wire_s_lower_bound_per_iter": wire_bounds,
+        "target_shape_fit_16gb": {
+            "target_rows": target_rows, "target_cols": target_cols,
+            "per_grid": fits,
+            "note": ("neither pure axis fits resident at the target "
+                     "shape when fits_16gb_hbm is false for 1xD and "
+                     "Dx1 alike; the composed stream x 2-D mode is the "
+                     "remaining path (fits_16gb_stream)"),
+        },
         "compiles_steady_total": sum(
-            int(results[d].get("compiles_steady", 0)) for d in ok),
+            int(results[g].get("compiles_steady", 0)) for g in ok),
     }
     line = json.dumps(out)
     out_path = os.environ.get(
         "BENCH_MULTICHIP_OUT",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "MULTICHIP_r06.json"))
+                     "MULTICHIP_r07.json"))
     with open(out_path, "w") as f:
         json.dump(out, f, indent=1)
         f.write("\n")
@@ -1621,14 +1745,17 @@ def main() -> None:
             ATTEMPT_TIMEOUT, "linear-leaf A/B (constant vs linear)")
 
     # multi-chip scaling (ISSUE 8): fused data-parallel at 1/2/4/8
-    # devices — real mesh when present, virtual CPU widths elsewhere —
-    # with bit-identity across widths and psum traffic vs the ICI bound
+    # grids — real mesh when present, virtual CPU grids elsewhere —
+    # with bit-identity across dd x ff grids on the quantized path, the
+    # composed stream arm vs its same-grid resident peer, and the
+    # three-collective wire traffic vs the ICI bound
     multichip = None
     if os.environ.get("BENCH_MULTICHIP", "1") != "0":
         multichip = _run_child(
             ["--multichip-scaling",
              os.environ.get("BENCH_MULTICHIP_ROWS", str(1 << 16)),
-             "255", "6"], 3600, "multichip scaling (1/2/4/8 devices)")
+             "255", "6"], 5400,
+            "multichip scaling (1x8/2x4/4x2/8x1 grids + stream arm)")
 
     # chip ceiling AFTER the attempts
     micro_post = (None if os.environ.get("BENCH_MICRO", "1") == "0"
@@ -1779,8 +1906,9 @@ if __name__ == "__main__":
             int(sys.argv[3]) if len(sys.argv) > 3 else 255,
             int(sys.argv[4]) if len(sys.argv) > 4 else 6)
     elif len(sys.argv) >= 6 and sys.argv[1] == "--multichip-attempt":
-        run_multichip_attempt(int(sys.argv[2]), int(sys.argv[3]),
-                              int(sys.argv[4]), int(sys.argv[5]))
+        run_multichip_attempt(sys.argv[2], int(sys.argv[3]),
+                              int(sys.argv[4]), int(sys.argv[5]),
+                              sys.argv[6] if len(sys.argv) > 6 else "hbm")
     elif sys.argv[1:2] == ["--micro"]:
         run_microbench()
     elif len(sys.argv) >= 4 and sys.argv[1] == "--predict-ab":

@@ -73,6 +73,13 @@ HOT_FUNCTIONS = frozenset({
     "stream_windows", "wait_ready", "_train_tree_stream",
     "_stream_small_hist", "_root_histogram_stream",
     "_leaf_histogram_stream", "_split_partition_stream",
+    # the composed stream x 2-D-mesh path (parallel/fused_parallel.py):
+    # the per-shard ring-fill pump and its host loop — an accidental
+    # sync in the per-block fetch serializes EVERY data shard's H2D
+    # behind the device, which kills the overlap fleet-wide, not just on
+    # one chip; the deliberate per-split pick/go_left fetches carry
+    # written justifications
+    "_train_tree_stream2d", "_s2_pump",
     # linear-leaf surfaces (ops/linear.py + models/linear_leaf.py): the
     # moment accumulation runs once per tree inside the boosting loop and
     # the shared leaf evaluation runs inside every predict dispatch — a

@@ -470,7 +470,7 @@ class Config:
     #            rebuild is not worth it on small data)
     tree_layout: str = "auto"                 # auto / gather / sorted
     tpu_num_devices: int = 0                  # 0 = all visible devices
-    mesh_shape: str = ""                      # device mesh extents "DATAxFEATURE" over parallel/sharding.py axes ("8", "8x1", "1x8"); "" = 1-D on the learner's natural axis with tpu_num_devices devices
+    mesh_shape: str = ""                      # device mesh extents "DATAxFEATURE" over parallel/sharding.py axes ("8", "8x1", "1x8", "4x2", wildcard "0x4"/"2x0" = all remaining devices on that axis); an explicit AxB grid routes distributed training through the fused 2-D data x feature learner; "" = 1-D on the learner's natural axis with tpu_num_devices devices
     tpu_fused_learner: str = "auto"           # auto / 1 / 0: whole-tree-on-device
     tpu_fast_predict_rows: int = 10000        # route predict batches up to this many rows through the threaded native traverser
     # -- out-of-core streaming training (docs/performance.md) -------------
@@ -704,19 +704,22 @@ class Config:
             if not ok:
                 log.fatal("Config check failed: %s", msg)
         if self.mesh_shape:
-            # geometry errors (bad syntax, 2-D data x feature execution)
-            # surface at config time, not at first shard_map trace —
-            # including for learners that never build a mesh
+            # syntax errors surface at config time, not at first shard_map
+            # trace — including for learners that never build a mesh.
+            # Wildcard extents ("0x4" / "2x0") are legal syntax here; their
+            # divisibility against the actual device count is checked by
+            # resolve_mesh_shape at mesh construction, where every
+            # rejection also names mesh_shape. Genuine 2-D dd x ff grids
+            # are executed by the fused 2-D learner (ISSUE 15).
             from .parallel.sharding import parse_mesh_shape
             try:
                 shape = parse_mesh_shape(self.mesh_shape)
             except ValueError as e:
                 log.fatal("Config check failed: %s", e)
             else:
-                if shape and shape[0] > 1 and shape[1] > 1:
-                    log.fatal("Config check failed: mesh_shape %dx%d: 2-D "
-                              "data x feature execution is not implemented "
-                              "yet; set one extent to 1", *shape)
+                if shape and shape[0] == 0 and shape[1] == 0:
+                    log.fatal("Config check failed: mesh_shape cannot be "
+                              "0x0 (at most one wildcard extent)")
         if self.boosting == "rf":
             if not (self.bagging_freq > 0 and self.bagging_fraction < 1.0):
                 log.fatal("Random forest needs bagging_freq > 0 and bagging_fraction < 1")

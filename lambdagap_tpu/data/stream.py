@@ -361,11 +361,19 @@ class ShardRing:
     completed, under ``chunk_wait``: with working overlap that span is
     ~zero, and a fat ``chunk_wait`` in the phase breakdown is the direct
     symptom of prefetch failing to hide the link.
+
+    ``shardings`` (optional) composes the ring with a device mesh: each
+    host buffer is ``device_put`` with its :class:`NamedSharding`, so one
+    ``put`` lands every data-block's slice of the window on its own
+    device — the per-host H2D path of the composed stream x distributed
+    mode (ISSUE 15). ``None`` entries fall back to the default placement.
     """
 
-    def __init__(self, depth: int = 2, telemetry=NULL_TELEMETRY) -> None:
+    def __init__(self, depth: int = 2, telemetry=NULL_TELEMETRY,
+                 shardings: Optional[Sequence] = None) -> None:
         self.depth = max(int(depth), 1)
         self.telemetry = telemetry
+        self.shardings = shardings
         self._slots: deque = deque()
 
     def __len__(self) -> int:
@@ -378,8 +386,14 @@ class ShardRing:
     def put(self, key, host_bufs: Sequence[np.ndarray]) -> None:
         import jax
         with self.telemetry.phase("h2d_prefetch"):
-            self._slots.append(
-                (key, tuple(jax.device_put(b) for b in host_bufs)))
+            if self.shardings is None:
+                devs = tuple(jax.device_put(b) for b in host_bufs)
+            else:
+                devs = tuple(
+                    jax.device_put(b, s) if s is not None
+                    else jax.device_put(b)
+                    for b, s in zip(host_bufs, self.shardings))
+            self._slots.append((key, devs))
 
     def wait_ready(self):
         """(key, device_bufs) of the oldest slot, transfer complete."""
@@ -395,7 +409,8 @@ class ShardRing:
 
 
 def stream_windows(nch: int, fetch: Callable, consume: Callable,
-                   telemetry=NULL_TELEMETRY, depth: int = 2) -> None:
+                   telemetry=NULL_TELEMETRY, depth: int = 2,
+                   shardings: Optional[Sequence] = None) -> None:
     """Drive ``nch`` windows through a :class:`ShardRing`.
 
     ``fetch(c)`` runs on the host and returns the window's host buffers
@@ -405,7 +420,7 @@ def stream_windows(nch: int, fetch: Callable, consume: Callable,
     consumer — fetch/transfer of window ``c+1`` is issued before window
     ``c`` is waited on, which is the whole overlap story.
     """
-    ring = ShardRing(depth=depth, telemetry=telemetry)
+    ring = ShardRing(depth=depth, telemetry=telemetry, shardings=shardings)
     issued = 0
     for c in range(nch):
         while issued < nch and (issued <= c or not ring.full):

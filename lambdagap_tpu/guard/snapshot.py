@@ -177,6 +177,16 @@ def restore_state(gbdt, state: Dict[str, Any]) -> None:
                      "re-sharded", mesh_rec.get("n_devices"),
                      have["n_devices"], mesh_rec.get("shape"),
                      have["shape"])
+        elif have["shape"] != mesh_rec.get("shape", have["shape"]):
+            # same device count, different dd x ff grid (4x2 -> 2x4): the
+            # 2-D program's quantized-path reductions are grid-invariant
+            # (integer psum over data; the feature all_gather argmax picks
+            # the same global first-max for any column blocking), so
+            # resuming across grid shapes is byte-identical too
+            log.info("elastic resume across grid shapes: snapshot mesh %s "
+                     "-> %s on %s devices; per-row state re-sharded",
+                     mesh_rec.get("shape"), have["shape"],
+                     have["n_devices"])
     stream = state.get("stream")
     if stream is not None and getattr(learner, "residency", "hbm") == "stream":
         have = int(getattr(learner.sdata, "shard_rows", 0))

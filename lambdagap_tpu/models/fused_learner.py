@@ -933,6 +933,17 @@ class FusedTreeLearner(SerialTreeLearner):
                                  jnp.zeros(W, jnp.int32)])
         hist_root = leaf_hist(perm0, srows, jnp.int32(0), jnp.int32(N))
         totals = jnp.sum(hist_root[0], axis=0)
+        if fax is not None and self.axis is not None:
+            # 2-D data x feature execution: hist_root[0] is each feature
+            # shard's LOCAL column 0, so the f32 bin-sum above adds the
+            # same rows in a different (bin-grouping) order per shard —
+            # ulp-divergent parent sums would make the per-shard scans
+            # disagree. Broadcast shard 0's totals so every shard scans
+            # with bit-identical aggregates (exact under quantization,
+            # and the contract the stream mirror replays).
+            fidx = lax.axis_index(fax)
+            totals = lax.psum(
+                jnp.where(fidx == 0, totals, jnp.zeros_like(totals)), fax)
         if voting:
             # local root hist: global parent sums need their own (tiny) psum
             totals = lax.psum(totals, self.axis)

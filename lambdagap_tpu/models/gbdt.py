@@ -242,6 +242,54 @@ class GBDT:
         from ..parallel.fused_parallel import FusedDataParallelTreeLearner
         return FusedDataParallelTreeLearner(ds, self.config)
 
+    def _route_fused_2d(self, ds: BinnedDataset, tl: str):
+        """Route distributed training onto the fused 2-D data x feature
+        learner (ISSUE 15) when either
+
+        - ``mesh_shape`` names BOTH axes explicitly ("4x2", "1x8",
+          "8x1", wildcard "0x2"): one program for every grid is what
+          makes the bench's dd x ff sweep comparable and elastic resume
+          across grid shapes byte-identical; or
+        - ``data_residency=stream`` (or a pre-sharded dataset) is
+          combined with ``tree_learner=data``: the composed out-of-core
+          mode — the stream x distributed cell this learner flips from
+          loud demotion to supported (docs/capability-matrix.md).
+
+        Returns None when the 1-D learner dispatch below should run.
+        """
+        cfg = self.config
+        if tl not in ("data", "voting", "feature"):
+            return None
+        s = str(cfg.mesh_shape).strip().lower().replace("*", "x")
+        explicit_2d = "x" in s
+        if not explicit_2d:
+            from ..data.stream import ShardedBinnedDataset
+            wants_stream = (cfg.data_residency == "stream"
+                            or isinstance(ds, ShardedBinnedDataset))
+            if not (wants_stream and tl == "data"):
+                return None
+        if not _fused_mode_enabled(cfg.tpu_fused_learner):
+            if explicit_2d:
+                log.fatal("mesh_shape=%s is a 2-D data x feature grid, "
+                          "which only the fused learner executes; keep "
+                          "tpu_fused_learner enabled or set one "
+                          "mesh_shape extent implicit ('%s')",
+                          cfg.mesh_shape, s.split("x")[0])
+            return None
+        if cfg.forcedsplits_filename:
+            # forced splits need the forced leaf's FULL histogram on
+            # every shard; the 2-D mesh shards histogram columns
+            return self._forced_splits_data_parallel(ds, tl)
+        not_applied = []
+        if _cegb_requested(cfg):
+            not_applied.append("cegb")
+        if not_applied:
+            log.warning("%s are not applied by the fused 2-D "
+                        "tree_learner=%s learner", ", ".join(not_applied),
+                        tl)
+        from ..parallel.fused_parallel import Fused2DTreeLearner
+        return Fused2DTreeLearner(ds, self.config)
+
     def _create_learner(self, ds: BinnedDataset):
         """Learner dispatch (reference: TreeLearner::CreateTreeLearner,
         src/treelearner/tree_learner.cpp — (tree_learner, device) -> class).
@@ -333,6 +381,9 @@ class GBDT:
                 self.config.tpu_fused_learner):
             _demote_advanced_monotone(self.config,
                                       "the fused distributed learners")
+        learner_2d = self._route_fused_2d(ds, tl)
+        if learner_2d is not None:
+            return learner_2d
         if tl == "data":
             # the fused whole-tree shard_map program is the production
             # multi-chip path (one psum per split, zero per-split host

@@ -276,12 +276,20 @@ class SerialTreeLearner:
                 # requested-stream distributed run device-resident would
                 # hide an OOM footprint the caller sized for streaming.
                 # Both axes named (R12b): the demoted knob AND the
-                # tree_learner value that forced the demotion
+                # tree_learner value that forced the demotion. Since
+                # ISSUE 15 the stream x distributed cell is SUPPORTED for
+                # tree_learner=data on the fused 2-D learner (gbdt routes
+                # it there before this resolver runs), so this branch
+                # fires only for the learners whose programs genuinely
+                # keep the matrix resident: the host-loop distributed
+                # trio, fused voting/feature, and pre-partitioned
+                # multi-process data.
                 log.warning("data_residency=stream is not supported with "
                             "tree_learner=%s (%s keeps its device "
                             "matrices resident); falling back to "
-                            "data_residency=hbm", config.tree_learner,
-                            type(self).__name__)
+                            "data_residency=hbm — tree_learner=data "
+                            "streams through the fused 2-D mesh program",
+                            config.tree_learner, type(self).__name__)
             return "hbm"
         blocker_knobs = self._stream_blockers(config)
         if blocker_knobs:

@@ -1,11 +1,13 @@
 from .data_parallel import DataParallelTreeLearner
 from .feature_parallel import FeatureParallelTreeLearner
-from .fused_parallel import FusedDataParallelTreeLearner
+from .fused_parallel import (Fused2DTreeLearner,
+                             FusedDataParallelTreeLearner)
 from .mesh import make_mesh
 from .sharding import DATA_AXIS, FEATURE_AXIS, MESH_AXES, RULES, spec, specs
 from .voting_parallel import VotingParallelTreeLearner
 
 __all__ = ["DataParallelTreeLearner", "FeatureParallelTreeLearner",
+           "Fused2DTreeLearner",
            "FusedDataParallelTreeLearner", "VotingParallelTreeLearner",
            "make_mesh", "DATA_AXIS", "FEATURE_AXIS", "MESH_AXES", "RULES",
            "spec", "specs"]

@@ -83,6 +83,28 @@ RULES: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
     # device-stacked local histograms [D*F, B, 3] (voting keeps histograms
     # shard-local and psums only voted columns)
     (r"^hist_(local|stack)$", (DATA_AXIS,)),
+    # 2-D program arrays (the fused data x feature learner + its stream
+    # mirror): histogram COLUMN blocks shard over "feature" while their
+    # row partials psum over "data" —
+    #   hist_cols  [C, B, 3]            one leaf's histogram, psum-ed over
+    #                                   data, column-sharded
+    #   hist_state [L+1, C, B, 3]       the carried per-leaf histogram state
+    #   hist_grid  [dd, C, B, 3]        per-(data,feature)-device partial
+    #                                   accumulator of the stream pump
+    #   win_bins   [dd, W, C]           one uploaded row window per data
+    #                                   block, columns sharded
+    #   win_cvals  [dd, PV]             per-block per-lane values (split
+    #                                   column / compaction positions)
+    #   leaf_local [dd, L+1, k]         per-data-shard leaf bookkeeping
+    #                                   (begin/count are row-partition
+    #                                   quantities — local per data block,
+    #                                   replicated over feature)
+    (r"^hist_cols$", (FEATURE_AXIS,)),
+    (r"^hist_state$", (None, FEATURE_AXIS)),
+    (r"^hist_grid$", (DATA_AXIS, FEATURE_AXIS)),
+    (r"^win_bins$", (DATA_AXIS, None, FEATURE_AXIS)),
+    (r"^win_(cvals|pos|lanes)$", (DATA_AXIS,)),
+    (r"^leaf_local$", (DATA_AXIS,)),
     # replicated state: psum-ed histograms, split results, node/leaf
     # tables, per-feature metadata, feature sampling masks, rng keys,
     # scalars. Derived from collectives on every shard -> identical
@@ -154,6 +176,50 @@ def parse_mesh_shape(mesh_shape: str) -> Optional[Tuple[int, int]]:
     return dims[0], dims[1]
 
 
+def resolve_mesh_shape(mesh_shape: str, num_devices: int
+                       ) -> Optional[Tuple[int, int]]:
+    """Resolve the ``mesh_shape`` knob against an actual device count:
+    wildcard extents (``"0x4"`` / ``"2x0"`` — "all remaining devices on
+    this axis") are filled in, divisibility and capacity are checked, and
+    every rejection names ``mesh_shape`` (the ``num_grad_quant_bins``
+    error-message precedent). ``""`` -> None (the learner picks its
+    natural 1-D placement)."""
+    shape = parse_mesh_shape(mesh_shape)
+    if shape is None:
+        return None
+    dd, ff = shape
+    if dd == 0 and ff == 0:
+        raise ValueError("mesh_shape cannot be 0x0 (at most one wildcard "
+                         "extent)")
+    if dd == 0:
+        if num_devices % max(ff, 1):
+            raise ValueError(
+                f"mesh_shape {mesh_shape!r}: the wildcard data extent "
+                f"needs the device count ({num_devices}) divisible by the "
+                f"feature extent ({ff})")
+        dd = num_devices // ff
+        if dd == 0:
+            raise ValueError(
+                f"mesh_shape {mesh_shape!r} needs at least {ff} devices, "
+                f"have {num_devices}")
+    if ff == 0:
+        if num_devices % max(dd, 1):
+            raise ValueError(
+                f"mesh_shape {mesh_shape!r}: the wildcard feature extent "
+                f"needs the device count ({num_devices}) divisible by the "
+                f"data extent ({dd})")
+        ff = num_devices // dd
+        if ff == 0:
+            raise ValueError(
+                f"mesh_shape {mesh_shape!r} needs at least {dd} devices, "
+                f"have {num_devices}")
+    if dd * ff > num_devices:
+        raise ValueError(
+            f"mesh_shape {mesh_shape!r} ({dd}x{ff}) needs {dd * ff} "
+            f"devices, have {num_devices}")
+    return dd, ff
+
+
 def make_mesh(num_devices: int = 0, devices: Optional[Sequence] = None,
               mesh_shape: str = "", shard_axis: str = DATA_AXIS) -> Mesh:
     """The registry mesh: ALWAYS 2-D named ``("data", "feature")``.
@@ -161,39 +227,22 @@ def make_mesh(num_devices: int = 0, devices: Optional[Sequence] = None,
     ``mesh_shape=""`` places ``num_devices`` (0 = all visible) on
     ``shard_axis`` — the learner's natural 1-D geometry: data/voting
     learners shard rows (``(D, 1)``), feature learners shard columns
-    (``(1, D)``). An explicit ``mesh_shape`` overrides both knobs.
+    (``(1, D)``). An explicit ``mesh_shape`` overrides both knobs —
+    including genuine 2-D ``dd x ff`` grids, executed by the fused 2-D
+    learner (rows shard over ``data``, histogram columns over
+    ``feature``; parallel/fused_parallel.py Fused2DTreeLearner).
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
-    shape = parse_mesh_shape(mesh_shape)
+    shape = resolve_mesh_shape(mesh_shape, len(devices))
     if shape is None:
         if num_devices and num_devices > 0:
             devices = devices[:num_devices]
         d = len(devices)
         shape = (d, 1) if shard_axis == DATA_AXIS else (1, d)
     else:
-        dd, ff = shape
-        if dd == 0 and ff == 0:
-            raise ValueError("mesh_shape cannot be 0x0")
-        if dd == 0:
-            dd = len(devices) // max(ff, 1)
-        if ff == 0:
-            ff = len(devices) // max(dd, 1)
-        if dd * ff > len(devices):
-            raise ValueError(
-                f"mesh_shape {dd}x{ff} needs {dd * ff} devices, "
-                f"have {len(devices)}")
-        devices = devices[:dd * ff]
-        shape = (dd, ff)
-        if dd > 1 and ff > 1:
-            # the RULES are 2-D ready (x_rows names both axes) but the
-            # fused programs' collectives currently reduce over exactly
-            # one axis per histogram; genuine data x feature execution is
-            # the registry's next consumer, not today's
-            raise ValueError(
-                f"mesh_shape {dd}x{ff}: 2-D data x feature execution is "
-                "not implemented yet; set one extent to 1")
+        devices = devices[:shape[0] * shape[1]]
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, MESH_AXES)
 

@@ -1,0 +1,38 @@
+"""R6 fixture (ISSUE 15): a 2-D program inventing a third axis.
+
+The fused 2-D learner reduces over BOTH registry axes — psum over
+``data`` for the histogram partials, all_gather over ``feature`` for the
+split decision. With a genuine ``dd x ff`` mesh live, a collective over
+any OTHER name is exactly the drift R6 exists to catch: it would trace
+fine on a mesh that happened to declare the private axis and fail (or
+silently mis-reduce through a rogue Mesh) everywhere else. The registry
+(``parallel/sharding.py`` MESH_AXES) stays the one axis universe.
+"""
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from .sharding import DATA_AXIS, FEATURE_AXIS, MESH_AXES
+
+
+def make_grid_mesh(devs, dd, ff):
+    # the registry-shaped 2-D mesh: both axes named, dd x ff extents —
+    # but a private Mesh() next to the registry is its own R10 finding
+    # (make_mesh is the one constructor)
+    return Mesh(np.asarray(devs).reshape(dd, ff), MESH_AXES)  # BAD:R10
+
+
+def leaf_hist_2d(local_partial):
+    # the 2-D decomposition's two legitimate collectives
+    full_cols = lax.psum(local_partial, DATA_AXIS)
+    return lax.all_gather(full_cols, FEATURE_AXIS)
+
+
+def bad_grid_axis(local_partial):
+    # a learner psum-ing over an axis the registry does not declare
+    # while the 2-D mesh is live
+    return lax.psum(local_partial, "grid")  # BAD:R6
+
+
+def bad_gather_axis(winners):
+    return lax.all_gather(winners, "cols")  # BAD:R6

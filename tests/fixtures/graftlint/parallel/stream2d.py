@@ -1,0 +1,38 @@
+"""R1 fixture (ISSUE 15): a D2H sync inside the per-shard ring-fill
+loop of the composed stream x 2-D-mesh path.
+
+``_s2_pump`` is the composed mode's window pump: the host builds one
+stacked per-block buffer per window and ONE mesh-sharded device_put
+lands every data block's slice on its own device. A blocking host sync
+inside the per-block fill loop serializes EVERY shard's H2D behind the
+device — the overlap dies fleet-wide while training still converges, so
+nothing crashes; only the phase breakdown (or this rule) notices.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _s2_pump(blocks, consume):
+    for c in range(len(blocks)):
+        stacked = []
+        for d, block in enumerate(blocks[c]):
+            buf = jax.device_put(block)
+            # forcing per-block completion defeats the ring
+            stacked.append(np.asarray(jax.device_get(buf)))  # BAD:R1
+        consume(c, jnp.stack([jnp.asarray(b) for b in stacked]))
+
+
+def _train_tree_stream2d(state, picks):
+    for k in range(len(picks)):
+        meta = state["leaf_f"][k]
+        host = float(jnp.sum(meta))  # BAD:R1
+        if host <= 0.0:
+            break
+    return state
+
+
+def build_block_buffers(blocks):
+    # clean: host-side gather/memcpy work only — no device sync in the
+    # fill path; the mesh-sharded put happens once per window downstream
+    return [np.concatenate(b, axis=0) for b in blocks]

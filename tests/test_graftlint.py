@@ -70,6 +70,8 @@ def fixture_findings():
     "serve/r5_frontend.py",
     "r6_collective_axis.py",
     "parallel/rogue_learner.py",
+    "parallel/r6_2d_program.py",
+    "parallel/stream2d.py",
     "obs/r7_unsynced_timing.py",
     "serve/r8_futures.py",
     "serve/r8_router.py",
@@ -235,10 +237,11 @@ def test_r6_registry_overrides_private_mesh_declarations(tmp_path):
     shutil.copy(rogue, tmp_path / "rogue_learner.py")
     alone = scan([str(tmp_path / "rogue_learner.py")], select=["R6"])
     assert alone == [], [f.format() for f in alone]
-    # with the registry: flagged
+    # with the registry: flagged (the 2-D-program fixture's private axes
+    # ride the same registry universe)
     together = scan([os.path.join(FIXTURES, "parallel")], select=["R6"])
     assert {(f.rule, os.path.basename(f.path)) for f in together} == {
-        ("R6", "rogue_learner.py")}
+        ("R6", "rogue_learner.py"), ("R6", "r6_2d_program.py")}
 
 
 def test_r6_clean_scan_over_refactored_parallel_package():

@@ -123,14 +123,17 @@ def test_stream_blocker_falls_back_to_hbm():
 
 @pytest.mark.parametrize("tree_learner", ["data", "voting", "feature"])
 @pytest.mark.parametrize("fused", [True, False])
-def test_stream_distributed_falls_back_to_hbm_loudly(tree_learner, fused,
-                                                     caplog):
-    """ISSUE-8 satellite: stream x distributed is an unsupported combo —
-    every distributed learner (fused and host-loop) must fall back to
-    device-resident training with the documented WARNING, never silently
-    and never by dying."""
+def test_stream_distributed_capability_matrix(tree_learner, fused, caplog):
+    """ISSUE-15 satellite (flipping the ISSUE-8 cell): stream x
+    distributed is now SUPPORTED for tree_learner=data on the fused 2-D
+    learner — the composed out-of-core program streams host shards
+    through the mesh with no demotion and no warning. Every other
+    distributed learner (host-loop trio, fused voting/feature) still
+    falls back to device-resident training with the documented WARNING,
+    never silently and never by dying."""
     import logging
     X, y = _data(n=1500)
+    supported = fused and tree_learner == "data"
     # verbose=0 keeps the package logger at WARNING: Config application
     # calls set_verbosity during train(), overriding caplog's level
     with caplog.at_level(logging.WARNING, logger="lambdagap_tpu"):
@@ -138,12 +141,20 @@ def test_stream_distributed_falls_back_to_hbm_loudly(tree_learner, fused,
                    {"tree_learner": tree_learner, "tpu_num_devices": 2,
                     "verbose": 0})
     learner = b._booster.learner
-    assert learner.residency == "hbm", type(learner).__name__
     assert b.num_trees() > 0
-    assert any("data_residency=stream is not supported" in r.message
-               and "falling back to data_residency=hbm" in r.message
-               for r in caplog.records), \
-        [r.message for r in caplog.records]
+    demotions = [r.message for r in caplog.records
+                 if "data_residency=stream is not supported" in r.message]
+    if supported:
+        from lambdagap_tpu.parallel.fused_parallel import Fused2DTreeLearner
+        assert isinstance(learner, Fused2DTreeLearner), type(learner).__name__
+        assert learner.residency == "stream"
+        assert (learner.dd, learner.ff) == (2, 1)
+        assert demotions == [], demotions
+    else:
+        assert learner.residency == "hbm", type(learner).__name__
+        assert any("falling back to data_residency=hbm" in m
+                   for m in demotions), \
+            [r.message for r in caplog.records]
 
 
 def test_auto_residency_picks_stream_for_sharded_dataset():

@@ -19,7 +19,17 @@ contract the unified sharding registry is supposed to guarantee:
    that keeps retracing would silently serialize the mesh);
 3. the guard snapshot sidecar carries the mesh + row-shard geometry
    fields (``mesh.axes/shape/n_devices/n_pad/n_loc``) that
-   ``resume=auto`` reads back for elastic resume.
+   ``resume=auto`` reads back for elastic resume;
+4. (ISSUE 15) the fused 2-D data x feature program on a genuine 2x4
+   grid builds quantized trees bit-identical to the 1-device serial
+   run with zero steady recompiles — G0 guards the dd>1 && ff>1
+   composition, not just the pure axes;
+5. (ISSUE 15) one stream x distributed parity check: the composed
+   out-of-core path on 2 virtual devices over 2 ragged host shards is
+   bit-identical to the resident run on the same grid (the same-grid
+   mirror contract — f32 cross-width identity is shape-lucky per the
+   ISSUE-8 finding, so the cross-width legs stay quantized), with the
+   h2d_prefetch/chunk_wait ring phases live and zero steady compiles.
 
 Exit 0 on success, 1 with a diagnostic on any violation.
 """
@@ -100,9 +110,77 @@ if mesh.get("n_loc", 0) * 8 != mesh.get("n_pad", -1):
           + json.dumps(mesh))
     sys.exit(1)
 
-print("MCGATE_" + "OK 8-device fused data-parallel bit-identical to "
-      "1-device serial, zero steady compiles, sidecar mesh fields "
-      + json.dumps(mesh))
+# -- ISSUE 15: the genuine 2-D data x feature program ------------------
+# quantized trees must be bit-identical on a real dd>1 && ff>1 grid too
+# (integer psum over data is grid-invariant; the feature all_gather
+# argmax picks the same global first-max for any column blocking)
+def train_grid(grid, residency="hbm", extra=None):
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "tree_learner": "data", "tpu_fused_learner": "1",
+              "min_data_in_leaf": 20, "mesh_shape": grid,
+              "use_quantized_grad": True, "stochastic_rounding": False,
+              "data_residency": residency,
+              "telemetry": True, "telemetry_warmup": WARMUP}
+    params.update(extra or {})
+    return lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                     num_boost_round=ROUNDS)
+
+from lambdagap_tpu.parallel.fused_parallel import Fused2DTreeLearner
+b24 = train_grid("2x4")
+assert isinstance(b24._booster.learner, Fused2DTreeLearner)
+t24 = b24.model_to_string().split("end of trees")[0]
+if t24.split("Tree=0")[1] != t1.split("Tree=0")[1]:
+    print("MCGATE_FAIL 2-D grid: 2x4 fused 2-D trees diverged from the "
+          "1-device fused serial learner on the quantized path")
+    sys.exit(1)
+tel24 = b24._booster.telemetry
+bad24 = [(r["iter"], r["compiles"]["total"]) for r in tel24.records
+         if r.get("iter", 0) >= WARMUP
+         and (r.get("compiles") or {}).get("total", 0)]
+if bad24:
+    print("MCGATE_FAIL steady-state recompiles on the 2x4 grid: "
+          + json.dumps(bad24))
+    sys.exit(1)
+
+# -- ISSUE 15: stream x distributed composition ------------------------
+# 2 devices, 2 ragged host shards: the composed out-of-core path must be
+# bit-identical to the RESIDENT run on the same grid (the same-grid
+# mirror contract; stream excludes quantization, and f32 cross-WIDTH
+# identity is shape-lucky per the ISSUE-8 finding, so the cross-width
+# leg above stays quantized while this leg pins stream==hbm)
+stream_extra = {"use_quantized_grad": False, "enable_bundle": False,
+                "stream_shard_rows": 3100}   # 6001 rows -> 2 ragged shards
+bs = train_grid("2x1", "stream", stream_extra)
+lr = bs._booster.learner
+assert isinstance(lr, Fused2DTreeLearner) and lr.residency == "stream", (
+    type(lr).__name__, getattr(lr, "residency", None))
+assert lr.sdata.num_shards == 2 and lr.sdata.shards[-1].shape[0] == 2901
+bh = train_grid("2x1", "hbm", stream_extra)
+if bs.model_to_string().split("end of trees")[0] \
+        != bh.model_to_string().split("end of trees")[0]:
+    print("MCGATE_FAIL stream x distributed: composed 2-device stream "
+          "trees diverged from the resident run on the same grid")
+    sys.exit(1)
+tels = bs._booster.telemetry
+bads = [(r["iter"], r["compiles"]["total"]) for r in tels.records
+        if r.get("iter", 0) >= WARMUP
+        and (r.get("compiles") or {}).get("total", 0)]
+if bads:
+    print("MCGATE_FAIL steady-state recompiles in the composed stream x "
+          "distributed arm: " + json.dumps(bads))
+    sys.exit(1)
+phases = set()
+for r in tels.records:
+    phases.update((r.get("phases") or {}).keys())
+if {"h2d_prefetch", "chunk_wait"} - phases:
+    print("MCGATE_FAIL ring phases missing from the composed stream arm: "
+          + json.dumps(sorted({"h2d_prefetch", "chunk_wait"} - phases)))
+    sys.exit(1)
+
+print("MCGATE_" + "OK 8-device fused data-parallel AND 2x4 fused 2-D "
+      "bit-identical to 1-device serial (quantized), composed stream x "
+      "distributed bit-identical to resident on 2 ragged shards, zero "
+      "steady compiles, sidecar mesh fields " + json.dumps(mesh))
 """
 
 
