@@ -117,7 +117,7 @@ def bench_engines(booster, X) -> dict:
     gb.config.tpu_fast_predict_rows = 0
     out = {"rows": len(X)}
     try:
-        for eng in ("tensor", "scan"):
+        for eng in ("tensor", "scan", "compiled"):
             gb.config.predict_engine = eng
             gb.invalidate_predict_cache()
             booster.predict(X)               # compile + warm
@@ -132,10 +132,68 @@ def bench_engines(booster, X) -> dict:
     out["tensor_speedup_vs_scan"] = (out["scan_us_per_row_warm"]
                                      / max(out["tensor_us_per_row_warm"],
                                            1e-9))
+    out["compiled_speedup_vs_tensor"] = (
+        out["tensor_us_per_row_warm"]
+        / max(out["compiled_us_per_row_warm"], 1e-9))
     t0 = time.perf_counter()
     booster.predict(X[:4096])                # native single-row traverser
     out["native_us_per_row"] = 1e6 * (time.perf_counter() - t0) / 4096
     return out
+
+
+def bench_pack_many_small(n_models: int = 6, trees: int = 24,
+                          feats: int = 16, rows_per_tenant: int = 32,
+                          windows: int = 30) -> dict:
+    """The many-small-models shape (ISSUE 16): N per-tenant forests too
+    small to fill a chip alone. Solo serving dispatches one executable
+    per tenant per window; the cross-model pack pads all members into ONE
+    executable and dispatches the mixed window once. Reports warm us/row
+    both ways plus the dispatch count ratio — on CPU the ratio documents
+    the mechanism (executable count), the chip run supplies the latency
+    ratio (see BENCH_NOTES.md)."""
+    import lambdagap_tpu as lgb
+    from lambdagap_tpu.serve.cache import (CompiledForestCache, ModelPack,
+                                           _plan)
+    rng = np.random.RandomState(7)
+    caches, tenants = {}, []
+    for m in range(n_models):
+        Xm = rng.randn(2000, feats).astype(np.float32)
+        ym = (Xm[:, 0] - 0.3 * Xm[:, (m + 1) % feats]
+              + 0.1 * rng.randn(2000)).astype(np.float32)
+        b = lgb.train({"objective": "regression", "num_leaves": 15,
+                       "verbose": -1, "tpu_fast_predict_rows": 0,
+                       "predict_engine": "compiled"},
+                      lgb.Dataset(Xm, label=ym), num_boost_round=trees)
+        caches[f"t{m}"] = CompiledForestCache(b._booster)
+        tenants.append((f"t{m}", Xm[:rows_per_tenant]))
+    pack = ModelPack(caches)
+    parts = [(name, rows, False) for name, rows in tenants]
+    total_rows = sum(len(r) for _, r in tenants)
+
+    solo = [caches[name].predict(rows) for name, rows in tenants]  # warm
+    t0 = time.perf_counter()
+    for _ in range(windows):
+        for name, rows in tenants:
+            caches[name].predict(rows)
+    solo_us = 1e6 * (time.perf_counter() - t0) / (windows * total_rows)
+
+    packed = pack.predict_mixed(parts)                             # warm
+    t0 = time.perf_counter()
+    for _ in range(windows):
+        pack.predict_mixed(parts)
+    pack_us = 1e6 * (time.perf_counter() - t0) / (windows * total_rows)
+
+    exact = all(np.array_equal(p, s) for p, s in zip(packed, solo))
+    plan = _plan(pack.buckets, total_rows)
+    return {"models": n_models, "trees_per_model": trees,
+            "rows_per_tenant": rows_per_tenant,
+            "packed_trees": pack.packed.num_trees,
+            "solo_dispatches_per_window": n_models,
+            "packed_dispatches_per_window": len(plan),
+            "solo_us_per_row_warm": solo_us,
+            "packed_us_per_row_warm": pack_us,
+            "pack_speedup_vs_solo": solo_us / max(pack_us, 1e-9),
+            "bit_identical_to_solo": bool(exact)}
 
 
 def bench_served(booster, X, n_requests: int, clients: int,
@@ -426,11 +484,28 @@ def main(argv=None) -> int:
               "Booster.predict path", file=sys.stderr)
         return 1
 
-    print("device engine A/B (tensor vs scan vs native)...", file=sys.stderr)
+    print("device engine A/B (tensor vs scan vs compiled vs native)...",
+          file=sys.stderr)
     engines = bench_engines(booster, X)
     print(f"  tensor {engines['tensor_us_per_row_warm']:.1f} us/row, "
           f"scan {engines['scan_us_per_row_warm']:.1f}, "
+          f"compiled {engines['compiled_us_per_row_warm']:.1f}, "
           f"native {engines['native_us_per_row']:.1f}", file=sys.stderr)
+
+    print("cross-model pack (many small tenant forests)...",
+          file=sys.stderr)
+    pack_small = bench_pack_many_small()
+    print(f"  {pack_small['models']} models: solo "
+          f"{pack_small['solo_us_per_row_warm']:.1f} us/row @ "
+          f"{pack_small['solo_dispatches_per_window']} dispatches, packed "
+          f"{pack_small['packed_us_per_row_warm']:.1f} us/row @ "
+          f"{pack_small['packed_dispatches_per_window']} "
+          f"(exact={pack_small['bit_identical_to_solo']})",
+          file=sys.stderr)
+    if not pack_small["bit_identical_to_solo"]:
+        print("FATAL: packed outputs diverge from solo member caches",
+              file=sys.stderr)
+        return 1
 
     print(f"naive per-request predict x{args.naive_requests}...",
           file=sys.stderr)
@@ -498,6 +573,7 @@ def main(argv=None) -> int:
         "backend": jax.default_backend(),
         "bit_identical_to_device_predict": exact,
         "engine_ab": engines,
+        "pack_many_small": pack_small,
         "naive": naive,
         "naive_device": naive_dev,
         "serve": served,

@@ -31,10 +31,14 @@ Heuristic hot contexts:
   serialize every histogram chunk of every split of every tree),
   ``ops/linear.py`` (the linear-leaf moment accumulation runs once per
   tree in the boosting loop; a sync inside its chunk loop would stall
-  every chunk of every tree's solve), and ``obs/trace.py`` /
+  every chunk of every tree's solve), ``obs/trace.py`` /
   ``obs/fleet.py`` (span enter/exit runs per sampled request per hop and
   the fleet merge per scrape tick — observability must never sync the
-  device it observes).
+  device it observes), and ``infer/`` (the compiled-forest subsystem:
+  the engine's traversal dispatch runs per serve bucket, and the
+  compiler's node-block packing loop runs per tree per compile — a
+  device fetch there serializes a hot-swap build against the serving
+  chip).
 
 Sync calls flagged: ``jax.device_get``, ``.item()``, ``.block_until_ready()``,
 ``float(...)``/``int(...)`` wrapping a jax/jnp call, and
@@ -94,11 +98,20 @@ HOT_FUNCTIONS = frozenset({
     # claims to measure; one in the merge would convoy the control loop
     # behind the data plane)
     "record", "maybe_trace", "merge_snapshots", "scrape",
+    # compiled-forest inference (infer/engine.py): the traversal kernel
+    # and its jitted drivers run once per serve dispatch — a D2H inside
+    # any of them stalls every padded bucket of every mixed batch; the
+    # compiler (infer/compile.py) is host-only by design, but its node-
+    # block packing loop runs per tree per compile and a device fetch
+    # there would serialize a hot-swap's build against the serving chip
+    "_traverse_kernel", "_traverse_block", "_traverse_all",
+    "_predict_compiled", "_predict_packed", "predict_mixed",
 })
 
 # files whose loop bodies are hot regardless of function name
 HOT_PATHS = ("/serve/", "/ops/predict_tensor", "/ops/hist_pallas",
-             "/data/stream", "/ops/linear", "/obs/trace", "/obs/fleet")
+             "/data/stream", "/ops/linear", "/obs/trace", "/obs/fleet",
+             "/infer/")
 
 # the sync classifier moved to analysis/effects.py (shared with the
 # transitive effect inference); this alias keeps the historical name

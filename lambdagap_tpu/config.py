@@ -352,9 +352,20 @@ class Config:
     pred_early_stop_margin: float = 10.0
     # device predict traversal engine (docs/serving.md "Forest layout &
     # traversal"): tensor = batched [rows x trees] node-table traversal;
-    # scan = sequential per-tree reference oracle (bit-identical outputs)
-    predict_engine: str = "tensor"       # tensor (batched rows x trees) / scan (per-tree oracle)
+    # scan = sequential per-tree reference oracle (bit-identical outputs);
+    # compiled = serving-shaped artifact traversal (lambdagap_tpu.infer —
+    # quantized node blocks, pruned/merged trees, Pallas kernel; raw rows
+    # only, binned replay paths demote to tensor)
+    predict_engine: str = "tensor"       # tensor (batched rows x trees) / scan (per-tree oracle) / compiled (infer artifact)
     predict_tree_tile: int = 64          # trees per tensorized tile dispatch
+
+    # -- infer (forest compiler; docs/serving.md "Compiled forest artifacts")
+    infer_quant: str = "auto"            # threshold/bitset palette code width: auto / u8 / u16 (u8|u16 error instead of widening)
+    infer_prune: bool = True             # drop branches no input can reach (exact path-interval analysis)
+    infer_merge_trees: bool = True       # trees with identical pruned structure share one traversal
+    infer_node_block_kb: int = 512       # node-table bytes per breadth-first block (the traversal kernel's VMEM working set)
+    infer_row_block: int = 256           # rows per traversal-kernel grid step; 0 = default
+    serve_pack_models: bool = False      # pack resident compiled models into ONE executable; mixed per-tenant batches dispatch once
 
     # -- serve (task=serve / Booster.as_server; docs/serving.md) ----------
     # padded request-batch sizes with pre-compiled predict executables;
@@ -616,9 +627,14 @@ class Config:
              f"use gbdt boosting"),
             (self.monotone_constraints_method in ("basic", "intermediate", "advanced"),
              "unknown monotone_constraints_method"),
-            (self.predict_engine in ("tensor", "scan"),
+            (self.predict_engine in ("tensor", "scan", "compiled"),
              f"unknown predict_engine {self.predict_engine!r}"),
             (self.predict_tree_tile >= 1, "predict_tree_tile must be >= 1"),
+            (self.infer_quant in ("auto", "u8", "u16"),
+             f"unknown infer_quant {self.infer_quant!r}"),
+            (self.infer_node_block_kb >= 1,
+             "infer_node_block_kb must be >= 1"),
+            (self.infer_row_block >= 0, "infer_row_block must be >= 0"),
             (self.serve_max_batch >= 1, "serve_max_batch must be >= 1"),
             (self.serve_max_delay_ms >= 0, "serve_max_delay_ms must be >= 0"),
             (all(b > 0 for b in self.serve_buckets),

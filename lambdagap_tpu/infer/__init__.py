@@ -1,0 +1,21 @@
+"""forgec: the inference-compiled forest subsystem.
+
+Training builds trees in a training-friendly shape (host ``Tree`` objects,
+SoA ``TreeArrays`` stacked per booster); serving until now traversed that
+SAME shape. This package is the missing lowering step — a forest
+*compiler* (:mod:`lambdagap_tpu.infer.compile`) that turns a trained
+booster into a serving-shaped artifact (quantized thresholds, packed
+feature ids, breadth-first node blocks, dead branches pruned,
+same-structure trees merged, sha256 content-addressed), and the engine
+(:mod:`lambdagap_tpu.infer.engine`, ``predict_engine=compiled``) that
+traverses it with a Pallas kernel while staying bit-identical to the scan
+oracle (docs/serving.md "Compiled forest artifacts").
+"""
+from .compile import (ArtifactMismatch, ArtifactStore, ForestArtifact,
+                      compile_forest, source_key_of)
+from .engine import CompiledForest, PackedForests
+
+__all__ = [
+    "ArtifactMismatch", "ArtifactStore", "ForestArtifact", "compile_forest",
+    "source_key_of", "CompiledForest", "PackedForests",
+]

@@ -92,8 +92,15 @@ def dispatch_forest_predict(cfg, x, forest, tree_class, num_class: int,
     ``blocks`` are pre-sliced tree tiles/blocks from the booster or serve
     caches (either engine consumes the same layout). ``has_linear`` turns
     on the per-leaf dot-product payload in the traversal carry (linear
-    trees; raw rows only — binned linear replay stays host-side)."""
-    if cfg.predict_engine == "tensor":
+    trees; raw rows only — binned linear replay stays host-side).
+
+    ``predict_engine=compiled`` rides the tensor branch here: this entry
+    point serves the training-side replay paths (binned rows, refit,
+    training score rebuilds), which traverse the TRAINING-shaped tables
+    the infer compiler does not model — the compiled artifact takes over
+    in GBDT.predict_raw and the serve cache, the raw serving shapes it
+    exists for (docs/serving.md "Compiled forest artifacts")."""
+    if cfg.predict_engine in ("tensor", "compiled"):
         return predict_forest_tensor(
             x, forest, tree_class, num_class, max_depth, binned,
             early_stop_freq, early_stop_margin,
@@ -107,8 +114,10 @@ def dispatch_forest_predict(cfg, x, forest, tree_class, num_class: int,
 def dispatch_forest_leaf(cfg, x, forest, max_depth: int, binned: bool,
                          blocks=None):
     """Engine-routed leaf-index dispatch ([T, N] int32), same contract as
-    :func:`dispatch_forest_predict`."""
-    if cfg.predict_engine == "tensor":
+    :func:`dispatch_forest_predict` (compiled rides the tensor branch: the
+    artifact renumbers nodes but never leaves, so leaf indices are already
+    engine-invariant)."""
+    if cfg.predict_engine in ("tensor", "compiled"):
         return predict_forest_leaf_tensor(
             x, forest, max_depth, binned,
             tree_tile=cfg.predict_tree_tile, tiles=blocks)
@@ -1071,6 +1080,7 @@ class GBDT:
         model-count component of the cache keys."""
         self._fast_cache = None
         self._forest_cache = None
+        self._compiled_cache = None
         self.generation += 1
 
     def _device_forest(self, idx, trees):
@@ -1087,13 +1097,36 @@ class GBDT:
             K = self.num_tree_per_iteration
             forest, depth = forest_to_arrays(trees, use_inner_feature=False)
             tree_class = jnp.asarray([i % K for i in idx], jnp.int32)
-            if cfg.predict_engine == "tensor":
+            if cfg.predict_engine in ("tensor", "compiled"):
                 blocks = build_tree_tiles(forest, tree_class,
                                           cfg.predict_tree_tile)
             else:
                 blocks = build_forest_blocks(forest, tree_class)
             self._forest_cache = (key, (forest, depth, tree_class, blocks))
         return self._forest_cache[1]
+
+    def _compiled_forest(self, start_iteration: int, num_iteration: int,
+                         es_freq: int = 0):
+        """Cached compiled-forest view (lambdagap_tpu.infer) for the raw
+        serving path: the forest is lowered ONCE — pruned, merged,
+        palette-quantized, blocked — and the CompiledForest holds the
+        device-resident buffers across predict calls, like _device_forest
+        does for the training-shaped tables."""
+        cfg = self.config
+        key = (self.generation, len(self.models), start_iteration,
+               num_iteration, es_freq,
+               float(cfg.pred_early_stop_margin), cfg.infer_quant,
+               cfg.infer_prune, cfg.infer_merge_trees,
+               cfg.infer_node_block_kb, cfg.infer_row_block)
+        cache = getattr(self, "_compiled_cache", None)
+        if cache is None or cache[0] != key:
+            from ..infer import CompiledForest, compile_forest
+            artifact = compile_forest(self, start_iteration, num_iteration)
+            self._compiled_cache = (key, CompiledForest(
+                artifact, early_stop_freq=es_freq,
+                early_stop_margin=float(cfg.pred_early_stop_margin),
+                row_block=cfg.infer_row_block))
+        return self._compiled_cache[1]
 
     def _fast_forest(self, idx, trees):
         """Cached flat forest for the native low-latency predictor; None
@@ -1150,6 +1183,17 @@ class GBDT:
                 if self.average_output:
                     res = res / max(1, len(idx) // max(K, 1))
                 return res[0] if K == 1 else res.T
+        if self.config.predict_engine == "compiled":
+            # serving-shaped path: the infer compiler lowers the forest
+            # once (pruned/merged/quantized node blocks); traversal +
+            # forest-order accumulation stay bit-identical to the engines
+            # below, so averaging/conversion here is shared unchanged
+            cf = self._compiled_forest(start_iteration, num_iteration,
+                                       es_freq)
+            res = np.asarray(jax.device_get(cf.predict(jnp.asarray(data))))
+            if self.average_output:
+                res = res / max(1, len(idx) // max(K, 1))
+            return res[0] if K == 1 else res.T
         forest, depth, tree_class, blocks = self._device_forest(idx, trees)
         # linear forests ride the SAME device dispatch: the traversal carry
         # accumulates each leaf's dot product from the padded coefficient

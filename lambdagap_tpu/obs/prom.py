@@ -104,6 +104,15 @@ def render_serve(snapshot: Dict) -> str:
              "Device forest (re)builds", "counter")
     w.metric(p + "bucket_compiles_total", cache.get("bucket_compiles", 0),
              "Bucket executable compiles", "counter")
+    w.metric(p + "compile_local_total", cache.get("compiles_local", 0),
+             "Forest artifacts lowered by the local infer compiler",
+             "counter")
+    w.metric(p + "compile_shared_total", cache.get("compiles_shared", 0),
+             "Forest builds satisfied by a fleet-shipped artifact "
+             "(sha256 admission instead of a local compile)", "counter")
+    w.metric(p + "packed_dispatches_total",
+             cache.get("packed_dispatches", 0),
+             "Cross-model pack dispatches (serve_pack_models)", "counter")
     w.metric(p + "swaps_total", snapshot.get("swaps", 0),
              "Model hot-swaps", "counter")
     w.metric(p + "evictions_total", snapshot.get("evictions", 0),

@@ -13,6 +13,8 @@ JSON — one object per line, matching the ``task=serve`` loop verbs:
     {"op": "health",  "id": 4}            {"op": "models",  "id": 6}
     {"op": "signals", "id": 7}            {"op": "prefetch", "id": 9,
                                            "model": "m"}
+    {"op": "artifact", "id": 10, "payload": "<b64>", "expect_hash": "..."}
+    {"op": "artifact_get", "id": 11, "model": "m"}
 
 The optional ``trace`` field carries the distributed-tracing context
 (obs/trace.py): the server records frontend/serve/dispatch child spans
@@ -56,6 +58,7 @@ import numpy as np
 
 from ..guard.degrade import (ReplicaUnavailable, ServeOverloaded,
                              ServeTimeout, SwapFailed, SwapRejected)
+from ..infer import ArtifactMismatch
 from ..obs import trace as obs_trace
 from ..utils import log
 
@@ -72,6 +75,7 @@ _KINDS = {
     "ServeTimeout": ServeTimeout,
     "SwapFailed": SwapFailed,
     "SwapRejected": SwapRejected,
+    "ArtifactMismatch": ArtifactMismatch,
     "ValueError": ValueError,
     "KeyError": KeyError,
 }
@@ -222,6 +226,30 @@ class _Conn:
         info = self.frontend.target.prefetch(**kwargs)
         self.send({"id": req_id, "ok": True, "info": info})
 
+    def _op_artifact(self, req_id, frame) -> None:
+        # compiled-forest artifact admission (docs/serving.md "Compiled
+        # forest artifacts"): the payload is the base64 of
+        # ForestArtifact.to_bytes(); the content hash is verified before
+        # the store mutates, so a torn/tampered frame answers
+        # ArtifactMismatch and the replica compiles locally instead —
+        # loudly, never serving a wrong model
+        import base64
+        payload = base64.b64decode(frame["payload"])
+        h = self.frontend.target.admit_artifact(
+            payload, expect_hash=frame.get("expect_hash"))
+        self.send({"id": req_id, "ok": True, "hash": h})
+
+    def _op_artifact_get(self, req_id, frame) -> None:
+        # the publisher side: serialize a model's compiled artifact so a
+        # peer (or an operator) can ship it to the rest of the fleet
+        import base64
+        kwargs = {}
+        if frame.get("model") is not None:
+            kwargs["model"] = frame["model"]
+        payload = self.frontend.target.artifact_bytes(**kwargs)
+        self.send({"id": req_id, "ok": True,
+                   "payload": base64.b64encode(payload).decode()})
+
     def _op_stats(self, req_id, frame) -> None:
         # reservoirs=true adds the raw reservoir states a fleet scraper
         # merges (bounded; obs/fleet.py)
@@ -300,8 +328,9 @@ class ServeFrontend:
             name=f"lambdagap-serve-frontend-{self._port}")
         self._accept_thread.start()
         log.info("serve frontend listening on %s:%d (newline-JSON "
-                 "protocol; ops: predict/swap/swap_delta/prefetch/stats/"
-                 "prometheus/signals/health/models)", self.host, self._port)
+                 "protocol; ops: predict/swap/swap_delta/prefetch/"
+                 "artifact/artifact_get/stats/prometheus/signals/health/"
+                 "models)", self.host, self._port)
         return self
 
     def _accept_loop(self) -> None:
@@ -511,6 +540,27 @@ class FrontendClient:
         (placement actuation; pays any readmission off the request
         path)."""
         return self._call("prefetch", timeout=timeout, model=model)["info"]
+
+    def push_artifact(self, payload: bytes,
+                      expect_hash: Optional[str] = None,
+                      timeout: Optional[float] = 120.0) -> str:
+        """Ship a serialized compiled-forest artifact to the remote
+        replica's store; its next matching build skips the compile
+        (the fleet-wide one-compile contract). Returns the verified
+        hash; a corrupt payload raises ``ArtifactMismatch``."""
+        import base64
+        return self._call("artifact", timeout=timeout,
+                          payload=base64.b64encode(payload).decode(),
+                          expect_hash=expect_hash)["hash"]
+
+    def fetch_artifact(self, model: Optional[str] = None,
+                       timeout: Optional[float] = 120.0) -> bytes:
+        """The publisher side: the remote replica's serialized compiled
+        artifact for ``model`` (requires predict_engine=compiled)."""
+        import base64
+        return base64.b64decode(
+            self._call("artifact_get", timeout=timeout,
+                       model=model)["payload"])
 
     def stats(self, timeout: Optional[float] = 30.0,
               reservoirs: bool = False) -> dict:

@@ -93,11 +93,16 @@ class ModelRegistry:
 
     def __init__(self, build_cache: Callable, stats=None,
                  hbm_budget_bytes: int = 0,
-                 breaker_threshold: int = 3) -> None:
+                 breaker_threshold: int = 3,
+                 artifact_store=None) -> None:
         self._build = build_cache
         self._stats = stats
         self.hbm_budget_bytes = int(hbm_budget_bytes)
         self._breaker_threshold = int(breaker_threshold)
+        # shared infer.ArtifactStore (compiled engine): builds consult it
+        # by source key before compiling, and admit_artifact() feeds it
+        # peer-shipped compiles so the whole fleet pays for ONE lowering
+        self.artifacts = artifact_store
         self._lock = threading.Lock()    # name map + LRU metadata + flips
         self._entries: Dict[str, ModelEntry] = {}
         self._seq = itertools.count(1)
@@ -193,6 +198,9 @@ class ModelRegistry:
                 if info is not None:
                     info["readmitted"] = True
                     info["build_s"] = time.perf_counter() - t0
+                    ah = getattr(cache, "artifact_hash", None)
+                    if ah:                       # compiled engine: which
+                        info["artifact_hash"] = ah  # artifact was rebuilt
                 admitted = self._admit(e, gbdt, cache, readmission=True,
                                        expect_generation=gen)
             finally:
@@ -291,6 +299,40 @@ class ModelRegistry:
                 f"serving continues on generation {e.generation}") from exc
         return self.swap(name, new_text)
 
+    def admit_artifact(self, payload: bytes,
+                       expect_hash: Optional[str] = None) -> str:
+        """Admit a peer-shipped compiled-forest artifact into this
+        replica's :class:`~lambdagap_tpu.infer.ArtifactStore` (content
+        hash verified BEFORE the store mutates — a torn or tampered frame
+        raises :class:`~lambdagap_tpu.infer.ArtifactMismatch` and the
+        next build falls back loudly to a local compile, never to a
+        wrong-model serve). Returns the verified hash; later builds whose
+        source key matches skip the compiler entirely
+        (``compile_shared_total``)."""
+        if self.artifacts is None:
+            from ..infer import ArtifactStore
+            self.artifacts = ArtifactStore()
+        art = self.artifacts.admit_bytes(payload, expect_hash=expect_hash)
+        log.info("serve registry: admitted compiled artifact %s "
+                 "(%d trees, %d bytes) by hash — local compile skipped on "
+                 "next matching build", art.hash[:12], art.num_trees,
+                 art.nbytes)
+        return art.hash
+
+    def artifact_bytes(self, name: str = DEFAULT_MODEL) -> bytes:
+        """Serialized compiled artifact of model ``name`` — what a
+        publisher ships to peers over the delta plane so N replicas
+        share ONE compile. Requires the compiled engine (the artifact is
+        attached at cache build time)."""
+        cache = self.get(name)
+        art = getattr(cache, "artifact", None)
+        if art is None:
+            raise ValueError(
+                f"serve model {name!r} has no compiled artifact (engine "
+                f"{cache.engine!r}; artifact sharing needs "
+                f"predict_engine=compiled)")
+        return art.to_bytes()
+
     def model_text(self, name: str = DEFAULT_MODEL) -> str:
         """The resident host model's full text — the base a delta
         publisher diffs against (host models survive eviction, so this
@@ -376,6 +418,9 @@ class ModelRegistry:
                 }
                 if e.cache is not None:
                     resident_bytes += e.bytes
+                    ah = getattr(e.cache, "artifact_hash", None)
+                    if ah:
+                        models[name]["artifact_hash"] = ah
             return {
                 "models": models,
                 "resident_models": sum(1 for m in models.values()

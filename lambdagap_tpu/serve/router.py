@@ -523,6 +523,41 @@ class Router:
             raise first_exc
         return last
 
+    def push_artifact(self, payload: bytes,
+                      expect_hash: Optional[str] = None,
+                      replica: Optional[str] = None) -> dict:
+        """Ship one compiled-forest artifact to every live replica's
+        store (``replica=<name>`` targets one), so the whole fleet pays
+        exactly ONE compile for a model its members later place
+        (docs/serving.md "Compiled forest artifacts"). Returns the
+        verified hash per replica; a replica that rejects the payload
+        (``ArtifactMismatch``) reports its error string instead and will
+        fall back — loudly — to a local compile, never to a wrong-model
+        serve. First failure propagates AFTER every replica was
+        attempted, matching the swap rollout semantics."""
+        names = [replica] if replica is not None \
+            else self.replica_names(live_only=True)
+        out = {}
+        first_exc = None
+        for name in names:
+            r = self.replica(name)
+            try:
+                if hasattr(r, "server"):
+                    out[name] = r.server.admit_artifact(
+                        payload, expect_hash=expect_hash)
+                else:
+                    out[name] = r.client.push_artifact(
+                        payload, expect_hash=expect_hash)
+            except Exception as e:
+                if first_exc is None:
+                    first_exc = e
+                out[name] = f"error: {e}"
+                log.warning("router: artifact push to replica %r failed: "
+                            "%s", name, e)
+        if first_exc is not None:
+            raise first_exc
+        return out
+
     def swap_on(self, name: str, source, model: Optional[str] = None):
         """Full swap on ONE replica (the rollback half of a delta
         rollout; serve/autonomics.py)."""

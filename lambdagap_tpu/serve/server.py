@@ -26,6 +26,7 @@ what lets several replica servers share nothing behind a router
 """
 from __future__ import annotations
 
+import threading
 import time
 from concurrent.futures import Future
 from typing import Dict, List, NamedTuple, Optional, Sequence
@@ -37,7 +38,7 @@ from ..guard.faults import plan_for
 from ..obs import trace as obs_trace
 from ..utils import log
 from .batcher import MicroBatcher, Request
-from .cache import DEFAULT_BUCKETS, CompiledForestCache
+from .cache import DEFAULT_BUCKETS, CompiledForestCache, ModelPack
 from .registry import DEFAULT_MODEL, ModelRegistry
 from .stats import ServeStats
 
@@ -101,11 +102,23 @@ class ForestServer:
         self._faults = plan_for(cfg)
         if hbm_budget_bytes is None:
             hbm_budget_bytes = int(cfg.serve_hbm_budget_mb * (1 << 20))
+        # the replica-wide compiled-artifact store: builds consult it by
+        # source key before lowering (peers ship artifacts over the wire,
+        # push_artifact), so N replicas placing one model pay ONE compile
+        from ..infer import ArtifactStore
+        self.artifacts = ArtifactStore()
+        # cross-model packing (serve_pack_models): resident compiled
+        # models fuse into ONE executable so a mixed FairQueue batch
+        # dispatches once; rebuilt lazily on membership/generation change
+        self._pack_models = bool(cfg.serve_pack_models)
+        self._pack: Optional[ModelPack] = None
+        self._pack_lock = threading.Lock()
         self.registry = ModelRegistry(
             self._build_cache, stats=self.stats,
             hbm_budget_bytes=hbm_budget_bytes,
             breaker_threshold=int(cfg.serve_swap_breaker
-                                  if swap_breaker is None else swap_breaker))
+                                  if swap_breaker is None else swap_breaker),
+            artifact_store=self.artifacts)
         self.registry.install(DEFAULT_MODEL, gbdt)
         self.health = HealthMonitor(
             breaker=self.registry.entry(DEFAULT_MODEL).breaker)
@@ -139,7 +152,8 @@ class ForestServer:
     def _build_cache(self, gbdt, generation: int) -> CompiledForestCache:
         cache = CompiledForestCache(
             gbdt, buckets=self._buckets, start_iteration=self._si,
-            num_iteration=self._ni, generation=generation, stats=self.stats)
+            num_iteration=self._ni, generation=generation, stats=self.stats,
+            artifact_store=self.artifacts)
         if self._warmup:
             cache.warm()
         return cache
@@ -171,6 +185,21 @@ class ForestServer:
 
     def models(self) -> List[str]:
         return self.registry.names()
+
+    def admit_artifact(self, payload: bytes,
+                       expect_hash: Optional[str] = None) -> str:
+        """Admit a peer replica's serialized compiled-forest artifact by
+        content hash (docs/serving.md "Compiled forest artifacts"). The
+        next compiled-engine build whose source key matches serves the
+        admitted artifact instead of compiling — a mismatched or torn
+        payload raises ``ArtifactMismatch`` and compiles locally instead,
+        never serving the wrong model. Returns the verified hash."""
+        return self.registry.admit_artifact(payload, expect_hash=expect_hash)
+
+    def artifact_bytes(self, model: str = DEFAULT_MODEL) -> bytes:
+        """Serialize ``model``'s compiled artifact for shipping to peers
+        (requires predict_engine=compiled)."""
+        return self.registry.artifact_bytes(model)
 
     # -- request path ---------------------------------------------------
     def submit(self, x, model: Optional[str] = None,
@@ -322,6 +351,7 @@ class ForestServer:
         groups: Dict[str, List[Request]] = {}
         for r in batch:
             groups.setdefault(r.model or DEFAULT_MODEL, []).append(r)
+        resolved: List[tuple] = []
         for name, reqs in sorted(groups.items()):
             info: Dict = {}
             t_reg_wall, t_reg = time.time(), time.perf_counter()
@@ -345,19 +375,61 @@ class ForestServer:
                            t_reg - r.t_submit)
                 # the registry resolve, per sampled request: a readmitted
                 # group makes the 174x cliff visible on every trace that
-                # paid it (registry_readmit nests the compile share)
+                # paid it (registry_readmit nests the compile share; the
+                # artifact_hash tag says WHICH compiled artifact was
+                # rebuilt, so fleet traces join on the shared-compile key)
                 sid = rec.record("registry_get", r.trace, t_reg_wall,
                                  reg_dur, model=name, **info)
                 if info.get("readmitted"):
                     rec.record("registry_readmit", r.trace, t_reg_wall,
                                info.get("build_s", reg_dur), parent=sid,
-                               model=name)
+                               model=name,
+                               **({"artifact_hash": info["artifact_hash"]}
+                                  if info.get("artifact_hash") else {}))
+            resolved.append((name, slot, reqs))
+        pack = self._model_pack() if (self._pack_models and resolved) else None
+        if pack is not None:
+            self._dispatch_packed(pack, resolved)
+            return
+        for name, slot, reqs in resolved:
             self._dispatch_group(name, slot, reqs)
 
-    def _dispatch_group(self, name: str, slot, reqs: List[Request]) -> None:
-        """One model's share of a batch through one padded dispatch."""
-        t0 = time.perf_counter()
-        t0_wall = time.time()
+    def _model_pack(self) -> Optional[ModelPack]:
+        """The cross-model pack covering every registered model, rebuilt
+        lazily whenever membership or any member's generation changes (the
+        pack key is the (name, cache key) set). Resolving every member
+        forces fleet-wide residency — packing implies the operator WANTS
+        all tenants resident; the HBM budget still applies and an evicted
+        member re-admits through the normal single-flight path. Returns
+        None (per-model dispatch fallback) when any member cannot pack
+        (non-compiled engine, early stop, or a failed build)."""
+        try:
+            slots: Dict[str, CompiledForestCache] = {}
+            for name in self.registry.names():
+                slot = self.registry.get(name)
+                if slot._compiled is None or slot._es_freq:
+                    return None
+                slots[name] = slot
+        except Exception as e:
+            log.warning("serve: cross-model pack unavailable (%s); "
+                        "dispatching per model", e)
+            return None
+        key = frozenset((n, c.key) for n, c in slots.items())
+        with self._pack_lock:
+            pack = self._pack
+            if pack is None or pack.key != key:
+                pack = ModelPack(slots, buckets=self._buckets,
+                                 stats=self.stats)
+                self._pack = pack
+                log.info("serve: packed %d models into one executable "
+                         "(%d trees, width %d, %d bytes)", len(slots),
+                         pack.packed.num_trees, pack.width, pack.hbm_bytes)
+            return pack
+
+    def _gather_rows(self, name: str, slot,
+                     reqs: List[Request]) -> tuple:
+        """Shape-check one model's requests against its compiled width:
+        returns (rows, good requests); violators fail their own future."""
         W = slot.width
         disable_check = slot.gbdt.config.predict_disable_shape_check
         rows: List[np.ndarray] = []
@@ -378,6 +450,51 @@ class ForestServer:
                                 np.float32)], axis=1)
             rows.append(np.ascontiguousarray(x[:, :W]))
             good.append(r)
+        return rows, good
+
+    def _dispatch_packed(self, pack: ModelPack, resolved: List[tuple]) -> None:
+        """A mixed multi-model batch through ONE packed executable: every
+        model's rows concatenate into shared cross-model padding buckets,
+        the traversal dispatches once per bucket, and each request's slice
+        comes back bit-identical to its member cache serving it alone."""
+        t0_wall, t0 = time.time(), time.perf_counter()
+        parts: List[tuple] = []
+        for name, slot, reqs in resolved:
+            rows, good = self._gather_rows(name, slot, reqs)
+            if good:
+                parts.append((name, slot, good, rows))
+        if not parts:
+            return
+        mixed = [(name, rows[0] if len(rows) == 1
+                  else np.concatenate(rows, axis=0), self.raw_score)
+                 for name, _slot, _good, rows in parts]
+        outs = pack.predict_mixed(mixed)
+        t1 = time.perf_counter()
+        total_rows = sum(x.shape[0] for _n, x, _r in mixed)
+        self.stats.record_dispatch(rows=total_rows, device_s=t1 - t0)
+        self.stats.record_packed_dispatch(models=len(parts), rows=total_rows)
+        rec = obs_trace.RECORDER
+        for (name, slot, good, rows), out in zip(parts, outs):
+            lo = 0
+            for r, x in zip(good, rows):
+                n = x.shape[0]
+                if r.trace is not None:
+                    rec.record("dispatch", r.trace, t0_wall, t1 - t0,
+                               rows=n, batch_rows=total_rows, model=name,
+                               packed_models=len(parts))
+                r.future.set_result(ServeResult(out[lo:lo + n],
+                                                slot.generation))
+                lo += n
+                self.stats.record_request(
+                    queue_wait=t0 - r.t_submit, device=t1 - t0,
+                    total=time.perf_counter() - r.t_submit,
+                    rows=n, model=name, tenant=r.tenant)
+
+    def _dispatch_group(self, name: str, slot, reqs: List[Request]) -> None:
+        """One model's share of a batch through one padded dispatch."""
+        t0 = time.perf_counter()
+        t0_wall = time.time()
+        rows, good = self._gather_rows(name, slot, reqs)
         if not good:
             return
         X = rows[0] if len(rows) == 1 else np.concatenate(rows, axis=0)

@@ -45,6 +45,9 @@ class ServeStats:
         self.per_bucket: Dict[int, Dict[str, int]] = {}
         self.forest_builds = 0
         self.bucket_compiles = 0
+        self.compiles_local = 0
+        self.compiles_shared = 0
+        self.packed_dispatches = 0
         self.swaps = 0
         self.evictions = 0
         self.readmissions = 0
@@ -163,6 +166,26 @@ class ServeStats:
         with self._lock:
             self.bucket_compiles += 1
 
+    def record_compile_local(self) -> None:
+        """A forest lowered by the infer compiler ON this replica (no
+        fleet peer had shipped the artifact first)."""
+        with self._lock:
+            self.compiles_local += 1
+
+    def record_compile_shared(self) -> None:
+        """A compiled-forest build satisfied from the artifact store — a
+        peer's sha256-addressed compile admitted instead of re-lowering
+        (the fleet-wide one-compile contract, docs/serving.md)."""
+        with self._lock:
+            self.compiles_shared += 1
+
+    def record_packed_dispatch(self, models: int, rows: int) -> None:
+        """One cross-model pack dispatch covering ``models`` tenants'
+        rows in a single executable (serve_pack_models)."""
+        del models, rows
+        with self._lock:
+            self.packed_dispatches += 1
+
     def record_swap(self) -> None:
         with self._lock:
             self.swaps += 1
@@ -225,6 +248,9 @@ class ServeStats:
                     "hit_rate": (self.cache_hits / total) if total else 0.0,
                     "forest_builds": self.forest_builds,
                     "bucket_compiles": self.bucket_compiles,
+                    "compiles_local": self.compiles_local,
+                    "compiles_shared": self.compiles_shared,
+                    "packed_dispatches": self.packed_dispatches,
                     "per_bucket": {str(k): dict(v)
                                    for k, v in self.per_bucket.items()},
                 },

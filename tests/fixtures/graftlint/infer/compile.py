@@ -1,0 +1,33 @@
+"""R1 fixture (compiled-forest subsystem): infer/ is a HOT_PATHS file —
+a D2H inside the node-block packing loop serializes every tree of a
+hot-swap's compile against the serving chip, and the engine's jitted
+drivers are hot by function name with no loop needed."""
+import jax
+import jax.numpy as jnp
+
+
+def pack_node_blocks(groups, budget):
+    # the breadth-first node-block packing loop: one iteration per tree
+    # group per compile; a device fetch here stalls the swap build
+    blocks, cur, used = [], [], 0
+    for root, nodes in groups:
+        size = jnp.asarray([len(nodes)]).sum()
+        used += size.item()  # BAD:R1
+        cur.append((root, nodes))
+        if used >= budget:
+            blocks.append(cur)
+            cur, used = [], 0
+    if cur:
+        blocks.append(cur)
+    return blocks
+
+
+def _predict_compiled(x, blocks):
+    # hot by function name (the engine's jitted driver), no loop needed
+    out = jnp.zeros((1, x.shape[0]), jnp.float32)
+    return jax.device_get(out)  # BAD:R1
+
+
+def artifact_digest(buffers):
+    # not hot: one-time content hashing on host-side numpy buffers
+    return jax.device_get(jnp.asarray(sorted(buffers)))

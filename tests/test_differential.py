@@ -91,7 +91,13 @@ def _random_case(rng, tmp_path=None, for_dp=False):
     return X, y, w, params
 
 
-@pytest.mark.parametrize("seed", range(20))
+# tier-1 hygiene (the 870s window, ROADMAP caveat): the differential fuzz
+# sweeps dominate the alphabetical window — keep a fast slice of each
+# sweep in tier-1 and push the long tail behind -m slow (the full sweeps
+# still run wherever slow marks do; seeds are stable so the split is too)
+@pytest.mark.parametrize(
+    "seed", list(range(8)) + [pytest.param(s, marks=pytest.mark.slow)
+                              for s in range(8, 20)])
 def test_host_vs_fused_random_config(seed, tmp_path):
     rng = np.random.RandomState(1000 + seed)
     X, y, w, params = _random_case(rng, tmp_path)
@@ -113,7 +119,9 @@ def test_host_vs_fused_random_config(seed, tmp_path):
                                rtol=1e-3, atol=1e-3)
 
 
-@pytest.mark.parametrize("seed", range(10))
+@pytest.mark.parametrize(
+    "seed", list(range(4)) + [pytest.param(s, marks=pytest.mark.slow)
+                              for s in range(4, 10)])
 def test_dp_1dev_vs_8dev_random_config(seed, tmp_path):
     """The fused data-parallel shard_map program must produce the same
     model on a 1-device and an 8-device mesh (per-split psum + replicated
@@ -137,7 +145,9 @@ def test_dp_1dev_vs_8dev_random_config(seed, tmp_path):
                                rtol=1e-3, atol=1e-3)
 
 
-@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize(
+    "seed", list(range(3)) + [pytest.param(s, marks=pytest.mark.slow)
+                              for s in range(3, 6)])
 def test_feature_parallel_vs_serial_random_config(seed):
     """Random-config differential for the fused FEATURE-parallel program:
     rows are replicated so the column-sharded scan must reproduce the
@@ -167,7 +177,9 @@ def test_feature_parallel_vs_serial_random_config(seed):
     assert close.mean() > 0.99, (params, float(close.mean()))
 
 
-@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize(
+    "seed", list(range(3)) + [pytest.param(s, marks=pytest.mark.slow)
+                              for s in range(3, 6)])
 def test_voting_fused_vs_host_loop_random_config(seed):
     """Random-config differential for the fused VOTING program against the
     host-loop voting learner — same algorithm (local top-k vote, voted

@@ -135,6 +135,10 @@ def test_host_loop_feature_parallel_opt_out():
                                rtol=1e-3, atol=1e-4)
 
 
+# tier-1 hygiene: the three heaviest tests here (~125s of the module's
+# ~270s) move behind -m slow; the per-learner parity tests above keep
+# the same programs covered in the 870s window
+@pytest.mark.slow
 def test_fused_feature_parallel_option_combos():
     """Monotone intermediate, extra_trees, bagging and interaction
     constraints all ride the feature-sharded program and match the fused
@@ -201,6 +205,7 @@ def test_shard_rows_explicit_mask_channel():
     assert not bool(m2[N:].any()) if pad else True
 
 
+@pytest.mark.slow
 def test_pad_rows_contribute_exact_zeros_every_learner():
     """N not divisible by the device count: pad rows must contribute
     EXACT zeros to histograms and root counts under every distributed
@@ -367,6 +372,7 @@ def test_fused_voting_parallel():
     assert close.mean() > 0.99, float(close.mean())
 
 
+@pytest.mark.slow
 def test_voting_extra_trees():
     """extra_trees under voting — both variants (the reference's voting
     learner inherits it from the serial learner,

@@ -89,6 +89,7 @@ def fixture_findings():
     "serve/r13_wire.py",
     "r14_inert.py",
     "data/stream.py",
+    "infer/compile.py",
 ])
 def test_rule_fixture_exact_findings(fixture_findings, relpath):
     got = fixture_findings.get(relpath, set())

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Compiled-forest gate (ISSUE 16): one compile per fleet, one dispatch
+per mixed batch.
+
+Run by tools/run_full_suite.sh G0. The multi-tenant serving contract the
+compiled subsystem exists to keep:
+
+1. a ``predict_engine=compiled`` server comes up, and steady-state
+   traffic at warmed bucket shapes triggers ZERO further bucket
+   compiles — recompilation in the request path is the outage mode the
+   padding buckets exist to prevent;
+2. with ``serve_pack_models=true``, one mixed 3-tenant batcher window
+   resolves through exactly ONE packed dispatch — many small forests,
+   one executable — and every tenant's rows match its solo cache
+   bit-for-bit;
+3. replica B admits A's serialized artifact BY CONTENT HASH over the
+   socket frontend, then places the same model: fleet-wide the shipped
+   model is compiled exactly ONCE (A's local compile; B's build is a
+   shared admission). A corrupt payload must be rejected loudly
+   (ArtifactMismatch) and leave B serving correctly via local compile —
+   never a wrong-model serve.
+
+Exit 0 on pass; nonzero with a reason on any violation.
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail(msg: str) -> int:
+    print(f"INFER GATE FAIL: {msg}")
+    return 1
+
+
+def train(params, rounds=10, seed=0, feats=10):
+    import numpy as np
+    import lambdagap_tpu as lgb
+    rng = np.random.RandomState(seed)
+    X = rng.randn(1500, feats).astype(np.float32)
+    X[::13, 2] = np.nan
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float32)
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                   "tpu_fast_predict_rows": 0,
+                   "predict_engine": "compiled", **params},
+                  lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return b, X
+
+
+def main() -> int:
+    import numpy as np
+    from lambdagap_tpu.infer import ArtifactMismatch
+    from lambdagap_tpu.serve import ForestServer, FrontendClient, \
+        ServeFrontend
+
+    # -- 1: zero steady-state recompiles ---------------------------------
+    b1, X = train({})
+    srv = ForestServer(b1, buckets=(8, 64, 512), warmup=True)
+    try:
+        warm = srv.stats.snapshot()["cache"]["bucket_compiles"]
+        for i in range(20):
+            srv.predict(X[: 1 + (i * 7) % 500])
+        steady = srv.stats.snapshot()["cache"]["bucket_compiles"]
+        print(f"infer gate: bucket compiles warmup={warm} "
+              f"after 20 mixed-shape rounds={steady}")
+        if steady != warm:
+            return fail(f"{steady - warm} steady-state bucket recompiles "
+                        "after warmup — compilation leaked into the "
+                        "request path")
+    finally:
+        srv.close()
+
+    # -- 2: one packed dispatch for a mixed 3-tenant window --------------
+    b_pk, _ = train({"serve_pack_models": True}, seed=1)
+    b2, _ = train({}, rounds=6, seed=2)
+    b3, _ = train({"num_leaves": 7}, rounds=4, seed=3)
+    # a long window so all three tenants land in ONE batcher round
+    pk = ForestServer(b_pk, warmup=False, max_delay_ms=200.0, workers=1)
+    try:
+        pk.add_model("t2", b2._booster)
+        pk.add_model("t3", b3._booster)
+        futs = [pk.submit(X[:13]), pk.submit(X[13:20], model="t2"),
+                pk.submit(X[20:31], model="t3")]
+        outs = [f.result(60.0) for f in futs]
+        packed = pk.stats.snapshot()["cache"]["packed_dispatches"]
+        print(f"infer gate: mixed 3-tenant window -> "
+              f"packed_dispatches={packed}")
+        if packed != 1:
+            return fail(f"mixed 3-tenant window cost {packed} packed "
+                        "dispatches (want exactly 1 executable for the "
+                        "whole window)")
+        refs = [pk.registry.get("default").predict(X[:13]),
+                pk.registry.get("t2").predict(X[13:20]),
+                pk.registry.get("t3").predict(X[20:31])]
+        for i, (out, ref) in enumerate(zip(outs, refs)):
+            if not np.array_equal(out.values, ref):
+                return fail(f"packed output for tenant {i} is not "
+                            "bit-identical to its solo cache")
+    finally:
+        pk.close()
+
+    # -- 3: fleet one-compile via hash admission over the wire -----------
+    bA, _ = train({}, rounds=8, seed=4)     # the model the fleet shares
+    boot, _ = train({"num_leaves": 7}, rounds=2, seed=5)
+    A = ForestServer(bA, warmup=False)
+    B = ForestServer(boot, warmup=False)
+    try:
+        with ServeFrontend(A) as feA, ServeFrontend(B) as feB:
+            cliA = FrontendClient("127.0.0.1", feA.port)
+            cliB = FrontendClient("127.0.0.1", feB.port)
+            with cliA, cliB:
+                payload = cliA.fetch_artifact()
+                h = A.registry.get("default").artifact_hash
+                try:
+                    cliB.push_artifact(payload[:-6], expect_hash=h)
+                    return fail("corrupt artifact payload was admitted")
+                except ArtifactMismatch as e:
+                    print(f"infer gate: corrupt admission rejected "
+                          f"loudly ({e})")
+                got = cliB.push_artifact(payload, expect_hash=h)
+                if got != h:
+                    return fail(f"admitted hash {got[:12]} != published "
+                                f"{h[:12]}")
+        B.add_model("shared", bA._booster)
+        sA = A.stats.snapshot()["cache"]
+        sB = B.stats.snapshot()["cache"]
+        fleet_local = sA["compiles_local"] + sB["compiles_local"]
+        print(f"infer gate: fleet compiles local A={sA['compiles_local']} "
+              f"B={sB['compiles_local']} shared B={sB['compiles_shared']}")
+        # each server compiles its own boot model at construction — A's
+        # boot IS the publisher compile — so the shipped model must add
+        # ZERO further local compiles fleet-wide
+        if fleet_local != 2 or sB["compiles_shared"] != 1:
+            return fail("shipped model was compiled more than once "
+                        f"fleet-wide (local={fleet_local}, want 2 = "
+                        "one boot per replica; "
+                        f"shared={sB['compiles_shared']}, want 1)")
+        if B.registry.get("shared").artifact_hash != h:
+            return fail("replica B's shared model does not carry the "
+                        "admitted artifact hash")
+        if not np.array_equal(B.predict(X[:64], model="shared"),
+                              A.predict(X[:64])):
+            return fail("replica B's admitted forest is not bit-identical "
+                        "to the publisher's")
+    finally:
+        A.close()
+        B.close()
+
+    print("infer gate: PASS — zero steady recompiles, mixed batch in one "
+          "packed dispatch, one compile fleet-wide by artifact hash")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -137,6 +137,18 @@ if ! env JAX_PLATFORMS=cpu python tools/autonomics_gate.py; then
     echo "'Fleet autonomics')"
     exit 1
 fi
+
+# compiled-forest gate (ISSUE 16): zero steady-state recompiles at warmed
+# bucket shapes; a mixed 3-tenant window through ONE packed executable,
+# bit-identical per tenant; replica B admits A's artifact by content hash
+# over the wire and the shipped model compiles exactly once fleet-wide
+# (corrupt payloads rejected loudly, local-compile fallback)
+if ! env JAX_PLATFORMS=cpu python tools/infer_gate.py; then
+    echo "FAIL-FAST: infer gate failed (steady-state recompiles, a split"
+    echo "packed window, or the fleet one-compile artifact contract"
+    echo "regressed; see docs/serving.md 'Compiled forest artifacts')"
+    exit 1
+fi
 echo "=== G1 $(date)"
 python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_predict_tensor.py tests/test_misc_api.py tests/test_graftlint.py -q 2>&1 | tail -1
 echo "=== G2 $(date)"
@@ -146,7 +158,7 @@ python -m pytest tests/test_monotone.py tests/test_tree_options.py tests/test_ex
 echo "=== G4 $(date)"
 python -m pytest tests/test_fused.py tests/test_layout.py tests/test_stream.py tests/test_distributed.py tests/test_quantized.py tests/test_continued.py tests/test_model_io.py tests/test_shap_json.py -q 2>&1 | tail -1
 echo "=== G5 $(date)"
-python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py tests/test_serve.py tests/test_serve_fleet.py tests/test_serve_stress.py -q 2>&1 | tail -1
+python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py tests/test_serve.py tests/test_serve_fleet.py tests/test_serve_stress.py tests/test_infer.py -q 2>&1 | tail -1
 echo "=== G6 full-length consistency $(date)"
 LAMBDAGAP_CONSISTENCY_FULL=1 python -m pytest tests/test_consistency.py -q 2>&1 | tail -1
 echo "=== DONE $(date)"
