@@ -198,9 +198,11 @@ def _predict_crossover(booster, Xv_np, n_big, t_dev_big, native_per_row):
 
 
 def _predict_engine_ab(booster, X, hbm_gbps: float = None) -> dict:
-    """Same-session A/B of the two device traversal engines on identical
-    rows (ISSUE 3 acceptance): warm us/row for the tensorized
-    [rows x trees] engine vs the sequential per-tree scan, plus a predict
+    """Same-session A/B of the device traversal engines on identical
+    rows (ISSUE 3 acceptance, compiled arm added by ISSUE 17): warm
+    us/row for the tensorized [rows x trees] engine vs the sequential
+    per-tree scan vs the compiled-forest artifact engine (palette gather
+    lattice, ISSUE 16), plus a predict
     roofline from the node-table traffic model — an upper bound assuming
     every per-level node gather misses (26 B node record + 4 B feature
     value per row/tree/level) and a lower bound assuming the node tables
@@ -214,7 +216,7 @@ def _predict_engine_ab(booster, X, hbm_gbps: float = None) -> dict:
     gb.config.tpu_fast_predict_rows = 0       # force the device path
     res = {"rows": len(X)}
     try:
-        for eng in ("tensor", "scan"):
+        for eng in ("tensor", "scan", "compiled"):
             gb.config.predict_engine = eng
             gb.invalidate_predict_cache()
             booster.predict(X)                # compile + warm this shape
@@ -229,6 +231,9 @@ def _predict_engine_ab(booster, X, hbm_gbps: float = None) -> dict:
     res["tensor_speedup_vs_scan"] = round(
         res["scan_us_per_row_warm"]
         / max(res["tensor_us_per_row_warm"], 1e-9), 3)
+    res["compiled_speedup_vs_scan"] = round(
+        res["scan_us_per_row_warm"]
+        / max(res["compiled_us_per_row_warm"], 1e-9), 3)
 
     # node-table traffic model (forest dims off the host trees, padded the
     # way forest_to_arrays pads them)

@@ -6,18 +6,42 @@ extended LambdaGap ranking objective family, running its compute core as
 XLA/Pallas programs on TPU and its distributed learners over
 ``jax.sharding`` meshes.
 """
-from .basic import Booster, Dataset, Sequence
-from .callback import (EarlyStopException, early_stopping, log_evaluation,
-                       record_evaluation, reset_parameter)
-from .config import Config
-from .data import BinnedDataset, Metadata, ShardedBinnedDataset
-from .engine import CVBooster, cv, train
-from .parallel.cluster import train_cluster
-from .models import GBDT, Tree
-from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
-from .utils.log import register_logger
+import os as _os
 
-__version__ = "0.1.0"
+if _os.environ.get("LAMBDAGAP_IR_CAPTURE"):  # graftir capture worker only
+    # must run BEFORE the heavy imports below: import-time decorations
+    # (functools.partial(jax.jit, ...) in ops/*.py) resolve jax.jit at
+    # module import, so the shim has to be in place first
+    from .analysis.ir import capture as _ir_capture
+    _ir_capture.install()
+
+if (_os.environ.get("LAMBDAGAP_LINT_ONLY")
+        and not _os.environ.get("LAMBDAGAP_IR_CAPTURE")):
+    # lint-side entry (tools/graftlint.py, tools/graftir_gate.py, the
+    # analysis CLI under `python -m`): graftlint and graftir's lint half
+    # are stdlib-only by design, so skipping the framework imports here
+    # keeps every linter subprocess off the ~1 s jax import it never
+    # uses — the G0 gate and the tier-1 CLI tests each spawn several.
+    # LAMBDAGAP_IR_CAPTURE wins over this flag: the graftir worker needs
+    # the real package (it trains through lgb.train), and the runner
+    # sets IR_CAPTURE in the worker env even when the parent CLI process
+    # was itself launched lint-only.
+    __version__ = "0.1.0"
+else:
+    from .basic import Booster, Dataset, Sequence
+    from .callback import (EarlyStopException, early_stopping,
+                           log_evaluation, record_evaluation,
+                           reset_parameter)
+    from .config import Config
+    from .data import BinnedDataset, Metadata, ShardedBinnedDataset
+    from .engine import CVBooster, cv, train
+    from .parallel.cluster import train_cluster
+    from .models import GBDT, Tree
+    from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
+                          LGBMRegressor)
+    from .utils.log import register_logger
+
+    __version__ = "0.1.0"
 
 __all__ = ["Booster", "Dataset", "Sequence", "Config", "BinnedDataset",
            "ShardedBinnedDataset", "train_cluster",
@@ -39,11 +63,13 @@ def __getattr__(name):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-try:  # matplotlib/graphviz are optional
-    from .plotting import (create_tree_digraph, plot_importance, plot_metric,
-                           plot_split_value_histogram, plot_tree)
-    __all__ += ["plot_importance", "plot_metric",
-                "plot_split_value_histogram", "plot_tree",
-                "create_tree_digraph"]
-except ImportError:  # pragma: no cover
-    pass
+if "Booster" in globals():  # skipped under LAMBDAGAP_LINT_ONLY
+    try:  # matplotlib/graphviz are optional
+        from .plotting import (create_tree_digraph, plot_importance,
+                               plot_metric, plot_split_value_histogram,
+                               plot_tree)
+        __all__ += ["plot_importance", "plot_metric",
+                    "plot_split_value_histogram", "plot_tree",
+                    "create_tree_digraph"]
+    except ImportError:  # pragma: no cover
+        pass

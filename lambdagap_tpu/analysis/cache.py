@@ -38,14 +38,19 @@ _analyzer_hash_memo: Optional[str] = None
 
 
 def analyzer_hash() -> str:
-    """sha256 over the analyzer's own sources: a rule edit must invalidate
-    every cached scan result."""
+    """sha256 over the AST analyzer's own sources: a rule edit must
+    invalidate every cached scan result. ``ir/`` is excluded — graftir
+    keys its own per-program cache (``ir/cache.py``); an IR checker edit
+    must not cold-start the AST scan."""
     global _analyzer_hash_memo
     if _analyzer_hash_memo is not None:
         return _analyzer_hash_memo
     here = os.path.dirname(os.path.abspath(__file__))
     h = hashlib.sha256()
     for fp, rel in sorted(iter_py_files([here])):
+        if rel.replace(os.sep, "/").startswith("ir/") or \
+                "/ir/" in rel.replace(os.sep, "/"):
+            continue
         h.update(rel.encode())
         with open(fp, "rb") as f:
             h.update(hashlib.sha256(f.read()).digest())

@@ -71,10 +71,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-seconds", type=float, default=None,
                    help="fail (exit 1) when the scan exceeds this "
                         "wall-clock budget — the G0 gate passes 2")
-    p.add_argument("--cache", default=scan_cache.DEFAULT_CACHE,
-                   help="content-hash scan cache file (default: "
-                        f"{scan_cache.DEFAULT_CACHE}; a warm hit replays "
-                        "byte-identical findings without re-analyzing)")
+    p.add_argument("--cache", default=None,
+                   help="content-hash cache file (default: "
+                        f"{scan_cache.DEFAULT_CACHE} for the AST scan, "
+                        ".graftir_cache.json for --ir; a warm hit "
+                        "replays byte-identical findings without "
+                        "re-analyzing)")
     p.add_argument("--no-cache", action="store_true",
                    help="force a cold scan (never read or write the "
                         "cache)")
@@ -88,6 +90,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --changed-only: also include files "
                         "differing from this git ref (e.g. a merge-base)")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--ir", action="store_true",
+                   help="run graftir, the IR-level contract pass, "
+                        "instead of the AST scan: capture every jitted "
+                        "hot program across the scenario inventory in a "
+                        "worker subprocess (8 virtual CPU devices), "
+                        "trace to jaxpr, and verify the contracts "
+                        "registered at definition sites (C1 collective "
+                        "schedule, C2 transfer-freedom, C3 precision, "
+                        "C4 retrace-freedom)")
+    p.add_argument("--ir-results", default=None, metavar="PATH",
+                   help="with --ir: skip the worker and check/format a "
+                        "previously captured worker result JSON (test "
+                        "seam; no cache involved)")
+    p.add_argument("--selftest", action="store_true",
+                   help="with --ir: run the seeded-violation mutation "
+                        "suite through the real checkers and fail "
+                        "unless every planted break is caught")
     return p
 
 
@@ -109,24 +128,27 @@ def render_github(findings: Sequence[Finding]) -> str:
     return "\n".join(out)
 
 
-def render_sarif(findings: Sequence[Finding]) -> str:
-    """Minimal valid SARIF 2.1.0 for code-scanning upload."""
+def render_sarif(findings: Sequence[Finding], tool: str = "graftlint",
+                 descriptions: Optional[dict] = None) -> str:
+    """Minimal valid SARIF 2.1.0 for code-scanning upload. ``tool`` and
+    ``descriptions`` let the graftir pass reuse the renderer with its
+    I-series catalog (fingerprints stay namespaced per tool)."""
     rule_ids = sorted({f.rule for f in findings})
-    by_id = {r.id: r for r in all_rules()}
+    if descriptions is None:
+        descriptions = {r.id: r.description for r in all_rules()}
     sarif = {
         "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
                     "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
         "version": "2.1.0",
         "runs": [{
             "tool": {"driver": {
-                "name": "graftlint",
+                "name": tool,
                 "informationUri":
                     "docs/static-analysis.md",
                 "rules": [{
                     "id": rid,
                     "shortDescription": {
-                        "text": by_id[rid].description
-                        if rid in by_id else rid},
+                        "text": descriptions.get(rid, rid)},
                 } for rid in rule_ids],
             }},
             "results": [{
@@ -140,11 +162,165 @@ def render_sarif(findings: Sequence[Finding]) -> str:
                                    "startColumn": f.col + 1},
                     },
                 }],
-                "fingerprints": {"graftlint/v1": f.fingerprint()},
+                "fingerprints": {f"{tool}/v1": f.fingerprint()},
             } for f in findings],
         }],
     }
     return json.dumps(sarif, indent=2)
+
+
+def merge_sarif(docs: Sequence[str]) -> str:
+    """Concatenate the ``runs`` of several SARIF documents into one —
+    the G0 gate publishes graftlint + graftir as a single artifact."""
+    runs = []
+    schema = version = None
+    for text in docs:
+        doc = json.loads(text)
+        schema = schema or doc.get("$schema")
+        version = version or doc.get("version")
+        runs.extend(doc.get("runs", ()))
+    return json.dumps({"$schema": schema, "version": version,
+                       "runs": runs}, indent=2)
+
+
+def _is_ir_entry(e: dict) -> bool:
+    """Baseline namespace test: graftir entries (I-series) and graftlint
+    entries (everything else) live in ONE file but are applied and
+    regenerated separately, so neither pass prunes the other's."""
+    return str(e.get("rule", "")).startswith("I")
+
+
+def main_ir(args) -> int:
+    """The --ir mode: graftir contract verification (see analysis/ir/)."""
+    from .ir import runner as ir_runner
+    from .ir.cache import DEFAULT_CACHE as IR_DEFAULT_CACHE
+    from .ir.contracts import IR_RULES
+
+    t0 = time.perf_counter()
+    if args.selftest:
+        try:
+            res = ir_runner.selftest(timeout=args.max_seconds)
+        except Exception as e:
+            print(f"graftir: selftest failed to run: {e}",
+                  file=sys.stderr)
+            return 1
+        for m in res.get("selftest", ()):
+            print(f"graftir selftest: {m['name']:20s} expect "
+                  f"{m['expect']} -> "
+                  f"{'caught' if m['caught'] else 'MISSED'}")
+        if not res.get("ok"):
+            print("graftir: mutation suite MISSED a planted violation — "
+                  "the checkers have lost their teeth", file=sys.stderr)
+            return 1
+        print("graftir: selftest OK (every seeded violation caught)")
+        return 0
+
+    if args.ir_results:
+        try:
+            with open(args.ir_results, "r", encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"graftir: cannot read --ir-results "
+                  f"{args.ir_results}: {e}", file=sys.stderr)
+            return 2
+        raw = data.get("findings", [])
+        info = {"cache_hit": False,
+                "uncontracted": data.get("uncontracted", []),
+                "programs": data.get("programs", {}),
+                "scenarios_run": data.get("scenarios_run", [])}
+    else:
+        cache_path = args.cache or IR_DEFAULT_CACHE
+        try:
+            raw, info = ir_runner.run(cache_path,
+                                      use_cache=not args.no_cache)
+        except Exception as e:
+            print(f"graftir: worker failed: {e}", file=sys.stderr)
+            return 1
+    elapsed = time.perf_counter() - t0
+    findings = [Finding(**d) for d in raw]
+
+    baseline_path = args.baseline
+    if baseline_path is None and os.path.exists(DEFAULT_BASELINE):
+        baseline_path = DEFAULT_BASELINE
+
+    if args.write_baseline:
+        out = baseline_path or DEFAULT_BASELINE
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        keep: List[dict] = []
+        if os.path.exists(out):
+            try:
+                keep = [e for e in load_baseline(out)
+                        if not _is_ir_entry(e)]
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"graftir: old baseline unreadable ({e}); "
+                      f"rebuilding the IR namespace from scratch",
+                      file=sys.stderr)
+        write_baseline(findings, out, extra=keep)
+        print(f"graftir: wrote {len(findings)} IR finding(s) to {out} "
+              f"(preserving {len(keep)} AST entr"
+              f"{'y' if len(keep) == 1 else 'ies'})")
+        return 0
+
+    entries: List[dict] = []
+    if baseline_path and not args.no_baseline:
+        try:
+            entries = [e for e in load_baseline(baseline_path)
+                       if _is_ir_entry(e)]
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"graftir: cannot read baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    new, stale = apply_baseline(findings, entries)
+    for e in stale:
+        new.append(Finding(
+            rule="R14", path=e["path"], line=1, col=0,
+            message=(f"stale baseline entry: the grandfathered "
+                     f"{e['rule']} IR finding ({e['snippet'][:60]!r}) no "
+                     f"longer exists; regenerate with --ir "
+                     f"--write-baseline so the entry cannot silently "
+                     f"absorb a future {e['rule']} finding"),
+            snippet=e["snippet"]))
+    new.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    coverage = {name: entry.get("scenarios", [])
+                for name, entry in sorted(info.get("programs",
+                                                   {}).items())}
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.__dict__ for f in new],
+            "baselined": len(findings) - (len(new) - len(stale)),
+            "stale_baseline_entries": stale,
+            "elapsed_s": elapsed,
+            "cache_hit": info.get("cache_hit", False),
+            "programs": coverage,
+            "uncontracted": info.get("uncontracted", []),
+            "scenarios_run": info.get("scenarios_run", []),
+        }, indent=2))
+    elif args.format == "github":
+        out = render_github(new)
+        if out:
+            print(out)
+    elif args.format == "sarif":
+        descr = dict(IR_RULES)
+        descr["R14"] = "stale baseline entry (the grandfathered finding "\
+                       "no longer exists)"
+        print(render_sarif(new, tool="graftir", descriptions=descr))
+    else:
+        for f in new:
+            print(f.format())
+        n_base = len(findings) - (len(new) - len(stale))
+        tail = f" ({n_base} baselined)" if n_base else ""
+        warm = ", warm cache" if info.get("cache_hit") else ""
+        print(f"graftir: {len(new)} finding(s){tail} over "
+              f"{len(coverage)} program(s) [{elapsed:.2f}s{warm}]")
+    if args.max_seconds is not None and elapsed > args.max_seconds:
+        print(f"graftir: pass took {elapsed:.2f}s, over the "
+              f"--max-seconds {args.max_seconds:g} budget (a warm cache "
+              f"answers in milliseconds — a budget overrun means the "
+              f"cache broke or the scenario inventory outgrew the "
+              f"budget; see docs/static-analysis.md)", file=sys.stderr)
+        return 1
+    return 1 if new else 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -154,7 +330,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         for r in all_rules():
             scope = ",".join(r.path_filter) if r.path_filter else "all files"
             print(f"{r.id}  [{r.severity}]  ({scope})  {r.description}")
+        if args.ir:
+            from .ir.contracts import IR_RULES
+            for rid, desc in sorted(IR_RULES.items()):
+                print(f"{rid}  [error]  (jitted programs)  {desc}")
         return 0
+
+    if args.ir or args.ir_results or args.selftest:
+        return main_ir(args)
 
     paths = args.paths or ["lambdagap_tpu"]
     for p in paths:
@@ -194,11 +377,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             partial = True
     t0 = time.perf_counter()
     cache_hit = False
+    cache_path = args.cache or scan_cache.DEFAULT_CACHE
     use_cache = not args.no_cache and not partial
     cache_key = None
     if use_cache:
         cache_key = scan_cache.scan_key(paths, select, disable)
-        cached = scan_cache.load(args.cache, cache_key)
+        cached = scan_cache.load(cache_path, cache_key)
         if cached is not None:
             findings = cached
             cache_hit = True
@@ -206,7 +390,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         findings = scan(paths, select=select, disable=disable,
                         partial=partial)
         if use_cache:
-            scan_cache.store(args.cache, cache_key, findings)
+            scan_cache.store(cache_path, cache_key, findings)
     elapsed = time.perf_counter() - t0
 
     baseline_path = args.baseline
@@ -217,15 +401,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         out = baseline_path or DEFAULT_BASELINE
         os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
         pruned = 0
+        keep: List[dict] = []
         if os.path.exists(out):
             try:
-                _new, stale_old = apply_baseline(findings,
-                                                 load_baseline(out))
+                old = load_baseline(out)
+                # the graftir (I-series) namespace passes through
+                # verbatim: an AST regeneration must not prune IR
+                # entries it cannot re-derive
+                keep = [e for e in old if _is_ir_entry(e)]
+                _new, stale_old = apply_baseline(
+                    findings, [e for e in old if not _is_ir_entry(e)])
                 pruned = len(stale_old)
             except (OSError, ValueError, json.JSONDecodeError) as e:
                 print(f"graftlint: old baseline unreadable ({e}); "
                       f"rebuilding from scratch", file=sys.stderr)
-        write_baseline(findings, out)
+        write_baseline(findings, out, extra=keep)
         tail = (f" (pruned {pruned} dead entr"
                 f"{'y' if pruned == 1 else 'ies'})") if pruned else ""
         print(f"graftlint: wrote {len(findings)} finding(s) to {out}"
@@ -235,7 +425,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     entries = []
     if baseline_path and not args.no_baseline:
         try:
-            entries = load_baseline(baseline_path)
+            entries = [e for e in load_baseline(baseline_path)
+                       if not _is_ir_entry(e)]
         except (OSError, ValueError, json.JSONDecodeError) as e:
             print(f"graftlint: cannot read baseline {baseline_path}: {e}",
                   file=sys.stderr)

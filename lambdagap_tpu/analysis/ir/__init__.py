@@ -1,0 +1,14 @@
+"""graftir — IR-level contract verification of the lowered programs.
+
+The second analysis pass (graftlint's AST rules are the first): capture
+every jitted hot program across representative scenarios, trace to
+jaxpr, and check the declared contracts — collective schedule (C1),
+transfer-freedom (C2), precision discipline (C3), retrace-freedom (C4).
+Driven by ``python -m lambdagap_tpu.analysis --ir``.
+
+Import surface is deliberately thin: ``contracts`` is stdlib-only (the
+CLI needs cache keys without importing jax); ``capture``/``checks``/
+``scenarios``/``worker`` import jax and must only load inside the
+capture worker subprocess.
+"""
+from . import contracts  # noqa: F401  (stdlib-only, safe everywhere)

@@ -1,0 +1,166 @@
+"""graftir per-program verdict cache.
+
+Unlike graftlint's whole-result cache (cross-module AST rules make
+per-file reuse unsound), IR verdicts ARE per-program: a program's
+findings depend only on (a) the graftir engine itself, (b) the source
+files its contract declares (``ProgramContract.sources`` — by default
+the registration module, which co-locates with the jitted code), and
+(c) the scenarios that capture it. So the cache keys each program by
+
+    sha256(engine_hash, name, [(source_rel, sha256(source_bytes))...])
+
+and editing a contract (or the module around it) invalidates exactly
+that module's programs; everything else replays warm in ~0 ms with no
+jax import and no subprocess. A partial invalidation re-runs only the
+union of the stale programs' recorded scenarios.
+
+Global guards that force a FULL re-run: an engine edit (any file in
+``analysis/ir/``), a change to the SET of contract-bearing files (a
+brand-new registration the stored program->sources map cannot know
+about), or a cache version bump. The detection scan is a cheap byte
+search for ``register_program(`` over the package tree — same cost
+class as graftlint's hash walk.
+
+Stdlib-only: the parent CLI imports this without jax.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .contracts import PKG_ROOT
+
+CACHE_VERSION = 1
+DEFAULT_CACHE = ".graftir_cache.json"
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+
+_MARKER = b"register_program("
+
+
+def _sha_bytes(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _sha_file(path: str) -> str:
+    try:
+        with open(path, "rb") as f:
+            return _sha_bytes(f.read())
+    except OSError:
+        return "<unreadable>"
+
+
+def engine_hash() -> str:
+    """sha256 over graftir's own sources (``analysis/ir/*.py``): a
+    checker/scenario/contract-schema edit invalidates every verdict."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for name in sorted(os.listdir(here)):
+        if name.endswith(".py"):
+            h.update(name.encode())
+            h.update(_sha_file(os.path.join(here, name)).encode())
+    return h.hexdigest()
+
+
+def contract_files() -> List[str]:
+    """Repo-relative paths of package files that register contracts —
+    the SET is a global cache key (content changes stay per-program)."""
+    out = []
+    skip_dir = os.path.join(PKG_ROOT, "analysis")
+    for root, dirs, files in os.walk(PKG_ROOT):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        if root.startswith(skip_dir):
+            continue
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            fp = os.path.join(root, name)
+            try:
+                with open(fp, "rb") as f:
+                    if _MARKER in f.read():
+                        out.append(os.path.relpath(fp, REPO_ROOT)
+                                   .replace(os.sep, "/"))
+            except OSError:
+                continue
+    return sorted(out)
+
+
+def program_key(name: str, sources: Sequence[str], engine: str) -> str:
+    h = hashlib.sha256()
+    h.update(engine.encode())
+    h.update(name.encode())
+    for rel in sorted(sources):
+        h.update(rel.encode())
+        h.update(_sha_file(os.path.join(REPO_ROOT, rel)).encode())
+    return h.hexdigest()
+
+
+def load(cache_path: str) -> Optional[Dict]:
+    try:
+        with open(cache_path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if data.get("version") != CACHE_VERSION:
+        return None
+    return data
+
+
+def plan(cached: Optional[Dict]) -> Tuple[Dict[str, List[dict]],
+                                          Optional[List[str]]]:
+    """Split the cached verdicts into (warm per-program findings,
+    scenarios that must re-run). Returns scenarios=None for a FULL run
+    (no/invalid cache, engine edit, contract-file set change, or a stale
+    program with no recorded scenarios) and scenarios=[] for a fully
+    warm replay."""
+    if not cached:
+        return {}, None
+    engine = engine_hash()
+    if cached.get("engine") != engine:
+        return {}, None
+    if cached.get("contract_files") != contract_files():
+        return {}, None
+    warm: Dict[str, List[dict]] = {}
+    rerun: set = set()
+    for name, entry in cached.get("programs", {}).items():
+        key = program_key(name, entry.get("sources", ()), engine)
+        if key == entry.get("key"):
+            warm[name] = entry.get("findings", [])
+        else:
+            scens = entry.get("scenarios", [])
+            if not scens:
+                return {}, None
+            rerun.update(scens)
+    return warm, sorted(rerun)
+
+
+def store(cache_path: str, programs: Dict[str, Dict],
+          meta: Optional[Dict] = None) -> None:
+    """Atomic best-effort write of the full per-program map. Each value
+    of ``programs`` must carry ``sources``, ``scenarios`` and
+    ``findings``; keys are (re)computed here."""
+    engine = engine_hash()
+    entries = {}
+    for name, entry in sorted(programs.items()):
+        entries[name] = {
+            "key": program_key(name, entry.get("sources", ()), engine),
+            "sources": sorted(entry.get("sources", ())),
+            "scenarios": sorted(entry.get("scenarios", ())),
+            "findings": entry.get("findings", []),
+        }
+    payload = {"version": CACHE_VERSION, "engine": engine,
+               "contract_files": contract_files(),
+               "programs": entries, "meta": meta or {}}
+    tmp = f"{cache_path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, cache_path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        # graftlint: disable=R8 — best-effort cleanup of a tmp file that
+        # may never have been created; the cache is a pure accelerator
+        except OSError:
+            pass

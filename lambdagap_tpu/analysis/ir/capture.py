@@ -1,0 +1,177 @@
+"""graftir trace capture: a ``jax.jit`` shim that records every program.
+
+Installed ONLY when ``LAMBDAGAP_IR_CAPTURE`` is set, by the env hook at
+the very top of ``lambdagap_tpu/__init__.py`` — BEFORE the package's
+heavy modules import, because import-time decorations
+(``functools.partial(jax.jit, ...)``) resolve ``jax.jit`` at module
+import. The shim is transparent: it builds the real jitted callable and
+delegates every call and attribute to it, additionally recording one
+:class:`CallRecord` per distinct (program, abstract-signature) pair with
+the live arguments, so the checker can re-trace the exact program later
+(including under ``enable_x64`` for the C3 sweep) without re-running any
+workload.
+
+Program naming unwraps ``functools.partial`` and ``shard_map`` wrappers
+down to the underlying function; bound methods are keyed by the owning
+INSTANCE's class (``Fused2DTreeLearner._train_tree_impl``), which is
+what separates the five learners that share one method object.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+
+_real_jit = None                   # the unpatched jax.jit
+_scenario: str = ""
+_scenario_flags: Dict[str, Any] = {}
+_records: List["CallRecord"] = []
+_seen: set = set()
+
+
+class CallRecord:
+    """One distinct (program, signature) call observed during a scenario.
+    Holds the jitted callable + live args so checks can AOT-trace it."""
+
+    __slots__ = ("program", "scenario", "flags", "sig", "jitted", "args",
+                 "kwargs")
+
+    def __init__(self, program: str, scenario: str, flags: Dict[str, Any],
+                 sig: str, jitted, args, kwargs) -> None:
+        self.program = program
+        self.scenario = scenario
+        self.flags = dict(flags)
+        self.sig = sig
+        self.jitted = jitted
+        self.args = args
+        self.kwargs = kwargs
+
+    def trace(self):
+        """AOT-trace to a ClosedJaxpr (never executes)."""
+        return self.jitted.trace(*self.args, **self.kwargs).jaxpr
+
+
+def installed() -> bool:
+    return _real_jit is not None
+
+
+def set_scenario(name: str, **flags) -> None:
+    global _scenario, _scenario_flags
+    _scenario = name
+    _scenario_flags = flags
+
+
+def records() -> List[CallRecord]:
+    return list(_records)
+
+
+def reset() -> None:
+    _records.clear()
+    _seen.clear()
+
+
+def _unwrap(fun):
+    """Peel partials and @wraps-style wrappers (shard_map) down to the
+    innermost function object."""
+    f = fun
+    for _ in range(16):
+        if isinstance(f, functools.partial):
+            f = f.func
+            continue
+        wrapped = getattr(f, "__wrapped__", None)
+        if wrapped is not None and wrapped is not f:
+            f = wrapped
+            continue
+        break
+    return f
+
+
+def program_name(fun) -> str:
+    f = _unwrap(fun)
+    qual = getattr(f, "__qualname__", None) or \
+        getattr(f, "__name__", None) or type(f).__name__
+    meth = qual.rsplit(".", 1)[-1]
+    owner = getattr(f, "__self__", None)
+    if owner is not None:
+        return f"{type(owner).__name__}.{meth}"
+    if "." in qual:
+        # closures keep their full lineage minus the <locals> markers:
+        # ObjectiveBase.get_gradients_fast.fn, not an ambiguous base.fn
+        return ".".join(s for s in qual.split(".") if s != "<locals>")
+    mod = (getattr(f, "__module__", "") or "").rsplit(".", 1)[-1]
+    return f"{mod}.{meth}"
+
+
+def _sig_of(args, kwargs) -> str:
+    """Coarse abstract signature: array leaves by (shape, dtype), other
+    leaves by repr — distinct sigs bound the C4 trace count from above
+    (equal sigs share one trace by jit's own cache)."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    parts = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is not None and dtype is not None:
+            parts.append(f"{tuple(shape)}:{dtype}")
+        else:
+            parts.append(repr(leaf))
+    return str(treedef) + "|" + ",".join(parts)
+
+
+class CapturedFunction:
+    """The stand-in ``jax.jit`` returns while capture is installed."""
+
+    def __init__(self, fun, jit_kwargs: Dict[str, Any]) -> None:
+        self._fun = fun
+        self._jit_kwargs = jit_kwargs
+        self._jitted = _real_jit(fun, **jit_kwargs)
+        self.program = program_name(fun)
+
+    def __call__(self, *args, **kwargs):
+        try:
+            leaves = jax.tree_util.tree_leaves((args, kwargs))
+            # a call from inside another trace passes Tracers — recording
+            # them would leak; the outer program's record covers it
+            if any(isinstance(x, jax.core.Tracer) for x in leaves):
+                return self._jitted(*args, **kwargs)
+            sig = _sig_of(args, kwargs)
+            key = (self.program, _scenario, sig)
+            if key not in _seen:
+                _seen.add(key)
+                _records.append(CallRecord(
+                    self.program, _scenario, _scenario_flags, sig,
+                    self._jitted, args, kwargs))
+        # graftlint: disable=R8 — the shim must NEVER break the workload
+        # it instruments: any recording failure falls through to the
+        # undisturbed real jit call below, and there is deliberately no
+        # logger here (the worker subprocess owns stdout for its JSON)
+        except Exception:
+            pass
+        return self._jitted(*args, **kwargs)
+
+    def __getattr__(self, name: str):
+        return getattr(self._jitted, name)
+
+
+def _capturing_jit(fun: Optional[Any] = None, **kwargs):
+    if fun is None:      # decorator-with-arguments form
+        return functools.partial(_capturing_jit, **kwargs)
+    return CapturedFunction(fun, kwargs)
+
+
+def install() -> None:
+    """Patch ``jax.jit`` (idempotent). Must run before any module whose
+    import decorates functions with ``jax.jit``."""
+    global _real_jit
+    if _real_jit is not None:
+        return
+    _real_jit = jax.jit
+    jax.jit = _capturing_jit
+
+
+def uninstall() -> None:
+    global _real_jit
+    if _real_jit is not None:
+        jax.jit = _real_jit
+        _real_jit = None

@@ -1,0 +1,121 @@
+"""graftir parent-side runner: cache plan -> worker subprocess -> merge.
+
+The CLI process stays jax-free: it plans against the per-program verdict
+cache (:mod:`.cache`), and only when something is stale does it spawn
+the capture worker as a subprocess with the ``LAMBDAGAP_IR_CAPTURE``
+hook armed and eight virtual CPU devices (the virtual grid the scenario
+inventory needs). A fully warm cache answers in milliseconds with zero
+subprocesses; a partial invalidation re-runs only the stale programs'
+scenarios and keeps every other verdict.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List, Optional, Tuple
+
+from . import cache as ir_cache
+
+WORKER_ENV = {
+    "LAMBDAGAP_IR_CAPTURE": "1",
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+
+def worker_cmd(extra: Optional[List[str]] = None) -> List[str]:
+    return ([sys.executable, "-m", "lambdagap_tpu.analysis.ir.worker"]
+            + (extra or []))
+
+
+def _spawn(extra: List[str], timeout: Optional[float]) -> Dict:
+    env = dict(os.environ)
+    env.update(WORKER_ENV)
+    # a lint-only parent (tools/graftir_gate.py) must not starve the
+    # worker of the real package — IR_CAPTURE wins in __init__, but be
+    # explicit rather than rely on the precedence
+    env.pop("LAMBDAGAP_LINT_ONLY", None)
+    fd, out_path = tempfile.mkstemp(prefix="graftir_", suffix=".json")
+    os.close(fd)
+    try:
+        proc = subprocess.run(
+            worker_cmd(extra + ["--out", out_path]),
+            cwd=ir_cache.REPO_ROOT, env=env, capture_output=True,
+            text=True, timeout=timeout)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"graftir worker exited {proc.returncode}:\n"
+                f"{proc.stdout[-1000:]}\n{proc.stderr[-2000:]}")
+        with open(out_path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    finally:
+        try:
+            os.unlink(out_path)
+        # graftlint: disable=R8 — tmp cleanup; the result was already read
+        except OSError:
+            pass
+
+
+def run(cache_path: str = ir_cache.DEFAULT_CACHE, use_cache: bool = True,
+        timeout: Optional[float] = None) -> Tuple[List[dict], Dict]:
+    """The IR pass: returns (finding dicts, info). ``info`` carries
+    ``cache_hit`` (full warm replay), ``scenarios_run``, per-program
+    ``programs``, and ``uncontracted``."""
+    warm: Dict[str, List[dict]] = {}
+    scenarios: Optional[List[str]] = None
+    cached = ir_cache.load(cache_path) if use_cache else None
+    if use_cache:
+        warm, scenarios = ir_cache.plan(cached)
+
+    if use_cache and scenarios == []:
+        findings = [f for name in sorted(warm) for f in warm[name]]
+        info = {"cache_hit": True, "scenarios_run": [],
+                "programs": cached.get("programs", {}),
+                "uncontracted": cached.get("meta", {}).get(
+                    "uncontracted", []),
+                "worker_elapsed_s": 0.0}
+        return findings, info
+
+    extra: List[str] = []
+    if use_cache and scenarios:
+        extra = ["--scenarios", ",".join(scenarios)]
+    result = _spawn(extra, timeout)
+
+    programs: Dict[str, Dict] = {}
+    if use_cache and scenarios:
+        # partial run: fresh verdicts for re-run programs, warm entries
+        # (key still valid) for the rest
+        for name, entry in (cached or {}).get("programs", {}).items():
+            if name in warm:
+                programs[name] = entry
+        for name, entry in result.get("programs", {}).items():
+            if name not in warm:
+                programs[name] = entry
+        uncontracted = sorted(
+            set(result.get("uncontracted", ()))
+            | set((cached or {}).get("meta", {}).get("uncontracted", ())))
+    else:
+        programs = result.get("programs", {})
+        uncontracted = result.get("uncontracted", [])
+
+    if use_cache:
+        ir_cache.store(cache_path, programs,
+                       meta={"uncontracted": uncontracted,
+                             "env": result.get("env", {})})
+
+    findings = [f for name in sorted(programs)
+                for f in programs[name].get("findings", [])]
+    info = {"cache_hit": False,
+            "scenarios_run": result.get("scenarios_run", []),
+            "programs": programs, "uncontracted": uncontracted,
+            "worker_elapsed_s": result.get("elapsed_s", 0.0)}
+    return findings, info
+
+
+def selftest(timeout: Optional[float] = None) -> Dict:
+    """Run the seeded-violation mutation suite in the worker; returns its
+    JSON payload (``ok`` + per-mutation results)."""
+    return _spawn(["--selftest"], timeout)

@@ -1,0 +1,146 @@
+"""graftir scenario inventory: the representative configs the worker runs.
+
+Each scenario is one tiny workload chosen so the program(s) under
+contract actually compile: the five learners (host serial, fused,
+fused-DP, fused-FP, fused-voting), the 2-D learner across all four
+virtual grids (quantized — the same leg also proves the integer
+reduction), stream kernels on ragged host shards (serial-fused and 2-D),
+linear-leaf moments, and the three predict engines. Shapes are small —
+the contract checks STRUCTURE of the lowered IR, which tiny shapes
+exhibit exactly as well as pod-scale ones — and deliberately ragged
+(rows not divisible by the grid) so padding buckets are live for C4.
+
+Import only inside the capture worker: this module pulls in the full
+package.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List
+
+ROWS = 1603                 # not divisible by 2/4/8: pad rows live
+FEATURES = 12
+ROUNDS = 3                  # >= 2 so steady-state iterations replay traces
+LEAVES = 7
+
+_BASE = {"objective": "binary", "num_leaves": LEAVES, "verbose": -1,
+         "min_data_in_leaf": 20, "deterministic": True}
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    flags: Dict                  # consumed by checks (quant, grid, ...)
+    dims: Dict                   # consumed by payload-byte formulas
+    run: Callable[[], None]
+
+
+def _data():
+    import numpy as np
+    rng = np.random.RandomState(0)
+    X = rng.randn(ROWS, FEATURES).astype(np.float32)
+    y = (X[:, 0] - 0.4 * X[:, 1] + 0.2 * rng.randn(ROWS) > 0
+         ).astype(np.float32)
+    return X, y
+
+
+def _train(extra: Dict, rounds: int = ROUNDS):
+    import lambdagap_tpu as lgb
+    X, y = _data()
+    params = dict(_BASE)
+    params.update(extra)
+    return lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                     num_boost_round=rounds)
+
+
+def _grid_dims(grid: str) -> Dict:
+    dd, ff = (int(v) for v in grid.split("x"))
+    # bins/hist_item feed the contract payload-byte formulas: histograms
+    # are (features x 256 bins) of {grad, hess, count} at 4 B each
+    return {"dd": dd, "ff": ff, "rows": ROWS, "features": FEATURES,
+            "leaves": LEAVES, "bins": 256, "hist_item": 12}
+
+
+def _mk_train(extra: Dict):
+    def run():
+        _train(extra)
+    return run
+
+
+def _mk_predict(engine: str):
+    def run():
+        import numpy as np
+        b = _train({"tpu_fused_learner": "1", "tree_learner": "serial",
+                    "tpu_fast_predict_rows": 0,
+                    "predict_engine": engine})
+        X, _ = _data()
+        b.predict(X[:601])
+        b.predict(X[:601])          # steady state: must replay the trace
+    return run
+
+
+def _mk_linear():
+    def run():
+        import numpy as np
+        import lambdagap_tpu as lgb
+        X, y = _data()
+        yr = (X[:, 0] * 2.0 - X[:, 1]).astype(np.float32)
+        params = dict(_BASE)
+        params.update({"objective": "regression", "linear_tree": True,
+                       "tpu_fused_learner": "1", "tree_learner": "serial"})
+        lgb.train(params, lgb.Dataset(X, label=yr, params=params),
+                  num_boost_round=ROUNDS)
+    return run
+
+
+def inventory() -> List[Scenario]:
+    scens: List[Scenario] = []
+    scens.append(Scenario(
+        "serial_host", {}, _grid_dims("1x1"),
+        _mk_train({"tree_learner": "serial", "tpu_fused_learner": "0"})))
+    scens.append(Scenario(
+        "fused", {}, _grid_dims("1x1"),
+        _mk_train({"tree_learner": "serial", "tpu_fused_learner": "1"})))
+    scens.append(Scenario(
+        "fused_dp", {}, _grid_dims("8x1"),
+        _mk_train({"tree_learner": "data", "tpu_fused_learner": "1",
+                   "tpu_num_devices": 8})))
+    scens.append(Scenario(
+        "fused_fp", {}, _grid_dims("1x8"),
+        _mk_train({"tree_learner": "feature", "tpu_fused_learner": "1",
+                   "tpu_num_devices": 8})))
+    scens.append(Scenario(
+        "fused_vp", {}, _grid_dims("8x1"),
+        _mk_train({"tree_learner": "voting", "tpu_fused_learner": "1",
+                   "tpu_num_devices": 8})))
+    # the 2-D grid sweep rides the QUANTIZED path: one leg proves both the
+    # grid-invariant three-collective schedule (C1) and the integer
+    # histogram reduction (C3b), exactly like tools/multichip_gate.py
+    for grid in ("1x8", "2x4", "4x2", "8x1"):
+        scens.append(Scenario(
+            f"fused2d_{grid}", {"quant": True}, _grid_dims(grid),
+            _mk_train({"tree_learner": "data", "tpu_fused_learner": "1",
+                       "mesh_shape": grid, "use_quantized_grad": True,
+                       "stochastic_rounding": False})))
+    scens.append(Scenario(
+        "quant_dp", {"quant": True}, _grid_dims("8x1"),
+        _mk_train({"tree_learner": "data", "tpu_fused_learner": "1",
+                   "tpu_num_devices": 8, "use_quantized_grad": True,
+                   "stochastic_rounding": False})))
+    scens.append(Scenario(
+        "stream", {"stream": True}, _grid_dims("1x1"),
+        _mk_train({"tree_learner": "serial", "tpu_fused_learner": "1",
+                   "data_residency": "stream", "enable_bundle": False,
+                   "stream_shard_rows": 900})))   # 1603 -> 2 ragged shards
+    scens.append(Scenario(
+        "stream2d", {"stream": True}, _grid_dims("2x1"),
+        _mk_train({"tree_learner": "data", "tpu_fused_learner": "1",
+                   "mesh_shape": "2x1", "data_residency": "stream",
+                   "enable_bundle": False, "stream_shard_rows": 900})))
+    scens.append(Scenario(
+        "linear", {}, _grid_dims("1x1"), _mk_linear()))
+    for engine in ("scan", "tensor", "compiled"):
+        scens.append(Scenario(
+            f"predict_{engine}", {"predict": True}, _grid_dims("1x1"),
+            _mk_predict(engine)))
+    return scens

@@ -178,3 +178,10 @@ class TrainGuard:
 
 #: shared inert guard for boosters constructed without a training config
 NULL_GUARD = TrainGuard(policy="off", plan=faults_mod.FaultPlan(""))
+
+
+# graftir IR contracts
+from ..analysis.ir.contracts import register_program
+
+register_program("nonfinite._finite_flag", collective_free=True)
+register_program("nonfinite._combine_ok", collective_free=True)

@@ -464,3 +464,12 @@ def _pack_leaf_tables(cfs, has_linear: bool):
         return (np.concatenate(lv_all), np.concatenate(lc_all),
                 np.concatenate(lf_all), np.concatenate(lcf_all))
     return (np.concatenate(lv_all),)
+
+
+# graftir IR contract
+from ..analysis.ir.contracts import register_program
+
+register_program(
+    "engine._predict_compiled", collective_free=True,
+    notes="compiled-forest palette kernel; steady-state predict replays "
+          "the one trace")

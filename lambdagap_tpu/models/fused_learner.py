@@ -2205,3 +2205,22 @@ class FusedTreeLearner(SerialTreeLearner):
                                     jnp.int32(left_count), fmask)
 
         return self._sj_final(state)
+
+
+# ---------------------------------------------------------------------------
+# graftir IR contracts: the single-device fused programs carry no mesh, so
+# their schedule clause is "collective-free"; what C2-C4 buy here is
+# transfer-freedom, f64-freedom under the x64 retrace, and one-trace
+# steady state (the ragged 900/703-row stream shards in the scenario
+# inventory prove the pow2 bucketing keeps every kernel at one trace).
+from ..analysis.ir.contracts import register_program
+
+register_program(
+    "FusedTreeLearner._train_tree_impl", collective_free=True,
+    notes="whole-tree single-device program: split loop fused, no mesh")
+for _k in ("init", "pick", "partition", "chunk", "finish", "finalize"):
+    register_program(
+        f"FusedTreeLearner._stream_{_k}_impl", collective_free=True,
+        notes="host-streamed kernel; shard rows bucket to pow2 so ragged "
+              "shards replay one trace")
+del _k

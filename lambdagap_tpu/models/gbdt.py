@@ -1399,3 +1399,11 @@ class GBDT:
                     predict_tree_binned(self.valid_binned[vi], arrs, depth))
         del self.models[-self.num_tree_per_iteration:]
         self.iter_ -= 1
+
+
+# graftir IR contract
+from ..analysis.ir.contracts import register_program
+
+register_program(
+    "gbdt._add_tree_score", collective_free=True,
+    notes="score accumulation after each tree; device-resident add")

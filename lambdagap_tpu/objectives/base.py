@@ -170,3 +170,12 @@ def weighted_percentile(values: np.ndarray, weights: Optional[np.ndarray],
     else:
         frac = 0.0
     return float(v[idx] * (1 - frac) + v[idx + 1] * frac)
+
+
+# graftir IR contract
+from ..analysis.ir.contracts import register_program
+
+register_program(
+    "ObjectiveFunction.get_gradients_fast.fn", collective_free=True,
+    notes="jitted gradient wrapper shared by the array-field objectives; "
+          "one trace per boosting run")

@@ -326,3 +326,16 @@ def full_histogram(x_binned: jax.Array, grad: jax.Array, hess: jax.Array,
              else sample_mask.astype(bool))
     return histogram_from_rows(x_binned, grad, hess, valid, num_bins,
                                rows_per_block, precision)
+
+
+# graftir IR contracts (`python -m lambdagap_tpu.analysis --ir`)
+from ..analysis.ir.contracts import register_program
+
+register_program(
+    "histogram.full_histogram", collective_free=True,
+    notes="root histogram over the full training slab; fixed shape")
+register_program(
+    "histogram.leaf_histogram", collective_free=True, max_traces=5,
+    notes="host-serial per-leaf slices retrace per pow2 row bucket by "
+          "design (the fused paths are where one-trace is contractual); "
+          "the 1603-row scenario exercises 3 buckets")

@@ -229,3 +229,11 @@ def linear_leaf_values(x: jax.Array, leaf_flat: jax.Array,
 
     lin = lax.fori_loop(0, FL, body, leaf_const_flat[leaf_flat])
     return jnp.where(nan_row, leaf_value_flat[leaf_flat], lin)
+
+
+# graftir IR contract
+from ..analysis.ir.contracts import register_program
+
+register_program(
+    "linear.accumulate_leaf_moments", collective_free=True,
+    notes="linear-leaf Gram/moment accumulation stays on device")

@@ -206,3 +206,12 @@ def split_partition_sorted_vals(bin_vals: jax.Array, gh_sorted: jax.Array,
     new_perm = perm.at[idx].set(rows[order], mode="drop")
     new_gh = gh_sorted.at[idx].set(gh_sorted[safe_idx][order], mode="drop")
     return new_perm, new_gh, left_count, go_left
+
+
+# graftir IR contract
+from ..analysis.ir.contracts import register_program
+
+register_program(
+    "partition.split_partition", collective_free=True, max_traces=6,
+    notes="host-serial permutation update retraces per pow2 leaf bucket "
+          "by design; the 1603-row scenario exercises 4 buckets")

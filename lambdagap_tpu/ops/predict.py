@@ -458,3 +458,12 @@ def predict_forest_leaf(x: jax.Array, forest: TreeArrays,
         ys = _predict_forest_leaf_block(x, blk, max_depth, binned)
         outs.append(ys[:n_real])
     return jnp.concatenate(outs, axis=0)
+
+
+# graftir IR contract
+from ..analysis.ir.contracts import register_program
+
+register_program(
+    "predict._predict_forest_block", collective_free=True,
+    notes="scan-engine block kernel; steady-state predict replays the "
+          "one trace")

@@ -260,3 +260,12 @@ def predict_forest_leaf_tensor(x: jax.Array, forest: TreeArrays,
         ys = _leaf_tensor_tile(x, blk, max_depth, binned)
         outs.append(ys[:n_real])
     return jnp.concatenate(outs, axis=0)
+
+
+# graftir IR contract
+from ..analysis.ir.contracts import register_program
+
+register_program(
+    "predict_tensor._predict_tensor_tile", collective_free=True,
+    notes="tensorized predict tile; steady-state predict replays the "
+          "one trace")

@@ -622,3 +622,11 @@ def find_best_split(hist: jax.Array, parent_g: jax.Array, parent_h: jax.Array,
         is_categorical=use_cat[best_f],
         cat_bitset=cat_bits[best_f],
     )
+
+
+# graftir IR contract
+from ..analysis.ir.contracts import register_program
+
+register_program(
+    "split.find_best_split", collective_free=True,
+    notes="histogram shapes are (F, bins)-fixed, so exactly one trace")

@@ -1298,3 +1298,86 @@ class Fused2DTreeLearner(FusedTreeLearner):
 
         rec = self._sj2_final(state)
         return rec._replace(row_leaf=rec.row_leaf[:Nr])
+
+
+# ---------------------------------------------------------------------------
+# graftir IR contracts (`python -m lambdagap_tpu.analysis --ir`): the
+# declared collective schedule of every program this module jits, verified
+# against the lowered jaxpr across all four virtual grids. Editing this
+# file invalidates exactly these programs' cached verdicts.
+from ..analysis.ir.contracts import all_gather, psum, register_program
+
+
+def _hist_bytes(d):
+    # per-shard leaf histogram: ceil(F/ff) features x bins x {g,h,cnt}
+    return -(-d["features"] // d["ff"]) * d["bins"] * d["hist_item"]
+
+
+def _rowflag_bytes(d):
+    # go-left partition flags: one byte per shard-resident row
+    return -(-d["rows"] // d["dd"])
+
+
+register_program(
+    "FusedDataParallelTreeLearner._train_tree_impl",
+    quant_int_reduction=True,
+    step_collectives=(psum("data", 1, "leaf histogram", _hist_bytes),),
+    setup_collectives=(psum("data", 1, "root histogram", _hist_bytes),),
+    notes="one histogram psum per split step; splits are chosen locally "
+          "on the replicated reduced histograms — no other wire traffic")
+
+register_program(
+    "FusedVotingParallelTreeLearner._train_tree_impl",
+    step_collectives=(psum("data", 1, "voted histogram columns"),
+                      all_gather("data", 1, "local top-k votes")),
+    setup_collectives=(psum("data", 2, "root histogram + vote meta"),
+                       all_gather("data", 1, "root votes")),
+    notes="PV-Tree schedule: local votes gathered over data, then only "
+          "the voted feature columns are psum-ed")
+
+register_program(
+    "FusedFeatureParallelTreeLearner.__init__.sharded",
+    step_collectives=(
+        psum("feature", 1, "go-left row flags", _rowflag_bytes),
+        all_gather("feature", 11, "best-split tuple (11 fields)")),
+    setup_collectives=(
+        all_gather("feature", 11, "root best-split tuple"),),
+    notes="rows replicated, features sharded: the winning split is "
+          "all_gather-ed over feature and partition flags psum-ed so "
+          "every shard keeps the full row->leaf map")
+
+register_program(
+    "Fused2DTreeLearner._train_tree_impl",
+    quant_int_reduction=True,
+    step_collectives=(
+        psum("data", 1, "leaf histogram", _hist_bytes),
+        psum("feature", 1, "go-left row flags", _rowflag_bytes),
+        all_gather("feature", 11, "best-split tuple (11 fields)")),
+    setup_collectives=(
+        psum("data", 1, "root histogram", _hist_bytes),
+        psum("feature", 1, "per-feature meta", lambda d: d["features"]),
+        all_gather("feature", 11, "root best-split tuple")),
+    notes="the PR 15 invariant: three logical collectives per split step "
+          "— hist psum over data, row-flag psum over feature, best-split "
+          "all_gather over feature (11 eqns = 11 tuple fields) — with "
+          "payload bytes grid-invariant-by-formula over 1x8/2x4/4x2/8x1")
+
+# streaming split-step bodies: the split loop is driven from host, so each
+# body's collectives sit at loop depth 0 (= the whole program IS one step)
+register_program(
+    "Fused2DTreeLearner._s2_init_body",
+    setup_collectives=(
+        psum("data", 1, "root histogram", _hist_bytes),
+        psum("feature", 1, "per-feature meta", lambda d: d["features"]),
+        all_gather("feature", 11, "root best-split tuple")))
+register_program(
+    "Fused2DTreeLearner._s2_finish_body",
+    setup_collectives=(
+        psum("data", 1, "sibling-subtracted child histogram", _hist_bytes),
+        all_gather("feature", 11, "best-split tuple")))
+register_program("Fused2DTreeLearner._s2_chunk_body", collective_free=True,
+                 max_traces=2,
+                 notes="full + compact payload layouts are two programs")
+register_program("Fused2DTreeLearner._s2_pick_body", collective_free=True)
+register_program("Fused2DTreeLearner._s2_part_body", collective_free=True)
+register_program("Fused2DTreeLearner._s2_final_body", collective_free=True)

@@ -1,0 +1,339 @@
+"""graftir (lambdagap_tpu.analysis.ir): the ISSUE-17 acceptance surface.
+
+Covers the contract registry (registration-site anchoring, the I-rule
+catalog, the stdlib-only import guarantee), the per-program verdict
+cache (key sensitivity, partial-invalidation planning, the global
+full-run guards), the ``--ir`` CLI through the ``--ir-results`` seam
+(formats, exit codes, budget enforcement, the I/R baseline namespace
+partition and its byte-stable round-trip), the merged SARIF artifact,
+the G0 wiring (gate present, budgets pinned), and — through the real
+worker subprocess — the mutation suite's teeth: every seeded violation
+class must be CAUGHT by the shipping checkers.
+
+The seam tests run without jax: the CLI, registry and cache are
+deliberately importable from the lint side, and the tests prove it.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from lambdagap_tpu.analysis import cli
+from lambdagap_tpu.analysis.core import Finding, load_baseline, \
+    write_baseline
+from lambdagap_tpu.analysis.ir import cache as ircache
+from lambdagap_tpu.analysis.ir import contracts
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+SUITE = os.path.join(REPO, "tools", "run_full_suite.sh")
+GATE = os.path.join(REPO, "tools", "graftir_gate.py")
+
+
+def _ir_finding(**over):
+    d = {"rule": "I1", "path": "lambdagap_tpu/parallel/fused_parallel.py",
+         "line": 700, "col": 0,
+         "message": "collective-schedule violation: expected 1 psum over "
+                    "'data', lowered 2 (program "
+                    "Fused2DTreeLearner._train_tree_impl, scenario "
+                    "fused2d_2x4)",
+         "severity": "error",
+         "snippet": "Fused2DTreeLearner._train_tree_impl"}
+    d.update(over)
+    return d
+
+
+def _results(findings=(), programs=None):
+    return {"findings": list(findings),
+            "programs": programs or {
+                "histogram.full_histogram": {
+                    "sources": ["lambdagap_tpu/ops/histogram.py"],
+                    "scenarios": ["serial_host"], "findings": []}},
+            "uncontracted": [], "scenarios_run": ["serial_host"],
+            "elapsed_s": 0.01}
+
+
+def _seam(tmp_path, findings=(), extra_args=(), programs=None):
+    rf = tmp_path / "ir_results.json"
+    rf.write_text(json.dumps(_results(findings, programs)))
+    return ["--ir-results", str(rf), *extra_args]
+
+
+# -- registry ------------------------------------------------------------
+def test_rule_catalog_covers_every_contract_clause():
+    assert set(contracts.IR_RULES) == {"I1", "I2", "I3", "I4", "I5"}
+    for desc in contracts.IR_RULES.values():
+        assert len(desc) > 20
+
+
+def test_register_program_anchors_registration_site():
+    snap = dict(contracts._REGISTRY)
+    try:
+        c = contracts.register_program(
+            "test.anchor_probe", collective_free=True, max_traces=3)
+        assert c.path.replace(os.sep, "/").endswith(
+            "tests/test_graftir.py")
+        assert c.line > 0
+        assert c.sources == (c.path,)       # default: the declaring file
+        assert c.max_traces == 3
+        assert contracts.get_contract("test.anchor_probe") is c
+    finally:
+        contracts._REGISTRY.clear()
+        contracts._REGISTRY.update(snap)
+
+
+def test_hot_program_inventory_registered_on_import():
+    """Importing the package registers the contract inventory — the
+    learners' split-step schedules, the stream kernels, the predict
+    engines, the linear-leaf moments (ISSUE-17 inventory floor)."""
+    # registrations live at module scope NEXT to the jitted code they
+    # constrain; importing the hot modules is the registration act
+    from lambdagap_tpu.infer import engine                    # noqa: F401
+    from lambdagap_tpu.models import fused_learner, gbdt      # noqa: F401
+    from lambdagap_tpu.objectives import base                 # noqa: F401
+    from lambdagap_tpu.ops import (histogram, linear,         # noqa: F401
+                                   partition, predict,
+                                   predict_tensor, split)
+    from lambdagap_tpu.parallel import fused_parallel         # noqa: F401
+    names = {c.name for c in contracts.all_contracts()}
+    for required in [
+            "FusedTreeLearner._train_tree_impl",
+            "FusedDataParallelTreeLearner._train_tree_impl",
+            "FusedFeatureParallelTreeLearner.__init__.sharded",
+            "FusedVotingParallelTreeLearner._train_tree_impl",
+            "Fused2DTreeLearner._train_tree_impl",
+            "histogram.full_histogram", "histogram.leaf_histogram",
+            "split.find_best_split", "partition.split_partition",
+            "predict._predict_forest_block",
+            "predict_tensor._predict_tensor_tile",
+            "engine._predict_compiled",
+            "linear.accumulate_leaf_moments"]:
+        assert required in names, f"missing contract: {required}"
+    # every 2-D split-step program is contracted
+    assert sum("._s2_" in n for n in names) >= 6
+    # learners sharing _train_tree_impl register DISTINCT contracts
+    two_d = contracts.get_contract("Fused2DTreeLearner._train_tree_impl")
+    assert two_d.step_collectives and two_d.quant_int_reduction
+
+
+def test_lint_side_ir_modules_are_stdlib_only():
+    """The modules the lint side loads (contracts, the verdict cache,
+    the runner that SPAWNS the worker) must keep jax/numpy behind the
+    subprocess boundary: no module-level jax import anywhere in them —
+    only capture/checks/scenarios/worker/mutations (worker-side) may
+    import jax, and only at module scope there."""
+    import ast
+    ir_dir = os.path.join(REPO, "lambdagap_tpu", "analysis", "ir")
+    lint_side = {"__init__.py", "contracts.py", "cache.py", "runner.py"}
+    for name in sorted(os.listdir(ir_dir)):
+        if not name.endswith(".py"):
+            continue
+        tree = ast.parse(open(os.path.join(ir_dir, name)).read())
+        top = set()
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                top.update(a.name.split(".")[0] for a in node.names)
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                top.add((node.module or "").split(".")[0])
+        if name in lint_side:
+            assert "jax" not in top and "numpy" not in top, \
+                f"{name} is lint-side: jax/numpy must stay worker-only"
+
+
+# -- the per-program verdict cache --------------------------------------
+def test_program_key_tracks_source_content(tmp_path, monkeypatch):
+    monkeypatch.setattr(ircache, "REPO_ROOT", str(tmp_path))
+    src = tmp_path / "mod.py"
+    src.write_text("x = 1\n")
+    eng = ircache.engine_hash()
+    k1 = ircache.program_key("p", ["mod.py"], eng)
+    assert k1 == ircache.program_key("p", ["mod.py"], eng)  # stable
+    src.write_text("x = 2\n")
+    assert ircache.program_key("p", ["mod.py"], eng) != k1
+    src.write_text("x = 1\n")
+    assert ircache.program_key("p", ["mod.py"], eng) == k1  # content, not mtime
+    assert ircache.program_key("q", ["mod.py"], eng) != k1  # name in key
+    assert ircache.program_key("p", ["mod.py"], "other-engine") != k1
+
+
+def test_plan_partial_invalidation_and_global_guards(tmp_path, monkeypatch):
+    """A source edit re-runs ONLY that program's scenarios; an engine
+    edit, a contract-file set change, or a scenario-less stale entry
+    forces the full run."""
+    monkeypatch.setattr(ircache, "REPO_ROOT", str(tmp_path))
+    (tmp_path / "a.py").write_text("a\n")
+    (tmp_path / "b.py").write_text("b\n")
+    cp = str(tmp_path / "cache.json")
+    ircache.store(cp, {
+        "prog.a": {"sources": ["a.py"], "scenarios": ["s_a"],
+                   "findings": [_ir_finding()]},
+        "prog.b": {"sources": ["b.py"], "scenarios": ["s_b", "s_b2"],
+                   "findings": []}})
+    warm, rerun = ircache.plan(ircache.load(cp))
+    assert rerun == [] and set(warm) == {"prog.a", "prog.b"}
+    assert warm["prog.a"] == [_ir_finding()]     # verdicts replay verbatim
+
+    (tmp_path / "b.py").write_text("b CHANGED\n")
+    warm, rerun = ircache.plan(ircache.load(cp))
+    assert set(warm) == {"prog.a"} and rerun == ["s_b", "s_b2"]
+
+    cached = ircache.load(cp)
+    cached["engine"] = "tampered"
+    assert ircache.plan(cached) == ({}, None)           # engine guard
+    cached = ircache.load(cp)
+    cached["contract_files"] = cached["contract_files"] + ["new_file.py"]
+    assert ircache.plan(cached) == ({}, None)           # set guard
+    cached = ircache.load(cp)
+    cached["programs"]["prog.b"]["scenarios"] = []
+    (tmp_path / "b.py").write_text("b CHANGED AGAIN\n")
+    assert ircache.plan(cached) == ({}, None)           # scenario-less stale
+    assert ircache.plan(None) == ({}, None)             # no cache at all
+
+
+def test_contract_file_scan_finds_registration_modules():
+    files = ircache.contract_files()
+    assert "lambdagap_tpu/parallel/fused_parallel.py" in files
+    assert "lambdagap_tpu/ops/histogram.py" in files
+    assert "lambdagap_tpu/infer/engine.py" in files
+    assert not any(f.startswith("lambdagap_tpu/analysis/") for f in files)
+
+
+# -- the --ir CLI through the --ir-results seam -------------------------
+def test_cli_clean_results_exit_zero(tmp_path, capsys):
+    rc = cli.main(_seam(tmp_path, extra_args=["--no-baseline"]))
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 finding(s)" in out and "1 program(s)" in out
+
+
+def test_cli_findings_exit_one_and_formats(tmp_path, capsys):
+    f = _ir_finding()
+    rc = cli.main(_seam(tmp_path, [f], ["--no-baseline"]))
+    assert rc == 1
+    assert "I1" in capsys.readouterr().out
+
+    rc = cli.main(_seam(tmp_path, [f],
+                        ["--no-baseline", "--format", "json"]))
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["findings"][0]["rule"] == "I1"
+    assert data["programs"] == {"histogram.full_histogram":
+                                ["serial_host"]}
+    assert data["scenarios_run"] == ["serial_host"]
+
+    rc = cli.main(_seam(tmp_path, [f],
+                        ["--no-baseline", "--format", "github"]))
+    assert rc == 1
+    assert "::error file=" in capsys.readouterr().out
+
+    rc = cli.main(_seam(tmp_path, [f],
+                        ["--no-baseline", "--format", "sarif"]))
+    sarif = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    driver = sarif["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "graftir"
+    assert {r["id"] for r in driver["rules"]} >= {"I1"}
+    assert sarif["runs"][0]["results"][0]["ruleId"] == "I1"
+
+
+def test_cli_budget_overrun_fails(tmp_path, capsys):
+    rc = cli.main(_seam(tmp_path,
+                        extra_args=["--no-baseline", "--max-seconds", "0"]))
+    assert rc == 1
+    assert "budget" in capsys.readouterr().err
+
+
+def test_baseline_namespace_partition_round_trip(tmp_path, capsys):
+    """The one baseline file holds BOTH namespaces: the IR writer touches
+    only I-entries (AST entries pass through verbatim), the round-trip is
+    byte-stable, and each pass applies only its own namespace."""
+    bl = tmp_path / "baseline.json"
+    # seed the AST namespace
+    write_baseline([Finding(rule="R1", path="models/learner.py", line=9,
+                            col=0, message="host sync",
+                            snippet="jax.device_get(x)")], str(bl))
+    # IR write-baseline adds the I-entry and PRESERVES the R-entry
+    rc = cli.main(_seam(tmp_path, [_ir_finding()],
+                        ["--write-baseline", "--baseline", str(bl)]))
+    capsys.readouterr()
+    assert rc == 0
+    entries = load_baseline(str(bl))
+    assert {e["rule"] for e in entries} == {"I1", "R1"}
+    first = bl.read_text()
+    rc = cli.main(_seam(tmp_path, [_ir_finding()],
+                        ["--write-baseline", "--baseline", str(bl)]))
+    capsys.readouterr()
+    assert rc == 0 and bl.read_text() == first      # byte-stable
+    # the baselined IR finding no longer fails the IR pass
+    rc = cli.main(_seam(tmp_path, [_ir_finding()],
+                        ["--baseline", str(bl), "--format", "json"]))
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0 and data["baselined"] == 1 and not data["findings"]
+
+
+def test_stale_ir_baseline_entry_is_a_finding(tmp_path, capsys):
+    bl = tmp_path / "baseline.json"
+    write_baseline([Finding(**_ir_finding())], str(bl))
+    rc = cli.main(_seam(tmp_path, [],        # the I1 finding is gone
+                        ["--baseline", str(bl), "--format", "json"]))
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert data["findings"][0]["rule"] == "R14"
+    assert "--ir --write-baseline" in data["findings"][0]["message"]
+
+
+def test_merge_sarif_concatenates_runs():
+    lint = cli.render_sarif([], tool="graftlint")
+    ir = cli.render_sarif([Finding(**_ir_finding())], tool="graftir",
+                          descriptions=contracts.IR_RULES)
+    merged = json.loads(cli.merge_sarif([lint, ir]))
+    assert [r["tool"]["driver"]["name"] for r in merged["runs"]] == \
+        ["graftlint", "graftir"]
+    assert merged["runs"][1]["results"][0]["ruleId"] == "I1"
+
+
+def test_list_rules_includes_ir_catalog(capsys):
+    rc = cli.main(["--list-rules", "--ir"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for rid in contracts.IR_RULES:
+        assert rid in out
+
+
+# -- G0 wiring: budgets are pinned in the suite, not hoped --------------
+def test_g0_budgets_asserted_in_full_suite():
+    """ISSUE-17 satellite 5: the suite runs graftlint cold under its 2 s
+    budget AND the graftir gate under its own 570 s budget, emitting the
+    single merged SARIF artifact."""
+    text = open(SUITE).read()
+    assert "--max-seconds 2" in text                    # graftlint budget
+    assert "graftir_gate.py --max-seconds 570" in text  # graftir budget
+    assert "--sarif-out" in text                        # merged artifact
+    # the graftir step must come BEFORE the test groups burn wall-clock
+    assert text.index("graftir_gate.py") < text.index("=== G1")
+
+
+def test_gate_script_parses_and_defaults_to_570():
+    src = open(GATE).read()
+    compile(src, GATE, "exec")
+    assert "570" in src and "merge_sarif" in src
+
+
+# -- the mutation suite's teeth (real worker, real checkers) ------------
+def test_mutation_selftest_catches_every_seeded_violation(capsys):
+    """Spawns the capture worker (jax subprocess) and runs the seeded
+    violations through the SHIPPING check functions: extra psum (I1),
+    host callback (I2), f64 literal / pre-psum scale / float-fed int
+    reduction (I3), unbucketed retrace (I4). A miss here means a checker
+    silently stopped matching — exactly what the G0 gate must catch."""
+    rc = cli.main(["--ir", "--selftest"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert out.count("-> caught") == 6
+    assert "MISSED" not in out
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-q"]))

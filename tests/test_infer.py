@@ -303,6 +303,87 @@ def test_quant_u8_overflow_errors_instead_of_widening():
         compile_forest(gb)
 
 
+# -- palette edges (ISSUE 17) -------------------------------------------
+def test_palette_u16_widened_forest_exact_and_admitted():
+    """>256 unique thresholds: the palette auto-widens u8 -> u16 and the
+    widened codes must still route bit-identically to the scan oracle —
+    AND the widened artifact must survive the hash-verified store
+    admission round-trip (the fleet path serves the u16 palette too)."""
+    X, y = _data(rows=1500)
+    b = _train({"objective": "regression", "num_leaves": 31}, X,
+               np.sin(np.nan_to_num(X).sum(axis=1)), rounds=30)
+    gb = b._booster
+    art = compile_forest(gb)
+    assert art.meta["thr_bits"] == 16
+    assert art.buffers["node_thr"].dtype == np.uint16
+    assert len(art.buffers["thr_table"]) > 256     # the widening reason
+    _assert_engine_parity(b, X)
+    store = ArtifactStore()
+    got = store.admit_bytes(art.to_bytes(), expect_hash=art.hash)
+    assert got.hash == art.hash
+    assert np.array_equal(got.buffers["node_thr"],
+                          art.buffers["node_thr"])
+
+
+def test_palette_constant_only_forest_exact_and_admitted():
+    """Every tree a single constant leaf (min_data_in_leaf > rows kills
+    all splits): zero internal nodes, an empty threshold palette — the
+    degenerate artifact must compile, round-trip the store, and predict
+    bit-identically to the scan oracle."""
+    X, y = _data()
+    b = _train({"objective": "regression", "num_leaves": 7,
+                "min_data_in_leaf": 10_000}, X, X[:, 0], rounds=3)
+    gb = b._booster
+    assert all(t.num_internal == 0 for t in gb.host_models)
+    art = compile_forest(gb)
+    # splitless rounds may stop boosting early; whatever trained, every
+    # tree is a stump and the artifact must carry them all
+    assert art.meta["num_trees"] == len(gb.host_models) >= 1
+    got = _assert_engine_parity(b, X)
+    assert np.ptp(got) == 0                       # constant forest output
+    store = ArtifactStore()
+    assert store.admit_bytes(art.to_bytes(),
+                             expect_hash=art.hash).hash == art.hash
+
+
+def test_palette_all_dead_branches_prune_to_root():
+    """Every non-root split shares the root's feature AND threshold, so
+    every one of them is decided by the root: the compiler bypasses ALL
+    of them (nodes_pruned == num_internal - 1 per tree) and the pruned
+    skeleton still routes bit-identically to the UNpruned scan oracle."""
+    X, y = _data(feats=4, nan_col=None)
+    b = _train({"objective": "binary", "num_leaves": 8}, X, y, rounds=2)
+    gb = b._booster
+    text = gb.save_model_to_string()
+    out_lines = []
+    for line in text.split("\n"):
+        if line.startswith("threshold="):
+            vals = line.split("=", 1)[1].split()
+            line = "threshold=" + " ".join([vals[0]] * len(vals))
+        elif line.startswith("split_feature="):
+            n = len(line.split("=", 1)[1].split())
+            line = "split_feature=" + " ".join(["0"] * n)
+        elif line.startswith("decision_type="):
+            # uniform numerical/default-left: a default-direction mismatch
+            # with the ancestor keeps a same-threshold node LIVE (the
+            # missing path is this node's to decide), which is not the
+            # edge under test
+            n = len(line.split("=", 1)[1].split())
+            line = "decision_type=" + " ".join(["2"] * n)
+        out_lines.append(line)
+    b2 = lgb.Booster(model_str="\n".join(out_lines),
+                     params=dict(DEVICE_PARAMS))
+    gb2 = b2._booster
+    art = compile_forest(gb2)
+    expect = sum(t.num_internal - 1 for t in gb2.host_models
+                 if t.num_internal > 0)
+    assert art.meta["nodes_pruned"] == expect > 0
+    _assert_engine_parity(b2, X)
+    store = ArtifactStore()
+    assert store.admit_bytes(art.to_bytes(),
+                             expect_hash=art.hash).hash == art.hash
+
+
 # -- cross-model packing (ModelPack) ------------------------------------
 def _cache(b, **kw):
     from lambdagap_tpu.serve.cache import CompiledForestCache

@@ -12,6 +12,10 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# the linter never touches jax; skip the framework half of the package
+# import (must be set before lambdagap_tpu's __init__ runs)
+os.environ.setdefault("LAMBDAGAP_LINT_ONLY", "1")
+
 from lambdagap_tpu.analysis.cli import main  # noqa: E402
 
 if __name__ == "__main__":

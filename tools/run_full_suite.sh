@@ -12,7 +12,8 @@ echo "=== G0 pre-test gates: graftlint + docs drift + telemetry $(date)"
 # every run, not hoped. The cache is deleted first so the budget measures
 # a COLD scan — the warm-cache assertion below covers the cached path.
 rm -f .graftlint_cache.json
-if ! python -m lambdagap_tpu.analysis --max-seconds 2 --format json \
+if ! env LAMBDAGAP_LINT_ONLY=1 \
+        python -m lambdagap_tpu.analysis --max-seconds 2 --format json \
         lambdagap_tpu bench.py bench_serve.py tools \
         > /tmp/graftlint_cold.json; then
     cat /tmp/graftlint_cold.json
@@ -25,7 +26,8 @@ fi
 # warm-cache re-scan (ISSUE 14): the content-hash cache must replay
 # byte-identical findings AND actually hit (cold==warm identity is the
 # cache's correctness contract; see docs/static-analysis.md)
-if ! python -m lambdagap_tpu.analysis --format json \
+if ! env LAMBDAGAP_LINT_ONLY=1 \
+        python -m lambdagap_tpu.analysis --format json \
         lambdagap_tpu bench.py bench_serve.py tools \
         > /tmp/graftlint_warm.json; then
     echo "FAIL-FAST: graftlint warm-cache re-scan found findings the cold"
@@ -46,6 +48,22 @@ PYEOF
 then
     echo "FAIL-FAST: warm-cache scan is not byte-identical to the cold"
     echo "scan (see docs/static-analysis.md 'Incremental scan cache')"
+    exit 1
+fi
+# graftir gate (ISSUE 17): IR-level contract verification of the lowered
+# programs — collective schedules across four virtual grids, transfer-
+# freedom, precision discipline, retrace-freedom — plus the seeded-
+# violation mutation selftest (proves the checkers still have teeth) and
+# the single merged graftlint+graftir SARIF artifact. The per-program
+# verdict cache is NOT deleted: an unchanged tree replays warm in
+# milliseconds, and the --max-seconds 570 budget fails the gate loudly
+# if the cache broke or the scenario inventory outgrew it.
+if ! python tools/graftir_gate.py --max-seconds 570 \
+        --sarif-out /tmp/static_analysis.sarif; then
+    echo "FAIL-FAST: graftir gate failed (a lowered program drifted from"
+    echo "its declared IR contract, the mutation suite lost its teeth,"
+    echo "or the pass blew its 570s budget; see docs/static-analysis.md"
+    echo "'IR contracts')"
     exit 1
 fi
 # composition-matrix drift (ISSUE 14): docs/capability-matrix.md must
@@ -150,7 +168,7 @@ if ! env JAX_PLATFORMS=cpu python tools/infer_gate.py; then
     exit 1
 fi
 echo "=== G1 $(date)"
-python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_predict_tensor.py tests/test_misc_api.py tests/test_graftlint.py -q 2>&1 | tail -1
+python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_predict_tensor.py tests/test_misc_api.py tests/test_graftlint.py tests/test_graftir.py -q 2>&1 | tail -1
 echo "=== G2 $(date)"
 python -m pytest tests/test_train.py tests/test_rank.py tests/test_cli_io.py -q 2>&1 | tail -1
 echo "=== G3 $(date)"
