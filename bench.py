@@ -864,6 +864,220 @@ def run_stream_ab(rows: int, max_bin: int, iters: int) -> None:
     }))
 
 
+def run_batch_ab(rows: int, trees: int, window: int) -> None:
+    """Child-process entry (ISSUE 18): warehouse batch scoring A/B —
+    ``predict_stream`` (windowed out-of-core driver: WindowPump H2D ring
+    in, ScoreRing D2H ring out, compiled-forest engine per window) vs the
+    resident ``predict_raw`` on the SAME model and rows. Reports:
+
+    * rows/s both arms + bit-identity (the streamed scores must be
+      ``array_equal`` to resident — the driver's contract);
+    * prefetch-overlap fraction from the ring telemetry (h2d_prefetch
+      issue time vs chunk_wait stall, same decomposition as
+      ``--stream-ab``) plus the ``d2h_scores`` phase, so BOTH link
+      directions are measured;
+    * the warehouse extrapolation: wall at 2^31 rows from the measured
+      streamed rows/s vs the 20 GB/s host-link stream bound on the
+      feature bytes (the number the driver exists for — a fraction near
+      1.0 means the pump keeps the link busy; on CPU the traversal
+      itself is the floor, so the fraction is chip-pending);
+    * the interactive-p99-protected arm: a co-tenant prober (its OWN
+      small model) issues 256-row resident predicts on a fixed cadence
+      while the backfill runs — unthrottled vs throttled, where the
+      :class:`CoTenantThrottle`'s signal source reports
+      ``good_fraction`` = share of recent probe latencies within 2x the
+      idle median (a stand-in for the SignalPlane's goodput block with
+      identical schema). Protected p99 must not exceed unthrottled p99.
+
+    Env: BENCH_BATCH_REPS (timed reps per arm, default 5),
+    BENCH_BATCH_PROBE_S (per-arm prober soak seconds, default 6)."""
+    _configure_jax_cache()
+    import threading
+
+    import jax
+
+    import lambdagap_tpu as lgb
+    from lambdagap_tpu.guard.backoff import Backoff
+    from lambdagap_tpu.infer.stream import CoTenantThrottle
+
+    reps = max(int(os.environ.get("BENCH_BATCH_REPS", "5")), 2)
+    probe_soak_s = float(os.environ.get("BENCH_BATCH_PROBE_S", "6"))
+    rng = np.random.RandomState(18)
+    X = rng.randn(rows, FEATURES).astype(np.float32)
+    X[rng.rand(rows, FEATURES) < 0.02] = np.nan
+    y = (np.nan_to_num(X[:, 0]) - 0.5 * np.nan_to_num(X[:, 1])
+         + 0.3 * rng.randn(rows) > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 63, "verbose": -1,
+              "max_bin": 63, "min_data_in_leaf": 50,
+              "tpu_fast_predict_rows": 0, "predict_engine": "compiled"}
+    t0 = time.time()
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=trees)
+    train_s = time.time() - t0
+    gb = bst._booster
+
+    # resident arm: predict_raw returns a host array (device-complete by
+    # construction), so the clock brackets full device work
+    ref = gb.predict_raw(X)                       # warm the resident path
+    res_s = []
+    for _ in range(reps):
+        t0 = time.time()
+        ref = gb.predict_raw(X)
+        res_s.append(time.time() - t0)
+    resident_s = float(np.median(res_s))
+
+    # streamed arm: same model, same rows, windowed through the rings
+    stats = {}
+    got = gb.predict_stream(X, raw_score=True, window_rows=window,
+                            stats_out=stats)      # warm every row bucket
+    stream_s = []
+    for _ in range(reps):
+        stats = {}
+        t0 = time.time()
+        got = gb.predict_stream(X, raw_score=True, window_rows=window,
+                                stats_out=stats)
+        stream_s.append(time.time() - t0)
+    streamed_s = float(np.median(stream_s))
+    bit_identical = bool(np.array_equal(ref, got))
+    phases = stats.get("phases", {}) or {}
+    prefetch_s = phases.get("h2d_prefetch")
+    wait_s = phases.get("chunk_wait")
+    overlap = None
+    if prefetch_s is not None and wait_s is not None \
+            and (prefetch_s + wait_s) > 0:
+        # fraction of the H2D streaming overhead hidden behind compute:
+        # chunk_wait is the part that surfaced as stall
+        overlap = round(prefetch_s / (prefetch_s + wait_s), 4)
+
+    # warehouse extrapolation: 2^31 rows at the measured streamed rate
+    # vs the 20 GB/s host-link stream bound on the f32 feature bytes
+    rows31 = 1 << 31
+    stream_rps = rows / max(streamed_s, 1e-9)
+    link_gbps = 20.0
+    feature_bytes = rows31 * FEATURES * 4
+    bound_wall_s = feature_bytes / (link_gbps * 1e9)
+    extrapolated_wall_s = rows31 / stream_rps
+    warehouse = {
+        "rows": rows31,
+        "feature_bytes": feature_bytes,
+        "link_stream_bound_gbps": link_gbps,
+        "link_stream_bound_wall_s": round(bound_wall_s, 1),
+        "extrapolated_wall_s": round(extrapolated_wall_s, 1),
+        "fraction_of_stream_bound": round(
+            min(bound_wall_s / extrapolated_wall_s, 1.0), 4),
+        "note": "bound = f32 feature bytes / 20 GB/s host link; the "
+                "fraction is how close the pump runs to a saturated "
+                "link — on CPU the per-row traversal is the floor, so "
+                "the fraction certifies plumbing, not TPU wall",
+    }
+
+    # interactive-p99-protected arm: a second tenant (its own small
+    # model) probes 256-row resident predicts on a fixed cadence; the
+    # throttle's signal source scores recent probe latencies against
+    # the idle baseline using the SignalPlane goodput schema
+    params_i = {**params, "num_leaves": 31}
+    bst_i = lgb.train(params_i,
+                      lgb.Dataset(X[:16384], label=y[:16384],
+                                  params=params_i),
+                      num_boost_round=50)
+    Xq = np.ascontiguousarray(X[:256])
+    bst_i._booster.predict_raw(Xq)                # warm the probe path
+
+    lat_lock = threading.Lock()
+    recent: list = []                             # rolling probe window
+
+    def _probe_loop(stop, out):
+        while not stop.is_set():
+            t0 = time.time()
+            bst_i._booster.predict_raw(Xq)        # host-complete result
+            dt = time.time() - t0
+            out.append(dt)
+            with lat_lock:
+                recent.append(dt)
+                del recent[:-32]
+            stop.wait(0.015)
+
+    def _soak(lat, fn):
+        stop = threading.Event()
+        th = threading.Thread(target=_probe_loop, args=(stop, lat),
+                              daemon=True)
+        th.start()
+        t_end = time.time() + probe_soak_s
+        while time.time() < t_end:
+            fn()
+        stop.set()
+        th.join()
+
+    def _pcts_ms(lat):
+        if not lat:
+            return None
+        return {f"p{p}": round(float(np.percentile(lat, p)) * 1e3, 3)
+                for p in (50, 90, 99)}
+
+    lat_idle: list = []
+    _soak(lat_idle, lambda: time.sleep(0.05))     # idle baseline
+    idle_med = float(np.median(lat_idle)) if lat_idle else 1e-3
+
+    lat_unthrottled: list = []
+    _soak(lat_unthrottled,
+          lambda: gb.predict_stream(X, raw_score=True, window_rows=window))
+
+    def _signals():
+        with lat_lock:
+            win = list(recent)
+        frac = (float(np.mean([d <= 2.0 * idle_med for d in win]))
+                if win else 1.0)
+        # the prober's SLO: 98% of recent probes within 2x idle median —
+        # a burst of slow probes trips the ratio and arms the backoff
+        return {"goodput": {"knee_rps": 0.0, "knee_margin": 1.0,
+                            "good_fraction": frac, "good_ratio": 0.98}}
+
+    throttle = CoTenantThrottle(
+        _signals, backoff=Backoff(base_s=0.02, factor=2.0, max_s=0.25,
+                                  jitter=0.0, seed=9))
+    recent.clear()
+    lat_protected: list = []
+    _soak(lat_protected,
+          lambda: gb.predict_stream(X, raw_score=True, window_rows=window,
+                                    throttle=throttle))
+
+    interactive = {
+        "probe": "256-row resident predict on its own 50-tree model, "
+                 "~15 ms cadence",
+        "soak_s_per_arm": probe_soak_s,
+        "idle_ms": _pcts_ms(lat_idle),
+        "unthrottled_ms": _pcts_ms(lat_unthrottled),
+        "protected_ms": _pcts_ms(lat_protected),
+        "p99_protected": (_pcts_ms(lat_protected) or {}).get("p99", 0.0)
+        <= (_pcts_ms(lat_unthrottled) or {}).get("p99", 0.0),
+        "throttle": throttle.snapshot(),
+    }
+
+    print(json.dumps({
+        "rows": rows, "trees": trees, "window_rows": window,
+        "features": FEATURES, "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+        "method": f"median of {reps} timed full-matrix passes per arm, "
+                  "warm buckets, host arrays close every bracket",
+        "train_s": round(train_s, 2),
+        "windows": stats.get("windows"),
+        "buckets": stats.get("buckets"),
+        "resident_s": round(resident_s, 4),
+        "streamed_s": round(streamed_s, 4),
+        "resident_rows_per_s": round(rows / max(resident_s, 1e-9)),
+        "streamed_rows_per_s": round(stream_rps),
+        "stream_over_resident": round(streamed_s / max(resident_s, 1e-9),
+                                      4),
+        "bit_identical": bit_identical,
+        "h2d_prefetch_s": prefetch_s,
+        "chunk_wait_s": wait_s,
+        "d2h_scores_s": phases.get("d2h_scores"),
+        "prefetch_overlap_fraction": overlap,
+        "warehouse_2p31": warehouse,
+        "interactive": interactive,
+    }))
+
+
 def run_multichip_attempt(grid: str, rows: int, max_bin: int,
                           iters: int, residency: str = "hbm") -> None:
     """Child-process entry (ISSUE 8, grid-swept in ISSUE 15): one fused
@@ -1739,6 +1953,20 @@ def main() -> None:
              str(ITERS_MEASURED)], ATTEMPT_TIMEOUT,
             "stream A/B (out-of-core vs resident)")
 
+    # warehouse batch-scoring A/B (ISSUE 18): predict_stream vs resident
+    # predict_raw on the compiled engine — rows/s + bit-identity, the
+    # ring overlap fractions, the 2^31-row extrapolation vs the 20 GB/s
+    # stream bound, and the interactive-p99-protected co-tenant arm
+    batch_ab = None
+    if os.environ.get("BENCH_BATCH_AB", "1") != "0":
+        batch_ab = _run_child(
+            ["--batch-ab",
+             os.environ.get("BENCH_BATCH_ROWS", str(1 << 18)),
+             os.environ.get("BENCH_BATCH_TREES", "200"),
+             os.environ.get("BENCH_BATCH_WINDOW", str(1 << 16))],
+            ATTEMPT_TIMEOUT,
+            "batch scoring A/B (predict_stream vs resident)")
+
     # constant-vs-linear leaves A/B (ISSUE 11): wall-clock-to-target-metric
     # at HIGGS- and MSLR-shaped configs — the per-iter cost the linear
     # solve adds vs the iterations it saves (arXiv:1802.05640)
@@ -1881,6 +2109,7 @@ def main() -> None:
             "microbench_post": micro_post,
             "layout_ab": layout_ab,
             "stream_ab": stream_ab,
+            "batch_ab": batch_ab,
             "linear_ab": linear_ab,
             "multichip": multichip,
             "roofline": roofline,
@@ -1902,6 +2131,8 @@ if __name__ == "__main__":
         run_layout_ab(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     elif len(sys.argv) >= 5 and sys.argv[1] == "--stream-ab":
         run_stream_ab(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+    elif len(sys.argv) >= 5 and sys.argv[1] == "--batch-ab":
+        run_batch_ab(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     elif len(sys.argv) >= 5 and sys.argv[1] == "--linear-ab":
         run_linear_ab(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
     elif sys.argv[1:2] == ["--multichip-scaling"]:

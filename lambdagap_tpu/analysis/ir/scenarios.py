@@ -93,6 +93,22 @@ def _mk_linear():
     return run
 
 
+def _mk_predict_stream():
+    def run():
+        b = _train({"tpu_fused_learner": "1", "tree_learner": "serial",
+                    "tpu_fast_predict_rows": 0,
+                    "predict_engine": "tensor"})
+        X, _ = _data()
+        gb = b._booster
+        # 1603 rows at 512-row windows: three steady 512-buckets + one
+        # ragged tail padded to its own pow2 bucket — exactly TWO distinct
+        # traces of stream._window_scorer (I4 max_traces=2); the second
+        # pass must replay both without compiling
+        gb.predict_stream(X, raw_score=True, window_rows=512)
+        gb.predict_stream(X, raw_score=True, window_rows=512)
+    return run
+
+
 def inventory() -> List[Scenario]:
     scens: List[Scenario] = []
     scens.append(Scenario(
@@ -143,4 +159,7 @@ def inventory() -> List[Scenario]:
         scens.append(Scenario(
             f"predict_{engine}", {"predict": True}, _grid_dims("1x1"),
             _mk_predict(engine)))
+    scens.append(Scenario(
+        "predict_stream", {"predict": True}, _grid_dims("1x1"),
+        _mk_predict_stream()))
     return scens

@@ -35,10 +35,15 @@ Heuristic hot contexts:
   ``obs/fleet.py`` (span enter/exit runs per sampled request per hop and
   the fleet merge per scrape tick — observability must never sync the
   device it observes), and ``infer/`` (the compiled-forest subsystem:
-  the engine's traversal dispatch runs per serve bucket, and the
+  the engine's traversal dispatch runs per serve bucket, the
   compiler's node-block packing loop runs per tree per compile — a
   device fetch there serializes a hot-swap build against the serving
-  chip).
+  chip — and ``infer/stream.py``, the out-of-core batch-scoring driver:
+  its window loop runs once per pumped window for the whole pass, so an
+  accidental sync inside the ring-fill or drive loop collapses BOTH
+  overlaps at once — H2D prefetch and D2H score readback; the deliberate
+  score-ring completion fetch and the bucket pre-warm sync carry written
+  justifications).
 
 Sync calls flagged: ``jax.device_get``, ``.item()``, ``.block_until_ready()``,
 ``float(...)``/``int(...)`` wrapping a jax/jnp call, and
@@ -106,6 +111,12 @@ HOT_FUNCTIONS = frozenset({
     # there would serialize a hot-swap's build against the serving chip
     "_traverse_kernel", "_traverse_block", "_traverse_all",
     "_predict_compiled", "_predict_packed", "predict_mixed",
+    # out-of-core batch scoring (infer/stream.py): the driver and its
+    # contrib twin loop once per window over the whole warehouse pass —
+    # one stray sync per window serializes every H2D against every D2H;
+    # the window-pump gate and the score ring's completion fetch are the
+    # only sanctioned host touches (both justified inline)
+    "predict_stream", "_contrib_stream",
 })
 
 # files whose loop bodies are hot regardless of function name

@@ -530,6 +530,37 @@ class Booster:
                                      start_iteration=start_iteration,
                                      num_iteration=num_iteration)
 
+    def predict_stream(self, data, raw_score: bool = False,
+                       start_iteration: int = 0, num_iteration: int = -1,
+                       pred_contrib: bool = False, window_rows: int = 0,
+                       out: Optional[np.ndarray] = None, signal_source=None,
+                       stats_out: Optional[Dict[str, Any]] = None
+                       ) -> np.ndarray:
+        """Warehouse-scale out-of-core batch scoring (ISSUE 18,
+        infer/stream.py): ``data`` is a dense matrix / ``np.memmap``, a
+        text data file path (scored block-wise, never fully parsed into
+        RAM), or a ``ShardedBinnedDataset`` sharing this model's bin
+        layout. Scores are bit-identical to :meth:`predict`; ``out``
+        (e.g. an ``np.memmap``) receives rows in place for results larger
+        than host RAM, ``signal_source`` (a serve SignalPlane) arms the
+        co-tenant throttle, and ``stats_out`` receives the run report
+        (windows, H2D/D2H phase totals, throttle snapshot)."""
+        if isinstance(data, (str, os.PathLike)):
+            src = data                     # block-wise file parse
+        elif isinstance(data, np.ndarray):
+            src = data                     # includes np.memmap
+        else:
+            from .data.stream import ShardedBinnedDataset
+            if isinstance(data, ShardedBinnedDataset):
+                src = data
+            else:
+                src, _, _ = _to_matrix(data)
+        return self._booster.predict_stream(
+            src, start_iteration=start_iteration,
+            num_iteration=num_iteration, raw_score=raw_score,
+            pred_contrib=pred_contrib, window_rows=window_rows, out=out,
+            signal_source=signal_source, stats_out=stats_out)
+
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,
                    importance_type: str = None) -> "Booster":

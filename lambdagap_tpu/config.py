@@ -505,6 +505,18 @@ class Config:
     stream_sketch_budget: int = 65536         # distinct values kept per feature by the streaming quantile sketch (exact below, GK-compacted above)
     stream_ingest_threshold_mb: int = 256     # data files larger than this load block-wise through the sketch/push path
 
+    # predict_stream — warehouse-scale out-of-core batch scoring
+    # (infer/stream.py): host/memmap/file row windows pump through a
+    # bounded H2D ring into the configured predict engine; scores stream
+    # back through a D2H ring (telemetry phase d2h_scores), with an
+    # optional co-tenant throttle fed by the SignalPlane's goodput knee
+    predict_stream_window_rows: int = 65536   # rows per scoring window (ragged tails pad to pow2 buckets; bigger windows amortize dispatch, smaller bound HBM)
+    predict_stream_depth: int = 0             # in-flight windows per ring; 0 = stream_prefetch_depth
+    predict_stream_throttle: str = "auto"     # auto/on/off — auto throttles window issue whenever a signal source is wired; off ignores it
+    predict_stream_knee_margin: float = 0.1   # serve-goodput headroom below which the batch job yields (fraction of the measured knee)
+    predict_stream_backoff_s: float = 0.05    # first co-tenant backoff delay (doubles per pressured check, bounded below)
+    predict_stream_backoff_max_s: float = 2.0  # backoff delay hard cap
+
     # gradient operand precision for the MXU histogram contraction:
     #   split — two-term bf16 (hi + residual) decomposition, ~f32-accurate
     #           at one extra matmul row-block (default; the reference
@@ -709,6 +721,22 @@ class Config:
              "stream_sketch_budget must be >= 256"),
             (self.stream_ingest_threshold_mb >= 0,
              "stream_ingest_threshold_mb must be >= 0"),
+            (self.predict_stream_window_rows >= 1,
+             "predict_stream_window_rows must be >= 1"),
+            (0 <= self.predict_stream_depth <= 16,
+             "predict_stream_depth must be in [0, 16] (0 = "
+             "stream_prefetch_depth)"),
+            (self.predict_stream_throttle in ("auto", "on", "off"),
+             f"predict_stream_throttle must be auto/on/off, "
+             f"got {self.predict_stream_throttle!r}"),
+            (0.0 <= self.predict_stream_knee_margin <= 1.0,
+             "predict_stream_knee_margin must be in [0, 1]"),
+            (self.predict_stream_backoff_s > 0.0,
+             "predict_stream_backoff_s must be > 0"),
+            (self.predict_stream_backoff_max_s
+             >= self.predict_stream_backoff_s,
+             "predict_stream_backoff_max_s must be >= "
+             "predict_stream_backoff_s"),
             (2 <= self.num_grad_quant_bins <= MAX_QUANT_BINS,
              f"num_grad_quant_bins must be in [2, {MAX_QUANT_BINS}] "
              f"(int8 histogram levels), got {self.num_grad_quant_bins}"),

@@ -208,6 +208,109 @@ def _parse_text_file(path: str, config: Config):
     return X, y, weight, group, feature_names
 
 
+def _libsvm_predict_width(path: str) -> int:
+    """Max feature index + 1 over the WHOLE file — one cheap text pass, so
+    block-wise LibSVM prediction yields the same matrix width the resident
+    :func:`_load_libsvm` whole-file parse produces."""
+    maxf = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            for tok in line.split()[1:]:
+                k, _, _v = tok.partition(":")
+                if k.lower() == "qid":
+                    continue
+                try:
+                    maxf = max(maxf, int(k))
+                except ValueError:
+                    log.fatal("LibSVM format error at %s:%d: bad token %r",
+                              path, lineno, tok)
+    return maxf + 1
+
+
+def iter_predict_blocks(path: str, config: Config, block_rows: int = 65536):
+    """Bounded-memory feature blocks for streamed file scoring
+    (infer/stream.py predict_stream): yields float64 ``[<=block_rows, F]``
+    matrices in file order with the SAME column handling as
+    :func:`_parse_text_file` (label stripped; weight/group/ignored columns
+    dropped; LibSVM width fixed by a whole-file pre-scan) — so scoring a
+    path block-wise produces exactly the matrix the resident
+    ``Booster.predict(path)`` parse would, one block resident at a time
+    (the two_round block-read discipline, :func:`_load_two_round`)."""
+    fmt = detect_format(path)
+    if fmt == "libsvm":
+        width = _libsvm_predict_width(path)
+        rows: List[dict] = []
+
+        def _dense(batch):
+            X = np.zeros((len(batch), width), dtype=np.float64)
+            for i, row in enumerate(batch):
+                for k, v in row.items():
+                    X[i, k] = v
+            return X
+
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                row: dict = {}
+                for tok in line.split()[1:]:
+                    k, _, v = tok.partition(":")
+                    if k.lower() == "qid":
+                        continue
+                    try:
+                        row[int(k)] = float(v)
+                    except ValueError:
+                        log.fatal("LibSVM format error at %s:%d: bad "
+                                  "token %r", path, lineno, tok)
+                rows.append(row)
+                if len(rows) >= block_rows:
+                    yield _dense(rows)
+                    rows = []
+        if rows:
+            yield _dense(rows)
+        return
+    delim = "," if fmt == "csv" else "\t"
+    header_names: Optional[List[str]] = None
+    with open(path) as f:
+        if config.header:
+            header_names = f.readline().strip().split(delim)
+        label_col = (_parse_column_spec(config.label_column, header_names)
+                     if config.label_column else 0)
+        drop = {label_col}
+        if config.weight_column:
+            drop.add(_parse_column_spec(config.weight_column, header_names))
+        if config.group_column:
+            drop.add(_parse_column_spec(config.group_column, header_names))
+        if config.ignore_column:
+            for spec in config.ignore_column.split(","):
+                if spec.strip():
+                    drop.add(_parse_column_spec(spec.strip(), header_names))
+        keep = None
+
+        def _parse(batch):
+            nonlocal keep
+            M = np.genfromtxt(batch, delimiter=delim)
+            M = M.reshape(len(batch), -1)
+            if keep is None:
+                keep = [j for j in range(M.shape[1]) if j not in drop]
+            return M[:, keep]
+
+        lines: List[str] = []
+        for line in f:
+            if not line.strip() or line.lstrip().startswith("#"):
+                continue
+            lines.append(line)
+            if len(lines) >= block_rows:
+                yield _parse(lines)
+                lines = []
+        if lines:
+            yield _parse(lines)
+
+
 def _load_two_round(path: str, config: Config,
                     reference: Optional[BinnedDataset]) -> BinnedDataset:
     """``two_round=true`` out-of-core text ingestion (reference:

@@ -408,6 +408,58 @@ class ShardRing:
         return key, bufs
 
 
+class WindowPump:
+    """The issue-ahead window pump, factored out of :func:`stream_windows`
+    (ISSUE 18) so the predict path can drive the SAME ring discipline
+    without carrying the train-only payload channels (grad/hess/perm
+    mirrors ride the ``host_bufs`` tuples the caller chooses; a
+    predict-mode pump carries exactly one buffer per window).
+
+    Iterating the pump yields ``(key, device_bufs)`` per window, oldest
+    first, keeping up to ``depth`` transfers in flight ahead of the
+    consumer: before each yield the pump tops the ring up from the
+    ``windows`` iterator — fetch/transfer of window ``c+1`` is issued
+    before window ``c`` is waited on, which is the whole overlap story.
+    The fetch/put/wait interleaving is call-for-call identical to the
+    historical ``stream_windows`` loop (tests/test_stream.py's
+    bit-identity matrix pins it).
+
+    ``gate`` (optional) runs on the host IMMEDIATELY before each window
+    is fetched and issued — the co-tenant throttle hook: a gate that
+    sleeps slows the ISSUE rate without touching ring mechanics, so
+    in-flight windows still land while the pump yields the link
+    (infer/stream.py CoTenantThrottle).
+    """
+
+    def __init__(self, windows, telemetry=NULL_TELEMETRY, depth: int = 2,
+                 shardings: Optional[Sequence] = None,
+                 gate: Optional[Callable[[], None]] = None) -> None:
+        self._it = iter(windows)
+        self.ring = ShardRing(depth=depth, telemetry=telemetry,
+                              shardings=shardings)
+        self.gate = gate
+
+    def __iter__(self):
+        ring = self.ring
+        exhausted = False
+        while True:
+            # top up: always refill an empty ring (progress), otherwise
+            # issue ahead until the ring is full — same policy as the
+            # historical `issued <= c or not ring.full` condition
+            while not exhausted and (not len(ring) or not ring.full):
+                if self.gate is not None:
+                    self.gate()
+                try:
+                    key, bufs = next(self._it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                ring.put(key, bufs)
+            if not len(ring):
+                return
+            yield ring.wait_ready()
+
+
 def stream_windows(nch: int, fetch: Callable, consume: Callable,
                    telemetry=NULL_TELEMETRY, depth: int = 2,
                    shardings: Optional[Sequence] = None) -> None:
@@ -420,11 +472,7 @@ def stream_windows(nch: int, fetch: Callable, consume: Callable,
     consumer — fetch/transfer of window ``c+1`` is issued before window
     ``c`` is waited on, which is the whole overlap story.
     """
-    ring = ShardRing(depth=depth, telemetry=telemetry, shardings=shardings)
-    issued = 0
-    for c in range(nch):
-        while issued < nch and (issued <= c or not ring.full):
-            ring.put(issued, fetch(issued))
-            issued += 1
-        key, bufs = ring.wait_ready()
+    pump = WindowPump(((c, fetch(c)) for c in range(nch)),
+                      telemetry=telemetry, depth=depth, shardings=shardings)
+    for key, bufs in pump:
         consume(key, *bufs)

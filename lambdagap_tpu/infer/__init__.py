@@ -10,12 +10,17 @@ same-structure trees merged, sha256 content-addressed), and the engine
 (:mod:`lambdagap_tpu.infer.engine`, ``predict_engine=compiled``) that
 traverses it with a Pallas kernel while staying bit-identical to the scan
 oracle (docs/serving.md "Compiled forest artifacts").
+:mod:`lambdagap_tpu.infer.stream` drives the artifact at warehouse scale:
+out-of-core batch scoring through double-buffered H2D/D2H rings with
+co-tenant throttling (docs/performance.md "Batch scoring").
 """
 from .compile import (ArtifactMismatch, ArtifactStore, ForestArtifact,
                       compile_forest, source_key_of)
 from .engine import CompiledForest, PackedForests
+from .stream import CoTenantThrottle, ScoreRing, predict_stream
 
 __all__ = [
     "ArtifactMismatch", "ArtifactStore", "ForestArtifact", "compile_forest",
     "source_key_of", "CompiledForest", "PackedForests",
+    "CoTenantThrottle", "ScoreRing", "predict_stream",
 ]

@@ -1081,6 +1081,7 @@ class GBDT:
         self._fast_cache = None
         self._forest_cache = None
         self._compiled_cache = None
+        self._pstream_cache = None
         self.generation += 1
 
     def _device_forest(self, idx, trees):
@@ -1275,6 +1276,29 @@ class GBDT:
             conv = np.asarray(jax.device_get(
                 self.objective.convert_output(jnp.asarray(stacked))))
         return conv[0] if self.num_tree_per_iteration == 1 else conv.T
+
+    def predict_stream(self, data, start_iteration: int = 0,
+                       num_iteration: int = -1, raw_score: bool = False,
+                       pred_contrib: bool = False, window_rows: int = 0,
+                       out: Optional[np.ndarray] = None,
+                       signal_source=None, throttle=None,
+                       stats_out: Optional[dict] = None) -> np.ndarray:
+        """Warehouse-scale out-of-core batch scoring (infer/stream.py):
+        pumps host/memmap/file/ShardedBinnedDataset row windows through
+        the double-buffered H2D ring into the configured predict engine
+        and streams scores back through the D2H score ring — bit-identical
+        to :meth:`predict_raw` (``raw_score=True``) / :meth:`predict` on
+        every engine, window split and mesh grid. ``out`` (e.g. an
+        ``np.memmap``) receives rows in place; ``signal_source`` (a
+        SignalPlane) arms the co-tenant throttle; ``stats_out`` receives
+        the run report (windows, phase totals, throttle snapshot)."""
+        from ..infer.stream import predict_stream as _predict_stream
+        return _predict_stream(
+            self, data, start_iteration=start_iteration,
+            num_iteration=num_iteration, raw_score=raw_score,
+            pred_contrib=pred_contrib, window_rows=window_rows, out=out,
+            signal_source=signal_source, throttle=throttle,
+            stats_out=stats_out)
 
     # ------------------------------------------------------------------
     # serialization

@@ -50,10 +50,14 @@ from .xla_watch import XlaWatchdog
 # (data/stream.py ShardRing): prefetch is the host-side window fetch +
 # async device_put issue, chunk_wait is the ring-slot completion block —
 # together they tile the streaming overhead into the iteration wall, so
-# overlap efficiency (chunk_wait ~ 0) is a measured number
+# overlap efficiency (chunk_wait ~ 0) is a measured number. "d2h_scores"
+# is the predict_stream score-ring counterpart (infer/stream.py
+# ScoreRing): the async copy_to_host_async issue plus the residual block
+# when the result is consumed — the D2H half of the batch-scoring
+# overlap story, measured the same way
 PHASES = ("gradients", "sampling", "layout_apply", "histogram", "split",
           "partition", "tree", "score_update", "eval", "device_wait",
-          "h2d_prefetch", "chunk_wait")
+          "h2d_prefetch", "chunk_wait", "d2h_scores")
 
 # phase -> the utils.timer scope name it replaces (the deprecation shim:
 # the legacy global_timer report keeps its historical row names)

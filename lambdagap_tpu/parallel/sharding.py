@@ -105,6 +105,17 @@ RULES: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
     (r"^win_bins$", (DATA_AXIS, None, FEATURE_AXIS)),
     (r"^win_(cvals|pos|lanes)$", (DATA_AXIS,)),
     (r"^leaf_local$", (DATA_AXIS,)),
+    # predict_stream batch-scoring arrays (infer/stream.py): scoring is
+    # collective-free and strictly per-row, so window rows shard over the
+    # WHOLE flattened grid — both mesh axes on the row dim — and every
+    # dd x ff factorization (1x8, 2x4, 8x1) runs the one program on its
+    # local rows:
+    #   pred_win    [W, F]   one padded scoring window, rows sharded,
+    #                        features replicated
+    #   pred_scores [K, W]   its score tile riding the D2H ring back,
+    #                        rows sharded the same way
+    (r"^pred_win$", ((DATA_AXIS, FEATURE_AXIS), None)),
+    (r"^pred_scores$", (None, (DATA_AXIS, FEATURE_AXIS))),
     # replicated state: psum-ed histograms, split results, node/leaf
     # tables, per-feature metadata, feature sampling masks, rng keys,
     # scalars. Derived from collectives on every shard -> identical

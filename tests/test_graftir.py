@@ -90,7 +90,7 @@ def test_hot_program_inventory_registered_on_import():
     engines, the linear-leaf moments (ISSUE-17 inventory floor)."""
     # registrations live at module scope NEXT to the jitted code they
     # constrain; importing the hot modules is the registration act
-    from lambdagap_tpu.infer import engine                    # noqa: F401
+    from lambdagap_tpu.infer import engine, stream            # noqa: F401
     from lambdagap_tpu.models import fused_learner, gbdt      # noqa: F401
     from lambdagap_tpu.objectives import base                 # noqa: F401
     from lambdagap_tpu.ops import (histogram, linear,         # noqa: F401
@@ -109,6 +109,7 @@ def test_hot_program_inventory_registered_on_import():
             "predict._predict_forest_block",
             "predict_tensor._predict_tensor_tile",
             "engine._predict_compiled",
+            "stream._window_scorer",
             "linear.accumulate_leaf_moments"]:
         assert required in names, f"missing contract: {required}"
     # every 2-D split-step program is contracted

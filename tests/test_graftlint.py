@@ -90,6 +90,7 @@ def fixture_findings():
     "r14_inert.py",
     "data/stream.py",
     "infer/compile.py",
+    "infer/stream.py",
 ])
 def test_rule_fixture_exact_findings(fixture_findings, relpath):
     got = fixture_findings.get(relpath, set())

@@ -1,0 +1,328 @@
+"""predict_stream — out-of-core batch scoring (ISSUE 18, infer/stream.py).
+
+The tier-1 acceptance surface, all on CPU:
+
+- streamed scores are BIT-IDENTICAL (``array_equal``) to the resident
+  predict on every engine (compiled/tensor/scan), every window
+  raggedness, memmap-backed inputs/outputs, NaN + categorical features,
+  multiclass, linear leaves, and every virtual mesh grid (1x8/2x4/8x1 —
+  conftest.py forces 8 virtual CPU devices);
+- file and ShardedBinnedDataset sources parse/traverse to the same bits
+  as the resident paths;
+- ``pred_contrib`` tiles match the resident SHAP matrix exactly and rows
+  sum to the raw prediction;
+- the pumped pass is compile-free in steady state (pow2 bucket pre-warm)
+  with the ``d2h_scores`` phase live in the telemetry;
+- the co-tenant throttle backs off under a scripted pressure signal and
+  recovers when it clears.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.data.stream import ShardedBinnedDataset
+from lambdagap_tpu.guard.backoff import Backoff
+from lambdagap_tpu.infer.stream import CoTenantThrottle, _pow2_bucket
+
+ROWS = 1603          # ragged against every window size used below
+
+
+def _data(n=ROWS, d=10, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    X[rng.rand(n, d) < 0.05] = np.nan          # missing values live
+    X[:, 3] = rng.randint(0, 7, n)             # categorical column
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * (X[:, 3] % 3)
+         + 0.1 * rng.randn(n))
+    return X, y
+
+
+def _train(X, y, extra=None, rounds=6, objective="regression"):
+    params = {"objective": objective, "num_leaves": 15,
+              "min_data_in_leaf": 10, "learning_rate": 0.2, "verbose": -1,
+              "tpu_fast_predict_rows": 0, "deterministic": True}
+    if extra:
+        params.update(extra)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[3], params=params)
+    return lgb.train(params, ds, num_boost_round=rounds)
+
+
+@pytest.fixture(scope="module")
+def reg():
+    X, y = _data()
+    return _train(X, y), X
+
+
+@pytest.fixture(scope="module")
+def multi():
+    X, _ = _data(seed=13)
+    rng = np.random.RandomState(13)
+    y = rng.randint(0, 3, ROWS)
+    return _train(X, y, {"num_class": 3}, objective="multiclass"), X
+
+
+# -- engine x raggedness parity ------------------------------------------
+@pytest.mark.parametrize("engine", ["tensor", "scan", "compiled"])
+@pytest.mark.parametrize("window_rows", [256, 512, 1 << 16])
+def test_engine_parity_bit_identical(reg, engine, window_rows):
+    bst, X = reg
+    gb = bst._booster
+    gb.config.predict_engine = engine
+    gb.invalidate_predict_cache()
+    try:
+        ref = gb.predict_raw(X)
+        got = gb.predict_stream(X, raw_score=True, window_rows=window_rows)
+        assert np.array_equal(ref, got)
+    finally:
+        gb.config.predict_engine = "tensor"
+        gb.invalidate_predict_cache()
+
+
+# -- mesh grids ----------------------------------------------------------
+@pytest.mark.parametrize("grid", ["1x8", "2x4", "8x1"])
+def test_mesh_grid_parity_bit_identical(multi, grid):
+    bst, X = multi
+    gb = bst._booster
+    ref = gb.predict_raw(X)
+    gb.config.mesh_shape = grid
+    gb._pstream_cache = None
+    try:
+        got = gb.predict_stream(X, raw_score=True, window_rows=256)
+        assert np.array_equal(ref, got)
+    finally:
+        gb.config.mesh_shape = ""
+        gb._pstream_cache = None
+
+
+# -- sources -------------------------------------------------------------
+def test_memmap_source_and_memmap_out(reg, tmp_path):
+    bst, X = reg
+    gb = bst._booster
+    ref = gb.predict_raw(X)
+    mp = tmp_path / "x.mm"
+    mm = np.memmap(mp, dtype=np.float32, mode="w+", shape=X.shape)
+    mm[:] = X
+    mm.flush()
+    om = np.memmap(tmp_path / "scores.mm", dtype=np.float32, mode="w+",
+                   shape=(ROWS,))
+    r = gb.predict_stream(mm, raw_score=True, window_rows=512, out=om)
+    assert r is om
+    assert np.array_equal(ref, np.asarray(om))
+
+
+def test_file_source_csv_parity(reg, tmp_path):
+    bst, X = reg
+    # file parse must equal Booster.predict(path): NaN-free matrix (csv
+    # text round-trips finite doubles exactly at %.17g)
+    Xf = np.nan_to_num(np.asarray(X, np.float64))
+    y = np.zeros(len(Xf))
+    p = str(tmp_path / "rows.csv")
+    np.savetxt(p, np.concatenate([y[:, None], Xf], axis=1),
+               delimiter=",", fmt="%.17g")
+    ref = bst.predict(p, raw_score=True)
+    got = bst.predict_stream(p, raw_score=True, window_rows=256)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_file_source_libsvm_parity(reg, tmp_path):
+    bst, X = reg
+    Xf = np.nan_to_num(np.asarray(X, np.float64))
+    p = str(tmp_path / "rows.svm")
+    with open(p, "w") as f:
+        for row in Xf:
+            feats = " ".join(f"{j}:{v:.17g}" for j, v in enumerate(row)
+                             if v != 0.0)
+            f.write(f"0 {feats}\n")
+    ref = bst.predict(p, raw_score=True)
+    got = bst.predict_stream(p, raw_score=True, window_rows=256)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_sharded_binned_source_parity(reg):
+    bst, X = reg
+    gb = bst._booster
+    ref = gb.predict_raw(X)
+    sds = ShardedBinnedDataset.from_dataset(gb.train_set, shard_rows=1024)
+    got = gb.predict_stream(sds, raw_score=True, window_rows=512)
+    assert np.array_equal(ref, got)
+
+
+# -- payload shapes ------------------------------------------------------
+def test_multiclass_and_converted_output(multi):
+    bst, X = multi
+    gb = bst._booster
+    ref_raw = gb.predict_raw(X)
+    got_raw = gb.predict_stream(X, raw_score=True, window_rows=512)
+    assert np.array_equal(ref_raw, got_raw)
+    # objective conversion (softmax) parity with the resident device path
+    ref = np.asarray(bst.predict(X))
+    got = np.asarray(gb.predict_stream(X, window_rows=512))
+    assert np.array_equal(ref.astype(np.float32), got.astype(np.float32))
+
+
+def test_linear_leaf_parity():
+    rng = np.random.RandomState(5)
+    X = rng.randn(ROWS, 8).astype(np.float32)
+    y = (X[:, 0] * 2.0 - X[:, 1]).astype(np.float32)
+    params = {"objective": "regression", "linear_tree": True,
+              "num_leaves": 10, "verbose": -1, "tpu_fast_predict_rows": 0}
+    bst = lgb.train(params, lgb.Dataset(X, label=y, params=params),
+                    num_boost_round=4)
+    gb = bst._booster
+    ref = gb.predict_raw(X)
+    got = gb.predict_stream(X, raw_score=True, window_rows=256)
+    assert np.array_equal(ref, got)
+
+
+def test_pred_contrib_matches_resident_and_sums(multi):
+    bst, X = multi
+    gb = bst._booster
+    sub = X[:700]
+    ref = gb.predict_contrib(sub)
+    got = gb.predict_stream(sub, pred_contrib=True, window_rows=256)
+    assert np.array_equal(ref, got)
+    # rows sum exactly to the raw prediction, per class
+    raw = np.asarray(gb.predict_raw(sub), np.float64)
+    K, F1 = 3, sub.shape[1] + 1
+    sums = got.reshape(len(sub), K, F1).sum(axis=2)
+    np.testing.assert_allclose(sums, raw, rtol=1e-5, atol=1e-6)
+
+
+# -- overlap telemetry + compile discipline ------------------------------
+def test_zero_steady_compiles_and_d2h_phase(reg):
+    bst, X = reg
+    gb = bst._booster
+    gb._pstream_cache = None
+    stats = {}
+    got = gb.predict_stream(X, raw_score=True, window_rows=256,
+                            stats_out=stats)
+    assert np.array_equal(gb.predict_raw(X), got)
+    assert stats["windows"] == -(-ROWS // 256)
+    assert stats["rows"] == ROWS
+    # ragged tail padded to its own pow2 bucket; steady window + tail
+    assert set(stats["buckets"]) == {256, _pow2_bucket(ROWS % 256, 256, 1)}
+    # both transfer directions measured
+    assert stats["phases"].get("h2d_prefetch", 0.0) > 0.0
+    assert "d2h_scores" in stats["phases"]
+    # the pumped pass never compiles inside a window record (buckets are
+    # pre-warmed before the pump opens)
+    steady = sum(r.get("compiles", {}).get("steady", 0)
+                 for r in stats["records"] if r.get("type") == "iteration")
+    assert steady == 0
+
+
+def test_scorer_cache_replays_across_calls(reg):
+    bst, X = reg
+    gb = bst._booster
+    gb._pstream_cache = None
+    a = gb.predict_stream(X, raw_score=True, window_rows=512)
+    cache = gb._pstream_cache
+    b = gb.predict_stream(X, raw_score=True, window_rows=512)
+    assert gb._pstream_cache is cache       # same scorer object replayed
+    assert np.array_equal(a, b)
+
+
+# -- co-tenant throttle --------------------------------------------------
+def _sig(margin, frac=0.99):
+    return {"goodput": {"knee_rps": 100.0, "knee_margin": margin,
+                        "good_fraction": frac, "good_ratio": 0.9}}
+
+
+def test_throttle_backs_off_and_recovers():
+    # 4 pressured checks then healthy forever: delays double, then one
+    # healthy check resets the backoff clock
+    sigs = iter([_sig(0.02)] * 4 + [_sig(0.5)] * 100)
+    slept = []
+    th = CoTenantThrottle(
+        lambda: next(sigs),
+        backoff=Backoff(base_s=0.01, factor=2.0, max_s=10.0, jitter=0.0,
+                        seed=1),
+        sleep=slept.append)
+    for _ in range(8):
+        th()
+    assert slept == [0.01, 0.02, 0.04, 0.08]
+    assert th.waits == 4 and th.checks == 8
+    assert not th.engaged                    # recovered
+    # fresh pressure after recovery starts over at the base delay
+    sigs2 = iter([_sig(0.02)])
+    th._source = lambda: next(sigs2)
+    th()
+    assert slept[-1] == 0.01
+
+
+def test_throttle_pressure_on_low_goodput():
+    th = CoTenantThrottle(lambda: _sig(0.5, frac=0.5), sleep=lambda s: None)
+    th()
+    assert th.engaged and th.waits == 1
+
+
+def test_throttle_gates_window_issue_and_scores_stay_exact(reg):
+    bst, X = reg
+    gb = bst._booster
+    ref = gb.predict_raw(X)
+    sigs = iter([_sig(0.02)] * 3 + [_sig(0.5)] * 100)
+    slept = []
+    th = CoTenantThrottle(
+        lambda: next(sigs),
+        backoff=Backoff(base_s=0.01, factor=2.0, max_s=0.1, jitter=0.0,
+                        seed=1),
+        sleep=slept.append)
+    got = gb.predict_stream(X, raw_score=True, window_rows=128, throttle=th)
+    assert np.array_equal(ref, got)          # throttling never changes bits
+    assert th.waits == 3 and slept == [0.01, 0.02, 0.04]
+    assert not th.engaged
+
+
+def test_throttle_off_knob_disarms(reg):
+    bst, X = reg
+    gb = bst._booster
+    gb.config.predict_stream_throttle = "off"
+    try:
+        calls = []
+        th = CoTenantThrottle(lambda: calls.append(1) or _sig(0.02),
+                              sleep=lambda s: None)
+        gb.predict_stream(X, raw_score=True, window_rows=512, throttle=th)
+        assert not calls                     # gate never consulted
+    finally:
+        gb.config.predict_stream_throttle = "auto"
+
+
+def test_dead_signal_source_never_kills_the_job(reg):
+    bst, X = reg
+    gb = bst._booster
+
+    def broken():
+        raise RuntimeError("signal plane gone")
+
+    th = CoTenantThrottle(broken, sleep=lambda s: None)
+    got = gb.predict_stream(X, raw_score=True, window_rows=512, throttle=th)
+    assert np.array_equal(gb.predict_raw(X), got)
+    assert th.waits == 0
+
+
+# -- API surface ---------------------------------------------------------
+def test_booster_level_wrapper(reg):
+    bst, X = reg
+    ref = bst.predict(X, raw_score=True)
+    got = bst.predict_stream(X, raw_score=True, window_rows=512)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_empty_model_scores_zeros(reg):
+    bst, X = reg
+    gb = bst._booster
+    got = gb.predict_stream(X, raw_score=True, num_iteration=0)
+    assert got.shape == (ROWS,)
+    assert not got.any()
+
+
+def test_pow2_bucketing():
+    assert _pow2_bucket(1, 1 << 16, 1) == 1
+    assert _pow2_bucket(67, 512, 1) == 128
+    assert _pow2_bucket(512, 512, 1) == 512
+    assert _pow2_bucket(700, 512, 1) == 512       # capped at the window
+    assert _pow2_bucket(67, 512, 8) == 128        # already a multiple
+    assert _pow2_bucket(2, 512, 8) == 8           # rounded to the grid

@@ -167,6 +167,18 @@ if ! env JAX_PLATFORMS=cpu python tools/infer_gate.py; then
     echo "regressed; see docs/serving.md 'Compiled forest artifacts')"
     exit 1
 fi
+# batch-scoring gate: 4 ragged predict_stream windows bit-identical to
+# resident predict_raw on the compiled engine, zero steady compiles in
+# the pumped pass, the d2h_scores phase live next to h2d_prefetch, and
+# the co-tenant throttle backing off under a scripted goodput knee and
+# recovering when it clears (docs/performance.md "Batch scoring")
+if ! env JAX_PLATFORMS=cpu python tools/batch_gate.py; then
+    echo "FAIL-FAST: batch gate failed (out-of-core scoring diverged from"
+    echo "resident predict, a window compiled in steady state, an overlap"
+    echo "direction went unmeasured, or the co-tenant throttle broke; see"
+    echo "docs/performance.md 'Batch scoring')"
+    exit 1
+fi
 echo "=== G1 $(date)"
 python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_predict_tensor.py tests/test_misc_api.py tests/test_graftlint.py tests/test_graftir.py -q 2>&1 | tail -1
 echo "=== G2 $(date)"
@@ -176,7 +188,7 @@ python -m pytest tests/test_monotone.py tests/test_tree_options.py tests/test_ex
 echo "=== G4 $(date)"
 python -m pytest tests/test_fused.py tests/test_layout.py tests/test_stream.py tests/test_distributed.py tests/test_quantized.py tests/test_continued.py tests/test_model_io.py tests/test_shap_json.py -q 2>&1 | tail -1
 echo "=== G5 $(date)"
-python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py tests/test_serve.py tests/test_serve_fleet.py tests/test_serve_stress.py tests/test_infer.py -q 2>&1 | tail -1
+python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py tests/test_serve.py tests/test_serve_fleet.py tests/test_serve_stress.py tests/test_infer.py tests/test_predict_stream.py -q 2>&1 | tail -1
 echo "=== G6 full-length consistency $(date)"
 LAMBDAGAP_CONSISTENCY_FULL=1 python -m pytest tests/test_consistency.py -q 2>&1 | tail -1
 echo "=== DONE $(date)"
