@@ -375,6 +375,15 @@ def _telemetry_section(booster, last_n: int) -> dict:
     }
 
 
+def _costplane_section(iterations: int):
+    """Measured train-side traffic from the analytic ledger: total
+    bytes/flops of the train-phase entries scaled by observed dispatch
+    counts, per iteration (warmup included — the executables are
+    identical). None when no train program was captured."""
+    from lambdagap_tpu.obs.costplane import PLANE
+    return PLANE.train_traffic(iterations)
+
+
 def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
     """Child-process entry: train + measure, print one JSON line."""
     _configure_jax_cache()
@@ -408,6 +417,10 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
         # phase-span telemetry rides every attempt (measured overhead < 2%,
         # BENCH_NOTES.md) so the JSON carries its own attribution
         "telemetry": True,
+        # analytic per-executable ledger (obs/costplane.py): the parent's
+        # roofline prefers XLA's own bytes/flops over the hand-derived
+        # traffic model where a ledger entry exists
+        "cost_plane": True,
     }
 
     t0 = time.time()
@@ -494,6 +507,7 @@ def run_attempt(rows: int, fused: bool, max_bin: int = None) -> None:
         "predict_ab": predict_ab,
         "visit_counts": visit_counts,
         "telemetry": _telemetry_section(booster, ITERS_MEASURED),
+        "costplane": _costplane_section(ITERS_MEASURED + 2),
         "dataload_s": round(t_gen, 3),
     }))
 
@@ -2019,7 +2033,24 @@ def main() -> None:
         bw_s = max(m["hbm_copy_gbps"] for m in micros) * 1e9
         bw_g = max(m.get("hbm_gather_gbps", 0) for m in micros) * 1e9
         gb, sb = model_bytes_per_iter(chosen["rows"])
-        bytes_floor = gb / (bw_g or bw_s) + sb / bw_s
+        model_bytes_floor = gb / (bw_g or bw_s) + sb / bw_s
+        # ISSUE 19: prefer the cost plane's measured per-iteration traffic
+        # (XLA's analytic bytes for the executables this attempt actually
+        # dispatched) over the hand-derived model; the ledger does not
+        # split gather vs stream, so the streaming bandwidth is the
+        # honest (optimistic) divisor. The model stays as a cross-check.
+        cp = chosen.get("costplane") or {}
+        cp_bytes = cp.get("bytes_per_iter", 0.0)
+        if cp_bytes:
+            bytes_floor = cp_bytes / bw_s
+            ratio = cp_bytes / max(gb + sb, 1.0)
+            if not 0.5 <= ratio <= 2.0:
+                print(f"[bench] costplane bytes/iter {cp_bytes:.3e} "
+                      f"disagrees with the traffic model {gb + sb:.3e} "
+                      f"({ratio:.2f}x) — trusting the ledger; re-derive "
+                      "model_bytes_per_iter", file=sys.stderr, flush=True)
+        else:
+            bytes_floor = model_bytes_floor
         fixed_s = (probe or {}).get("per_iter_s", 0.0) or 0.0
 
         def _rate(name):
@@ -2073,6 +2104,11 @@ def main() -> None:
             # fixed-cost-inclusive variant, kept separate so readers
             # never double-count fixed_s
             "bytes_floor_per_iter_s": round(bytes_floor, 4),
+            "bytes_floor_source": "costplane" if cp_bytes else "model",
+            "costplane_bytes_per_iter": int(cp_bytes) if cp_bytes else None,
+            "costplane_flops_per_iter": (int(cp["flops_per_iter"])
+                                         if cp_bytes else None),
+            "model_bytes_floor_per_iter_s": round(model_bytes_floor, 4),
             "bytes_floor_plus_fixed_s": round(bytes_plus_fixed_s, 4),
             "issue_estimate": issue_est,
             "fixed_cost_per_iter_s": round(fixed_s, 4),

@@ -54,6 +54,8 @@ import numpy as np
 from ..analysis.ir.contracts import register_program
 from ..data.stream import ShardedBinnedDataset, WindowPump
 from ..guard.backoff import Backoff
+from ..obs import costplane
+from ..obs.profile import ProfileWindow
 from ..obs.telemetry import NULL_TELEMETRY, TrainTelemetry
 from ..parallel.sharding import make_mesh, shard_map, sharding, spec
 from ..utils import log
@@ -474,6 +476,12 @@ def predict_stream(gb, data, *, start_iteration: int = 0,
         tel = TrainTelemetry(enabled=True,
                              ring=getattr(cfg, "telemetry_ring", 256),
                              warmup=getattr(cfg, "telemetry_warmup", 2))
+    # profiler window keyed to the stream window index (the inference
+    # analog of profile_start_iter; docs/observability.md)
+    pw = ProfileWindow(
+        start_iter=getattr(cfg, "profile_stream_start_window", -1),
+        n_iters=getattr(cfg, "profile_stream_n_windows", 1),
+        out_dir=getattr(cfg, "profile_dir", ""), unit="stream_window")
     t_start = time.perf_counter()
     metas: dict = {}
     buckets: set = set()
@@ -523,8 +531,14 @@ def predict_stream(gb, data, *, start_iteration: int = 0,
                 dev = jax.device_put(dummy)
             # deliberate warmup sync, not steady state: the bucket traces
             # must land BEFORE the pump opens (a compile under a window
-            # record would be a steady-state compile)
-            scorer(dev).block_until_ready()
+            # record would be a steady-state compile). The cost plane
+            # captures the window scorer here, at the same warm dispatch.
+            costplane.observed_call(
+                "predict_stream.window", scorer, (dev,), bucket=b,
+                phase="predict_stream",
+                shard_spec=",".join(f"{a}={mesh.shape[a]}"
+                                    for a in mesh.axis_names)
+                if mesh is not None else "").block_until_ready()
 
     res = None
     if out is None and src.n_rows is not None:
@@ -559,6 +573,7 @@ def predict_stream(gb, data, *, start_iteration: int = 0,
     try:
         tel.begin_iteration(0)
         for key, bufs in pump:
+            pw.on_tick(n_windows)
             scores = scorer(bufs[0])
             sring.put(key, scores)
             if sring.full:
@@ -569,7 +584,11 @@ def predict_stream(gb, data, *, start_iteration: int = 0,
         while len(sring):
             _drain_one()
         tel.end_iteration(sync=None)
+        # device-complete by construction: every window's scores were
+        # drained through ScoreRing.wait_ready above
         wall = time.perf_counter() - t_start
+        costplane.PLANE.note_wall("predict_stream", wall,
+                                  calls=max(n_windows, 1))
         if stats_out is not None:
             n_scored = rows_done
             stats_out.update({
@@ -589,6 +608,7 @@ def predict_stream(gb, data, *, start_iteration: int = 0,
                 "throttle": gate.snapshot() if gate is not None else None,
             })
     finally:
+        pw.close(n_windows)
         tel.close()
 
     if out is not None:

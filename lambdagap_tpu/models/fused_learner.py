@@ -409,9 +409,13 @@ class FusedTreeLearner(SerialTreeLearner):
                                          has_mask=row_mask is not None)
         else:
             srows = self._srows_dummy
-        rec = self._train_jit(grad, hess, mask, fmask, self.hx_rows,
-                              self.x_cols, srows, gq, hq, gs, hs, ekey,
-                              has_mask=row_mask is not None)
+        from ..obs import costplane
+        rec = costplane.observed_call(
+            "train.fused", self._train_jit,
+            (grad, hess, mask, fmask, self.hx_rows, self.x_cols, srows,
+             gq, hq, gs, hs, ekey),
+            dict(has_mask=row_mask is not None),
+            bucket=int(grad.shape[0]), phase="tree")
         self.last_row_leaf = rec.row_leaf
         return rec
 

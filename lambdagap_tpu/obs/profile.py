@@ -1,4 +1,4 @@
-"""``jax.profiler`` capture windows keyed to boosting iterations.
+"""``jax.profiler`` capture windows keyed to training, serving or streaming.
 
 The coarse phase spans of :mod:`.telemetry` answer "which phase is slow";
 a profiler trace answers "why". This module turns the
@@ -10,57 +10,88 @@ instead of an unboundedly large trace. The fused learner's program sections
 carry ``jax.named_scope`` annotations (histogram / partition / split_scan),
 so the captured trace shows the same phase structure the telemetry reports.
 
+The window is unit-agnostic: training drives it per boosting iteration,
+``ForestServer`` per submitted request (``profile_serve_start_req`` /
+``profile_serve_n_req``) and ``predict_stream`` per scoring window
+(``profile_stream_start_window`` / ``profile_stream_n_windows``), so the
+"why is this phase slow" recipe works on the inference paths too. Serve
+submissions arrive from many client threads, so the tick path is
+lock-guarded.
+
 Recipe (docs/observability.md): ``telemetry=true profile_start_iter=10
 profile_n_iters=3 profile_dir=/tmp/trace`` then
 ``tensorboard --logdir /tmp/trace``.
 """
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from ..utils import log
 
 
 class ProfileWindow:
-    """One bounded trace window; inert when ``profile_dir`` is empty or
+    """One bounded trace window; inert when ``out_dir`` is empty or
     ``start_iter`` is negative. Exceptions from the profiler never
-    propagate into training."""
+    propagate into training or serving."""
 
     def __init__(self, start_iter: int = -1, n_iters: int = 1,
-                 out_dir: str = "") -> None:
+                 out_dir: str = "", unit: str = "iteration") -> None:
         self.start_iter = int(start_iter)
         self.n_iters = max(int(n_iters), 1)
         self.out_dir = out_dir
+        self.unit = unit
         self.active = False
         self.done = False
+        self._ticks = 0
+        self._lock = threading.Lock()
 
     @property
     def enabled(self) -> bool:
         return bool(self.out_dir) and self.start_iter >= 0
 
     def on_iteration_start(self, iteration: int) -> Optional[str]:
-        """Drive the window from iteration boundaries. Returns
-        "start"/"stop" when the window toggled (for the run-log event),
-        else None."""
+        """Training-loop entry point (kept for the telemetry driver):
+        identical to :meth:`on_tick` with the boosting iteration as the
+        count."""
+        return self.on_tick(iteration)
+
+    def tick(self) -> Optional[str]:
+        """Self-counting tick for callers without a natural index (the
+        serve submit path): the Nth call behaves like ``on_tick(N-1)``."""
+        with self._lock:
+            count = self._ticks
+            self._ticks += 1
+        return self.on_tick(count)
+
+    def on_tick(self, count: int) -> Optional[str]:
+        """Drive the window from unit boundaries (iteration, serve
+        request, stream window — per :attr:`unit`). Returns "start"/"stop"
+        when the window toggled (for the run-log event), else None.
+        Thread-safe: concurrent serve submits race on the same window."""
         if not self.enabled or self.done:
             return None
-        if not self.active and iteration >= self.start_iter:
-            try:
-                import jax.profiler
-                jax.profiler.start_trace(self.out_dir)
-            except Exception as e:  # pragma: no cover - backend-dependent
-                log.warning("profiler window could not start: %s", e)
-                self.done = True
-                return None
-            self.active = True
-            log.info("profiler trace started at iteration %d -> %s",
-                     iteration, self.out_dir)
-            return "start"
-        if self.active and iteration >= self.start_iter + self.n_iters:
-            return self._stop(iteration)
-        return None
+        with self._lock:
+            if not self.active and not self.done and count >= self.start_iter:
+                return self._start_locked(count)
+            if self.active and count >= self.start_iter + self.n_iters:
+                return self._stop_locked(count)
+            return None
 
-    def _stop(self, iteration: int) -> Optional[str]:
+    def _start_locked(self, count: int) -> Optional[str]:
+        try:
+            import jax.profiler
+            jax.profiler.start_trace(self.out_dir)
+        except Exception as e:  # pragma: no cover - backend-dependent
+            log.warning("profiler window could not start: %s", e)
+            self.done = True
+            return None
+        self.active = True
+        log.info("profiler trace started at %s %d -> %s",
+                 self.unit, count, self.out_dir)
+        return "start"
+
+    def _stop_locked(self, count: int) -> Optional[str]:
         try:
             import jax.profiler
             jax.profiler.stop_trace()
@@ -68,11 +99,17 @@ class ProfileWindow:
             log.warning("profiler window could not stop cleanly: %s", e)
         self.active = False
         self.done = True
-        log.info("profiler trace stopped at iteration %d (%d iterations "
-                 "captured)", iteration, self.n_iters)
+        log.info("profiler trace stopped at %s %d (%d %ss captured)",
+                 self.unit, count, self.n_iters, self.unit)
         return "stop"
 
-    def close(self, iteration: int = -1) -> None:
+    # back-compat name used by pre-existing callers/tests
+    def _stop(self, count: int) -> Optional[str]:
+        with self._lock:
+            if not self.active:
+                return None
+            return self._stop_locked(count)
+
+    def close(self, count: int = -1) -> None:
         """Stop a window left open by a short run."""
-        if self.active:
-            self._stop(iteration)
+        self._stop(count)

@@ -469,9 +469,29 @@ class FlightRecorder:
         lines = [json.dumps(hdr, separators=(",", ":"), default=str)]
         lines += [json.dumps(r, separators=(",", ":"), default=str)
                   for r in recs]
+        cost = self._cost_plane_record()
+        if cost is not None:
+            lines.append(json.dumps(cost, separators=(",", ":"),
+                                    default=str))
         atomic_write_text(self.path, "\n".join(lines) + "\n")
         self.dumps += 1
         return self.path
+
+    def _cost_plane_record(self) -> Optional[Dict[str, Any]]:
+        """One ``cost_plane`` event record appended to each dump when the
+        analytic ledger is armed: the postmortem of a killed replica then
+        carries the per-executable traffic facts next to its last spans."""
+        try:
+            from .costplane import PLANE
+            if not PLANE.enabled or not PLANE.entries:
+                return None
+            attr = PLANE.attribution()
+            return {"type": "event", "event": "cost_plane",
+                    "proc": self.recorder.proc, "time_unix": time.time(),
+                    "entries": len(PLANE.entries),
+                    "phases": attr["phases"], "peaks": attr["peaks"]}
+        except Exception:  # pragma: no cover - the dump must never fail
+            return None
 
     # -- hooks ----------------------------------------------------------
     def install(self) -> "FlightRecorder":

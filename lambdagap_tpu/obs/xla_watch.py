@@ -39,6 +39,25 @@ def _is_transfer_event(event: str) -> bool:
     return "transfer" in event
 
 
+# jax.monitoring kwargs keys that identify WHICH executable a compile
+# event belongs to, in preference order. Current jax versions fire
+# backend_compile with empty kwargs (every compile is then an anonymous
+# per-phase count, as before), but fingerprint/module kwargs exist in the
+# instrumented builds and newer versions — when present, the watchdog
+# attributes the compile to them so `totals()["compiles_by_module"]`
+# names the recompiling program instead of just its phase.
+_MODULE_KWARGS = ("fingerprint", "module_name", "fun_name", "module",
+                  "name")
+
+
+def _module_of(kwargs: Dict) -> Optional[str]:
+    for key in _MODULE_KWARGS:
+        val = kwargs.get(key)
+        if val:
+            return str(val)
+    return None
+
+
 class XlaWatchdog:
     """Counts compiles/transfers per phase; warns on steady-state compiles.
 
@@ -61,6 +80,7 @@ class XlaWatchdog:
         self.steady_compiles = 0
         self.transfers = 0
         self.compiles_by_phase: Dict[str, int] = {}
+        self.compiles_by_module: Dict[str, int] = {}
         self.transfers_by_phase: Dict[str, int] = {}
         self.compile_secs = 0.0
         self._warnings = 0
@@ -78,16 +98,36 @@ class XlaWatchdog:
     def uninstall(self) -> None:
         if not self.installed:
             return
-        try:
-            from jax._src import monitoring as _m
-            _m._unregister_event_listener_by_callback(self._on_event)
-            _m._unregister_event_duration_listener_by_callback(
-                self._on_duration)
-        except Exception:  # pragma: no cover - jax internals moved
-            log.warning("could not unregister jax.monitoring listeners; "
-                        "the watchdog callbacks stay registered (harmless "
-                        "but counted across runs)")
+        if not self._uninstall_public():
+            try:
+                from jax._src import monitoring as _m
+                _m._unregister_event_listener_by_callback(self._on_event)
+                _m._unregister_event_duration_listener_by_callback(
+                    self._on_duration)
+            except Exception:  # pragma: no cover - jax internals moved
+                log.warning("could not unregister jax.monitoring "
+                            "listeners; the watchdog callbacks stay "
+                            "registered (harmless but counted across runs)")
         self.installed = False
+
+    def _uninstall_public(self) -> bool:
+        """Prefer a public unregister API when the jax version grows one
+        (the `_src` fallback below is version-coupled); returns True when
+        both listeners were removed publicly."""
+        import jax.monitoring
+        unreg_ev = getattr(jax.monitoring,
+                           "unregister_event_listener_by_callback", None)
+        unreg_dur = getattr(
+            jax.monitoring,
+            "unregister_event_duration_listener_by_callback", None)
+        if unreg_ev is None or unreg_dur is None:
+            return False
+        try:
+            unreg_ev(self._on_event)
+            unreg_dur(self._on_duration)
+            return True
+        except Exception:  # pragma: no cover - listener already gone
+            return False
 
     def set_iteration(self, iteration: Optional[int]) -> None:
         self.iteration = iteration
@@ -95,7 +135,7 @@ class XlaWatchdog:
     # -- listeners ------------------------------------------------------
     def _on_event(self, event: str, **kwargs) -> None:
         if _is_compile_event(event):
-            self._record_compile(event, 0.0)
+            self._record_compile(event, 0.0, kwargs)
         elif _is_transfer_event(event):
             with self._lock:
                 self.transfers += 1
@@ -105,17 +145,22 @@ class XlaWatchdog:
 
     def _on_duration(self, event: str, duration: float, **kwargs) -> None:
         if _is_compile_event(event):
-            self._record_compile(event, float(duration))
+            self._record_compile(event, float(duration), kwargs)
         elif _is_transfer_event(event):
             self._on_event(event)
 
-    def _record_compile(self, event: str, duration: float) -> None:
+    def _record_compile(self, event: str, duration: float,
+                        kwargs: Optional[Dict] = None) -> None:
+        module = _module_of(kwargs) if kwargs else None
         with self._lock:
             self.compiles += 1
             self.compile_secs += duration
             phase = self._phase_getter() or "outside"
             self.compiles_by_phase[phase] = \
                 self.compiles_by_phase.get(phase, 0) + 1
+            if module is not None:
+                self.compiles_by_module[module] = \
+                    self.compiles_by_module.get(module, 0) + 1
             it = self.iteration
             steady = it is not None and it >= self.warmup
             if steady:
@@ -145,5 +190,6 @@ class XlaWatchdog:
                 "compile_secs": self.compile_secs,
                 "transfers": self.transfers,
                 "compiles_by_phase": dict(self.compiles_by_phase),
+                "compiles_by_module": dict(self.compiles_by_module),
                 "transfers_by_phase": dict(self.transfers_by_phase),
             }

@@ -389,18 +389,23 @@ def predict_forest(x: jax.Array, forest: TreeArrays, tree_class: jax.Array,
         tree_block = int(os.environ.get("LAMBDAGAP_PREDICT_TREE_BLOCK", 64))
     init = (jnp.zeros((num_class, N), jnp.float32),
             jnp.zeros(N, dtype=bool), jnp.int32(0))
+    from ..obs import costplane
     if blocks is None:
         if tree_block <= 0 or T <= tree_block:
-            out, _, _ = _predict_forest_block(
-                x, forest, tree_class, init, num_class, max_depth, binned,
-                early_stop_freq, early_stop_margin, has_linear)
+            out, _, _ = costplane.observed_call(
+                "predict.scan", _predict_forest_block,
+                (x, forest, tree_class, init, num_class, max_depth,
+                 binned, early_stop_freq, early_stop_margin, has_linear),
+                bucket=N, phase="predict")
             return out
         blocks = build_forest_blocks(forest, tree_class, tree_block)
     carry = init
     for blk, tc, _ in blocks:
-        carry = _predict_forest_block(
-            x, blk, tc, carry, num_class, max_depth, binned,
-            early_stop_freq, early_stop_margin, has_linear)
+        carry = costplane.observed_call(
+            "predict.scan", _predict_forest_block,
+            (x, blk, tc, carry, num_class, max_depth, binned,
+             early_stop_freq, early_stop_margin, has_linear),
+            bucket=N, phase="predict")
     return carry[0]
 
 

@@ -227,18 +227,23 @@ def predict_forest_tensor(x: jax.Array, forest: TreeArrays,
         tree_tile = default_tree_tile()
     init = (jnp.zeros((num_class, N), jnp.float32),
             jnp.zeros(N, dtype=bool), jnp.int32(0))
+    from ..obs import costplane
     if tiles is None:
         if tree_tile <= 0 or T <= tree_tile:
-            out, _, _ = _predict_tensor_tile(
-                x, forest, tree_class, init, num_class, max_depth, binned,
-                early_stop_freq, early_stop_margin, has_linear)
+            out, _, _ = costplane.observed_call(
+                "predict.tensor", _predict_tensor_tile,
+                (x, forest, tree_class, init, num_class, max_depth,
+                 binned, early_stop_freq, early_stop_margin, has_linear),
+                bucket=N, phase="predict")
             return out
         tiles = build_tree_tiles(forest, tree_class, tree_tile)
     carry = init
     for blk, tc, _ in tiles:
-        carry = _predict_tensor_tile(
-            x, blk, tc, carry, num_class, max_depth, binned,
-            early_stop_freq, early_stop_margin, has_linear)
+        carry = costplane.observed_call(
+            "predict.tensor", _predict_tensor_tile,
+            (x, blk, tc, carry, num_class, max_depth, binned,
+             early_stop_freq, early_stop_margin, has_linear),
+            bucket=N, phase="predict")
     return carry[0]
 
 

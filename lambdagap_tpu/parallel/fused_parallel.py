@@ -662,8 +662,14 @@ class Fused2DTreeLearner(FusedTreeLearner):
             ekey = jnp.zeros((2, 2), jnp.uint32)
         g = self._shard_vec(grad)
         h = self._shard_vec(hess)
-        rec = self._train_jit_2d(g, h, m, fmask, self.hx_rows, self.x_cols,
-                                 self._srows_dummy, gq, hq, gs, hs, ekey)
+        from ..obs import costplane
+        rec = costplane.observed_call(
+            "train.fused2d", self._train_jit_2d,
+            (g, h, m, fmask, self.hx_rows, self.x_cols,
+             self._srows_dummy, gq, hq, gs, hs, ekey),
+            bucket=int(g.shape[0]), phase="tree",
+            shard_spec=",".join(f"{a}={self.mesh.shape[a]}"
+                                for a in self.mesh.axis_names))
         rec = rec._replace(row_leaf=rec.row_leaf[:self.num_data])
         self.last_row_leaf = rec.row_leaf
         return rec

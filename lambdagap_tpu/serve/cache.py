@@ -261,8 +261,10 @@ class CompiledForestCache:
         if (self._forest is None and self._compiled is None) or N == 0:
             res = np.zeros((K, N), dtype=np.float32)
             return res[0] if K == 1 else res.T
+        from ..obs import costplane
         parts = []
         lo = 0
+        t_dispatch = time.perf_counter()
         for n, b in self.plan(N):
             chunk = X[lo:lo + n]
             lo += n
@@ -281,6 +283,11 @@ class CompiledForestCache:
             # graftlint: disable=R1 — the terminal D2H of the response is
             # inherent to serving: results must reach the client as numpy
             parts.append(np.asarray(jax.device_get(out))[:, :n])
+        # every chunk ended in a device_get, so this wall is device-
+        # complete — the serve-side join the cost plane's roofline uses
+        costplane.PLANE.note_wall("serve_dispatch",
+                                  time.perf_counter() - t_dispatch,
+                                  calls=len(parts))
         res = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
         return res[0] if K == 1 else res.T
 

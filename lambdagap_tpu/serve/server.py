@@ -147,6 +147,14 @@ class ForestServer:
             tenant_max_share=float(cfg.serve_tenant_max_share
                                    if tenant_max_share is None
                                    else tenant_max_share))
+        # serve-side profiler window keyed to the submitted-request count
+        # (profile_serve_start_req/profile_serve_n_req): the inference
+        # analog of profile_start_iter (docs/observability.md)
+        from ..obs.profile import ProfileWindow
+        self._profile = ProfileWindow(
+            start_iter=int(getattr(cfg, "profile_serve_start_req", -1)),
+            n_iters=int(getattr(cfg, "profile_serve_n_req", 1)),
+            out_dir=getattr(cfg, "profile_dir", ""), unit="serve_request")
 
     # ------------------------------------------------------------------
     def _build_cache(self, gbdt, generation: int) -> CompiledForestCache:
@@ -223,6 +231,8 @@ class ForestServer:
             x = x[None, :]
         if x.ndim != 2:
             raise ValueError(f"serve requests are rows [n, D], got {x.shape}")
+        if self._profile.enabled:        # request-count profiler window
+            self._profile.tick()
         ctx = trace if trace is not None \
             else obs_trace.RECORDER.maybe_trace()
         if ctx is None:                  # the untraced fast path
@@ -333,6 +343,7 @@ class ForestServer:
             self._closed = True
             self.health.set_draining()
             self._batcher.close(timeout)
+            self._profile.close()
 
     def __enter__(self) -> "ForestServer":
         return self

@@ -73,6 +73,7 @@ def fixture_findings():
     "parallel/r6_2d_program.py",
     "parallel/stream2d.py",
     "obs/r7_unsynced_timing.py",
+    "obs/costplane.py",
     "serve/r8_futures.py",
     "serve/r8_router.py",
     "serve/r9_cycle_a.py",

@@ -1,0 +1,138 @@
+"""obs/profile.py — bounded jax.profiler capture windows.
+
+The ISSUE-19 satellite surface: window start/stop boundaries for every
+unit (training iteration, serve request, stream window), a short run's
+``close()`` stopping a window left open, and the disabled path staying a
+complete no-op (no profiler import, no trace started).
+"""
+import threading
+
+import pytest
+
+from lambdagap_tpu.obs.profile import ProfileWindow
+
+
+class _FakeProfiler:
+    """Stands in for jax.profiler: records start/stop without tracing."""
+
+    def __init__(self):
+        self.starts = []
+        self.stops = 0
+
+    def install(self, monkeypatch):
+        import jax.profiler
+        monkeypatch.setattr(jax.profiler, "start_trace",
+                            lambda out_dir: self.starts.append(out_dir))
+        monkeypatch.setattr(jax.profiler, "stop_trace",
+                            lambda: setattr(self, "stops", self.stops + 1))
+
+
+# -- boundaries ---------------------------------------------------------
+def test_window_start_stop_boundaries(monkeypatch, tmp_path):
+    fake = _FakeProfiler()
+    fake.install(monkeypatch)
+    pw = ProfileWindow(start_iter=3, n_iters=2, out_dir=str(tmp_path))
+    assert pw.enabled
+    toggles = {i: pw.on_iteration_start(i) for i in range(8)}
+    # starts exactly AT start_iter, stops exactly n_iters later
+    assert toggles == {0: None, 1: None, 2: None, 3: "start", 4: None,
+                      5: "stop", 6: None, 7: None}
+    assert fake.starts == [str(tmp_path)]
+    assert fake.stops == 1
+    assert pw.done and not pw.active
+
+
+def test_window_units_drive_on_tick(monkeypatch, tmp_path):
+    # the serve/stream units use the same boundary machinery via on_tick
+    for unit in ("serve_request", "stream_window"):
+        fake = _FakeProfiler()
+        fake.install(monkeypatch)
+        pw = ProfileWindow(start_iter=1, n_iters=1, out_dir=str(tmp_path),
+                           unit=unit)
+        assert pw.on_tick(0) is None
+        assert pw.on_tick(1) == "start"
+        assert pw.on_tick(2) == "stop"
+        assert pw.on_tick(3) is None           # one window per run
+        assert fake.starts and fake.stops == 1
+
+
+def test_self_counting_tick(monkeypatch, tmp_path):
+    # serve submits have no natural index: tick() counts calls itself
+    fake = _FakeProfiler()
+    fake.install(monkeypatch)
+    pw = ProfileWindow(start_iter=2, n_iters=1, out_dir=str(tmp_path),
+                       unit="serve_request")
+    got = [pw.tick() for _ in range(5)]
+    assert got == [None, None, "start", "stop", None]
+
+
+def test_concurrent_ticks_start_once(monkeypatch, tmp_path):
+    # many serve workers race the same window: exactly one start/stop
+    fake = _FakeProfiler()
+    fake.install(monkeypatch)
+    pw = ProfileWindow(start_iter=0, n_iters=1, out_dir=str(tmp_path),
+                       unit="serve_request")
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        for _ in range(20):
+            pw.tick()
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(fake.starts) == 1
+    assert fake.stops == 1
+
+
+# -- short runs ---------------------------------------------------------
+def test_close_stops_short_run_window(monkeypatch, tmp_path):
+    # run ends INSIDE the window: close() must stop the open trace
+    fake = _FakeProfiler()
+    fake.install(monkeypatch)
+    pw = ProfileWindow(start_iter=1, n_iters=100, out_dir=str(tmp_path))
+    pw.on_iteration_start(0)
+    pw.on_iteration_start(1)
+    assert pw.active and fake.starts
+    pw.close(2)
+    assert not pw.active and pw.done
+    assert fake.stops == 1
+    pw.close(3)                                # idempotent
+    assert fake.stops == 1
+
+
+def test_close_without_start_is_noop(monkeypatch, tmp_path):
+    fake = _FakeProfiler()
+    fake.install(monkeypatch)
+    pw = ProfileWindow(start_iter=50, n_iters=1, out_dir=str(tmp_path))
+    pw.on_iteration_start(0)
+    pw.close(1)
+    assert fake.starts == [] and fake.stops == 0
+
+
+# -- disabled path ------------------------------------------------------
+@pytest.mark.parametrize("kwargs", [
+    {},                                        # both defaults off
+    {"start_iter": 5},                         # no out_dir
+    {"out_dir": "/tmp/x"},                     # no start_iter
+    {"start_iter": -1, "out_dir": "/tmp/x"},   # explicit off
+])
+def test_disabled_window_is_inert(monkeypatch, kwargs):
+    # the disabled path must never touch jax.profiler at all
+    import jax.profiler
+
+    def boom(*a, **k):  # pragma: no cover - failing is the assertion
+        raise AssertionError("disabled ProfileWindow touched jax.profiler")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    monkeypatch.setattr(jax.profiler, "stop_trace", boom)
+    pw = ProfileWindow(**kwargs)
+    assert not pw.enabled
+    for i in range(10):
+        assert pw.on_iteration_start(i) is None
+        assert pw.on_tick(i) is None
+    pw.close(10)
+    assert not pw.active and not pw.done

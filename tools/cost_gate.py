@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Cost-plane gate for tools/run_full_suite.sh (ISSUE 19 CI satellite).
+
+Runs the cost-plane scenario matrix in one process — the three learners
+(serial, fused, fused-2D on an 8-way virtual mesh) and the three predict
+engines (scan, tensor, compiled) plus ``predict_stream`` and SHAP — into
+one analytic ledger (``lambdagap_tpu.obs.costplane``), then diffs the
+ledger's per-program maxima against the checked-in budget
+(``tools/cost_budget.json``):
+
+- any ``steady`` budget program missing from the ledger fails (a capture
+  site silently unwired is exactly the regression this catches);
+- on a matching backend, a ``hot`` program growing its analytic
+  bytes-accessed past the budget tolerance (default +10%) or its peak
+  HBM at all fails — XLA's analytic counts are deterministic, so any
+  growth is a real program change, not noise;
+- on a foreign backend the byte/HBM diffs are skipped (the analytic
+  counts are backend-shaped) but the presence inventory still gates.
+
+A self-test perturbs a hot program's bytes by +20% in memory and asserts
+the check fails, so the gate cannot rot into a tautology.
+
+Modes: default (scenarios -> check -> selftest), ``--emit PATH`` (also
+persist the ledger, e.g. the repo COSTS.json artifact), ``--seed-budget``
+(rewrite tools/cost_budget.json from this run), ``--selftest`` (skip the
+scenario run; needs an existing ledger via ``--ledger``).
+
+Exit 0 on success, 1 with a diagnostic on any violation.
+"""
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# 8 virtual CPU devices BEFORE jax import: the fused-2D scenario lowers
+# on a real 4x2 mesh, so its ledger entry carries the sharded shapes
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+BUDGET_PATH = os.path.join(REPO, "tools", "cost_budget.json")
+ROUNDS = 4
+
+
+def run_scenarios():
+    """Train every learner and score through every engine with the plane
+    armed; returns the populated module PLANE."""
+    import numpy as np
+
+    import lambdagap_tpu as lgb
+    from lambdagap_tpu.obs.costplane import PLANE
+
+    PLANE.reset()
+    rng = np.random.RandomState(0)
+    X = rng.randn(2000, 16).astype(np.float32)
+    y = (X[:, 0] - 0.4 * X[:, 1] + 0.2 * rng.randn(2000) > 0
+         ).astype(np.float32)
+    ds = lambda: lgb.Dataset(X, label=y)  # noqa: E731
+    Xp = rng.randn(1536, 16).astype(np.float32)
+    base = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+            "cost_plane": True, "telemetry": True,
+            "tpu_fast_predict_rows": 0}   # rows > threshold: device path
+
+    # one learner + one predict engine per training; each train
+    # re-configures the plane with cost_plane on, so the ledger accumulates
+    serial = lgb.train({**base, "tpu_fused_learner": "0",
+                        "predict_engine": "scan"},
+                       ds(), num_boost_round=ROUNDS)
+    serial.predict(Xp)
+    fused = lgb.train({**base, "tpu_fused_learner": "1",
+                       "predict_engine": "tensor"},
+                      ds(), num_boost_round=ROUNDS)
+    fused.predict(Xp)
+    fused2d = lgb.train({**base, "tpu_fused_learner": "1",
+                         "tree_learner": "data", "mesh_shape": "4x2",
+                         "predict_engine": "compiled"},
+                        ds(), num_boost_round=ROUNDS)
+    fused2d.predict(Xp)
+    fused.predict_stream(Xp, raw_score=True, window_rows=512)
+    serial.predict(Xp[:256], pred_contrib=True)
+    return PLANE
+
+
+def _by_program(doc: dict) -> dict:
+    """Per-program maxima over padding buckets from a ledger document
+    (mirror of CostPlane.by_program, but over the persisted JSON)."""
+    out: dict = {}
+    for e in doc.get("entries", {}).values():
+        agg = out.setdefault(e["program"], {"bytes_accessed": 0.0,
+                                            "peak_hbm_bytes": 0.0})
+        agg["bytes_accessed"] = max(agg["bytes_accessed"],
+                                    float(e["bytes_accessed"]))
+        agg["peak_hbm_bytes"] = max(agg["peak_hbm_bytes"],
+                                    float(e["peak_hbm_bytes"]))
+    return out
+
+
+def check(doc: dict, budget: dict) -> list:
+    """Diff a ledger document against the budget; returns failure strings
+    (empty = pass)."""
+    errs = []
+    got = _by_program(doc)
+    same_backend = doc.get("backend") == budget.get("backend")
+    tol = budget.get("tolerance", {})
+    tol_bytes = float(tol.get("bytes_accessed_frac", 0.10))
+    tol_hbm = float(tol.get("peak_hbm_frac", 0.0))
+    for name, b in sorted(budget.get("programs", {}).items()):
+        if name not in got:
+            if b.get("steady"):
+                errs.append(f"steady program {name} missing from the "
+                            "ledger (capture site unwired?)")
+            continue
+        if not (b.get("hot") and same_backend):
+            continue
+        g = got[name]
+        lim = b["bytes_accessed"] * (1.0 + tol_bytes)
+        if g["bytes_accessed"] > lim + 1e-9:
+            errs.append(
+                f"{name}: bytes_accessed {g['bytes_accessed']:.3e} exceeds "
+                f"budget {b['bytes_accessed']:.3e} by more than "
+                f"{tol_bytes:.0%}")
+        lim = b["peak_hbm_bytes"] * (1.0 + tol_hbm)
+        if g["peak_hbm_bytes"] > lim + 1e-9:
+            errs.append(
+                f"{name}: peak HBM {g['peak_hbm_bytes']:.3e} regressed past "
+                f"budget {b['peak_hbm_bytes']:.3e}")
+    if not same_backend:
+        errs = errs or []
+        print(f"cost gate: note: ledger backend {doc.get('backend')!r} != "
+              f"budget backend {budget.get('backend')!r}; byte/HBM diffs "
+              "skipped, presence inventory still gated")
+    return errs
+
+
+def seed_budget(doc: dict, path: str = BUDGET_PATH) -> dict:
+    """Budget from a ledger's per-program maxima: device programs are hot
+    (byte/HBM gated), everything captured is steady (presence gated)."""
+    programs = {}
+    for name, agg in sorted(_by_program(doc).items()):
+        host = any(e["program"] == name
+                   and e.get("memory_source") == "host_analytic"
+                   for e in doc["entries"].values())
+        programs[name] = {
+            "bytes_accessed": agg["bytes_accessed"],
+            "peak_hbm_bytes": agg["peak_hbm_bytes"],
+            "hot": not host,
+            "steady": True,
+        }
+    budget = {
+        "schema_version": doc.get("schema_version", 1),
+        "backend": doc.get("backend", "unknown"),
+        "tolerance": {"bytes_accessed_frac": 0.10, "peak_hbm_frac": 0.0},
+        "programs": programs,
+    }
+    with open(path, "w") as f:
+        json.dump(budget, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return budget
+
+
+def selftest(doc: dict, budget: dict) -> list:
+    """The gate must pass on its own ledger and fail on an injected +20%
+    bytes regression of a hot program."""
+    errs = check(doc, budget)
+    if errs:
+        return [f"selftest: unperturbed ledger failed: {e}" for e in errs]
+    hot = [n for n, b in budget["programs"].items()
+           if b.get("hot") and n in _by_program(doc)]
+    if not hot:
+        return ["selftest: no hot budget program present in the ledger"]
+    bad = copy.deepcopy(doc)
+    victim = sorted(hot)[0]
+    for e in bad["entries"].values():
+        if e["program"] == victim:
+            e["bytes_accessed"] = float(e["bytes_accessed"]) * 1.2
+    if not check(bad, budget):
+        return [f"selftest: +20% bytes on {victim} was NOT caught — the "
+                "gate is a tautology"]
+    return []
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--emit", metavar="PATH",
+                    help="also persist the ledger document to PATH")
+    ap.add_argument("--ledger", metavar="PATH",
+                    help="check an existing ledger instead of running the "
+                         "scenario matrix")
+    ap.add_argument("--budget", metavar="PATH", default=BUDGET_PATH,
+                    help=f"budget file (default {BUDGET_PATH})")
+    ap.add_argument("--seed-budget", action="store_true",
+                    help="rewrite the budget from this run's ledger")
+    ap.add_argument("--selftest", action="store_true",
+                    help="only run the perturbation self-test")
+    args = ap.parse_args(argv)
+
+    if args.ledger:
+        doc = json.load(open(args.ledger))
+    else:
+        plane = run_scenarios()
+        doc = plane.to_json()
+    if args.emit:
+        with open(args.emit, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"cost gate: ledger written to {args.emit} "
+              f"({len(doc['entries'])} entries)")
+    if args.seed_budget:
+        budget = seed_budget(doc, args.budget)
+        print(f"cost gate: budget seeded at {args.budget} "
+              f"({len(budget['programs'])} programs)")
+        if not args.selftest:
+            return 0
+    if not os.path.exists(args.budget):
+        print(f"cost gate: no budget at {args.budget}; run --seed-budget "
+              "first", file=sys.stderr)
+        return 1
+    budget = json.load(open(args.budget))
+
+    if not args.selftest:
+        errs = check(doc, budget)
+        if errs:
+            print("cost gate: FAIL\n  " + "\n  ".join(errs),
+                  file=sys.stderr)
+            return 1
+    st = selftest(doc, budget)
+    if st:
+        print("cost gate: FAIL\n  " + "\n  ".join(st), file=sys.stderr)
+        return 1
+    byp = _by_program(doc)
+    walls = doc.get("walls", {})
+    print(f"cost gate: OK ({len(doc['entries'])} ledger entries over "
+          f"{len(byp)} programs, {len(walls)} measured wall phases, "
+          "selftest caught the injected +20% bytes regression)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -179,8 +179,19 @@ if ! env JAX_PLATFORMS=cpu python tools/batch_gate.py; then
     echo "docs/performance.md 'Batch scoring')"
     exit 1
 fi
+# cost-plane gate (ISSUE 19): every learner and predict engine must land
+# an analytic ledger entry (a silently unwired capture site fails the
+# presence inventory), no hot program may grow its bytes-accessed >10% or
+# its peak HBM at all vs tools/cost_budget.json, and the perturbation
+# self-test proves the diff still bites
+if ! env JAX_PLATFORMS=cpu python tools/cost_gate.py; then
+    echo "FAIL-FAST: cost gate failed (a capture site went missing or a"
+    echo "hot program's analytic bytes/peak-HBM regressed past the budget;"
+    echo "see docs/observability.md 'Cost plane')"
+    exit 1
+fi
 echo "=== G1 $(date)"
-python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_predict_tensor.py tests/test_misc_api.py tests/test_graftlint.py tests/test_graftir.py -q 2>&1 | tail -1
+python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_predict_tensor.py tests/test_misc_api.py tests/test_graftlint.py tests/test_graftir.py tests/test_costplane.py tests/test_profile.py -q 2>&1 | tail -1
 echo "=== G2 $(date)"
 python -m pytest tests/test_train.py tests/test_rank.py tests/test_cli_io.py -q 2>&1 | tail -1
 echo "=== G3 $(date)"
