@@ -124,7 +124,8 @@ def run_train(cfg: Config) -> None:
         if cfg.snapshot_freq > 0 and (it + 1) % cfg.snapshot_freq == 0:
             from .guard.snapshot import write_training_snapshot
             write_training_snapshot(booster, cfg.output_model,
-                                    faults=booster.guard.plan)
+                                    faults=booster.guard.plan,
+                                    keep=cfg.guard_snapshot_keep)
         if stop:
             break
     if booster.telemetry.enabled:
@@ -268,6 +269,23 @@ def _build_serve_target(cfg: Config, booster):
             placement_budget_bytes=int(cfg.serve_hbm_budget_mb * (1 << 20)),
             faults=plan_for(cfg)).start()
         router.attach_autonomics(auto)
+        if cfg.serve_shadow_sample > 0:
+            # continuous learning (docs/continuous-learning.md): watch the
+            # candidate family a co-resident task=loop_train writes to
+            # (output_model), shadow-evaluate new epochs on a mirrored
+            # slice, and promote through the fleet-atomic delta rollout.
+            # input_model is the rollback anchor for post-promote
+            # regressions.
+            from .loop import PromotionController
+            PromotionController(
+                router, auto, cfg.output_model,
+                sample=cfg.serve_shadow_sample,
+                min_requests=cfg.loop_shadow_min_requests,
+                threshold=cfg.loop_promote_threshold,
+                interval_s=cfg.loop_interval_s,
+                base_source=cfg.input_model or None,
+                signals=scraper.signals if scraper else None,
+                faults=plan_for(cfg)).start()
     return router
 
 
@@ -395,6 +413,42 @@ def run_refit(cfg: Config) -> None:
     log.info("Refitted model saved to %s", cfg.output_model)
 
 
+def run_loop_train(cfg: Config, params: dict) -> None:
+    """Continuous learning (docs/continuous-learning.md): tail a batch
+    directory, fold fresh rows in without global rebinning, and emit
+    epoch-tagged candidate snapshots for shadow evaluation. ``data=`` is
+    a DIRECTORY of ``.npy`` batches (data/tail.py); crash-anywhere: a
+    SIGKILLed trainer restarted with the same command resumes from the
+    latest valid candidate (tools/loop_gate.py proves it)."""
+    if not cfg.data:
+        log.fatal("task=loop_train requires data=<batch directory>")
+    from .data.tail import SequenceTail
+    from .guard.faults import plan_for
+    from .loop.trainer import TailingTrainer
+    flight = _configure_observability(cfg)
+    train_params = {k: v for k, v in params.items()
+                    if k not in ("task", "data", "valid")}
+    trainer = TailingTrainer(
+        train_params, SequenceTail(cfg.data), cfg.output_model,
+        iters_per_fold=cfg.loop_iters_per_fold,
+        keep=cfg.guard_snapshot_keep, faults=plan_for(cfg))
+    max_epochs = int(cfg.extra.get("loop_max_epochs", 0))
+    log.info("tailing trainer on %s (iters_per_fold=%d, keep=%d, "
+             "max_epochs=%d)", cfg.data, cfg.loop_iters_per_fold,
+             cfg.guard_snapshot_keep, max_epochs)
+    try:
+        emitted = trainer.run(interval_s=cfg.loop_interval_s,
+                              max_epochs=max_epochs)
+    finally:
+        if flight is not None:
+            flight.close()
+        if cfg.serve_trace_out:
+            from .obs import trace as obs_trace
+            obs_trace.RECORDER.close()
+    log.info("tailing trainer done: %d candidates emitted (last epoch %d)",
+             emitted, trainer.epoch)
+
+
 def run_save_binary(cfg: Config) -> None:
     if not cfg.data:
         log.fatal("task=save_binary requires data=<file>")
@@ -434,6 +488,8 @@ def main(argv=None) -> int:
         run_convert_model(cfg)
     elif task == "refit":
         run_refit(cfg)
+    elif task == "loop_train":
+        run_loop_train(cfg, params)
     else:
         log.fatal("Unknown task %r", task)
     return 0

@@ -406,11 +406,19 @@ class Config:
     serve_autonomics_cooldown_s: float = 10.0  # minimum seconds between scale actions (rate limit)
     serve_autonomics_hysteresis_ticks: int = 3  # consecutive ticks a margin condition must hold before acting
     serve_autonomics_placement: bool = True  # HBM-aware model placement + residency-preferring routing (needs serve_hbm_budget_mb > 0 to bind)
+    serve_shadow_sample: float = 0.0     # shadow-mirror sample fraction [0, 1]; mirrored requests re-score on the shadow replica strictly OFF the reply path; 0 = off (docs/continuous-learning.md)
+
+    # -- continuous learning loop (lambdagap_tpu.loop; docs/continuous-learning.md)
+    loop_shadow_min_requests: int = 200  # shadow comparisons required before the promote/reject decision
+    loop_promote_threshold: float = 1e-3  # promote when the shadow window's mean |prediction delta| is <= this
+    loop_interval_s: float = 1.0         # promotion-controller tick period / tailing-trainer poll period (seconds)
+    loop_iters_per_fold: int = 5         # boosting iterations the tailing trainer adds per data fold (one candidate per fold)
 
     # -- guard (lambdagap_tpu.guard; docs/robustness.md) ------------------
     guard_nonfinite: str = "raise"       # non-finite grad/hess/score policy: raise / skip_tree / clip / off
     guard_clip: float = 1e30             # clip bound for guard_nonfinite=clip
     resume: str = ""                     # "auto": continue from the latest valid training snapshot
+    guard_snapshot_keep: int = 0         # keep only the newest K snapshots, pruning after each write (the newest VALID one always survives); 0 = keep all
     guard_faults: str = ""               # fault-injection spec (testing; merges over LAMBDAGAP_FAULTS)
 
     # -- observability (lambdagap_tpu.obs; docs/observability.md) ---------
@@ -718,6 +726,17 @@ class Config:
              "serve_autonomics_cooldown_s must be >= 0"),
             (self.serve_autonomics_hysteresis_ticks >= 1,
              "serve_autonomics_hysteresis_ticks must be >= 1"),
+            (0.0 <= self.serve_shadow_sample <= 1.0,
+             "serve_shadow_sample must be in [0, 1]"),
+            (self.loop_shadow_min_requests >= 1,
+             "loop_shadow_min_requests must be >= 1"),
+            (self.loop_promote_threshold >= 0,
+             "loop_promote_threshold must be >= 0"),
+            (self.loop_interval_s > 0, "loop_interval_s must be > 0"),
+            (self.loop_iters_per_fold >= 1,
+             "loop_iters_per_fold must be >= 1"),
+            (self.guard_snapshot_keep >= 0,
+             "guard_snapshot_keep must be >= 0 (0 = keep all)"),
             (self.guard_nonfinite in ("off", "raise", "skip_tree", "clip"),
              f"unknown guard_nonfinite {self.guard_nonfinite!r}"),
             (self.guard_clip > 0, "guard_clip must be > 0"),

@@ -1,0 +1,105 @@
+"""Sequence tailing: the directory-of-batches source for graftloop.
+
+The continuous-learning loop (lambdagap_tpu.loop; docs/continuous-
+learning.md) needs fresh rows to arrive while training runs. The
+wire-format here is deliberately boring: producers land one ``.npy``
+file per row batch — a 2-D float array whose column 0 is the label and
+columns 1.. are features — written ATOMICALLY (tmp name in the same
+directory, then ``os.replace``; :func:`write_batch` does it right).
+:class:`SequenceTail` polls the directory and returns each batch exactly
+once, in filename order, so producers control ordering by naming
+(``batch_000001.npy`` …).
+
+A file that fails to parse is NOT marked seen — a non-atomic writer's
+half-landed file is simply retried on the next poll, so the tail never
+consumes a torn batch and never wedges on one either.
+
+Batches become :class:`~lambdagap_tpu.basic.Sequence` views
+(:class:`ArraySequence`) feeding ``Dataset`` construction through
+``BinnedDataset.from_sequences``: per-sequence quantile sketches merge
+psum-style, and later folds pass the first fold's dataset as
+``reference=`` so new data adopts the existing bin mappers — the world
+is never re-binned.
+"""
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+from ..basic import Sequence
+from ..utils import log
+
+
+class ArraySequence(Sequence):
+    """In-memory row batch as a streaming Sequence view."""
+
+    def __init__(self, arr, batch_size: int = 4096) -> None:
+        self.arr = np.ascontiguousarray(arr, dtype=np.float64)
+        self.batch_size = int(batch_size)
+
+    def __len__(self) -> int:
+        return int(self.arr.shape[0])
+
+    def __getitem__(self, idx):
+        return self.arr[idx]
+
+
+def split_batch(arr: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """One tailed batch -> (features, label): column 0 is the label."""
+    arr = np.asarray(arr, dtype=np.float64)
+    if arr.ndim != 2 or arr.shape[1] < 2:
+        raise ValueError("a tailed batch must be 2-D with a label column "
+                         f"plus >= 1 feature column; got shape {arr.shape}")
+    return arr[:, 1:], arr[:, 0]
+
+
+def write_batch(dirpath: str, name: str, X, y) -> str:
+    """Land one batch file atomically (the producer half of the protocol).
+    Returns the final path."""
+    X = np.asarray(X, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64).reshape(-1, 1)
+    if X.shape[0] != y.shape[0]:
+        raise ValueError(f"X has {X.shape[0]} rows but y has {y.shape[0]}")
+    if not name.endswith(".npy"):
+        name += ".npy"
+    path = os.path.join(dirpath, name)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.save(f, np.hstack([y, X]))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+class SequenceTail:
+    """Polls a directory for new batch files; each valid file is returned
+    exactly once, in filename order."""
+
+    def __init__(self, path: str) -> None:
+        self.path = str(path)
+        self._seen: set = set()
+
+    def poll(self) -> List[np.ndarray]:
+        """New, fully-landed batches since the last poll (may be empty)."""
+        out: List[np.ndarray] = []
+        for p in sorted(glob.glob(os.path.join(self.path, "*.npy"))):
+            name = os.path.basename(p)
+            if name in self._seen or ".tmp." in name:
+                continue
+            try:
+                arr = np.load(p, allow_pickle=False)
+                arr = np.asarray(arr, dtype=np.float64)
+                if arr.ndim != 2 or arr.shape[1] < 2:
+                    raise ValueError(f"bad batch shape {arr.shape}")
+            except (OSError, ValueError) as e:
+                # not marked seen: a half-landed file from a non-atomic
+                # producer gets retried next poll instead of lost
+                log.warning("tail: skipping unreadable batch %s (%s)", p, e)
+                continue
+            self._seen.add(name)
+            out.append(arr)
+        return out

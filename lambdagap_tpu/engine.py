@@ -118,7 +118,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # atomic write + state sidecar, guard/snapshot.py)
             guard_snapshot.write_training_snapshot(
                 booster._booster, cfg.output_model, early_stop=es_state,
-                faults=booster._booster.guard.plan)
+                faults=booster._booster.guard.plan,
+                keep=cfg.guard_snapshot_keep)
 
         evals = []
         with telemetry.phase("eval"):

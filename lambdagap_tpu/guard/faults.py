@@ -30,6 +30,19 @@ arms these points:
   :class:`InjectedFault` before reconstructing the model text: one armed
   replica turns a fleet delta rollout into the partial-failure case the
   rollback path must clean up (tools/autonomics_gate.py).
+- ``candidate_torn=K`` — the K-th *candidate* snapshot write of the
+  tailing trainer (loop/trainer.py) is torn exactly like
+  ``torn_snapshot``, but on its own counter: the SIGKILL-mid-candidate
+  window of the continuous-learning loop, materialized
+  (tools/loop_gate.py).
+- ``shadow_dispatch_fail=K`` — the next K shadow mirror dispatches
+  (serve/shadow.py) raise :class:`InjectedFault` before reaching the
+  shadow replica: mirror failure must shed silently and be counted,
+  never surfacing on the live reply path.
+- ``promote_crash_at=stage`` — the promotion controller
+  (loop/controller.py) raises :class:`InjectedFault` when it reaches the
+  named stage (``resolve`` / ``rollout`` / ``commit``): the
+  crash-mid-promote case the fleet-convergence contract must absorb.
 
 All points are inert unless armed; parsing happens once per plan. Plans
 are per-booster / per-server (``plan_for(config)``), so two servers in one
@@ -88,12 +101,18 @@ class FaultPlan:
         self.torn_snapshot: int = int(kv.get("torn_snapshot", 0))
         self.revive_fail: int = int(kv.get("revive_fail", 0))
         self.delta_swap_fail: int = int(kv.get("delta_swap_fail", 0))
+        self.candidate_torn: int = int(kv.get("candidate_torn", 0))
+        self.shadow_dispatch_fail: int = int(
+            kv.get("shadow_dispatch_fail", 0))
+        self.promote_crash_at: str = kv.get("promote_crash_at", "")
         self._fired_nonfinite: set = set()
         self._snapshot_writes = 0
+        self._candidate_writes = 0
         unknown = set(kv) - {"crash_at_iter", "nonfinite_grad",
                              "serve_dispatch_fail", "serve_dispatch_slow_ms",
                              "torn_snapshot", "revive_fail",
-                             "delta_swap_fail"}
+                             "delta_swap_fail", "candidate_torn",
+                             "shadow_dispatch_fail", "promote_crash_at"}
         if unknown:
             log.warning("unknown fault point(s) ignored: %s",
                         ", ".join(sorted(unknown)))
@@ -106,7 +125,10 @@ class FaultPlan:
                 or self.serve_dispatch_slow_ms > 0
                 or self.torn_snapshot > 0
                 or self.revive_fail > 0
-                or self.delta_swap_fail > 0)
+                or self.delta_swap_fail > 0
+                or self.candidate_torn > 0
+                or self.shadow_dispatch_fail > 0
+                or bool(self.promote_crash_at))
 
     # -- training points ------------------------------------------------
     def crash_point(self, iteration: int) -> None:
@@ -160,6 +182,23 @@ class FaultPlan:
             raise InjectedFault("injected delta swap failure "
                                 f"({self.delta_swap_fail} left)")
 
+    # -- continuous-learning loop points --------------------------------
+    def shadow_fault(self) -> None:
+        """Called before every shadow mirror dispatch (serve/shadow.py)."""
+        if self.shadow_dispatch_fail > 0:
+            self.shadow_dispatch_fail -= 1
+            raise InjectedFault("injected shadow dispatch failure "
+                                f"({self.shadow_dispatch_fail} left)")
+
+    def promote_crash(self, stage: str) -> None:
+        """Called at the start of every promotion stage; raises when the
+        armed stage name matches (loop/controller.py)."""
+        if self.promote_crash_at and self.promote_crash_at == stage:
+            armed = self.promote_crash_at
+            self.promote_crash_at = ""
+            raise InjectedFault(
+                f"injected promotion crash at stage {armed!r}")
+
     # -- snapshot point -------------------------------------------------
     def tear_snapshot(self, path: str, data: str) -> bool:
         """If this is the armed write, simulate a crash mid-write: half the
@@ -171,6 +210,20 @@ class FaultPlan:
         if self._snapshot_writes != self.torn_snapshot:
             return False
         log.warning("fault injection: torn snapshot write to %s", path)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(data[:max(1, len(data) // 2)])
+        return True
+
+    def tear_candidate(self, path: str, data: str) -> bool:
+        """Candidate-snapshot variant of :meth:`tear_snapshot` on its own
+        write counter: the K-th candidate write of the tailing trainer
+        lands half-written in the final path with no checksum trailer."""
+        if self.candidate_torn <= 0:
+            return False
+        self._candidate_writes += 1
+        if self._candidate_writes != self.candidate_torn:
+            return False
+        log.warning("fault injection: torn candidate write to %s", path)
         with open(path, "w", encoding="utf-8") as f:
             f.write(data[:max(1, len(data) // 2)])
         return True

@@ -225,16 +225,32 @@ def compose_snapshot(model_text: str, state: Dict[str, Any]) -> str:
 
 def write_training_snapshot(gbdt, output_model: str,
                             early_stop: Optional[Dict] = None,
-                            faults=None) -> str:
+                            faults=None, keep: int = 0,
+                            extra_state: Optional[Dict] = None,
+                            candidate: bool = False) -> str:
     """The one snapshot writer (deduplicates the former copy-pasted
     ``save_model`` calls in engine.py and cli.py, and makes both atomic).
-    Returns the snapshot path."""
+    Returns the snapshot path.
+
+    ``extra_state`` keys are merged into the sidecar (the continuous-
+    learning loop tags candidates with a monotonically increasing
+    ``candidate_epoch`` this way; :func:`restore_state` ignores unknown
+    keys by design). ``candidate=True`` routes the torn-write fault check
+    through the ``candidate_torn`` point instead of ``torn_snapshot``.
+    ``keep > 0`` prunes to the newest ``keep`` snapshots after a
+    successful write (see :func:`prune_snapshots`)."""
     path = snapshot_path(output_model, gbdt.iter_)
     state = capture_state(gbdt, early_stop=early_stop)
+    if extra_state:
+        state.update(extra_state)
     data = compose_snapshot(gbdt.save_model_to_string(), state)
-    if faults is not None and faults.tear_snapshot(path, data):
+    torn = (faults.tear_candidate(path, data) if candidate
+            else faults.tear_snapshot(path, data)) if faults else False
+    if torn:
         return path                      # fault point: torn write simulated
     atomic_write_text(path, data)
+    if keep > 0:
+        prune_snapshots(output_model, keep)
     return path
 
 
@@ -290,3 +306,59 @@ def latest_snapshot(output_model: str
             continue
         return p, model_text, state
     return None
+
+
+def list_snapshots(output_model: str) -> list:
+    """All snapshot paths for ``output_model``, newest iteration first
+    (validity not checked)."""
+    pattern = glob.escape(output_model) + ".snapshot_iter_*"
+    candidates = []
+    for p in glob.glob(pattern):
+        suffix = p.rsplit(".snapshot_iter_", 1)[-1]
+        try:
+            candidates.append((int(suffix), p))
+        except ValueError:
+            continue
+    return [p for _, p in sorted(candidates, reverse=True)]
+
+
+def prune_snapshots(output_model: str, keep: int) -> list:
+    """Delete all but the newest ``keep`` snapshots (``guard_snapshot_keep``)
+    — EXCEPT the newest *valid* one, which survives unconditionally.
+
+    Long-lived continuous training would otherwise grow the snapshot
+    directory without bound. The validity carve-out matters when the
+    newest file by iteration number is torn (crash mid-write with the
+    atomic path bypassed): ``latest_snapshot`` falls back to the newest
+    valid file, so pruning must never remove the file resume will
+    actually use, no matter where it sorts. Deletion is a single
+    ``os.unlink`` per file — atomic, and safe to race with a concurrent
+    ``latest_snapshot`` scan (the reader skips vanished paths as invalid).
+    Returns the removed paths."""
+    if keep <= 0:
+        return []
+    paths = list_snapshots(output_model)
+    if len(paths) <= keep:
+        return []
+    newest_valid = None
+    for p in paths:
+        try:
+            read_snapshot(p)
+        except SnapshotError:
+            continue
+        newest_valid = p
+        break
+    removed = []
+    for p in paths[keep:]:
+        if p == newest_valid:
+            continue
+        try:
+            os.unlink(p)
+        except OSError as e:
+            log.warning("could not prune snapshot %s: %s", p, e)
+            continue
+        removed.append(p)
+    if removed:
+        log.info("pruned %d snapshot(s) (guard_snapshot_keep=%d)",
+                 len(removed), keep)
+    return removed

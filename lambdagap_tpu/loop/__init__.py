@@ -1,0 +1,13 @@
+"""graftloop — continuous learning as a service (docs/continuous-learning.md).
+
+The train→serve loop as one subsystem: a tailing trainer folds fresh
+rows into the binned world and emits epoch-tagged candidate snapshots
+(:mod:`.trainer`); the router shadow-evaluates each candidate on live
+traffic strictly off the reply path (serve/shadow.py); and a promotion
+controller gates the fleet-atomic delta rollout on the shadow window
+(:mod:`.controller`). tools/loop_gate.py SIGKILLs every seam.
+"""
+from .controller import PromotionController, default_make_shadow
+from .trainer import TailingTrainer
+
+__all__ = ["PromotionController", "TailingTrainer", "default_make_shadow"]

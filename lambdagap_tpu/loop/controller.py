@@ -1,0 +1,352 @@
+"""Gated promotion: candidate → shadow window → fleet rollout (or back).
+
+The serving half of graftloop (docs/continuous-learning.md). One
+deterministic state machine, one public :meth:`~PromotionController.tick`
+(the autonomics idiom — a background thread just calls it on a timer):
+
+    idle ──candidate epoch > promoted──▶ shadowing
+    shadowing ──window full, delta ≤ threshold──▶ promoting
+    shadowing ──window full, delta > threshold──▶ idle   (rejected)
+    promoting ──rollout_delta landed──▶ watching
+    promoting ──SwapFailed (fleet rolled back)──▶ idle   (loop_rollback)
+    watching ──window clean──▶ idle  /  ──regression──▶ idle (rollback)
+
+Every transition emits a ``loop_*`` JSONL event through the span
+recorder (schema-valid ``type: "event"`` records; docs/observability.md)
+and each promotion stage runs inside its own span, so a promotion is a
+readable trace. The ``promote_crash_at=stage`` fault point
+(guard/faults.py) injects a crash at any stage; the controller's
+resume-from-where-it-crashed bookkeeping (``_rollout_done``) is exactly
+the recovery a real controller restart needs — in particular a crash
+AFTER the fleet swap but before commit bookkeeping finishes the commit
+on the next tick instead of double-applying the rollout.
+
+Lock discipline (graftlint R9): ``_lock`` guards the state fields and
+counters ONLY. Candidate reads, shadow replica builds, rollout RPCs and
+fleet snapshots all run outside it — ticks snapshot state under the
+lock, actuate outside, then write the transition back.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ..guard.degrade import SwapFailed
+from ..guard.faults import FaultPlan, InjectedFault
+from ..guard.snapshot import latest_snapshot
+from ..obs import trace as obs_trace
+from ..serve.shadow import ShadowMirror
+from ..utils import log
+
+IDLE, SHADOWING, PROMOTING, WATCHING = \
+    "idle", "shadowing", "promoting", "watching"
+
+
+def default_make_shadow(model_text: str):
+    """Build an in-process shadow replica serving ``model_text``."""
+    from ..basic import Booster
+    from ..serve.router import LocalReplica
+    booster = Booster(model_str=model_text)
+    return LocalReplica("shadow", booster.as_server())
+
+
+class PromotionController:
+    """Watches a candidate snapshot family, shadow-evaluates new epochs
+    on live traffic, and promotes through the fleet-atomic delta rollout.
+
+    ``router`` must be the serving :class:`~lambdagap_tpu.serve.router.
+    Router`; ``autonomics`` an :class:`~lambdagap_tpu.serve.autonomics.
+    Autonomics` (its ``rollout_delta`` is the promotion actuator and the
+    rollback path). ``candidate_model`` names the snapshot family the
+    tailing trainer writes (``<candidate_model>.snapshot_iter_N``).
+    ``make_shadow(model_text) -> replica`` overrides how shadow replicas
+    are built (the loop gate spawns subprocesses here).
+    """
+
+    def __init__(self, router, autonomics, candidate_model: str, *,
+                 sample: float = 1.0, min_requests: int = 200,
+                 threshold: float = 1e-3, interval_s: float = 1.0,
+                 base_source=None,
+                 make_shadow: Optional[Callable] = None,
+                 watch_min_requests: Optional[int] = None,
+                 regression_threshold: float = 0.05,
+                 signals=None, faults=None, recorder=None) -> None:
+        self._router = router
+        self._autonomics = autonomics
+        self.candidate_model = candidate_model
+        self.sample = float(sample)
+        self.min_requests = int(min_requests)
+        self.threshold = float(threshold)
+        self.interval_s = max(float(interval_s), 0.05)
+        self._base_source = base_source
+        self._make_shadow = make_shadow if make_shadow is not None \
+            else default_make_shadow
+        self.watch_min_requests = int(watch_min_requests
+                                      if watch_min_requests is not None
+                                      else min_requests)
+        self.regression_threshold = float(regression_threshold)
+        self._signals = signals
+        self._faults = faults if faults is not None else FaultPlan("")
+        self._recorder = recorder if recorder is not None \
+            else obs_trace.RECORDER
+        self._lock = threading.Lock()    # state fields + counters ONLY
+        self._state = IDLE
+        self._cand_epoch = 0
+        self._cand_text: Optional[str] = None
+        self.promoted_epoch = 0
+        self._failed_epochs: set = set()
+        self._rollout_done = False
+        self._watch_base: Optional[Dict] = None
+        self.counters = {"candidates_seen": 0, "promotions": 0,
+                         "rejections": 0, "rollbacks": 0,
+                         "shadow_restarts": 0, "promote_crashes": 0}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._closed = False
+        # self-adopt: router.loop_status()/snapshot() answer from this
+        # controller, router.close() stops it
+        router.attach_loop(self)
+
+    # -- the tick --------------------------------------------------------
+    def tick(self) -> None:
+        """One deterministic pass of the state machine (public: tests and
+        the gate drive it directly; :meth:`start` drives it on a timer)."""
+        with self._lock:
+            if self._closed:
+                return
+            state = self._state
+        if state == IDLE:
+            self._tick_idle()
+        elif state == SHADOWING:
+            self._tick_shadowing()
+        elif state == PROMOTING:
+            self._tick_promoting()
+        elif state == WATCHING:
+            self._tick_watching()
+
+    def _tick_idle(self) -> None:
+        found = latest_snapshot(self.candidate_model)
+        if found is None:
+            return
+        path, text, state = found
+        epoch = int(state.get("candidate_epoch", 0))
+        with self._lock:
+            stale = (epoch <= self.promoted_epoch
+                     or epoch in self._failed_epochs)
+        if stale:
+            return
+        self._event("loop_candidate", epoch=epoch, path=path,
+                    iteration=int(state.get("iteration", 0)))
+        try:
+            replica = self._make_shadow(text)   # build/compile: no lock
+        except Exception as e:
+            log.warning("loop: shadow replica build for epoch %d failed: "
+                        "%s", epoch, e)
+            with self._lock:
+                self._failed_epochs.add(epoch)
+            self._event("loop_shadow_build_failed", epoch=epoch,
+                        error=str(e))
+            return
+        mirror = ShadowMirror(replica, sample=self.sample,
+                              faults=self._faults, seed=epoch)
+        self._router.arm_shadow(mirror)
+        with self._lock:
+            self.counters["candidates_seen"] += 1
+            self._cand_epoch, self._cand_text = epoch, text
+            self._state = SHADOWING
+        self._event("loop_shadow_start", epoch=epoch, sample=self.sample,
+                    min_requests=self.min_requests)
+
+    def _tick_shadowing(self) -> None:
+        snap = self._router.shadow_snapshot()
+        with self._lock:
+            epoch, text = self._cand_epoch, self._cand_text
+        if snap is None:                 # disarmed out from under us
+            with self._lock:
+                self._state = IDLE
+            return
+        if self._signals is not None:
+            self._signals.note_shadow(snap)
+        if snap["dead"]:
+            # shadow death sheds silently on the live path; here the
+            # window restarts on a fresh replica (counted, evented)
+            try:
+                replica = self._make_shadow(text)
+            except Exception as e:
+                log.warning("loop: shadow restart failed (%s); retrying "
+                            "next tick", e)
+                return
+            mirror = ShadowMirror(replica, sample=self.sample,
+                                  faults=self._faults, seed=epoch)
+            self._router.arm_shadow(mirror)   # closes the dead mirror
+            with self._lock:
+                self.counters["shadow_restarts"] += 1
+            self._event("loop_shadow_restart", epoch=epoch)
+            return
+        if snap["compared"] < self.min_requests:
+            return                       # window still filling
+        delta = float(snap["delta"].get("mean", 0.0))
+        if delta <= self.threshold:
+            self._event("loop_shadow_window", epoch=epoch,
+                        decision="promote", compared=snap["compared"],
+                        delta_mean=delta, threshold=self.threshold)
+            with self._lock:
+                self._state = PROMOTING
+        else:
+            self._event("loop_shadow_window", epoch=epoch,
+                        decision="reject", compared=snap["compared"],
+                        delta_mean=delta, threshold=self.threshold)
+            self._router.disarm_shadow()
+            with self._lock:
+                self.counters["rejections"] += 1
+                self._failed_epochs.add(epoch)
+                self._state = IDLE
+
+    def _tick_promoting(self) -> None:
+        with self._lock:
+            epoch, text = self._cand_epoch, self._cand_text
+            rollout_done = self._rollout_done
+        ctx = obs_trace.start_trace()    # promotions are rare: always trace
+        try:
+            if not rollout_done:
+                with self._recorder.span("loop_promote:resolve", ctx,
+                                         epoch=epoch):
+                    self._faults.promote_crash("resolve")
+                    base = self._base_source
+                with self._recorder.span("loop_promote:rollout", ctx,
+                                         epoch=epoch):
+                    self._faults.promote_crash("rollout")
+                    result = self._autonomics.rollout_delta(
+                        text, base_source=base)
+                with self._lock:
+                    self._rollout_done = True
+                self._event("loop_rollout", epoch=epoch,
+                            mode=result["mode"],
+                            replicas=len(result["replicas"]),
+                            delta_bytes=result.get("delta_bytes", 0),
+                            full_bytes=result["full_bytes"])
+            with self._recorder.span("loop_promote:commit", ctx,
+                                     epoch=epoch):
+                self._faults.promote_crash("commit")
+                self._router.disarm_shadow()
+                watch_base = self._fleet_counters()
+                with self._lock:
+                    self.promoted_epoch = epoch
+                    self._rollout_done = False
+                    self._watch_base = watch_base
+                    self.counters["promotions"] += 1
+                    self._state = WATCHING
+            self._event("loop_promote", epoch=epoch)
+        except InjectedFault as e:
+            # simulated controller crash mid-promote: state survives, the
+            # next tick resumes exactly where this one died (a completed
+            # rollout is NOT re-applied)
+            with self._lock:
+                self.counters["promote_crashes"] += 1
+            self._event("loop_promote_crash", epoch=epoch, error=str(e))
+        except SwapFailed as e:
+            # rollout_delta already swapped every committed replica back:
+            # the fleet is uniformly on base — record, reject the epoch
+            self._event("loop_rollback", epoch=epoch,
+                        reason="rollout_failed", error=str(e))
+            self._router.disarm_shadow()
+            with self._lock:
+                self.counters["rollbacks"] += 1
+                self._failed_epochs.add(epoch)
+                self._rollout_done = False
+                self._state = IDLE
+
+    def _tick_watching(self) -> None:
+        with self._lock:
+            epoch = self._cand_epoch
+            base = self._watch_base
+        cur = self._fleet_counters()
+        requests = cur["routed"] - base["routed"]
+        if requests < self.watch_min_requests:
+            return                       # window still filling
+        bad = cur["bad"] - base["bad"]
+        frac = bad / max(requests, 1)
+        if frac > self.regression_threshold:
+            self._rollback_post_promote(epoch, frac)
+        else:
+            self._event("loop_watch_clear", epoch=epoch,
+                        requests=requests, bad_fraction=round(frac, 6))
+            with self._lock:
+                self._state = IDLE
+
+    def _rollback_post_promote(self, epoch: int, frac: float) -> None:
+        """Post-promote regression: swap the fleet back to the pre-promote
+        base (full-swap mode of the same fleet-atomic rollout protocol)."""
+        base = self._base_source
+        if base is None:
+            log.warning("loop: regression after epoch %d but no "
+                        "base_source to roll back to", epoch)
+        else:
+            try:
+                self._autonomics.rollout_delta(base)
+            except SwapFailed as e:
+                log.warning("loop: post-promote rollback rollout failed: "
+                            "%s", e)
+        self._event("loop_rollback", epoch=epoch, reason="regression",
+                    bad_fraction=round(frac, 6))
+        with self._lock:
+            self.counters["rollbacks"] += 1
+            self._failed_epochs.add(epoch)
+            self.promoted_epoch = max(0, epoch - 1)
+            self._state = IDLE
+
+    def _fleet_counters(self) -> Dict[str, int]:
+        """Routed/bad request totals from the router snapshot (cheap:
+        counters only, no per-replica stats RPCs)."""
+        snap = self._router.snapshot()
+        routed = sum(int(info["routed"])
+                     for info in snap["replicas"].values())
+        bad = (int(snap["failovers"])
+               + int(snap["rejected_no_replica"]))
+        return {"routed": routed, "bad": bad}
+
+    def _event(self, event: str, **fields) -> None:
+        self._recorder.event(event, **fields)
+
+    # -- reporting / lifecycle ------------------------------------------
+    def status(self) -> Dict:
+        """The state machine's position — the ``loop_status`` wire answer
+        and the router snapshot's ``loop`` block."""
+        with self._lock:
+            out = {"state": self._state,
+                   "candidate_epoch": int(self._cand_epoch),
+                   "promoted_epoch": int(self.promoted_epoch),
+                   "counters": dict(self.counters)}
+        shadow = self._router.shadow_snapshot()
+        if shadow is not None:
+            out["shadow"] = shadow
+        return out
+
+    def start(self) -> "PromotionController":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="lambdagap-loop")
+        self._thread.start()
+        log.info("promotion controller up: every %.2fs (sample %.2f, "
+                 "window %d, threshold %g)", self.interval_s, self.sample,
+                 self.min_requests, self.threshold)
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:       # pragma: no cover
+                log.warning("loop tick failed: %s", e)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

@@ -1,0 +1,129 @@
+"""The tailing trainer: fold fresh rows in, emit tagged candidates.
+
+One long-lived process (``task=loop_train``) alternates between pulling
+new row batches from a :class:`~lambdagap_tpu.data.tail.SequenceTail`
+and continuing training over everything seen so far:
+
+- **no global rebinning**: the first fold bins the world through
+  ``BinnedDataset.from_sequences`` (per-sequence quantile sketches,
+  merged psum-style); every later fold passes that first dataset as
+  ``reference=`` so new rows adopt the existing bin mappers.
+- **crash-anywhere resume**: each fold calls ``engine.train`` with
+  ``resume="auto"``, so a SIGKILLed trainer restarts from the latest
+  VALID candidate snapshot — a torn candidate (crash mid-write, or the
+  ``candidate_torn`` fault point) is rejected by its checksum and the
+  next-older one is used; tools/loop_gate.py proves the resumed trees
+  extend the last valid candidate byte-identically.
+- **tagged candidates**: after each fold the trainer writes one
+  candidate through the atomic tmp+fsync+rename snapshot path, with a
+  monotonically increasing ``candidate_epoch`` in the sidecar (the
+  promotion controller keys on it) and ``guard_snapshot_keep``
+  retention pruning.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import engine
+from ..basic import Dataset
+from ..data.tail import ArraySequence, SequenceTail
+from ..guard.faults import FaultPlan
+from ..guard.snapshot import latest_snapshot, write_training_snapshot
+from ..obs import trace as obs_trace
+from ..utils import log
+
+
+class TailingTrainer:
+    """Continuous training over a tailed batch directory.
+
+    ``params`` is a standard train-params dict; ``output_model`` names
+    the candidate snapshot family (``<output_model>.snapshot_iter_N``).
+    Single-threaded by design — drive it with :meth:`fold_once` or
+    :meth:`run`.
+    """
+
+    def __init__(self, params: Dict, tail: SequenceTail, output_model: str,
+                 iters_per_fold: int = 5, keep: int = 0,
+                 faults: Optional[FaultPlan] = None,
+                 recorder=None) -> None:
+        self.params = dict(params)
+        self.params["output_model"] = output_model
+        # the per-fold candidate IS the snapshot; the in-loop periodic
+        # writer would double-write untagged files between folds
+        self.params["snapshot_freq"] = -1
+        self.params.pop("resume", None)
+        self.params.pop("save_period", None)
+        self.tail = tail
+        self.output_model = output_model
+        self.iters_per_fold = int(iters_per_fold)
+        self.keep = int(keep)
+        self.faults = faults if faults is not None else FaultPlan("")
+        self.recorder = recorder if recorder is not None \
+            else obs_trace.RECORDER
+        self.epoch = 0                   # last emitted candidate epoch
+        self.total_iters = 0
+        found = latest_snapshot(output_model)
+        if found is not None:
+            path, _text, state = found
+            self.epoch = int(state.get("candidate_epoch", 0))
+            self.total_iters = int(state.get("iteration", 0))
+            log.info("tailing trainer resuming after candidate epoch %d "
+                     "(%d iterations, %s)", self.epoch, self.total_iters,
+                     path)
+        self._batches: list = []
+        self._ref: Optional[Dataset] = None
+        self._trained_once = False
+
+    def fold_once(self) -> Optional[Dict]:
+        """Poll the tail, fold any new rows in, train ``iters_per_fold``
+        more iterations, and emit one tagged candidate. Returns the
+        candidate record, or None when there is nothing to do — no data
+        at all, or no NEW data since the last fold (the first fold after
+        construction always runs if any rows exist, so a restarted
+        trainer immediately continues from its resumed snapshot)."""
+        new = self.tail.poll()
+        self._batches.extend(new)
+        if not self._batches or (not new and self._trained_once):
+            return None
+        label = np.concatenate([b[:, 0] for b in self._batches])
+        seqs = [ArraySequence(b[:, 1:]) for b in self._batches]
+        ds = Dataset(seqs, label=label, reference=self._ref,
+                     params=self.params, free_raw_data=False)
+        target = self.total_iters + self.iters_per_fold
+        self.params["num_iterations"] = target
+        booster = engine.train(self.params, ds, num_boost_round=target,
+                               resume="auto")
+        self.total_iters = int(booster._booster.iter_)
+        self.epoch += 1
+        path = write_training_snapshot(
+            booster._booster, self.output_model, faults=self.faults,
+            keep=self.keep, candidate=True,
+            extra_state={"candidate_epoch": self.epoch})
+        self._trained_once = True
+        if self._ref is None:
+            self._ref = ds               # bin mappers for every later fold
+        rec = {"epoch": self.epoch, "iteration": self.total_iters,
+               "path": path, "rows": int(label.shape[0]),
+               "new_batches": len(new)}
+        self.recorder.event("loop_candidate_written", **rec)
+        log.info("candidate epoch %d written at iteration %d (%d rows)",
+                 self.epoch, self.total_iters, rec["rows"])
+        return rec
+
+    def run(self, interval_s: float = 1.0, max_epochs: int = 0,
+            stop=None) -> int:
+        """Fold until ``max_epochs`` candidates were emitted (0 = forever)
+        or ``stop`` (a threading.Event) is set; idle polls sleep
+        ``interval_s``. Returns the number of candidates emitted."""
+        emitted = 0
+        while (max_epochs <= 0 or emitted < max_epochs) \
+                and not (stop is not None and stop.is_set()):
+            rec = self.fold_once()
+            if rec is None:
+                time.sleep(interval_s)
+                continue
+            emitted += 1
+        return emitted

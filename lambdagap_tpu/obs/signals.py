@@ -134,7 +134,16 @@ class SignalPlane:
         self._lock = threading.Lock()
         self._prev: Optional[Dict] = None
         self._latest: Optional[Dict] = None
+        self._shadow: Optional[Dict] = None
         self.ticks = 0
+
+    def note_shadow(self, shadow: Optional[Dict]) -> None:
+        """The promotion controller's shadow-delta window joins the signal
+        stream: subsequent ticks carry it as the OPTIONAL ``shadow`` block
+        (absent unless a shadow is armed — the schema stays backward-
+        compatible). Pass None to clear it."""
+        with self._lock:
+            self._shadow = dict(shadow) if shadow is not None else None
 
     # -- folding ---------------------------------------------------------
     @staticmethod
@@ -184,6 +193,8 @@ class SignalPlane:
             "health": self.health.snapshot(),
         }
         with self._lock:
+            if self._shadow is not None:
+                signals["shadow"] = self._shadow
             self._latest = signals
             self.ticks += 1
         if self._recorder is not None:
@@ -294,4 +305,12 @@ def validate_signals(obj: Any) -> List[str]:
             or not isinstance(health.get("current"), dict):
         errs.append("health block needs 'current' map + 'transitions' "
                     "list")
+    shadow = obj.get("shadow")
+    if shadow is not None:                # OPTIONAL: only while armed
+        if not isinstance(shadow, dict):
+            errs.append("shadow block must be an object")
+        else:
+            for key in ("sample", "dead", "mirrored", "compared", "shed"):
+                if key not in shadow:
+                    errs.append(f"shadow.{key} missing")
     return errs

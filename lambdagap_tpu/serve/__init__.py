@@ -38,6 +38,7 @@ from .registry import DEFAULT_MODEL, ModelEntry, ModelRegistry
 from .router import LocalReplica, RemoteReplica, Router
 from .server import (ForestServer, ServeResult, parse_tenant_weights,
                      serve_loop)
+from .shadow import ShadowMirror
 from .stats import ServeStats
 from .swap import SwapController, load_booster
 
@@ -51,4 +52,5 @@ __all__ = ["ForestServer", "ServeResult", "serve_loop", "MicroBatcher",
            "SwapRejected", "ReplicaUnavailable", "FleetScraper",
            "fleet_snapshot", "merge_snapshots", "SignalPlane",
            "Autonomics", "default_revive", "DeltaMismatch", "make_delta",
-           "apply_delta", "plan_placement", "plan_from_fleet"]
+           "apply_delta", "plan_placement", "plan_from_fleet",
+           "ShadowMirror"]

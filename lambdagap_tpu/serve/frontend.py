@@ -15,6 +15,8 @@ JSON — one object per line, matching the ``task=serve`` loop verbs:
                                            "model": "m"}
     {"op": "artifact", "id": 10, "payload": "<b64>", "expect_hash": "..."}
     {"op": "artifact_get", "id": 11, "model": "m"}
+    {"op": "shadow_on", "id": 12, "source": "cand.txt", "sample": 0.1}
+    {"op": "loop_status", "id": 13}
 
 The optional ``trace`` field carries the distributed-tracing context
 (obs/trace.py): the server records frontend/serve/dispatch child spans
@@ -278,6 +280,20 @@ class _Conn:
         self.send({"id": req_id, "ok": True,
                    "models": self.frontend.target.models()})
 
+    def _op_shadow_on(self, req_id, frame) -> None:
+        # arm (or with sample<=0 disarm) shadow mirroring on the fronted
+        # router (docs/continuous-learning.md). Strictly off the reply
+        # path: live answers are bit-identical with shadow armed.
+        info = self.frontend.target.shadow_on(
+            frame.get("source"), sample=float(frame.get("sample", 1.0)))
+        self.send({"id": req_id, "ok": True, "shadow": info})
+
+    def _op_loop_status(self, req_id, frame) -> None:
+        # promotion state machine position (loop/controller.py): state,
+        # candidate/promoted epochs, counters, live shadow window
+        self.send({"id": req_id, "ok": True,
+                   "status": self.frontend.target.loop_status()})
+
 
 class ServeFrontend:
     """TCP front end for one serve target (a ForestServer — or anything
@@ -330,7 +346,7 @@ class ServeFrontend:
         log.info("serve frontend listening on %s:%d (newline-JSON "
                  "protocol; ops: predict/swap/swap_delta/prefetch/"
                  "artifact/artifact_get/stats/prometheus/signals/health/"
-                 "models)", self.host, self._port)
+                 "models/shadow_on/loop_status)", self.host, self._port)
         return self
 
     def _accept_loop(self) -> None:
@@ -582,6 +598,20 @@ class FrontendClient:
 
     def models(self, timeout: Optional[float] = 30.0) -> list:
         return self._call("models", timeout=timeout)["models"]
+
+    def shadow_on(self, source, sample: float = 1.0,
+                  timeout: Optional[float] = 120.0) -> dict:
+        """Arm shadow mirroring of a candidate model on the remote
+        router (``sample<=0`` disarms and returns the final window).
+        Shadow traffic never touches live answers — see
+        docs/continuous-learning.md."""
+        return self._call("shadow_on", timeout=timeout, source=source,
+                          sample=sample)["shadow"]
+
+    def loop_status(self, timeout: Optional[float] = 30.0) -> dict:
+        """Where the continuous-learning state machine is: state,
+        candidate/promoted epochs, counters, live shadow window."""
+        return self._call("loop_status", timeout=timeout)["status"]
 
     def close(self) -> None:
         self._die(ReplicaUnavailable("frontend client closed"))

@@ -180,6 +180,8 @@ class Router:
         self._closed = False
         self._scraper = None             # obs.fleet.FleetScraper, attached
         self._autonomics = None          # serve.autonomics.Autonomics
+        self._shadow = None              # serve.shadow.ShadowMirror, armed
+        self._loop = None                # loop.controller.PromotionController
 
     # -- dispatch -------------------------------------------------------
     def submit(self, x, model: Optional[str] = None,
@@ -214,6 +216,12 @@ class Router:
                           trace=hop, route_state=route_state)
         else:
             self._attempt(outer, x, model, tenant, tried=set())
+        # shadow mirroring rides AFTER the live dispatch is in flight and
+        # owns no stake in ``outer``: a coin flip + worker handoff, so a
+        # dead/slow shadow cannot move a live answer (serve/shadow.py)
+        mirror = self._shadow
+        if mirror is not None:
+            mirror.maybe_mirror(x, model, tenant, outer, ctx)
         return outer
 
     def predict(self, x, timeout: Optional[float] = None,
@@ -656,6 +664,68 @@ class Router:
         behavior)."""
         self._autonomics = controller
 
+    def attach_loop(self, controller) -> None:
+        """Adopt a running :class:`~lambdagap_tpu.loop.controller.
+        PromotionController`: ``close`` stops it, :meth:`loop_status`
+        answers from it, and the ``loop`` block joins :meth:`snapshot`
+        (only then — same knob-off byte-identity rule as autonomics)."""
+        self._loop = controller
+
+    def arm_shadow(self, mirror) -> None:
+        """Install a built :class:`~lambdagap_tpu.serve.shadow.
+        ShadowMirror` (construct it — replica spawn, warmup — OUTSIDE any
+        lock; this is only the pointer flip). An already-armed mirror is
+        disarmed first."""
+        with self._lock:
+            old, self._shadow = self._shadow, mirror
+        if old is not None:
+            old.close()
+
+    def disarm_shadow(self) -> Optional[dict]:
+        """Stop mirroring; returns the final shadow window snapshot (or
+        None when nothing was armed)."""
+        with self._lock:
+            mirror, self._shadow = self._shadow, None
+        if mirror is None:
+            return None
+        final = mirror.snapshot()
+        mirror.close()
+        return final
+
+    def shadow_snapshot(self) -> Optional[dict]:
+        """The armed shadow window's counters/deltas, or None."""
+        mirror = self._shadow
+        return mirror.snapshot() if mirror is not None else None
+
+    def shadow_on(self, source, sample: float = 1.0) -> dict:
+        """Operator entry point (wire op ``shadow_on``): build a shadow
+        replica from a model ``source`` (path or model text) and arm it
+        at ``sample``; ``sample<=0`` disarms instead and returns the
+        final window. The replica build runs before the pointer flip, so
+        the reply path never waits on it."""
+        if sample <= 0.0:
+            final = self.disarm_shadow()
+            return {"armed": False, "final": final}
+        from ..loop.controller import default_make_shadow
+        from .shadow import ShadowMirror
+        text = source
+        if isinstance(source, str) and "\n" not in source:
+            with open(source, "r") as f:
+                text = f.read()
+        mirror = ShadowMirror(default_make_shadow(text),
+                              sample=float(sample))
+        self.arm_shadow(mirror)
+        return {"armed": True, "sample": float(sample)}
+
+    def loop_status(self) -> dict:
+        """The promotion state machine's position (docs/continuous-
+        learning.md) — ``{"state": "off"}`` when no controller is
+        attached."""
+        loop = self._loop
+        if loop is None:
+            return {"state": "off"}
+        return loop.status()
+
     def signals(self) -> dict:
         """The current control-signal tick (obs/signals.py). Requires an
         attached scraper with a signal plane — the CLI wires one when
@@ -698,8 +768,16 @@ class Router:
                                     for m, names in
                                     sorted(self._placement.items())}
             autonomics = self._autonomics
+            shadow = self._shadow
+            loop = self._loop
         if autonomics is not None:
             out["autonomics"] = autonomics.snapshot()
+        # shadow/loop keys appear ONLY while armed/attached — same
+        # knob-off byte-identity contract as the autonomics block
+        if shadow is not None:
+            out["shadow"] = shadow.snapshot()
+        if loop is not None:
+            out["loop"] = loop.status()
         for r in self._replicas:         # health probes outside the lock
             try:
                 replicas[r.name]["health"] = (
@@ -711,6 +789,9 @@ class Router:
 
     def close(self) -> None:
         self._closed = True
+        if self._loop is not None:
+            self._loop.close()
+        self.disarm_shadow()
         if self._autonomics is not None:
             self._autonomics.close()
         if self._scraper is not None:

@@ -1,0 +1,154 @@
+"""Shadow evaluation: mirror live traffic to a candidate, off the reply path.
+
+The promotion half of graftloop (docs/continuous-learning.md) needs to
+know how a candidate model would answer REAL traffic before any client
+sees it. :class:`ShadowMirror` rides the router's submit path: a sampled
+slice of requests (``serve_shadow_sample``, same coin-flip shape as the
+trace sampler) is handed to the mirror's own worker pool, re-scored on
+the shadow replica, and compared against the live answer — per-request
+absolute prediction deltas accumulate in a :class:`Reservoir` window the
+promotion controller reads.
+
+The contract that makes shadowing safe to arm in production:
+
+- **never on the reply path**: the live future is returned to the caller
+  before the mirror sees the request; comparison waits on it from the
+  mirror's worker thread. A shadow replica that is slow, overloaded, or
+  dead cannot move a live answer by a single byte (tests/test_shadow.py
+  asserts bit-identity with the shadow hard-down).
+- **overload sheds silently and is counted**: a full mirror queue drops
+  the request (``shed``), a dead shadow marks the window ``dead`` —
+  nothing propagates, the counters tell the story.
+- **the mirror cost is measurable**: each comparison lands a
+  ``shadow_predict`` span parented into the request's trace tree, so the
+  trace plane attributes exactly what shadowing costs.
+
+Lock discipline (graftlint R9): ``_lock`` guards counters, the sampler
+RNG, and the pending gauge only — dispatch, result waits, and comparison
+all happen on the worker pool, never under the lock.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import numpy as np
+
+from ..guard.degrade import ReplicaUnavailable
+from ..guard.faults import FaultPlan
+from ..obs import trace as obs_trace
+from ..obs.reservoir import Reservoir
+from ..utils import log
+
+# transport-shaped failures that mark the shadow replica dead (the same
+# indictment set the router uses for live replicas)
+_DEAD_MARKING = (ReplicaUnavailable, ConnectionError, OSError)
+
+
+class ShadowMirror:
+    """One armed shadow window over one candidate replica."""
+
+    def __init__(self, replica, sample: float = 1.0, faults=None,
+                 seed: int = 0, max_pending: int = 64,
+                 wait_s: float = 10.0, own_replica: bool = True) -> None:
+        self.replica = replica
+        self.sample = float(sample)
+        self._faults = faults if faults is not None else FaultPlan("")
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._max_pending = int(max_pending)
+        self._wait_s = float(wait_s)
+        self._own = own_replica
+        self._closed = False
+        self.dead = False
+        self.deltas = Reservoir()
+        self.counters = {"mirrored": 0, "compared": 0, "shed": 0,
+                         "errors": 0}
+        self._pool = ThreadPoolExecutor(max_workers=2,
+                                        thread_name_prefix="shadow")
+
+    # -- submit-path hook (must stay cheap: coin flip + handoff) --------
+    def maybe_mirror(self, x, model, tenant, live_future, ctx) -> None:
+        """Called by the router AFTER the live dispatch is in flight; the
+        live future is already owned by the caller, so nothing here can
+        delay or change the answer."""
+        if self._closed:
+            return
+        with self._lock:
+            if self.dead:
+                self.counters["shed"] += 1
+                return
+            if self.sample < 1.0 and self._rng.random() >= self.sample:
+                return
+            if self._pending >= self._max_pending:
+                self.counters["shed"] += 1   # overload sheds silently
+                return
+            self._pending += 1
+            self.counters["mirrored"] += 1
+        try:
+            self._pool.submit(self._mirror_one, x, model, tenant,
+                              live_future, ctx)
+        except RuntimeError:                 # pool shut down mid-handoff
+            with self._lock:
+                self._pending -= 1
+                self.counters["shed"] += 1
+
+    # -- worker side ----------------------------------------------------
+    def _mirror_one(self, x, model, tenant, live_future, ctx) -> None:
+        t0_wall, t0 = time.time(), time.perf_counter()
+        outcome, delta = "compared", None
+        try:
+            self._faults.shadow_fault()
+            sx = np.array(x, copy=True)      # caller may reuse its buffer
+            sf = self.replica.submit(sx, model=model, tenant=tenant)
+            shadow_vals = np.asarray(sf.result(self._wait_s).values)
+            live_vals = np.asarray(live_future.result(self._wait_s).values)
+            delta = float(np.max(np.abs(shadow_vals - live_vals)))
+            with self._lock:
+                self.counters["compared"] += 1
+                self.deltas.add(delta)
+        except Exception as e:               # NOTHING escapes the mirror
+            outcome = "shed"
+            with self._lock:
+                self.counters["shed"] += 1
+                self.counters["errors"] += 1
+                if isinstance(e, _DEAD_MARKING):
+                    self.dead = True
+            if isinstance(e, _DEAD_MARKING):
+                log.warning("shadow replica down; window marked dead (%s)",
+                            e)
+        finally:
+            with self._lock:
+                self._pending -= 1
+        if ctx is not None:
+            hop = ctx.child()
+            obs_trace.RECORDER.record(
+                "shadow_predict", ctx, t0_wall, time.perf_counter() - t0,
+                span_id=hop.span_id, outcome=outcome, delta=delta)
+
+    # -- control/observability ------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            snap = {"sample": self.sample, "dead": bool(self.dead),
+                    "pending": int(self._pending)}
+            snap.update({k: int(v) for k, v in self.counters.items()})
+            # the delta reservoir is guarded by the same lock as the
+            # counters (pure in-memory sort, no blocking work)
+            snap["delta"] = (self.deltas.percentiles()
+                             if self.counters["compared"] else {})
+        return snap
+
+    def close(self) -> None:
+        self._closed = True
+        # never block a disarm on a wedged shadow RPC: drop queued work,
+        # let in-flight worker calls finish on their own bounded waits
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._own:
+            try:
+                self.replica.close()
+            except Exception as e:
+                log.warning("closing shadow replica failed: %s", e)

@@ -1,0 +1,42 @@
+"""R9 fixture (ISSUE 20): the promotion-controller hazard class. The
+shadow window lives on the other side of an RPC — fetching it is a
+blocking ``Future.result`` wait, and it sits one *resolved call* away
+from the tick: R5's lexical scan of the with-body sees only an innocent
+method call, but the semantic index resolves ``_shadow_metrics`` to the
+blocking wait and R9 flags holding the controller lock across it. The
+clean shape at the bottom is what the real ``loop/controller.py`` does:
+snapshot state under the lock, fetch and decide outside it, write the
+transition back."""
+import threading
+
+
+class LockedPromoter:
+    def __init__(self, shadow_client):
+        self._shadow = shadow_client
+        self._mu = threading.Lock()      # identity-resolved, name-opaque
+        self._state = "idle"
+
+    def _shadow_metrics(self):
+        # the blocking window fetch lives one resolved call away: the
+        # shadow replica answers over a socket, seconds away when it is
+        # overloaded — and shadow overload must NEVER convoy the tick
+        return self._shadow.window_future.result(30.0)
+
+    def tick_locked(self):
+        with self._mu:
+            window = self._shadow_metrics()  # BAD:R9
+            if window["compared"] >= 200:
+                self._state = "promoting"
+        return self._state
+
+    # -- the clean shape (the real controller's discipline) ------------
+    def tick(self):
+        with self._mu:
+            state = self._state
+        if state != "shadowing":
+            return state
+        window = self._shadow_metrics()  # no lock held: sheds, not convoys
+        with self._mu:
+            if window["compared"] >= 200:
+                self._state = "promoting"
+            return self._state

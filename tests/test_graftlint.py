@@ -82,6 +82,7 @@ def fixture_findings():
     "serve/r9_deep.py",
     "serve/r9_scrape.py",
     "serve/r9_autonomics.py",
+    "serve/r9_loop.py",
     "obs/trace.py",
     "parallel/r10_rogue_specs.py",
     "r11_drift/config.py",

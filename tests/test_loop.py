@@ -1,0 +1,371 @@
+"""Continuous learning (ISSUE 20): snapshot retention, the tailing
+trainer, and the promotion controller's state machine — promote, reject,
+rollback, and the mid-promote crash window, every fault point proven
+live. The end-to-end version under real subprocesses and SIGKILL lives
+in tools/loop_gate.py.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.data.tail import SequenceTail, write_batch
+from lambdagap_tpu.guard.faults import FaultPlan, InjectedFault
+from lambdagap_tpu.guard.snapshot import (STATE_VERSION, SnapshotError,
+                                          compose_snapshot, latest_snapshot,
+                                          list_snapshots, prune_snapshots,
+                                          read_snapshot, snapshot_path,
+                                          write_training_snapshot)
+from lambdagap_tpu.loop import PromotionController, TailingTrainer
+from lambdagap_tpu.obs import events as obs_events
+from lambdagap_tpu.obs import trace as obs_trace
+from lambdagap_tpu.serve import Autonomics, LocalReplica, Router
+from lambdagap_tpu.serve.delta import split_model_text
+
+PARAMS = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbose": -1, "tpu_fast_predict_rows": 0}
+
+
+def _fake_snapshot(family: str, iteration: int, epoch: int,
+                   torn: bool = False) -> str:
+    """A schema-valid (or deliberately torn) snapshot file without the
+    cost of training — retention logic only reads the sidecar."""
+    state = {"version": STATE_VERSION, "iteration": iteration,
+             "candidate_epoch": epoch}
+    data = compose_snapshot(f"tree\n(fake model {iteration})\n", state)
+    if torn:
+        data = data[: len(data) // 2]
+    path = snapshot_path(family, iteration)
+    with open(path, "w") as f:
+        f.write(data)
+    return path
+
+
+def _train_base(rounds: int = 4, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(400, 5).astype(np.float32)
+    y = (X[:, 0] * 2.0 - X[:, 1]).astype(np.float32)
+    b = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return b, X
+
+
+def _continue_from(base_path: str, rounds: int, seed: int = 1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(400, 5).astype(np.float32)
+    y = (X[:, 0] * 2.0 - X[:, 1]).astype(np.float32)
+    return lgb.train(PARAMS, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds, init_model=base_path)
+
+
+# ---------------------------------------------------------------------------
+# retention (guard_snapshot_keep)
+# ---------------------------------------------------------------------------
+def test_prune_keeps_newest_k(tmp_path):
+    fam = str(tmp_path / "m.txt")
+    for i in range(1, 6):
+        _fake_snapshot(fam, i * 3, i)
+    removed = prune_snapshots(fam, keep=2)
+    assert len(removed) == 3
+    assert list_snapshots(fam) == [snapshot_path(fam, 15),
+                                   snapshot_path(fam, 12)]
+    # idempotent once at the floor
+    assert prune_snapshots(fam, keep=2) == []
+
+
+def test_prune_never_deletes_newest_valid_under_torn_head(tmp_path):
+    """The file resume will actually use must survive any keep value:
+    with the newest-by-iteration snapshot torn, latest_snapshot falls
+    back to the newest VALID one — pruning to keep=1 must keep THAT file
+    (plus the newest by sort), not strand resume on a corrupt head."""
+    fam = str(tmp_path / "m.txt")
+    _fake_snapshot(fam, 3, 1)
+    good = _fake_snapshot(fam, 6, 2)
+    torn = _fake_snapshot(fam, 9, 3, torn=True)
+    prune_snapshots(fam, keep=1)
+    left = list_snapshots(fam)
+    assert good in left and torn in left
+    assert snapshot_path(fam, 3) not in left
+    path, _text, state = latest_snapshot(fam)
+    assert path == good and state["candidate_epoch"] == 2
+
+
+def test_candidate_torn_fault_point_is_live(tmp_path):
+    """`candidate_torn=K` tears the K-th CANDIDATE write on its own
+    counter: the torn file fails read_snapshot, latest_snapshot skips
+    it, and the plain (non-candidate) snapshot path is untouched."""
+    fam = str(tmp_path / "cand.txt")
+    base, _X = _train_base(rounds=4)
+    faults = FaultPlan("candidate_torn=1")
+    p1 = write_training_snapshot(base._booster, fam, faults=faults,
+                                 candidate=True,
+                                 extra_state={"candidate_epoch": 1})
+    with pytest.raises(SnapshotError):
+        read_snapshot(p1)
+    assert latest_snapshot(fam) is None
+    # the fault is one-shot: the next candidate write lands valid
+    p2 = write_training_snapshot(base._booster, fam, faults=faults,
+                                 candidate=True,
+                                 extra_state={"candidate_epoch": 2})
+    assert p2 == p1                      # same iteration, now valid
+    assert latest_snapshot(fam)[2]["candidate_epoch"] == 2
+
+
+def test_write_training_snapshot_applies_keep(tmp_path):
+    fam = str(tmp_path / "m.txt")
+    for i in range(1, 4):
+        _fake_snapshot(fam, i, i)
+    base, _X = _train_base(rounds=4)      # iter_ = 4, the newest
+    write_training_snapshot(base._booster, fam, keep=2)
+    assert list_snapshots(fam) == [snapshot_path(fam, 4),
+                                   snapshot_path(fam, 3)]
+
+
+# ---------------------------------------------------------------------------
+# the tailing trainer
+# ---------------------------------------------------------------------------
+def _write_fold(dirpath, name, rows=150, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(rows, 4)
+    y = X[:, 0] * 1.5 + 0.05 * rng.randn(rows)
+    write_batch(str(dirpath), name, X, y)
+
+
+def test_tailing_trainer_epochs_extend_trees(tmp_path):
+    """Each fold emits one tagged candidate; epoch and iteration are
+    monotone, and a later epoch's model text EXTENDS the earlier one's
+    trees byte-identically (continuation, not retrain — the bin mappers
+    are adopted through reference=, never recomputed)."""
+    batches = tmp_path / "batches"
+    batches.mkdir()
+    fam = str(tmp_path / "cand.txt")
+    _write_fold(batches, "batch_0000", seed=0)
+    tr = TailingTrainer(dict(PARAMS), SequenceTail(str(batches)), fam,
+                        iters_per_fold=2)
+    rec1 = tr.fold_once()
+    assert rec1["epoch"] == 1 and rec1["iteration"] == 2
+    assert tr.fold_once() is None        # no new data -> no fold
+    _write_fold(batches, "batch_0001", seed=1)
+    rec2 = tr.fold_once()
+    assert rec2["epoch"] == 2 and rec2["iteration"] == 4
+    text1 = read_snapshot(rec1["path"])[0]
+    text2 = read_snapshot(rec2["path"])[0]
+    t1, t2 = split_model_text(text1)[1], split_model_text(text2)[1]
+    assert len(t2) == 4 and t2[:2] == t1
+
+
+def test_tailing_trainer_resumes_from_latest_valid(tmp_path):
+    """A fresh TailingTrainer over an existing family adopts its epoch/
+    iteration (the restarted-process case), and its first fold runs even
+    without NEW batches — a restart continues immediately."""
+    batches = tmp_path / "batches"
+    batches.mkdir()
+    fam = str(tmp_path / "cand.txt")
+    _write_fold(batches, "batch_0000", seed=0)
+    tr = TailingTrainer(dict(PARAMS), SequenceTail(str(batches)), fam,
+                        iters_per_fold=2)
+    rec1 = tr.fold_once()
+    tr2 = TailingTrainer(dict(PARAMS), SequenceTail(str(batches)), fam,
+                         iters_per_fold=2)
+    assert tr2.epoch == 1 and tr2.total_iters == 2
+    rec2 = tr2.fold_once()               # same rows, continued training
+    assert rec2["epoch"] == 2 and rec2["iteration"] == 4
+    t1 = split_model_text(read_snapshot(rec1["path"])[0])[1]
+    t2 = split_model_text(read_snapshot(rec2["path"])[0])[1]
+    assert t2[:2] == t1
+
+
+# ---------------------------------------------------------------------------
+# the promotion controller
+# ---------------------------------------------------------------------------
+def _fleet(base, n=2):
+    servers = [base.as_server() for _ in range(n)]
+    router = Router([LocalReplica(f"r{i}", s)
+                     for i, s in enumerate(servers)], own_replicas=True)
+    auto = Autonomics(router)            # never started: the actuator only
+    router.attach_autonomics(auto)
+    return router, auto
+
+
+def _fill_window(ctl, router, X, n=8, timeout_s=10.0):
+    """Drive n live requests and tick until the shadow window compared
+    them all (the mirror pool is async)."""
+    for i in range(n):
+        router.predict(X[i:i + 1])
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        snap = router.shadow_snapshot()
+        if snap is not None and snap["compared"] >= n:
+            return snap
+        time.sleep(0.05)
+    raise AssertionError(f"shadow window never filled: "
+                         f"{router.shadow_snapshot()}")
+
+
+def _candidate_on_disk(tmp_path, base_path, epoch, rounds):
+    cand = _continue_from(base_path, rounds=rounds)
+    fam = str(tmp_path / "cand.txt")
+    return fam, write_training_snapshot(
+        cand._booster, fam, candidate=True,
+        extra_state={"candidate_epoch": epoch}), cand
+
+
+def test_controller_promotes_within_threshold(tmp_path):
+    base, X = _train_base(rounds=4)
+    base_path = str(tmp_path / "base.txt")
+    base.save_model(base_path)
+    fam, _path, cand = _candidate_on_disk(tmp_path, base_path, 1, rounds=6)
+    router, auto = _fleet(base)
+    try:
+        ctl = PromotionController(router, auto, fam, sample=1.0,
+                                  min_requests=8, threshold=1e9,
+                                  base_source=base_path,
+                                  watch_min_requests=4)
+        assert router.loop_status()["state"] == "idle"   # self-attached
+        ctl.tick()
+        assert ctl.status()["state"] == "shadowing"
+        _fill_window(ctl, router, X, n=8)
+        ctl.tick()                       # decide -> promoting
+        ctl.tick()                       # rollout + commit -> watching
+        st = ctl.status()
+        assert st["state"] == "watching" and st["promoted_epoch"] == 1
+        assert auto.counters["delta_rollouts"] == 1
+        want = split_model_text(cand._booster.save_model_to_string())[1]
+        for name in router.replica_names(live_only=True):
+            got = router.replica(name).server.registry.model_text("default")
+            assert split_model_text(got)[1] == want
+        for _ in range(6):               # fill the watch window
+            router.predict(X[:1])
+        ctl.tick()
+        assert ctl.status()["state"] == "idle"
+        assert ctl.counters["rollbacks"] == 0
+    finally:
+        router.close()
+
+
+def test_controller_rejects_and_never_retries(tmp_path):
+    base, X = _train_base(rounds=4)
+    base_path = str(tmp_path / "base.txt")
+    base.save_model(base_path)
+    fam, _path, _c = _candidate_on_disk(tmp_path, base_path, 1, rounds=6)
+    router, auto = _fleet(base, n=1)
+    try:
+        ctl = PromotionController(router, auto, fam, sample=1.0,
+                                  min_requests=8, threshold=0.0,
+                                  base_source=base_path)
+        ctl.tick()
+        _fill_window(ctl, router, X, n=8)
+        ctl.tick()
+        st = ctl.status()
+        assert st["state"] == "idle" and st["promoted_epoch"] == 0
+        assert ctl.counters["rejections"] == 1
+        assert router.shadow_snapshot() is None   # disarmed
+        ctl.tick()                       # the rejected epoch is remembered
+        assert ctl.status()["state"] == "idle"
+        assert ctl.counters["candidates_seen"] == 1
+        got = router.replica("r0").server.registry.model_text("default")
+        want = split_model_text(base.model_to_string())[1]
+        assert split_model_text(got)[1] == want   # live fleet untouched
+    finally:
+        router.close()
+
+
+def test_promote_crash_at_commit_does_not_double_rollout(tmp_path):
+    """`promote_crash_at=commit` is live: the crash lands AFTER the
+    rollout, and the retry tick must commit WITHOUT re-applying it."""
+    base, X = _train_base(rounds=4)
+    base_path = str(tmp_path / "base.txt")
+    base.save_model(base_path)
+    fam, _path, _c = _candidate_on_disk(tmp_path, base_path, 1, rounds=6)
+    router, auto = _fleet(base)
+    try:
+        ctl = PromotionController(router, auto, fam, sample=1.0,
+                                  min_requests=4, threshold=1e9,
+                                  base_source=base_path,
+                                  faults=FaultPlan("promote_crash_at=commit"))
+        ctl.tick()
+        _fill_window(ctl, router, X, n=4)
+        ctl.tick()                       # -> promoting
+        ctl.tick()                       # rollout lands, commit crashes
+        assert ctl.counters["promote_crashes"] == 1
+        assert ctl.status()["state"] == "promoting"
+        assert auto.counters["delta_rollouts"] == 1
+        ctl.tick()                       # retry: commit only
+        assert ctl.status()["state"] == "watching"
+        assert ctl.counters["promotions"] == 1
+        assert auto.counters["delta_rollouts"] == 1   # never re-applied
+    finally:
+        router.close()
+
+
+def test_post_promote_regression_rolls_back(tmp_path):
+    base, X = _train_base(rounds=4)
+    base_path = str(tmp_path / "base.txt")
+    base.save_model(base_path)
+    fam, _path, _c = _candidate_on_disk(tmp_path, base_path, 1, rounds=6)
+    router, auto = _fleet(base)
+    try:
+        ctl = PromotionController(router, auto, fam, sample=1.0,
+                                  min_requests=4, threshold=1e9,
+                                  base_source=base_path,
+                                  watch_min_requests=10,
+                                  regression_threshold=0.05)
+        ctl.tick()
+        _fill_window(ctl, router, X, n=4)
+        ctl.tick()
+        ctl.tick()
+        assert ctl.status()["state"] == "watching"
+        # script the watch window: 20 requests, 30% bad
+        base_counters = ctl._watch_base
+        ctl._fleet_counters = lambda: {
+            "routed": base_counters["routed"] + 20,
+            "bad": base_counters["bad"] + 6}
+        ctl.tick()
+        st = ctl.status()
+        assert st["state"] == "idle"
+        assert ctl.counters["rollbacks"] == 1
+        assert st["promoted_epoch"] == 0
+        want = split_model_text(base.model_to_string())[1]
+        for name in router.replica_names(live_only=True):
+            got = router.replica(name).server.registry.model_text("default")
+            assert split_model_text(got)[1] == want   # back on base
+    finally:
+        router.close()
+
+
+def test_loop_events_schema_valid(tmp_path):
+    """One full promote cycle's JSONL stream passes the observability
+    schema validator (run_header first, every loop_* record typed)."""
+    out = str(tmp_path / "events.jsonl")
+    rec = obs_trace.SpanRecorder().configure(sample=1.0, out=out)
+    base, X = _train_base(rounds=4)
+    base_path = str(tmp_path / "base.txt")
+    base.save_model(base_path)
+    fam, _path, _c = _candidate_on_disk(tmp_path, base_path, 1, rounds=6)
+    router, auto = _fleet(base, n=1)
+    try:
+        ctl = PromotionController(router, auto, fam, sample=1.0,
+                                  min_requests=4, threshold=1e9,
+                                  base_source=base_path,
+                                  watch_min_requests=2, recorder=rec)
+        ctl.tick()
+        _fill_window(ctl, router, X, n=4)
+        ctl.tick()
+        ctl.tick()
+        for _ in range(4):
+            router.predict(X[:1])
+        ctl.tick()
+    finally:
+        router.close()
+        rec.close()
+    assert obs_events.validate_file(out) == []
+    records, _trunc = obs_events.read_file(out)
+    seen = {r.get("event") for r in records if r.get("type") == "event"}
+    for required in ("loop_candidate", "loop_shadow_start",
+                     "loop_shadow_window", "loop_rollout", "loop_promote",
+                     "loop_watch_clear"):
+        assert required in seen, f"missing {required} in {sorted(seen)}"
+    spans = {r.get("name") for r in records if r.get("type") == "span"}
+    assert {"loop_promote:resolve", "loop_promote:rollout",
+            "loop_promote:commit"} <= spans

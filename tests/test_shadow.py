@@ -1,0 +1,241 @@
+"""Shadow evaluation (ISSUE 20) — the acceptance criterion: the mirror
+is strictly OFF the reply path. A dead, failing, or wedged shadow
+replica must never move a live answer by a bit or cost the live path a
+request; everything it does is counted, and its re-scores join the
+request's trace tree as ``shadow_predict`` spans.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lambdagap_tpu as lgb
+from lambdagap_tpu.guard.degrade import ReplicaUnavailable
+from lambdagap_tpu.guard.faults import FaultPlan
+from lambdagap_tpu.obs import trace as obs_trace
+from lambdagap_tpu.serve import LocalReplica, Router, ShadowMirror
+from lambdagap_tpu.serve.frontend import FrontendClient, ServeFrontend
+
+PARAMS = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+          "verbose": -1, "tpu_fast_predict_rows": 0}
+
+
+def _train(rounds=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(400, 5).astype(np.float32)
+    y = (X[:, 0] * 2.0 - X[:, 1]).astype(np.float32)
+    b = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return b, X
+
+
+def _router(base, n=1):
+    return Router([LocalReplica(f"r{i}", base.as_server())
+                   for i in range(n)], own_replicas=True)
+
+
+class DeadReplica:
+    """A shadow replica that died: every submit is a transport failure."""
+    name = "shadow"
+
+    def submit(self, x, model=None, tenant=None, trace=None):
+        raise ReplicaUnavailable("shadow is dead")
+
+    def close(self):
+        pass
+
+
+class GatedReplica:
+    """A wedged shadow replica: submits block until released."""
+    name = "shadow"
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.gate = threading.Event()
+
+    def submit(self, x, model=None, tenant=None, trace=None):
+        self.gate.wait(10.0)
+        return self.inner.submit(x, model=model, tenant=tenant)
+
+    def close(self):
+        self.gate.set()
+
+
+def _drain(mirror, timeout_s=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        if mirror.snapshot()["pending"] == 0:
+            return mirror.snapshot()
+        time.sleep(0.02)
+    raise AssertionError(f"mirror never drained: {mirror.snapshot()}")
+
+
+# ---------------------------------------------------------------------------
+# the acceptance criterion: bit-identical live answers, matched goodput
+# ---------------------------------------------------------------------------
+def test_dead_shadow_never_moves_a_live_answer():
+    """sample=1.0 + a shadow that dies on every mirror: every live
+    answer is BIT-identical to the unshadowed run, every live request
+    succeeds (goodput match), and the sheds are counted."""
+    base, X = _train()
+    rows = [X[i:i + 3] for i in range(0, 30, 3)]
+    router = _router(base)
+    try:
+        bare = [router.predict(r) for r in rows]
+        before = router.snapshot()["replicas"]["r0"]["routed"]
+        mirror = ShadowMirror(DeadReplica(), sample=1.0)
+        router.arm_shadow(mirror)
+        shadowed = [router.predict(r) for r in rows]
+        snap = router.snapshot()
+        for a, b in zip(bare, shadowed):
+            assert np.array_equal(a, b)          # bit-identical, not close
+        assert snap["replicas"]["r0"]["routed"] - before == len(rows)
+        assert snap["failovers"] == 0 and snap["rejected_no_replica"] == 0
+        ssnap = _drain(mirror)
+        assert ssnap["dead"] is True
+        assert ssnap["errors"] >= 1
+        # everything after the death was shed silently, nothing dropped
+        assert ssnap["shed"] + ssnap["compared"] + ssnap["errors"] \
+            >= ssnap["mirrored"]
+    finally:
+        router.close()
+
+
+def test_live_mirror_compares_bit_identical_candidate():
+    """Sanity for the promote gate's signal: shadowing the SAME model
+    yields exact-zero deltas on every compared request."""
+    base, X = _train()
+    router = _router(base)
+    try:
+        mirror = ShadowMirror(LocalReplica("shadow", base.as_server()),
+                              sample=1.0)
+        router.arm_shadow(mirror)
+        for i in range(8):
+            router.predict(X[i:i + 1])
+        snap = _drain(mirror)
+        assert snap["compared"] == 8 and snap["errors"] == 0
+        assert snap["delta"]["max"] == 0.0
+    finally:
+        router.close()
+
+
+def test_shadow_dispatch_fail_fault_point_is_live():
+    """`shadow_dispatch_fail=K` raises inside the mirror worker: K sheds
+    with errors counted, the live path untouched, the mirror NOT marked
+    dead (an injected fault is not a transport indictment)."""
+    base, X = _train()
+    router = _router(base)
+    try:
+        mirror = ShadowMirror(LocalReplica("shadow", base.as_server()),
+                              sample=1.0,
+                              faults=FaultPlan("shadow_dispatch_fail=2"))
+        router.arm_shadow(mirror)
+        live = [router.predict(X[i:i + 1]) for i in range(6)]
+        snap = _drain(mirror)
+        assert snap["errors"] == 2 and snap["shed"] == 2
+        assert snap["compared"] == 4
+        assert snap["dead"] is False
+        assert len(live) == 6            # every live request answered
+    finally:
+        router.close()
+
+
+def test_wedged_shadow_sheds_on_bounded_queue():
+    """A hung shadow RPC fills the bounded pending window; overflow is
+    shed at hand-off — the live path never queues behind the shadow."""
+    base, X = _train()
+    inner = LocalReplica("inner", base.as_server())
+    gated = GatedReplica(inner)
+    router = _router(base)
+    try:
+        mirror = ShadowMirror(gated, sample=1.0, max_pending=2)
+        router.arm_shadow(mirror)
+        t0 = time.time()
+        for i in range(10):
+            router.predict(X[i:i + 1])
+        live_wall = time.time() - t0
+        assert live_wall < 5.0           # never convoyed behind the gate
+        assert mirror.snapshot()["shed"] >= 8
+        gated.gate.set()
+        snap = _drain(mirror)
+        assert snap["compared"] <= 2
+    finally:
+        router.close()
+        inner.close()
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+def test_shadow_spans_join_the_trace_tree(tmp_path):
+    out = str(tmp_path / "events.jsonl")
+    obs_trace.configure(sample=1.0, out=out)
+    base, X = _train()
+    router = _router(base)
+    try:
+        mirror = ShadowMirror(LocalReplica("shadow", base.as_server()),
+                              sample=1.0)
+        router.arm_shadow(mirror)
+        for i in range(4):
+            router.predict(X[i:i + 1])
+        _drain(mirror)
+    finally:
+        router.close()
+        obs_trace.RECORDER.close()
+        obs_trace.configure(sample=0.0)
+    from lambdagap_tpu.obs import events as obs_events
+    records, _trunc = obs_events.read_file(out)
+    spans = [r for r in records if r.get("type") == "span"]
+    shadow = [s for s in spans if s["name"] == "shadow_predict"]
+    assert len(shadow) == 4
+    route_traces = {s["trace"] for s in spans if s["name"] == "route"}
+    for s in shadow:
+        assert s["trace"] in route_traces    # same tree as the live hop
+        assert s["attrs"]["outcome"] == "compared"
+        assert s["attrs"]["delta"] == 0.0
+
+
+def test_router_snapshot_byte_identical_without_shadow():
+    """Knob off -> schema untouched: no shadow/loop keys anywhere until
+    a mirror is armed, and disarming removes them again."""
+    base, X = _train()
+    router = _router(base)
+    try:
+        snap = router.snapshot()
+        assert "shadow" not in snap and "loop" not in snap
+        mirror = ShadowMirror(LocalReplica("shadow", base.as_server()),
+                              sample=1.0)
+        router.arm_shadow(mirror)
+        assert "shadow" in router.snapshot()
+        final = router.disarm_shadow()
+        assert final is not None
+        assert "shadow" not in router.snapshot()
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# the wire surface (docs/serving.md)
+# ---------------------------------------------------------------------------
+def test_shadow_on_and_loop_status_over_the_wire(tmp_path):
+    base, X = _train()
+    base_path = str(tmp_path / "base.txt")
+    base.save_model(base_path)
+    router = _router(base)
+    fe = ServeFrontend(router, port=0).start()
+    client = FrontendClient("127.0.0.1", fe.port)
+    try:
+        assert client.loop_status() == {"state": "off"}  # no controller
+        info = client.shadow_on(base_path, sample=1.0)
+        assert info == {"armed": True, "sample": 1.0}
+        vals = client.predict(X[:2])
+        assert np.array_equal(vals, router.predict(X[:2]))
+        stats = router.shadow_snapshot()
+        assert stats is not None and stats["sample"] == 1.0
+        off = client.shadow_on(None, sample=0.0)
+        assert off["armed"] is False and "final" in off
+        assert router.shadow_snapshot() is None
+    finally:
+        client.close()
+        fe.close()
+        router.close()

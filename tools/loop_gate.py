@@ -1,0 +1,589 @@
+#!/usr/bin/env python
+"""Continuous-learning loop gate (ISSUE 20): every seam SIGKILLed.
+
+Run by tools/run_full_suite.sh G0. Four scenarios, one per seam of the
+train -> shadow -> promote loop (docs/continuous-learning.md):
+
+A. **trainer killed mid-candidate-write** — a REAL ``task=loop_train``
+   subprocess folds tailed batches; the ``candidate_torn`` fault tears
+   its second candidate write (the SIGKILL-mid-write window,
+   materialized) and the process is then SIGKILLed. The torn candidate
+   must be rejected by checksum, resume must pick the last VALID epoch,
+   and the restarted trainer's next candidate must extend that epoch's
+   trees byte-identically.
+B. **shadow replica killed mid-evaluation** — the live fleet serves
+   while a subprocess shadow replica mirrors 100% of traffic; the
+   shadow is SIGKILLed mid-load. Live goodput must stay >= 95% of the
+   pre-kill baseline (shadow is strictly off the reply path), the
+   sheds must be counted, and the controller must restart the shadow
+   window on a fresh replica.
+C. **serving replica killed mid-promote** — a 3-replica subprocess
+   fleet; one replica is SIGKILLed between the shadow decision and the
+   rollout. The fleet-atomic rollout must roll back — survivors
+   converge all-base, tree-hash identical, never mixed — and the NEXT
+   candidate epoch must then promote onto the survivors.
+D. **delta_swap_fail injected mid-rollout** — one in-process replica
+   arms the delta fault; the promotion's rollout must observe the
+   fleet-atomic rollback (``loop_rollback`` JSONL event, every replica
+   back on base trees), and ``loop_status`` must answer the state
+   machine's position over the wire.
+
+Exit 0 on pass; nonzero with a reason on any violation.
+"""
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SHADOW_GOODPUT_FRACTION = 0.95
+
+
+def fail(msg: str) -> int:
+    print(f"LOOP GATE FAIL: {msg}")
+    return 1
+
+
+def write_batches(dirpath: str, n: int, start: int = 0, rows: int = 300,
+                  cols: int = 5, seed: int = 0) -> None:
+    import numpy as np
+    from lambdagap_tpu.data.tail import write_batch
+    rng = np.random.RandomState(seed + start)
+    for i in range(start, start + n):
+        X = rng.randn(rows, cols)
+        y = X[:, 0] * 2.0 + 0.1 * rng.randn(rows)
+        write_batch(dirpath, f"batch_{i:04d}", X, y)
+
+
+def spawn_trainer(batches: str, model: str, *, faults: str = "",
+                  max_epochs: int = 0, trace_out: str = ""):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable, "-m", "lambdagap_tpu", "task=loop_train",
+           f"data={batches}", f"output_model={model}", "verbose=-1",
+           "min_data_in_leaf=5", "num_leaves=7", "loop_iters_per_fold=3",
+           "loop_interval_s=0.2", "guard_snapshot_keep=4",
+           f"loop_max_epochs={max_epochs}"]
+    if faults:
+        cmd.append(f"guard_faults={faults}")
+    if trace_out:
+        cmd.append(f"serve_trace_out={trace_out}")
+    return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL, cwd=REPO, env=env)
+
+
+def spawn_replica(model_path: str, port: int = 0):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "lambdagap_tpu", "task=serve",
+         f"input_model={model_path}", f"serve_port={port}", "verbose=-1",
+         "serve_max_delay_ms=1"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, cwd=REPO, env=env)
+
+
+def await_port(proc, timeout_s: float = 120.0) -> int:
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        if line.startswith("SERVE_PORT="):
+            return int(line.split("=", 1)[1])
+    raise RuntimeError("replica never printed SERVE_PORT")
+
+
+def await_file(path: str, timeout_s: float = 180.0) -> None:
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        if os.path.exists(path):
+            return
+        time.sleep(0.1)
+    raise RuntimeError(f"timed out waiting for {path}")
+
+
+def reap(*procs) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def trees_of(text: str):
+    from lambdagap_tpu.serve.delta import split_model_text
+    return split_model_text(text)[1]
+
+
+def write_candidate(booster, family: str, epoch: int) -> str:
+    from lambdagap_tpu.guard.snapshot import write_training_snapshot
+    return write_training_snapshot(
+        booster._booster, family, candidate=True,
+        extra_state={"candidate_epoch": epoch})
+
+
+def train_base(path: str, seed: int = 0, rounds: int = 8):
+    import numpy as np
+    import lambdagap_tpu as lgb
+    rng = np.random.RandomState(seed)
+    X = rng.randn(1200, 8).astype(np.float32)
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float32)
+    b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1,
+                   "tpu_fast_predict_rows": 0},
+                  lgb.Dataset(X, label=y), num_boost_round=rounds)
+    b.save_model(path)
+    return X, y, b
+
+
+# ---------------------------------------------------------------------------
+def scenario_a_trainer_torn(tmp: str) -> int:
+    from lambdagap_tpu.guard.snapshot import (latest_snapshot,
+                                              list_snapshots, read_snapshot)
+    from lambdagap_tpu.obs import events as obs_events
+
+    batches = os.path.join(tmp, "batches_a")
+    os.makedirs(batches)
+    model = os.path.join(tmp, "cand_a.txt")
+    write_batches(batches, 1, start=0)
+    print("loop gate [A]: spawning task=loop_train with candidate_torn=2")
+    trainer = spawn_trainer(batches, model, faults="candidate_torn=2")
+    try:
+        # epoch 1 lands valid (iters_per_fold=3 -> snapshot_iter_3)
+        await_file(f"{model}.snapshot_iter_3")
+        found = latest_snapshot(model)
+        if found is None:
+            return fail("[A] epoch-1 candidate unreadable")
+        # feed one more batch: the fold after it is the TORN write
+        write_batches(batches, 1, start=1)
+        await_file(f"{model}.snapshot_iter_6")
+        time.sleep(0.2)                  # let the torn bytes settle
+        print("loop gate [A]: SIGKILL trainer after the torn epoch-2 write")
+        trainer.send_signal(signal.SIGKILL)
+        trainer.wait(timeout=20)
+
+        torn_path = f"{model}.snapshot_iter_6"
+        try:
+            read_snapshot(torn_path)
+            return fail("[A] the torn candidate validated — the fault "
+                        "point did not tear it")
+        # graftlint: disable=R8 — the raise IS the pass condition: a
+        # torn candidate must be rejected by checksum, and the assertion
+        # above already fails the gate when it validates
+        except Exception:
+            pass
+        found = latest_snapshot(model)
+        if found is None:
+            return fail("[A] no valid snapshot survived the torn write")
+        path1, text1, state1 = found
+        if int(state1.get("candidate_epoch", -1)) != 1:
+            return fail(f"[A] resume picked {path1} (epoch "
+                        f"{state1.get('candidate_epoch')}), not the last "
+                        "VALID epoch 1 — torn candidate not rejected")
+        print(f"loop gate [A]: torn epoch-2 rejected; latest valid is "
+              f"epoch 1 at {os.path.basename(path1)}")
+
+        # restart: the trainer must resume from epoch 1 and extend it
+        trace_out = os.path.join(tmp, "trainer_events.jsonl")
+        t2 = spawn_trainer(batches, model, max_epochs=1,
+                           trace_out=trace_out)
+        if t2.wait(timeout=300) != 0:
+            return fail("[A] restarted trainer exited nonzero")
+        path2, text2, state2 = latest_snapshot(model)
+        if int(state2.get("candidate_epoch", -1)) != 2:
+            return fail(f"[A] restarted trainer wrote epoch "
+                        f"{state2.get('candidate_epoch')}, wanted 2")
+        old, new = trees_of(text1), trees_of(text2)
+        if len(new) <= len(old) or new[:len(old)] != old:
+            return fail("[A] resumed candidate does not extend the last "
+                        "valid candidate's trees byte-identically")
+        print(f"loop gate [A]: resumed epoch 2 extends epoch 1 "
+              f"byte-identically ({len(old)} -> {len(new)} trees); "
+              f"{len(list_snapshots(model))} snapshots retained")
+        records, _torn = obs_events.read_file(trace_out)
+        if not any(r.get("event") == "loop_candidate_written"
+                   for r in records):
+            return fail("[A] trainer emitted no loop_candidate_written "
+                        "event")
+        errs = obs_events.validate_file(trace_out)
+        if errs:
+            return fail(f"[A] trainer event log is not schema-valid: "
+                        f"{errs[:3]}")
+        print("loop gate [A]: PASS")
+        return 0
+    finally:
+        reap(trainer)
+
+
+# ---------------------------------------------------------------------------
+def scenario_b_shadow_killed(tmp: str) -> int:
+    import lambdagap_tpu as lgb
+    from lambdagap_tpu.serve import (Autonomics, LocalReplica,
+                                     RemoteReplica, Router, run_open_loop)
+    from lambdagap_tpu.loop import PromotionController
+
+    base_path = os.path.join(tmp, "base_b.txt")
+    X, y, base = train_base(base_path, seed=1)
+    cand = lgb.train({"objective": "binary", "num_leaves": 15,
+                      "verbose": -1}, lgb.Dataset(X, label=y),
+                     num_boost_round=3, init_model=base_path)
+    family = os.path.join(tmp, "cand_b.txt")
+    write_candidate(cand, family, epoch=1)
+
+    router = Router(
+        [LocalReplica(f"r{i}",
+                      lgb.Booster(model_file=base_path).as_server(
+                          max_delay_ms=1.0))
+         for i in range(2)], own_replicas=True)
+    auto = Autonomics(router, interval_s=999.0)
+    shadow_procs = []
+
+    def make_shadow(text):
+        p = os.path.join(tmp, f"shadow_b_{len(shadow_procs)}.txt")
+        with open(p, "w") as f:
+            f.write(text)
+        proc = spawn_replica(p)
+        shadow_procs.append(proc)
+        return RemoteReplica("shadow", "127.0.0.1", await_port(proc))
+
+    ctl = PromotionController(router, auto, family, sample=1.0,
+                              min_requests=10 ** 9,  # hold the window open
+                              make_shadow=make_shadow)
+    try:
+        ctl.tick()                       # idle -> shadowing
+        if router.loop_status()["state"] != "shadowing":
+            return fail("[B] controller never armed the shadow")
+        print("loop gate [B]: shadow replica up; measuring baseline")
+        pre = run_open_loop(router.submit, X, 120.0, 180,
+                            deadline_ms=250.0, seed=1)
+        if pre["goodput_ratio"] < 0.5 or pre["counts"]["error"]:
+            return fail(f"[B] baseline round unusable: {pre['counts']}")
+
+        def killer():
+            time.sleep(180 / 120.0 * 0.4)
+            print("loop gate [B]: SIGKILL shadow replica mid-evaluation")
+            shadow_procs[-1].send_signal(signal.SIGKILL)
+
+        k = threading.Thread(target=killer)
+        k.start()
+        chaos = run_open_loop(router.submit, X, 120.0, 180,
+                              deadline_ms=250.0, seed=2)
+        k.join()
+        if chaos["counts"]["error"]:
+            return fail(f"[B] live path saw errors with the shadow dying: "
+                        f"{chaos['counts']}")
+        if chaos["goodput_ratio"] < SHADOW_GOODPUT_FRACTION \
+                * pre["goodput_ratio"]:
+            return fail(f"[B] live goodput collapsed with the shadow: "
+                        f"{chaos['goodput_ratio']:.2f} < "
+                        f"{SHADOW_GOODPUT_FRACTION:.0%} of "
+                        f"{pre['goodput_ratio']:.2f}")
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            snap = router.shadow_snapshot()
+            if snap and snap["dead"]:
+                break
+            time.sleep(0.2)
+        else:
+            return fail(f"[B] mirror never marked the dead shadow: {snap}")
+        if snap["shed"] == 0:
+            return fail("[B] shadow death shed nothing — mirrors were not "
+                        "reaching the replica")
+        print(f"loop gate [B]: live goodput {chaos['goodput_ratio']:.2f} "
+              f"vs baseline {pre['goodput_ratio']:.2f}; "
+              f"{snap['shed']} mirror(s) shed silently")
+
+        ctl.tick()                       # dead shadow -> window restart
+        st = ctl.status()
+        if st["counters"]["shadow_restarts"] != 1:
+            return fail(f"[B] controller did not restart the window: "
+                        f"{st['counters']}")
+        futs = [router.submit(X[:1]) for _ in range(30)]
+        for f in futs:
+            f.result(30)
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            snap2 = router.shadow_snapshot()
+            if snap2 and not snap2["dead"] and snap2["compared"] > 0:
+                break
+            time.sleep(0.2)
+        else:
+            return fail(f"[B] restarted window never compared a "
+                        f"request: {snap2}")
+        print(f"loop gate [B]: fresh window live on the respawned shadow "
+              f"({snap2['compared']} compared)")
+        print("loop gate [B]: PASS")
+        return 0
+    finally:
+        router.close()
+        reap(*shadow_procs)
+
+
+# ---------------------------------------------------------------------------
+def scenario_c_replica_killed_mid_promote(tmp: str) -> int:
+    import lambdagap_tpu as lgb
+    from lambdagap_tpu.serve import (Autonomics, RemoteReplica, Router)
+    from lambdagap_tpu.loop import PromotionController
+
+    base_path = os.path.join(tmp, "base_c.txt")
+    X, y, base = train_base(base_path, seed=2)
+    base_text = open(base_path).read()
+    family = os.path.join(tmp, "cand_c.txt")
+    cand1 = lgb.train({"objective": "binary", "num_leaves": 15,
+                       "verbose": -1}, lgb.Dataset(X, label=y),
+                      num_boost_round=3, init_model=base_path)
+    write_candidate(cand1, family, epoch=1)
+
+    print("loop gate [C]: spawning 3 task=serve replicas")
+    procs = {f"r{i}": spawn_replica(base_path) for i in range(3)}
+    ports = {n: await_port(p) for n, p in procs.items()}
+    router = Router([RemoteReplica(n, "127.0.0.1", port)
+                     for n, port in sorted(ports.items())])
+    auto = Autonomics(router, interval_s=999.0)
+    ctl = PromotionController(router, auto, family, sample=1.0,
+                              min_requests=15, threshold=1e9,
+                              base_source=base_text,
+                              watch_min_requests=10)
+
+    def drive(n):
+        futs = [router.submit(X[:1]) for _ in range(n)]
+        for f in futs:
+            f.result(30)
+
+    try:
+        ctl.tick()                       # idle -> shadowing
+        drive(30)
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            snap = router.shadow_snapshot()
+            if snap and snap["compared"] >= 15:
+                break
+            time.sleep(0.2)
+        else:
+            return fail(f"[C] shadow window never filled: {snap}")
+        ctl.tick()                       # shadowing -> promoting
+        if ctl.status()["state"] != "promoting":
+            return fail(f"[C] window full but state is "
+                        f"{ctl.status()['state']}")
+        print("loop gate [C]: SIGKILL replica r2 mid-promote")
+        procs["r2"].send_signal(signal.SIGKILL)
+        procs["r2"].wait(timeout=20)
+        ctl.tick()                       # rollout hits the corpse
+        st = ctl.status()
+        if st["state"] != "idle" or st["counters"]["rollbacks"] != 1:
+            return fail(f"[C] promote over a dead replica did not roll "
+                        f"back: {st}")
+
+        # survivors must agree — all-base: probe each one directly and
+        # require exact prediction agreement with each other and the base
+        survivors = ["r0", "r1"]
+        import numpy as np
+        probe = X[:16]
+        want = base.predict(probe)
+        got = {}
+        for n in survivors:
+            got[n] = np.asarray(
+                router.replica(n).submit(probe).result(30).values)
+        agree = all(np.array_equal(got[survivors[0]], v)
+                    for v in got.values())
+        if not agree:
+            return fail("[C] MIXED fleet: survivors answer differently "
+                        "after the failed promote")
+        if not np.allclose(got[survivors[0]].ravel(), want.ravel(),
+                           rtol=0, atol=1e-6):
+            return fail("[C] survivors are uniform but NOT on base after "
+                        "the rollback")
+        print("loop gate [C]: survivors converged all-base "
+              f"(rollback after dead r2; epoch 1 rejected)")
+
+        # the corpse must leave rotation, then the NEXT epoch promotes
+        drive(30)                        # failovers mark r2 dead
+        if "r2" in router.replica_names(live_only=True):
+            return fail("[C] dead r2 still in live rotation")
+        cand2 = lgb.train({"objective": "binary", "num_leaves": 15,
+                           "verbose": -1}, lgb.Dataset(X, label=y),
+                          num_boost_round=5, init_model=base_path)
+        write_candidate(cand2, family, epoch=2)
+        ctl.tick()                       # idle -> shadowing (epoch 2)
+        drive(30)
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            snap = router.shadow_snapshot()
+            if snap and snap["compared"] >= 15:
+                break
+            time.sleep(0.2)
+        else:
+            return fail(f"[C] epoch-2 window never filled: {snap}")
+        ctl.tick()                       # -> promoting
+        ctl.tick()                       # -> watching (rollout on survivors)
+        st = ctl.status()
+        if st["state"] != "watching" or st["promoted_epoch"] != 2:
+            return fail(f"[C] epoch 2 did not promote on survivors: {st}")
+        drive(20)
+        ctl.tick()                       # watching -> idle (clean)
+        st = ctl.status()
+        if st["state"] != "idle" or st["counters"]["promotions"] != 1:
+            return fail(f"[C] watch window did not clear: {st}")
+        cand2_vals = cand2.predict(probe)
+        for n in survivors:
+            v = np.asarray(router.replica(n).submit(probe).result(30).values)
+            if not np.allclose(v.ravel(), cand2_vals.ravel(), rtol=0,
+                               atol=1e-6):
+                return fail(f"[C] survivor {n} is not serving the "
+                            "promoted epoch-2 candidate")
+        print("loop gate [C]: epoch 2 promoted onto the 2 survivors; "
+              "watch window clean")
+        print("loop gate [C]: PASS")
+        return 0
+    finally:
+        router.close()
+        reap(*procs.values())
+
+
+# ---------------------------------------------------------------------------
+def scenario_d_delta_fault_mid_rollout(tmp: str, events_path: str) -> int:
+    import numpy as np
+    import lambdagap_tpu as lgb
+    from lambdagap_tpu.guard.faults import FaultPlan
+    from lambdagap_tpu.loop import PromotionController
+    from lambdagap_tpu.obs import events as obs_events
+    from lambdagap_tpu.serve import (Autonomics, FrontendClient,
+                                     LocalReplica, Router, ServeFrontend)
+
+    base_path = os.path.join(tmp, "base_d.txt")
+    X, y, base = train_base(base_path, seed=3)
+    base_text = open(base_path).read()
+    cand = lgb.train({"objective": "binary", "num_leaves": 15,
+                      "verbose": -1}, lgb.Dataset(X, label=y),
+                     num_boost_round=3, init_model=base_path)
+    family = os.path.join(tmp, "cand_d.txt")
+    write_candidate(cand, family, epoch=1)
+
+    router = Router(
+        [LocalReplica(f"r{i}",
+                      lgb.Booster(model_file=base_path).as_server(
+                          max_delay_ms=1.0))
+         for i in range(2)], own_replicas=True)
+    auto = Autonomics(router, interval_s=999.0)
+    ctl = PromotionController(router, auto, family, sample=1.0,
+                              min_requests=10, threshold=1e9,
+                              base_source=base_text)
+    fe = ServeFrontend(router).start()
+    client = FrontendClient("127.0.0.1", fe.port)
+    try:
+        # arm the delta fault on ONE replica: rollout must be all-or-none
+        router.replica("r1").server._faults = FaultPlan("delta_swap_fail=1")
+        ctl.tick()                       # idle -> shadowing
+        futs = [router.submit(X[:1]) for _ in range(20)]
+        for f in futs:
+            f.result(30)
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            snap = router.shadow_snapshot()
+            if snap and snap["compared"] >= 10:
+                break
+            time.sleep(0.2)
+        else:
+            return fail(f"[D] shadow window never filled: {snap}")
+        ctl.tick()                       # -> promoting
+        ctl.tick()                       # rollout: r0 lands, r1 faults
+        st = client.loop_status()
+        if st["state"] != "idle" or st["counters"]["rollbacks"] != 1:
+            return fail(f"[D] faulted rollout did not roll back: {st}")
+        if auto.counters["delta_rollbacks"] != 1:
+            return fail("[D] autonomics did not record the delta rollback")
+        base_trees = tuple(trees_of(base_text))
+        forests = {tuple(trees_of(router.replica(n).server.model_text()))
+                   for n in router.replica_names()}
+        if forests != {base_trees}:
+            return fail("[D] fleet not uniformly on base after the "
+                        "mid-rollout fault — rollback was not atomic")
+        print(f"loop gate [D]: delta fault mid-rollout rolled the fleet "
+              f"back atomically; wire loop_status={st['state']}")
+
+        # with the fault disarmed, the NEXT epoch lands delta-mode
+        cand2 = lgb.train({"objective": "binary", "num_leaves": 15,
+                           "verbose": -1}, lgb.Dataset(X, label=y),
+                          num_boost_round=5, init_model=base_path)
+        write_candidate(cand2, family, epoch=2)
+        ctl.tick()
+        futs = [router.submit(X[:1]) for _ in range(20)]
+        for f in futs:
+            f.result(30)
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            snap = router.shadow_snapshot()
+            if snap and snap["compared"] >= 10:
+                break
+            time.sleep(0.2)
+        ctl.tick()
+        ctl.tick()
+        st = client.loop_status()
+        if st["state"] != "watching" or st["promoted_epoch"] != 2:
+            return fail(f"[D] epoch 2 did not promote after the fault "
+                        f"cleared: {st}")
+        # wire op bijection partner: shadow_on arms/disarms over the wire
+        sh = client.shadow_on(base_path, sample=1.0)
+        if not sh.get("armed"):
+            return fail(f"[D] shadow_on did not arm over the wire: {sh}")
+        sh = client.shadow_on(None, sample=0.0)
+        if sh.get("armed"):
+            return fail(f"[D] shadow_on sample=0 did not disarm: {sh}")
+
+        # every stage of the loop must have emitted a schema-valid event
+        from lambdagap_tpu.obs import trace as obs_trace
+        obs_trace.RECORDER.close()
+        errs = obs_events.validate_file(events_path)
+        if errs:
+            return fail(f"[D] loop event log is not schema-valid: "
+                        f"{errs[:3]}")
+        records, _torn = obs_events.read_file(events_path)
+        seen = {r.get("event") for r in records}
+        need = {"loop_candidate", "loop_shadow_start", "loop_shadow_window",
+                "loop_rollback", "loop_rollout", "loop_promote",
+                "loop_shadow_restart"}
+        missing = need - seen
+        if missing:
+            return fail(f"[D] loop events missing from the JSONL stream: "
+                        f"{sorted(missing)}")
+        print(f"loop gate [D]: {len(need)} loop_* event kinds "
+              "schema-valid in the JSONL stream")
+        print("loop gate [D]: PASS")
+        return 0
+    finally:
+        client.close()
+        fe.close()
+        router.close()
+
+
+def main() -> int:
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        events_path = os.path.join(tmp, "loop_events.jsonl")
+        from lambdagap_tpu.obs import trace as obs_trace
+        obs_trace.configure(out=events_path)
+        rc = scenario_a_trainer_torn(tmp)
+        if rc:
+            return rc
+        rc = scenario_b_shadow_killed(tmp)
+        if rc:
+            return rc
+        rc = scenario_c_replica_killed_mid_promote(tmp)
+        if rc:
+            return rc
+        rc = scenario_d_delta_fault_mid_rollout(tmp, events_path)
+        if rc:
+            return rc
+    print("loop gate: PASS — torn candidate resume, shadow isolation "
+          "under death, fleet convergence through a mid-promote kill, "
+          "atomic rollback on an injected delta fault")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -190,6 +190,19 @@ if ! env JAX_PLATFORMS=cpu python tools/cost_gate.py; then
     echo "see docs/observability.md 'Cost plane')"
     exit 1
 fi
+# loop gate (ISSUE 20): every seam of the continuous-learning loop
+# SIGKILLed — a torn mid-write candidate must be rejected and resume
+# byte-identical; a shadow replica death must not cost live goodput
+# (>= 95% of baseline) and the window must restart; a replica killed
+# mid-promote must leave the fleet all-base or all-candidate, never
+# mixed; an injected delta_swap_fail must roll back fleet-atomically
+# with the loop_rollback event and a wire-queryable loop_status
+if ! env JAX_PLATFORMS=cpu python tools/loop_gate.py; then
+    echo "FAIL-FAST: loop gate failed (the continuous-learning loop tore a"
+    echo "candidate into resume, let a shadow touch the live path, or left"
+    echo "the fleet mixed-generation; see docs/continuous-learning.md)"
+    exit 1
+fi
 echo "=== G1 $(date)"
 python -m pytest tests/test_binning.py tests/test_split_math.py tests/test_efb.py tests/test_capi.py tests/test_fast_predict.py tests/test_predict_tensor.py tests/test_misc_api.py tests/test_graftlint.py tests/test_graftir.py tests/test_costplane.py tests/test_profile.py -q 2>&1 | tail -1
 echo "=== G2 $(date)"
@@ -199,7 +212,7 @@ python -m pytest tests/test_monotone.py tests/test_tree_options.py tests/test_ex
 echo "=== G4 $(date)"
 python -m pytest tests/test_fused.py tests/test_layout.py tests/test_stream.py tests/test_distributed.py tests/test_quantized.py tests/test_continued.py tests/test_model_io.py tests/test_shap_json.py -q 2>&1 | tail -1
 echo "=== G5 $(date)"
-python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py tests/test_serve.py tests/test_serve_fleet.py tests/test_serve_stress.py tests/test_infer.py tests/test_predict_stream.py -q 2>&1 | tail -1
+python -m pytest tests/test_multiprocess.py tests/test_arrow.py tests/test_sparse_ingest.py tests/test_differential.py tests/test_serve.py tests/test_serve_fleet.py tests/test_serve_stress.py tests/test_infer.py tests/test_predict_stream.py tests/test_shadow.py tests/test_loop.py -q 2>&1 | tail -1
 echo "=== G6 full-length consistency $(date)"
 LAMBDAGAP_CONSISTENCY_FULL=1 python -m pytest tests/test_consistency.py -q 2>&1 | tail -1
 echo "=== DONE $(date)"
